@@ -1,0 +1,16 @@
+"""Test configuration: force a virtual 8-device CPU platform for JAX tests.
+
+Multi-chip TPU hardware is not available in CI; all sharding/parallelism tests
+run on an 8-device virtual CPU mesh (same XLA SPMD partitioner as TPU).
+Must run before any ``import jax`` anywhere in the test session.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,98 @@
+"""Identifier properties, incl. the reference's hard-coded compatibility vectors
+(reference: task/common/identifier_test.go:10-75) so IDs never drift."""
+
+import secrets
+
+import pytest
+
+from tpu_task.common.identifier import (
+    MAXIMUM_LONG_LENGTH,
+    SHORT_LENGTH,
+    Identifier,
+    WrongIdentifierError,
+    normalize,
+)
+
+
+def random_sentence(words=64):
+    return " ".join(secrets.token_hex(4) for _ in range(words))
+
+
+def test_stability():
+    name = random_sentence()
+    identifier = Identifier.deterministic(name)
+    assert identifier.long() == Identifier.deterministic(name).long()
+    assert identifier.short() == Identifier.deterministic(name).short()
+
+
+def test_consistency():
+    identifier = Identifier.deterministic("5299fe10-79e9-4c3b-b15e-036e8e60ab6c")
+    parsed = Identifier.parse(identifier.long())
+    assert parsed.long() == identifier.long()
+    assert parsed.short() == identifier.short()
+
+
+def test_homogeneity():
+    identifier = Identifier.deterministic(random_sentence())
+    long, short = identifier.long(), identifier.short()
+    assert long.startswith("tpi-")
+    assert all(c in "abcdefghijklmnopqrstuvwxyz0123456789-" for c in long)
+    assert all(c in "abcdefghijklmnopqrstuvwxyz0123456789" for c in short)
+    assert len(long) <= MAXIMUM_LONG_LENGTH
+    assert len(short) == SHORT_LENGTH
+
+
+def test_compatibility_vector():
+    """Hard-coded vector from the reference test suite — must match exactly."""
+    identifier = Identifier.deterministic("test")
+    assert identifier.long() == "tpi-test-3z4xlzwq-3u0vweb4"
+    assert identifier.short() == "3z4xlzwq3u0vweb4"
+    parsed = Identifier.parse(identifier.long())
+    assert parsed.long() == identifier.long()
+
+
+def test_prefix():
+    identifier = Identifier.deterministic("test", prefix="ipsum")
+    assert identifier.long() == "ips-test-3z4xlzwq-3u0vweb4"
+    assert identifier.short() == "3z4xlzwq3u0vweb4"
+    assert Identifier.parse(identifier.long()).long() == identifier.long()
+
+
+def test_randomness():
+    first = Identifier.random("test")
+    second = Identifier.random("test")
+    assert first.long() != second.long()
+    assert first.short() != second.short()
+    assert "test" in first.long()
+
+
+def test_random_petname():
+    identifier = Identifier.random()
+    assert identifier.name
+    assert Identifier.parse(identifier.long()).long() == identifier.long()
+
+
+def test_parse_rejects_garbage():
+    with pytest.raises(WrongIdentifierError):
+        Identifier.parse("not-a-valid-identifier")
+    with pytest.raises(WrongIdentifierError):
+        # Valid shape, wrong checksum.
+        Identifier.parse("tpi-test-3z4xlzwq-00000000")
+
+
+def test_normalize():
+    assert normalize("Hello, World!") == "hello-world"
+    assert normalize("--x--") == "x"
+    assert len(normalize("a" * 100)) == 28
+
+
+def test_validation_failures():
+    """Names/prefixes that would produce unparseable identifiers fail loudly."""
+    with pytest.raises(ValueError):
+        Identifier.deterministic("!!!")
+    with pytest.raises(ValueError):
+        Identifier.deterministic("")
+    with pytest.raises(ValueError):
+        Identifier.deterministic("test", prefix="ab")
+    with pytest.raises(WrongIdentifierError):
+        Identifier.parse("tpi-test-3z4xlzwq-3u0vweb4\n")

@@ -1,0 +1,32 @@
+"""Step-runner fail-fast semantics (reference: task/common/steps_test.go:14-54)."""
+
+import pytest
+
+from tpu_task.common.steps import Step, run_steps
+
+
+def test_runs_all_steps_in_order():
+    log = []
+    steps = [Step(description=f"step {i}", action=lambda i=i: log.append(i)) for i in range(5)]
+    run_steps(steps)
+    assert log == [0, 1, 2, 3, 4]
+
+
+def test_fail_fast():
+    log = []
+
+    def boom():
+        raise RuntimeError("boom")
+
+    steps = [
+        Step(description="one", action=lambda: log.append(1)),
+        Step(description="two", action=boom),
+        Step(description="three", action=lambda: log.append(3)),
+    ]
+    with pytest.raises(RuntimeError, match="boom"):
+        run_steps(steps)
+    assert log == [1]
+
+
+def test_empty_plan():
+    run_steps([])

@@ -1,0 +1,56 @@
+"""Task value model: Variables.enrich glob semantics, status codes, spot policy."""
+
+import os
+
+from tpu_task.common.values import (
+    SPOT_DISABLED,
+    SPOT_ENABLED,
+    Spot,
+    StatusCode,
+    Task,
+    Variables,
+)
+
+
+def test_enrich_literal_values():
+    variables = Variables({"FOO": "bar", "BAZ": "qux"})
+    assert variables.enrich() == {"FOO": "bar", "BAZ": "qux"}
+
+
+def test_enrich_resolves_none_from_environ(monkeypatch):
+    monkeypatch.setenv("TPU_TASK_TEST_VAR", "hello")
+    variables = Variables({"TPU_TASK_TEST_VAR": None})
+    assert variables.enrich() == {"TPU_TASK_TEST_VAR": "hello"}
+
+
+def test_enrich_glob_keys(monkeypatch):
+    monkeypatch.setenv("MYPREFIX_ONE", "1")
+    monkeypatch.setenv("MYPREFIX_TWO", "2")
+    monkeypatch.setenv("OTHER_VAR", "3")
+    variables = Variables({"MYPREFIX_*": None})
+    enriched = variables.enrich()
+    assert enriched == {"MYPREFIX_ONE": "1", "MYPREFIX_TWO": "2"}
+
+
+def test_enrich_missing_env_is_empty():
+    variables = Variables({"DEFINITELY_NOT_SET_ANYWHERE_12345": None})
+    assert variables.enrich() == {}
+
+
+def test_spot_policy():
+    assert SPOT_DISABLED < 0
+    assert SPOT_ENABLED == 0
+    assert Spot(1.5) > 0
+
+
+def test_status_codes():
+    assert StatusCode.ACTIVE.value == "running"
+    assert StatusCode.SUCCEEDED.value == "succeeded"
+    assert StatusCode.FAILED.value == "failed"
+
+
+def test_task_defaults():
+    task = Task()
+    assert task.parallelism == 1
+    assert task.spot == SPOT_DISABLED
+    assert task.environment.timeout.total_seconds() == 24 * 3600

@@ -1,0 +1,8 @@
+"""tpu-task: TPU-native full-lifecycle orchestration of ephemeral ML tasks.
+
+A from-scratch rebuild of the capabilities of terraform-provider-iterative
+(see SURVEY.md), targeting Cloud TPU as a first-class citizen, plus a JAX/Pallas
+compute stack (models, parallelism, kernels) for the task scripts it runs.
+"""
+
+__version__ = "0.1.0"

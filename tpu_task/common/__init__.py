@@ -1,0 +1,42 @@
+from tpu_task.common.cloud import (
+    AWSCredentials,
+    AZCredentials,
+    Cloud,
+    Credentials,
+    GCPCredentials,
+    K8SCredentials,
+    Provider,
+    Region,
+    Timeouts,
+)
+from tpu_task.common.errors import ResourceNotFoundError, ResourceNotImplementedError
+from tpu_task.common.identifier import Identifier, WrongIdentifierError, normalize
+from tpu_task.common.resource import Resource, StorageCredentials
+from tpu_task.common.steps import Step, run_steps
+from tpu_task.common.values import (
+    SPOT_DISABLED,
+    SPOT_ENABLED,
+    Environment,
+    Event,
+    Firewall,
+    FirewallRule,
+    RemoteStorage,
+    Size,
+    Spot,
+    Status,
+    StatusCode,
+    Task,
+    Variables,
+)
+
+__all__ = [
+    "AWSCredentials", "AZCredentials", "Cloud", "Credentials", "GCPCredentials",
+    "K8SCredentials", "Provider", "Region", "Timeouts",
+    "ResourceNotFoundError", "ResourceNotImplementedError",
+    "Identifier", "WrongIdentifierError", "normalize",
+    "Resource", "StorageCredentials",
+    "Step", "run_steps",
+    "SPOT_DISABLED", "SPOT_ENABLED", "Environment", "Event", "Firewall",
+    "FirewallRule", "RemoteStorage", "Size", "Spot", "Status", "StatusCode",
+    "Task", "Variables",
+]

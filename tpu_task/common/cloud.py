@@ -1,0 +1,123 @@
+"""Cloud/provider configuration: provider enum, regions, credentials, timeouts.
+
+Parity with /root/reference/task/common/cloud.go:8-69, extended with the
+first-class TPU provider and a hermetic ``local`` provider used by tests and
+the fake control plane (the hermetic layer the reference lacks — SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from datetime import timedelta
+from enum import Enum
+from typing import Dict, Optional
+
+
+class Provider(str, Enum):
+    AWS = "aws"
+    GCP = "gcp"
+    AZ = "az"
+    K8S = "k8s"
+    # TPU-native first-class target: Cloud TPU QueuedResource/Node API.
+    TPU = "tpu"
+    # Hermetic in-process backend (local filesystem bucket + subprocess "VM").
+    LOCAL = "local"
+
+
+Region = str
+
+
+@dataclass
+class Timeouts:
+    create: timedelta = timedelta(minutes=15)
+    read: timedelta = timedelta(minutes=3)
+    update: timedelta = timedelta(minutes=3)
+    delete: timedelta = timedelta(minutes=15)
+
+
+@dataclass
+class AWSCredentials:
+    access_key_id: str = ""
+    secret_access_key: str = ""
+    session_token: str = ""
+
+    @classmethod
+    def from_env(cls) -> "AWSCredentials":
+        return cls(
+            access_key_id=os.environ.get("AWS_ACCESS_KEY_ID", ""),
+            secret_access_key=os.environ.get("AWS_SECRET_ACCESS_KEY", ""),
+            session_token=os.environ.get("AWS_SESSION_TOKEN", ""),
+        )
+
+
+@dataclass
+class GCPCredentials:
+    # Contents of the service-account JSON (GOOGLE_APPLICATION_CREDENTIALS_DATA).
+    application_credentials: str = ""
+
+    @classmethod
+    def from_env(cls) -> "GCPCredentials":
+        data = os.environ.get("GOOGLE_APPLICATION_CREDENTIALS_DATA", "")
+        if not data:
+            path = os.environ.get("GOOGLE_APPLICATION_CREDENTIALS", "")
+            if path and os.path.exists(path):
+                with open(path) as handle:
+                    data = handle.read()
+        return cls(application_credentials=data)
+
+
+@dataclass
+class AZCredentials:
+    client_id: str = ""
+    client_secret: str = ""
+    subscription_id: str = ""
+    tenant_id: str = ""
+
+    @classmethod
+    def from_env(cls) -> "AZCredentials":
+        return cls(
+            client_id=os.environ.get("AZURE_CLIENT_ID", ""),
+            client_secret=os.environ.get("AZURE_CLIENT_SECRET", ""),
+            subscription_id=os.environ.get("AZURE_SUBSCRIPTION_ID", ""),
+            tenant_id=os.environ.get("AZURE_TENANT_ID", ""),
+        )
+
+
+@dataclass
+class K8SCredentials:
+    config: str = ""
+
+    @classmethod
+    def from_env(cls) -> "K8SCredentials":
+        data = os.environ.get("KUBECONFIG_DATA", "")
+        if not data:
+            path = os.environ.get("KUBECONFIG", os.path.expanduser("~/.kube/config"))
+            if path and os.path.exists(path):
+                with open(path) as handle:
+                    data = handle.read()
+        return cls(config=data)
+
+
+@dataclass
+class Credentials:
+    aws: Optional[AWSCredentials] = None
+    gcp: Optional[GCPCredentials] = None
+    az: Optional[AZCredentials] = None
+    k8s: Optional[K8SCredentials] = None
+
+
+@dataclass
+class Cloud:
+    provider: Provider = Provider.LOCAL
+    region: Region = "us-central2"
+    credentials: Credentials = field(default_factory=Credentials)
+    timeouts: Timeouts = field(default_factory=Timeouts)
+    tags: Dict[str, str] = field(default_factory=dict)
+
+    def get_closest_region(self, regions: Dict[str, Region]) -> str:
+        """Map a generic region to the provider-native region (cloud.go:61-69)."""
+        for key, value in regions.items():
+            if value == self.region:
+                return key
+        raise ValueError(f"native region not found: {self.region}")

@@ -1,0 +1,9 @@
+"""Common error types (reference: task/common/values.go:13-14)."""
+
+
+class ResourceNotFoundError(Exception):
+    """Raised when a cloud resource does not exist (reference NotFoundError)."""
+
+
+class ResourceNotImplementedError(Exception):
+    """Raised when a resource method is not implemented (reference NotImplementedError)."""

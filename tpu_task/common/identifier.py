@@ -1,0 +1,247 @@
+"""Deterministic, parseable, self-verifying task identifiers.
+
+Behavioral parity with the reference implementation
+(/root/reference/task/common/identifier.go:31-115): identifiers have the shape
+``{prefix}-{name}-{salt}-{check}`` where
+
+* ``prefix`` is a 3-character namespace (default ``tpi``),
+* ``name`` is the RFC1123-normalized user name truncated to 28 characters,
+* ``salt`` is 8 base36 characters (deterministic: hash of the normalized name;
+  random: hash of a random seed),
+* ``check`` is 8 base36 characters: ``hash(name + salt)`` — making every
+  identifier self-verifying and parseable without any stored state.
+
+``hash`` is the first ``size`` characters of the base36 rendering of the
+big-endian integer value of ``sha256(seed)``; verified against the reference's
+hard-coded compatibility vector ``tpi-test-3z4xlzwq-3u0vweb4``
+(identifier_test.go:50-57).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+import secrets
+from dataclasses import dataclass
+
+DEFAULT_IDENTIFIER_PREFIX = "tpi"
+MAXIMUM_LONG_LENGTH = 50
+SHORT_LENGTH = 16
+NAME_LENGTH = MAXIMUM_LONG_LENGTH - SHORT_LENGTH - len("tpi---")  # 28
+
+_BASE36 = "0123456789abcdefghijklmnopqrstuvwxyz"
+
+_PARSE_RE = re.compile(
+    r"([a-z0-9]{3})-([a-z0-9]+(?:[a-z0-9-]*[a-z0-9])?)-([a-z0-9]+)-([a-z0-9]+)"
+)
+
+# Small embedded petname-style vocabulary for random human-readable names
+# (reference uses golang-petname; any 3-word generator is acceptable since
+# random identifiers only need uniqueness via the salt, not specific words).
+_ADVERBS = (
+    "barely", "boldly", "briefly", "calmly", "daily", "deeply", "duly",
+    "early", "easily", "fairly", "fast", "gently", "gladly", "highly",
+    "jointly", "justly", "keenly", "kindly", "lately", "lightly", "loudly",
+    "madly", "mainly", "mostly", "neatly", "newly", "nicely", "openly",
+    "partly", "plainly", "poorly", "quickly", "rarely", "readily", "really",
+    "richly", "rightly", "roughly", "sadly", "safely", "shortly", "shyly",
+    "simply", "slowly", "softly", "solely", "soundly", "strictly", "swiftly",
+    "tightly", "truly", "vastly", "warmly", "wholly", "widely", "wildly",
+)
+_ADJECTIVES = (
+    "able", "active", "adapted", "alert", "amazed", "ample", "apt", "awake",
+    "boss", "brave", "bright", "busy", "calm", "capable", "careful", "casual",
+    "causal", "central", "certain", "cheerful", "chief", "civil", "classic",
+    "clean", "clear", "clever", "close", "cosmic", "crisp", "cuddly",
+    "curious", "daring", "decent", "direct", "driven", "eager", "easy",
+    "electric", "emerging", "eminent", "enabled", "engaged", "epic", "equal",
+    "ethical", "exact", "excited", "exotic", "expert", "faithful", "famous",
+    "fancy", "finer", "firm", "fit", "fleet", "flying", "fond", "frank",
+    "free", "fresh", "full", "funny", "game", "gentle", "giving", "glad",
+    "golden", "grand", "great", "growing", "guided", "handy", "happy",
+    "hardy", "helped", "heroic", "holy", "honest", "humane", "ideal",
+    "immune", "improved", "intense", "intent", "keen", "key", "kind",
+    "known", "large", "lasting", "leading", "legal", "lenient", "liberal",
+    "light", "liked", "literate", "live", "living", "logical", "loved",
+    "loyal", "lucky", "magical", "major", "many", "master", "mature",
+    "measured", "meet", "merry", "mighty", "mint", "model", "modern",
+    "modest", "moral", "more", "moved", "musical", "mutual", "national",
+    "native", "natural", "nearby", "neat", "needed", "neutral", "new",
+    "next", "nice", "noble", "normal", "notable", "noted", "novel", "obliging",
+    "on", "one", "open", "optimal", "optimum", "organic", "oriented",
+    "outgoing", "patient", "peaceful", "perfect", "pet", "picked", "pleasant",
+    "pleased", "pleasing", "poetic", "polished", "polite", "popular",
+    "positive", "possible", "powerful", "precious", "precise", "premium",
+    "prepared", "present", "pretty", "primary", "prime", "pro", "probable",
+    "profound", "promoted", "proper", "proud", "proven", "pumped", "pure",
+    "quality", "quick", "quiet", "rapid", "rare", "rational", "ready",
+    "real", "refined", "regular", "related", "relative", "relaxed",
+    "relaxing", "relevant", "relieved", "renewed", "renewing", "resolved",
+    "rested", "rich", "right", "robust", "romantic", "ruling", "sacred",
+    "safe", "saved", "saving", "secure", "select", "selected", "sensible",
+    "settled", "settling", "sharing", "sharp", "shining", "simple",
+    "sincere", "singular", "skilled", "smart", "smashing", "smiling",
+    "smooth", "social", "solid", "sought", "sound", "special", "splendid",
+    "square", "stable", "star", "steady", "sterling", "still", "stirred",
+    "striking", "strong", "stunning", "subtle", "suitable", "suited",
+    "summary", "sunny", "super", "superb", "supreme", "sure", "sweet",
+    "talented", "teaching", "tender", "thankful", "tidy", "tight", "together",
+    "tolerant", "top", "topical", "tops", "touched", "touching", "tough",
+    "true", "trusted", "trusting", "trusty", "ultimate", "unbiased", "uncommon",
+    "unified", "unique", "united", "up", "upright", "upward", "usable",
+    "useful", "utmost", "valid", "valued", "vast", "verified", "viable",
+    "vital", "vocal", "wanted", "warm", "wealthy", "welcome", "welcomed",
+    "well", "whole", "willing", "winning", "wired", "wise", "witty",
+    "wondrous", "workable", "working", "worthy",
+)
+_ANIMALS = (
+    "ant", "ape", "asp", "badger", "bass", "bat", "bear", "bee", "beetle",
+    "bengal", "bird", "bison", "bluejay", "boa", "boar", "bobcat", "bonefish",
+    "buck", "buffalo", "bug", "bull", "burro", "buzzard", "caiman", "calf",
+    "camel", "cardinal", "caribou", "cat", "catfish", "cattle", "chamois",
+    "cheetah", "chicken", "chigger", "chimp", "chipmunk", "chow", "cicada",
+    "civet", "cobra", "cod", "collie", "colt", "condor", "coral", "corgi",
+    "cougar", "cow", "coyote", "crab", "crane", "crappie", "crawdad",
+    "crayfish", "cricket", "crow", "cub", "deer", "dingo", "dodo", "doe",
+    "dog", "dolphin", "donkey", "dory", "dove", "dragon", "drake", "drum",
+    "duck", "duckling", "eagle", "earwig", "eel", "eft", "egret", "elephant",
+    "elf", "elk", "emu", "escargot", "ewe", "falcon", "fawn", "feline",
+    "ferret", "filly", "finch", "firefly", "fish", "flamingo", "flea",
+    "flounder", "fly", "foal", "fowl", "fox", "frog", "gannet", "gar",
+    "gator", "gazelle", "gecko", "gelding", "ghost", "ghoul", "gibbon",
+    "giraffe", "glider", "gnat", "gnu", "goat", "gobbler", "goldfish",
+    "goose", "gopher", "gorilla", "goshawk", "grackle", "griffon", "grouper",
+    "grouse", "grub", "grubworm", "guinea", "gull", "guppy", "haddock",
+    "halibut", "hamster", "hare", "hawk", "hen", "hermit", "heron", "herring",
+    "hippo", "hog", "honeybee", "hookworm", "hornet", "horse", "hound",
+    "humpback", "husky", "hyena", "ibex", "iguana", "imp", "impala",
+    "insect", "jackal", "jaguar", "javelin", "jawfish", "jay", "jaybird",
+    "jennet", "kangaroo", "katydid", "kid", "killdeer", "kingfish", "kit",
+    "kite", "kitten", "kiwi", "koala", "kodiak", "koi", "krill", "lab",
+    "labrador", "lacewing", "ladybird", "ladybug", "lamb", "lamprey",
+    "lark", "leech", "lemming", "lemur", "leopard", "lion", "lioness",
+    "lionfish", "lizard", "llama", "lobster", "locust", "longhorn", "loon",
+    "louse", "lynx", "macaque", "macaw", "mackerel", "maggot", "magpie",
+    "mako", "mallard", "mammal", "mammoth", "man", "manatee", "mantis",
+    "marlin", "marmoset", "marten", "martin", "mastiff", "mastodon", "mayfly",
+    "meerkat", "midge", "mink", "minnow", "mite", "mole", "mollusk", "molly",
+    "monarch", "mongoose", "mongrel", "monitor", "monkey", "monkfish",
+    "monster", "moose", "moray", "mosquito", "moth", "mouse", "mudfish",
+    "mule", "mullet", "muskox", "muskrat", "mustang", "mutt", "narwhal",
+    "newt", "octopus", "opossum", "orca", "oriole", "osprey", "ostrich",
+    "owl", "ox", "oyster", "panda", "panther", "parakeet", "parrot",
+    "peacock", "pegasus", "pelican", "penguin", "perch", "pheasant", "phoenix",
+    "pig", "pigeon", "piglet", "pika", "pipefish", "piranha", "platypus",
+    "polecat", "polliwog", "pony", "poodle", "porpoise", "possum", "prawn",
+    "primate", "pug", "puma", "pup", "python", "quagga", "quail", "quetzal",
+    "rabbit", "raccoon", "racer", "ram", "raptor", "rat", "rattler", "raven",
+    "ray", "redbird", "redfish", "reindeer", "reptile", "rhino", "ringtail",
+    "robin", "rodent", "rooster", "sailfish", "salmon", "sawfish", "sawfly",
+    "scorpion", "seagull", "seahorse", "seal", "seasnail", "serval", "shad",
+    "shark", "sheep", "sheepdog", "shepherd", "shiner", "shrew", "shrimp",
+    "silkworm", "skink", "skunk", "skylark", "sloth", "slug", "snail",
+    "snake", "snapper", "snipe", "sole", "sparrow", "spider", "sponge",
+    "squid", "squirrel", "stag", "stallion", "starfish", "starling",
+    "stingray", "stinkbug", "stork", "stud", "sturgeon", "sunbeam", "sunbird",
+    "sunfish", "swan", "swift", "swine", "tadpole", "tahr", "tapir",
+    "tarpon", "teal", "termite", "terrapin", "terrier", "tetra", "thrush",
+    "tick", "tiger", "titmouse", "toad", "tomcat", "tortoise", "toucan",
+    "treefrog", "troll", "trout", "tuna", "turkey", "turtle", "unicorn",
+    "urchin", "vervet", "viper", "vulture", "walleye", "walrus", "warthog",
+    "wasp", "weasel", "weevil", "werewolf", "whale", "whippet", "wildcat",
+    "wolf", "wombat", "woodcock", "worm", "wren", "yak", "yeti", "zebra",
+)
+
+
+class WrongIdentifierError(ValueError):
+    """Raised when a string cannot be parsed as a valid identifier."""
+
+
+def _validate_prefix(prefix: str) -> str:
+    """Prefixes must provide at least 3 usable characters; fail loudly otherwise
+    (the reference panics on short prefixes — identifier.go:47)."""
+    if len(prefix) < 3:
+        raise ValueError(f"identifier prefix must be at least 3 characters: {prefix!r}")
+    return prefix[:3]
+
+
+def _validate_name(name: str) -> str:
+    """Names must survive normalization non-empty, or the resulting identifier
+    could never be parsed back (the parse regex requires a non-empty name)."""
+    seed = normalize(name, NAME_LENGTH)
+    if not seed:
+        raise ValueError(f"identifier name normalizes to empty: {name!r}")
+    return seed
+
+
+def _hash(seed: str, size: int) -> str:
+    """First ``size`` chars of base36(sha256(seed)), matching the reference."""
+    digest = hashlib.sha256(seed.encode()).digest()
+    value = int.from_bytes(digest, "big")
+    out = []
+    while value:
+        value, rem = divmod(value, 36)
+        out.append(_BASE36[rem])
+    result = "".join(reversed(out)) or "0"
+    if len(result) < size:
+        raise RuntimeError("not enough bytes to satisfy requested size")
+    return result[:size]
+
+
+def normalize(identifier: str, truncate: int = NAME_LENGTH) -> str:
+    """RFC1123-like normalization: lowercase, [^a-z0-9]+ → '-', truncate, trim."""
+    lowercase = identifier.lower()
+    normalized = re.sub(r"[^a-z0-9]+", "-", lowercase)
+    normalized = normalized[:truncate]
+    return re.sub(r"(^-)|(-$)", "", normalized)
+
+
+def _random_petname(words: int = 3, separator: str = "-") -> str:
+    rng = secrets.SystemRandom()
+    parts = []
+    if words > 2:
+        parts.extend(rng.choice(_ADVERBS) for _ in range(words - 2))
+    if words > 1:
+        parts.append(rng.choice(_ADJECTIVES))
+    parts.append(rng.choice(_ANIMALS))
+    return separator.join(parts)
+
+
+@dataclass(frozen=True)
+class Identifier:
+    """A task identifier: cloud-safe, ≤50 chars, deterministic or random."""
+
+    prefix: str
+    name: str
+    salt: str
+
+    @classmethod
+    def deterministic(cls, name: str, prefix: str = DEFAULT_IDENTIFIER_PREFIX) -> "Identifier":
+        seed = _validate_name(name)
+        return cls(prefix=_validate_prefix(prefix), name=name, salt=_hash(seed, SHORT_LENGTH // 2))
+
+    @classmethod
+    def random(cls, name: str = "", prefix: str = DEFAULT_IDENTIFIER_PREFIX) -> "Identifier":
+        seed = "".join(secrets.choice(_BASE36) for _ in range(8))
+        if not name:
+            name = _random_petname(3, "-")
+        _validate_name(name)
+        return cls(prefix=_validate_prefix(prefix), name=name, salt=_hash(seed, SHORT_LENGTH // 2))
+
+    @classmethod
+    def parse(cls, identifier: str) -> "Identifier":
+        match = _PARSE_RE.fullmatch(identifier)
+        if match and _hash(match.group(2) + match.group(3), SHORT_LENGTH // 2) == match.group(4):
+            return cls(prefix=match.group(1), name=match.group(2), salt=match.group(3))
+        raise WrongIdentifierError(f"wrong identifier: {identifier!r}")
+
+    def long(self) -> str:
+        name = normalize(self.name, NAME_LENGTH)
+        return f"{self.prefix}-{name}-{self.salt}-{_hash(name + self.salt, SHORT_LENGTH // 2)}"
+
+    def short(self) -> str:
+        parts = self.long().split("-")
+        return parts[-2] + parts[-1]
+
+    def __str__(self) -> str:  # pragma: no cover - convenience
+        return self.long()

@@ -1,0 +1,23 @@
+"""Resource CRUD contract (reference: task/common/resource.go:8-21)."""
+
+from __future__ import annotations
+
+from typing import Protocol, runtime_checkable
+
+
+@runtime_checkable
+class Resource(Protocol):
+    """Interface implemented by every deployment resource."""
+
+    def read(self) -> None: ...
+
+    def create(self) -> None: ...
+
+    def delete(self) -> None: ...
+
+
+@runtime_checkable
+class StorageCredentials(Protocol):
+    """Implemented by resources that provide access to storage containers."""
+
+    def connection_string(self) -> str: ...

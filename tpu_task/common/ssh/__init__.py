@@ -1,0 +1,3 @@
+from tpu_task.common.ssh.keys import DeterministicSSHKeyPair
+
+__all__ = ["DeterministicSSHKeyPair"]

@@ -1,0 +1,134 @@
+"""Deterministic SSH keypairs derived from a cloud secret.
+
+Parity with the reference's gokey-based scheme
+(/root/reference/task/common/ssh/deterministic_key_pair_ssh.go:12-21): the RSA
+keypair is *derived* from ``(secret, realm)`` via a KDF-seeded DRBG, so no key
+state is ever stored anywhere — re-deriving with the same inputs always yields
+the same keypair. (We are not bit-compatible with gokey — the build is a new
+framework, not a port — but the property and API are the same.)
+
+Key material pipeline:
+  scrypt(secret, salt=realm) → HMAC-SHA256 counter DRBG → rejection-sampled
+  probable primes (Miller-Rabin, deterministic bases from the DRBG) → RSA key.
+
+Serialization (PEM / OpenSSH authorized_keys) is delegated to ``cryptography``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+
+from cryptography.hazmat.primitives import serialization
+from cryptography.hazmat.primitives.asymmetric import rsa
+
+_E = 65537
+
+_SMALL_PRIMES = [3, 5, 7, 11, 13, 17, 19, 23, 29, 31, 37, 41, 43, 47, 53, 59,
+                 61, 67, 71, 73, 79, 83, 89, 97, 101, 103, 107, 109, 113, 127,
+                 131, 137, 139, 149, 151, 157, 163, 167, 173, 179, 181, 191,
+                 193, 197, 199, 211, 223, 227, 229, 233, 239, 241, 251]
+
+
+class _DRBG:
+    """HMAC-SHA256 counter DRBG; deterministic byte stream from a 32-byte seed."""
+
+    def __init__(self, seed: bytes):
+        self._key = seed
+        self._counter = 0
+        self._buffer = b""
+
+    def read(self, n: int) -> bytes:
+        while len(self._buffer) < n:
+            block = hmac.new(self._key, self._counter.to_bytes(8, "big"), hashlib.sha256).digest()
+            self._counter += 1
+            self._buffer += block
+        out, self._buffer = self._buffer[:n], self._buffer[n:]
+        return out
+
+    def read_int(self, bits: int) -> int:
+        nbytes = (bits + 7) // 8
+        value = int.from_bytes(self.read(nbytes), "big")
+        return value >> (nbytes * 8 - bits)
+
+
+def _is_probable_prime(n: int, drbg: _DRBG, rounds: int = 32) -> bool:
+    for p in _SMALL_PRIMES:
+        if n % p == 0:
+            return n == p
+    d, r = n - 1, 0
+    while d % 2 == 0:
+        d //= 2
+        r += 1
+    for _ in range(rounds):
+        a = 2 + drbg.read_int(64) % (min(n - 4, 1 << 62))
+        x = pow(a, d, n)
+        if x in (1, n - 1):
+            continue
+        for _ in range(r - 1):
+            x = pow(x, 2, n)
+            if x == n - 1:
+                break
+        else:
+            return False
+    return True
+
+
+def _generate_prime(bits: int, drbg: _DRBG) -> int:
+    while True:
+        candidate = drbg.read_int(bits)
+        candidate |= (1 << (bits - 1)) | (1 << (bits - 2)) | 1
+        if candidate % _E == 1:
+            continue
+        if _is_probable_prime(candidate, drbg):
+            return candidate
+
+
+def _derive_rsa_key(secret: str, realm: str, bits: int) -> rsa.RSAPrivateKey:
+    # Deliberately uncached: a module-level cache would pin plaintext secrets
+    # and private keys in memory for the process lifetime.
+    seed = hashlib.scrypt(
+        secret.encode(), salt=b"tpu-task/ssh/" + realm.encode(),
+        n=2 ** 14, r=8, p=1, dklen=32,
+    )
+    drbg = _DRBG(seed)
+    half = bits // 2
+    while True:
+        p = _generate_prime(half, drbg)
+        q = _generate_prime(half, drbg)
+        if p == q:
+            continue
+        if p < q:
+            p, q = q, p
+        n = p * q
+        if n.bit_length() != bits:
+            continue
+        phi = (p - 1) * (q - 1)
+        d = pow(_E, -1, phi)
+        numbers = rsa.RSAPrivateNumbers(
+            p=p, q=q, d=d,
+            dmp1=d % (p - 1), dmq1=d % (q - 1),
+            iqmp=pow(q, -1, p),
+            public_numbers=rsa.RSAPublicNumbers(e=_E, n=n),
+        )
+        return numbers.private_key()
+
+
+class DeterministicSSHKeyPair:
+    """RSA keypair deterministically derived from (secret, realm) — no stored state."""
+
+    def __init__(self, secret: str, realm: str, bits: int = 4096):
+        self._key = _derive_rsa_key(secret, realm, bits)
+
+    def private_string(self) -> str:
+        return self._key.private_bytes(
+            encoding=serialization.Encoding.PEM,
+            format=serialization.PrivateFormat.TraditionalOpenSSL,
+            encryption_algorithm=serialization.NoEncryption(),
+        ).decode()
+
+    def public_string(self) -> str:
+        return self._key.public_key().public_bytes(
+            encoding=serialization.Encoding.OpenSSH,
+            format=serialization.PublicFormat.OpenSSH,
+        ).decode() + "\n"

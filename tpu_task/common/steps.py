@@ -1,0 +1,27 @@
+"""Ordered, logged, fail-fast step-plan runner (reference: task/common/steps.go:9-27)."""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Callable, Sequence
+
+logger = logging.getLogger("tpu_task")
+
+
+@dataclass
+class Step:
+    description: str
+    action: Callable[[], None]
+
+
+def run_steps(steps: Sequence[Step]) -> None:
+    """Execute steps in order, logging ``[i/N] description``; raise on first failure."""
+    total = len(steps)
+    for index, step in enumerate(steps, start=1):
+        logger.info("[%d/%d] %s", index, total, step.description)
+        try:
+            step.action()
+        except Exception as error:
+            logger.debug("step: %s error: %s", step.description, error)
+            raise

@@ -1,0 +1,140 @@
+"""Task value model: spec, status, events, firewall, environment.
+
+Behavioral parity with the reference value structs
+(/root/reference/task/common/values.go:17-118), re-expressed as Python
+dataclasses. The orchestrator is cloud-control-plane code, so plain Python
+(not JAX) is the right tool here; the compute stack lives under
+``tpu_task.models`` / ``tpu_task.parallel``.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import os
+import re
+from dataclasses import dataclass, field
+from datetime import datetime, timedelta
+from enum import Enum
+from typing import Dict, List, Optional
+
+
+class Spot(float):
+    """Spot/preemptible policy: <0 disabled, 0 auto (no price cap), >0 fixed max price.
+
+    Reference: task/common/values.go:16-22. For the TPU backend, any value >= 0
+    maps to preemptible/spot TPU capacity with QueuedResource re-queue.
+    """
+
+
+SPOT_DISABLED = Spot(-1)
+SPOT_ENABLED = Spot(0)
+
+
+class StatusCode(str, Enum):
+    ACTIVE = "running"
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+
+
+Status = Dict[StatusCode, int]
+
+
+@dataclass
+class Size:
+    """Machine size: accelerator/machine type + root storage GB.
+
+    ``machine`` accepts the generic grammar (``s``/``m``/``l``/``xl`` with
+    ``+accel*N``) or a TPU accelerator type (``v2-8``, ``v4-32``, ``v5p-128``
+    etc.) — the TPU grammar replaces the reference's GPU size maps
+    (resource_instance_template.go:72-107).
+    """
+
+    machine: str = "m"
+    storage: int = -1
+
+
+@dataclass
+class Event:
+    time: datetime
+    code: str
+    description: List[str] = field(default_factory=list)
+
+
+@dataclass
+class RemoteStorage:
+    """Pre-allocated storage container configuration (values.go:45-55)."""
+
+    container: str
+    path: str = ""
+    config: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class FirewallRule:
+    """None fields mean "allow any"; specified-but-empty mean "allow none".
+
+    Ports are both TCP and UDP; no ports → every port and protocol
+    (values.go:78-84).
+    """
+
+    nets: Optional[List[ipaddress.IPv4Network]] = None
+    ports: Optional[List[int]] = None
+
+
+@dataclass
+class Firewall:
+    ingress: FirewallRule = field(default_factory=FirewallRule)
+    egress: FirewallRule = field(default_factory=FirewallRule)
+
+
+class Variables(Dict[str, Optional[str]]):
+    """Environment variable map; None values resolve from process env with glob keys.
+
+    Reference: Variables.Enrich (values.go:102-118) — a key with a None value is
+    treated as a ``*``-glob over process environment variable names.
+    """
+
+    def enrich(self) -> Dict[str, str]:
+        result: Dict[str, str] = {}
+        for name, value in self.items():
+            if value is None:
+                # Only '*' is a wildcard; every other character is literal
+                # (reference quotes all glob metacharacters then re-enables
+                # '*' alone — values.go:106-107).
+                pattern = re.compile(re.escape(name).replace(r"\*", ".*"))
+                for key, env_value in os.environ.items():
+                    if pattern.fullmatch(key):
+                        result[key] = env_value
+            else:
+                result[name] = value
+        return result
+
+
+@dataclass
+class Environment:
+    image: str = ""
+    script: str = ""
+    variables: Variables = field(default_factory=Variables)
+    timeout: Optional[timedelta] = timedelta(hours=24)
+    directory: str = ""
+    directory_out: str = ""
+    exclude_list: List[str] = field(default_factory=list)
+
+
+@dataclass
+class Task:
+    """Cloud-agnostic task specification (values.go:57-70)."""
+
+    size: Size = field(default_factory=Size)
+    environment: Environment = field(default_factory=Environment)
+    firewall: Firewall = field(default_factory=Firewall)
+    permission_set: str = ""
+    spot: Spot = SPOT_DISABLED
+    parallelism: int = 1
+
+    remote_storage: Optional[RemoteStorage] = None
+
+    # Computed attributes, populated by Read.
+    addresses: List[str] = field(default_factory=list)
+    status: Status = field(default_factory=dict)
+    events: List[Event] = field(default_factory=list)
