@@ -1,0 +1,96 @@
+"""The hermetic worker agent ("subprocess VM"): full supervision semantics —
+restore, run, log/data sync loops, status report, timeout, self-destruct."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_agent(tmp_path, script_text, timeout_epoch=0.0, machine_id="m1", worker_id=0,
+              pre_bucket_data=None):
+    remote = tmp_path / "bucket"
+    workdir = tmp_path / "workdir"
+    remote.mkdir(exist_ok=True)
+    workdir.mkdir(exist_ok=True)
+    if pre_bucket_data:
+        (remote / "data").mkdir(exist_ok=True)
+        for name, content in pre_bucket_data.items():
+            (remote / "data" / name).write_text(content)
+    script = tmp_path / "task.sh"
+    script.write_text(script_text)
+    process = subprocess.run(
+        [sys.executable, "-m", "tpu_task.machine.local_agent",
+         "--remote", str(remote), "--directory", str(workdir),
+         "--script", str(script), "--machine-id", machine_id,
+         "--timeout", str(timeout_epoch),
+         "--log-period", "0.1", "--data-period", "0.1",
+         "--worker-id", str(worker_id)],
+        capture_output=True, text=True, timeout=60,
+        env={**os.environ, "PYTHONPATH": REPO},
+    )
+    return remote, workdir, process
+
+
+def test_successful_task(tmp_path):
+    remote, workdir, process = run_agent(tmp_path, "echo hello world\nexit 0\n")
+    assert process.returncode == 0, process.stderr
+    status = json.loads((remote / "reports" / "status-m1").read_text())
+    assert status["code"] == "0"
+    logs = (remote / "reports" / "task-m1").read_text()
+    assert "hello world" in logs
+    # Log lines carry ISO timestamps like the journald formatting (tpl:110).
+    assert logs.split(" ")[0].endswith("Z")
+    # Worker 0 leaves the self-destruct marker.
+    assert (remote / "shutdown").exists()
+
+
+def test_failing_task(tmp_path):
+    remote, _workdir, process = run_agent(tmp_path, "echo dying\nexit 3\n")
+    assert process.returncode == 3
+    status = json.loads((remote / "reports" / "status-m1").read_text())
+    assert status["code"] == "3"
+
+
+def test_timeout_task(tmp_path):
+    remote, _workdir, process = run_agent(
+        tmp_path, "sleep 60\n", timeout_epoch=time.time() + 1.5)
+    status = json.loads((remote / "reports" / "status-m1").read_text())
+    assert status["result"] == "timeout"
+    assert status["code"] == ""
+
+
+def test_data_restore_and_sync(tmp_path):
+    """Respawned worker restores bucket data; outputs sync back (tpl:89,118-124)."""
+    remote, _workdir, process = run_agent(
+        tmp_path,
+        "cat checkpoint.txt\necho result > output.txt\nsleep 0.5\n",
+        pre_bucket_data={"checkpoint.txt": "epoch 7"},
+    )
+    assert process.returncode == 0, process.stderr
+    logs = (remote / "reports" / "task-m1").read_text()
+    assert "epoch 7" in logs  # restore worked
+    assert (remote / "data" / "output.txt").read_text() == "result\n"  # sync back
+    assert (remote / "data" / "checkpoint.txt").read_text() == "epoch 7"
+
+
+def test_nonzero_worker_does_not_self_destruct_or_upload(tmp_path):
+    remote, _workdir, process = run_agent(
+        tmp_path, "echo worker one\n", machine_id="m2", worker_id=1)
+    assert process.returncode == 0
+    assert not (remote / "shutdown").exists()
+    assert not (remote / "data").exists()
+    # But its logs and status still stream (per-machine blobs, tpl:110-115).
+    assert (remote / "reports" / "task-m2").exists()
+    assert (remote / "reports" / "status-m2").exists()
+
+
+def test_env_variables_visible_to_task(tmp_path):
+    remote, _workdir, process = run_agent(
+        tmp_path, 'echo "rank=$TPU_WORKER_ID id=$TPU_TASK_MACHINE_IDENTITY"\n')
+    logs = (remote / "reports" / "task-m1").read_text()
+    assert "rank=0" in logs
+    assert "id=m1" in logs

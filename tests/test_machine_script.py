@@ -1,0 +1,78 @@
+"""Golden-file tests for the worker bootstrap script renderer
+(reference strategy: task/common/machine/script_test.go:14-41 + goldie).
+
+Regenerate goldens with: UPDATE_GOLDEN=1 python -m pytest tests/test_machine_script.py
+"""
+
+import os
+from datetime import datetime, timezone
+
+import pytest
+
+from tpu_task.common.values import Variables
+from tpu_task.machine import render_script
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "testdata")
+
+
+def check_golden(name: str, content: str):
+    path = os.path.join(GOLDEN_DIR, name + ".golden")
+    if os.environ.get("UPDATE_GOLDEN"):
+        os.makedirs(GOLDEN_DIR, exist_ok=True)
+        with open(path, "w") as handle:
+            handle.write(content)
+    with open(path) as handle:
+        assert content == handle.read()
+
+
+def test_machine_script_minimal():
+    script = render_script("\n", {}, Variables(), None)
+    check_golden("machine_script_minimal", script)
+
+
+def test_machine_script_full():
+    timeout = datetime(2025, 3, 1, 12, 0, 0, tzinfo=timezone.utc)
+    script = render_script(
+        "#!/bin/bash\necho hello\n",
+        {"TPU_TASK_REMOTE": ":googlecloudstorage:bucket/prefix",
+         "TPU_TASK_CLOUD_PROVIDER": "tpu",
+         "TPU_TASK_CLOUD_REGION": "us-central2-b",
+         "TPU_TASK_IDENTIFIER": "tpi-test-3z4xlzwq-3u0vweb4"},
+        Variables({"MY_VAR": 'va"lue'}),
+        timeout,
+    )
+    check_golden("machine_script_full", script)
+
+
+def test_timeout_embedding():
+    timeout = datetime(2025, 3, 1, 12, 0, 0, tzinfo=timezone.utc)
+    script = render_script("x", {}, Variables(), timeout)
+    assert str(int(timeout.timestamp())) in script
+    assert "infinity" not in script.split("RuntimeMaxSec")[0].split("REMAINING")[1]
+
+
+def test_no_timeout_is_infinity():
+    script = render_script("x", {}, Variables(), None)
+    assert "$((infinity-$(date +%s)))" in script
+
+
+def test_credentials_are_shell_escaped():
+    script = render_script("x", {"KEY": "va'lue; rm -rf /"}, Variables(), None)
+    import base64
+    # Extract the credentials payload (third base64 block) and verify quoting.
+    blocks = [b.strip() for b in script.split("END")]
+    decoded = []
+    for block in blocks:
+        tail = block.rsplit("\n", 1)[-1]
+        try:
+            decoded.append(base64.b64decode(tail.encode()).decode())
+        except Exception:
+            decoded.append("")
+    creds = [d for d in decoded if d.startswith("export ")]
+    assert creds, "credentials block not found"
+    assert creds[0] == "export 'KEY=va'\"'\"'lue; rm -rf /'\n"
+
+
+def test_worker_zero_guards_self_destruct():
+    script = render_script("x", {}, Variables(), None)
+    assert 'test "${TPU_WORKER_ID:-0}" != "0"' in script

@@ -1,0 +1,220 @@
+"""Data-plane tests, mirroring the reference's hermetic local-backend strategy
+(reference: task/common/machine/storage_test.go:15-119)."""
+
+import json
+import os
+
+import pytest
+
+from tpu_task.common.errors import ResourceNotFoundError
+from tpu_task.common.values import StatusCode
+from tpu_task.storage import (
+    Connection,
+    delete_storage,
+    limit_transfer,
+    logs,
+    status,
+    sync,
+    transfer,
+)
+
+
+# --- connection strings (storage_test.go:15-53 vectors) ---------------------
+
+def test_connection_string_with_config():
+    conn = Connection(
+        backend="azureblob", container="container",
+        config={"account": "az_account", "key": "az_key"},
+    )
+    assert str(conn) == ":azureblob,account='az_account',key='az_key':container"
+
+
+def test_connection_string_with_path():
+    conn = Connection(backend="azureblob", container="container", path="/subdirectory")
+    assert str(conn) == ":azureblob:container/subdirectory"
+
+
+def test_connection_string_path_without_separator():
+    conn = Connection(backend="azureblob", container="container", path="subdirectory")
+    assert str(conn) == ":azureblob:container/subdirectory"
+
+
+def test_connection_string_parse_roundtrip():
+    conn = Connection(
+        backend="googlecloudstorage", container="bucket", path="/sub",
+        config={"service_account_credentials": '{"a": "b,c"}'},
+    )
+    parsed = Connection.parse(str(conn))
+    assert parsed.backend == conn.backend
+    assert parsed.container == conn.container
+    assert parsed.path == conn.path
+    assert parsed.config == conn.config
+
+
+def test_connection_parse_local_path():
+    conn = Connection.parse("/some/dir")
+    assert conn.backend == "local"
+    assert conn.path == "/some/dir"
+
+
+# --- transfer filter semantics (storage_test.go:55-101) ---------------------
+
+@pytest.fixture
+def fixture_tree(tmp_path):
+    src = tmp_path / "src"
+    (src / "temp").mkdir(parents=True)
+    (src / "main.tf").write_text("terraform config — must never transfer")
+    (src / "a.txt").write_text("root a")
+    (src / "temp" / "a.txt").write_text("nested a")
+    (src / "temp" / "b.txt").write_text("nested b")
+    return str(src)
+
+
+def list_tree(root):
+    entries = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        for name in dirnames + filenames:
+            full = os.path.join(dirpath, name)
+            entries.append("/" + os.path.relpath(full, root).replace(os.sep, "/"))
+    return sorted(entries)
+
+
+def test_builtin_excludes_terraform_files(fixture_tree, tmp_path):
+    dst = tmp_path / "dst1"
+    transfer(fixture_tree, str(dst))
+    assert list_tree(dst) == ["/a.txt", "/temp", "/temp/a.txt", "/temp/b.txt"]
+
+
+def test_glob_exclude_keeps_directories(fixture_tree, tmp_path):
+    dst = tmp_path / "dst2"
+    transfer(fixture_tree, str(dst), exclude=["**.txt"])
+    assert list_tree(dst) == ["/temp"]  # directory still gets transferred
+
+
+def test_explicitly_anchored_exclude(fixture_tree, tmp_path):
+    dst = tmp_path / "dst3"
+    transfer(fixture_tree, str(dst), exclude=["/a.txt"])
+    assert list_tree(dst) == ["/temp", "/temp/a.txt", "/temp/b.txt"]
+
+
+def test_implicitly_anchored_exclude(fixture_tree, tmp_path):
+    dst = tmp_path / "dst4"
+    transfer(fixture_tree, str(dst), exclude=["a.txt"])
+    assert list_tree(dst) == ["/temp", "/temp/a.txt", "/temp/b.txt"]
+
+
+def test_transfer_preserves_contents(fixture_tree, tmp_path):
+    dst = tmp_path / "dst5"
+    transfer(fixture_tree, str(dst))
+    assert (dst / "temp" / "b.txt").read_text() == "nested b"
+
+
+# --- sync (mirror) semantics ------------------------------------------------
+
+def test_sync_removes_extraneous(fixture_tree, tmp_path):
+    dst = tmp_path / "dst6"
+    dst.mkdir()
+    (dst / "stale.bin").write_text("left over from a previous epoch")
+    sync(fixture_tree, str(dst))
+    assert "/stale.bin" not in list_tree(dst)
+    assert "/a.txt" in list_tree(dst)
+
+
+def test_sync_roundtrip_restore(fixture_tree, tmp_path):
+    """Workdir → bucket → fresh workdir (the preemption-recovery restore path)."""
+    bucket = tmp_path / "bucket" / "data"
+    restored = tmp_path / "restored"
+    sync(fixture_tree, str(bucket))
+    sync(str(bucket), str(restored))
+    assert (restored / "temp" / "a.txt").read_text() == "nested a"
+
+
+# --- limit_transfer (storage.go:265-280) ------------------------------------
+
+def test_limit_transfer_rules():
+    rules = limit_transfer("output", ["- cache/**"])
+    assert rules == ["- cache/**", "+ /output", "+ /output/**", "- /**"]
+
+
+def test_limit_transfer_noop_for_root():
+    assert limit_transfer("", ["- x"]) == ["- x"]
+    assert limit_transfer(".", ["- x"]) == ["- x"]
+
+
+def test_limit_transfer_end_to_end(fixture_tree, tmp_path):
+    dst = tmp_path / "dst7"
+    transfer(fixture_tree, str(dst), exclude=limit_transfer("temp", []))
+    assert list_tree(dst) == ["/temp", "/temp/a.txt", "/temp/b.txt"]
+
+
+# --- mailbox protocol: reports / logs / status ------------------------------
+
+@pytest.fixture
+def mailbox(tmp_path):
+    remote = tmp_path / "bucket"
+    (remote / "reports").mkdir(parents=True)
+    return remote
+
+
+def test_logs_reads_task_reports(mailbox):
+    (mailbox / "reports" / "task-machine1").write_text("line one\nline two\n")
+    (mailbox / "reports" / "task-machine2").write_text("other machine\n")
+    (mailbox / "reports" / "status-machine1").write_text("{}")
+    result = sorted(logs(str(mailbox)))
+    assert result == ["line one\nline two\n", "other machine\n"]
+
+
+def test_status_counts_exit_codes(mailbox):
+    (mailbox / "reports" / "status-m1").write_text(
+        json.dumps({"result": "exit-code", "code": "0", "status": "0"}))
+    (mailbox / "reports" / "status-m2").write_text(
+        json.dumps({"result": "exit-code", "code": "1", "status": "1"}))
+    (mailbox / "reports" / "status-m3").write_text(
+        json.dumps({"result": "timeout", "code": "", "status": ""}))
+    result = status(str(mailbox), {StatusCode.ACTIVE: 3})
+    assert result[StatusCode.ACTIVE] == 3
+    assert result[StatusCode.SUCCEEDED] == 1
+    assert result[StatusCode.FAILED] == 2
+
+
+def test_status_uppercase_keys(mailbox):
+    """Go's encoding/json matches keys case-insensitively; so do we."""
+    (mailbox / "reports" / "status-m1").write_text('{"Code": "0"}')
+    assert status(str(mailbox))[StatusCode.SUCCEEDED] == 1
+
+
+def test_status_malformed_report_raises(mailbox):
+    (mailbox / "reports" / "status-m1").write_text("not json")
+    with pytest.raises(ValueError):
+        status(str(mailbox))
+
+
+def test_delete_storage(mailbox):
+    (mailbox / "reports" / "task-m1").write_text("x")
+    (mailbox / "data").mkdir()
+    (mailbox / "data" / "f").write_text("y")
+    delete_storage(str(mailbox))
+    assert os.listdir(mailbox) == []
+
+
+def test_delete_missing_storage_raises(tmp_path):
+    with pytest.raises(ResourceNotFoundError):
+        delete_storage(str(tmp_path / "never-created"))
+
+
+# --- native core ------------------------------------------------------------
+
+def test_native_copy_core(tmp_path):
+    from tpu_task.storage import native
+
+    pairs = []
+    for index in range(20):
+        src = tmp_path / f"src{index}.bin"
+        src.write_bytes(os.urandom(1000 * index))
+        pairs.append((str(src), str(tmp_path / "out" / f"dst{index}.bin")))
+    available = native.copy_files(pairs, threads=4)
+    if not available:
+        pytest.skip("native toolchain unavailable")
+    for index, (src, dst) in enumerate(pairs):
+        with open(src, "rb") as a, open(dst, "rb") as b:
+            assert a.read() == b.read()

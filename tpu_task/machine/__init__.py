@@ -1,0 +1,3 @@
+from tpu_task.machine.script import render_script
+
+__all__ = ["render_script"]

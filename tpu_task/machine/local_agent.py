@@ -1,0 +1,217 @@
+"""Hermetic worker agent: emulates a TPU-VM worker as a local subprocess.
+
+This is the "subprocess VM" of the hermetic end-to-end slice (SURVEY.md §7):
+it reproduces the on-VM agent's observable behavior — restore workdir from
+the bucket, run the task script under supervision with a hard timeout, sync
+logs every log-period and data every data-period, write the exit status JSON
+report, final-sync, and (on worker 0) touch the self-destruct marker — using
+a local directory as the bucket, so the full lifecycle is testable with zero
+cloud credentials, exactly what the reference never had (SURVEY.md §4).
+
+Behavioral contract mirrored from
+/root/reference/task/common/machine/machine-script.sh.tpl:
+  * status report JSON: {"result", "code", "status"} (tpl:51)
+  * report blob names: reports/task-{machine}, reports/status-{machine} (tpl:110)
+  * data restore before start (tpl:89); mtime-gated data sync loop (tpl:118-124)
+  * timeout → result "timeout", no exit code (tpl:56 RuntimeMaxSec semantics)
+
+Run: python -m tpu_task.machine.local_agent --remote DIR --directory DIR \
+         --script FILE [--timeout EPOCH] [--machine-id ID] ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from datetime import datetime, timezone
+
+from tpu_task.storage import sync as storage_sync
+from tpu_task.storage import transfer as storage_transfer
+
+
+def _iso_now() -> str:
+    return datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+class Agent:
+    def __init__(self, remote: str, directory: str, script_path: str,
+                 machine_id: str, timeout_epoch: float,
+                 log_period: float, data_period: float, worker_id: int = 0):
+        self.remote = remote
+        self.directory = directory
+        self.script_path = script_path
+        self.machine_id = machine_id
+        self.timeout_epoch = timeout_epoch
+        self.log_period = log_period
+        self.data_period = data_period
+        self.worker_id = worker_id
+        self.log_lines: list[str] = []
+        self._log_lock = threading.Lock()
+        self._done = threading.Event()
+
+    # -- sync loops ----------------------------------------------------------
+    def _reports_dir(self) -> str:
+        return os.path.join(self.remote, "reports")
+
+    def _write_report(self, prefix: str, content: str) -> None:
+        os.makedirs(self._reports_dir(), exist_ok=True)
+        path = os.path.join(self._reports_dir(), f"{prefix}-{self.machine_id}")
+        with open(path, "w") as handle:
+            handle.write(content)
+
+    def _sync_logs(self) -> None:
+        with self._log_lock:
+            content = "".join(self.log_lines)
+        self._write_report("task", content)
+
+    def _log_loop(self) -> None:
+        last = None
+        while not self._done.wait(self.log_period):
+            with self._log_lock:
+                current = len(self.log_lines)
+            if current != last:
+                last = current
+                self._sync_logs()
+
+    def _data_loop(self) -> None:
+        if self.worker_id != 0:
+            return
+        last_epoch = None
+        while not self._done.wait(self.data_period):
+            epoch = self._data_epoch()
+            if epoch != last_epoch:
+                last_epoch = epoch
+                try:
+                    storage_sync(self.directory, os.path.join(self.remote, "data"))
+                except Exception as error:  # keep looping like the shell loop
+                    self._append_log(f"data sync error: {error}\n")
+
+    def _data_epoch(self) -> float:
+        newest = 0.0
+        for dirpath, _dirnames, filenames in os.walk(self.directory):
+            for name in filenames:
+                try:
+                    newest = max(newest, os.path.getmtime(os.path.join(dirpath, name)))
+                except OSError:
+                    pass
+        return newest
+
+    def _append_log(self, line: str) -> None:
+        with self._log_lock:
+            self.log_lines.append(f"{_iso_now()} {line}")
+
+    # -- lifecycle -----------------------------------------------------------
+    def run(self) -> int:
+        os.makedirs(self.directory, exist_ok=True)
+        data_remote = os.path.join(self.remote, "data")
+        if os.path.isdir(data_remote):
+            storage_transfer(data_remote, self.directory)
+
+        env = dict(os.environ)
+        env["TPU_WORKER_ID"] = str(self.worker_id)
+        env["TPU_TASK_MACHINE_IDENTITY"] = self.machine_id
+
+        remaining = None
+        if self.timeout_epoch > 0:
+            remaining = self.timeout_epoch - time.time()
+            if remaining < 1:
+                remaining = 1
+
+        process = subprocess.Popen(
+            ["/bin/bash", self.script_path],
+            cwd=self.directory, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            start_new_session=True,
+        )
+
+        threads = [
+            threading.Thread(target=self._log_loop, daemon=True),
+            threading.Thread(target=self._data_loop, daemon=True),
+        ]
+        for thread in threads:
+            thread.start()
+
+        reader = threading.Thread(target=self._read_output, args=(process,), daemon=True)
+        reader.start()
+
+        timed_out = False
+        try:
+            process.wait(timeout=remaining)
+        except subprocess.TimeoutExpired:
+            timed_out = True
+            try:
+                os.killpg(process.pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+            try:
+                process.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                os.killpg(process.pid, signal.SIGKILL)
+                process.wait()
+
+        reader.join(timeout=5)
+        self._done.set()
+        for thread in threads:
+            thread.join(timeout=5)
+
+        # Status report (tpl:51): timeout has result "timeout" and no code.
+        if timed_out:
+            report = {"result": "timeout", "code": "", "status": ""}
+        else:
+            code = process.returncode
+            report = {
+                "result": "exit-code" if code else "success",
+                "code": str(code),
+                "status": str(code),
+            }
+        self._sync_logs()
+        self._write_report("status", json.dumps(report))
+        if self.worker_id == 0:
+            try:
+                storage_sync(self.directory, data_remote)
+            except Exception as error:
+                self._append_log(f"final data sync error: {error}\n")
+                self._sync_logs()
+            # Self-destruct signal: the control plane scales the group to zero
+            # when it sees this marker (the hermetic `leo stop` equivalent).
+            with open(os.path.join(self.remote, "shutdown"), "w") as handle:
+                handle.write(self.machine_id)
+        return process.returncode or 0
+
+    def _read_output(self, process: subprocess.Popen) -> None:
+        assert process.stdout is not None
+        for raw in process.stdout:
+            self._append_log(raw.decode(errors="replace"))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--remote", required=True, help="bucket directory")
+    parser.add_argument("--directory", required=True, help="task working directory")
+    parser.add_argument("--script", required=True, help="task script path")
+    parser.add_argument("--machine-id", default="")
+    parser.add_argument("--timeout", type=float, default=0.0, help="absolute epoch")
+    parser.add_argument("--log-period", type=float, default=5.0)
+    parser.add_argument("--data-period", type=float, default=10.0)
+    parser.add_argument("--worker-id", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    machine_id = args.machine_id or f"{uuid.uuid4()}-worker{args.worker_id}"
+    agent = Agent(
+        remote=args.remote, directory=args.directory, script_path=args.script,
+        machine_id=machine_id, timeout_epoch=args.timeout,
+        log_period=args.log_period, data_period=args.data_period,
+        worker_id=args.worker_id,
+    )
+    return agent.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

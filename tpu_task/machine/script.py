@@ -1,0 +1,52 @@
+"""Worker bootstrap-script renderer.
+
+Parity with /root/reference/task/common/machine/script.go:22-60: embed the
+user task script (base64), environment variables, credentials exports, and an
+absolute timeout epoch into the worker bootstrap template. The template itself
+is the TPU-VM replacement for the reference's cloud-init payload (see
+templates/tpu-worker-script.sh.tpl).
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+import shlex
+from datetime import datetime
+from typing import Dict, Optional
+
+from tpu_task.common.values import Variables
+
+_TEMPLATE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "templates", "tpu-worker-script.sh.tpl"
+)
+
+
+def render_script(
+    script: str,
+    credentials: Dict[str, str],
+    variables: Variables,
+    timeout: Optional[datetime],
+) -> str:
+    """Render the worker bootstrap script (machine.Script equivalent)."""
+    timeout_string = "infinity" if timeout is None else str(int(timeout.timestamp()))
+
+    environment = ""
+    for name, value in variables.enrich().items():
+        escaped = value.replace('"', '\\"')
+        environment += f'{name}="{escaped}"\n'
+
+    export_credentials = ""
+    for name, value in credentials.items():
+        export_credentials += "export " + shlex.quote(f"{name}={value}") + "\n"
+
+    with open(_TEMPLATE_PATH) as handle:
+        template = handle.read()
+
+    return (
+        template
+        .replace("@TASK_SCRIPT@", base64.b64encode(script.encode()).decode())
+        .replace("@VARIABLES@", base64.b64encode(environment.encode()).decode())
+        .replace("@CREDENTIALS@", base64.b64encode(export_credentials.encode()).decode())
+        .replace("@TIMEOUT@", timeout_string)
+    )

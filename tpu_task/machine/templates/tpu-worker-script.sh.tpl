@@ -1,0 +1,117 @@
+#!/bin/bash
+# tpu-task worker bootstrap — runs as the startup script on every TPU-VM
+# worker of a slice. Semantics mirror the reference on-VM agent
+# (/root/reference/task/common/machine/machine-script.sh.tpl): install the
+# task as a supervised systemd unit with a hard runtime countdown, restore
+# the workdir from the bucket, stream logs/status back, self-destruct at
+# exit — with TPU-first replacements: jax[tpu] instead of NVIDIA drivers,
+# the TPU metadata server for worker identity, and the tpu-task data-plane
+# CLI instead of rclone.
+
+sudo mkdir --parents /opt/task/directory
+chmod u=rwx,g=rwx,o=rwx /opt/task/directory
+
+base64 --decode << END | sudo tee /usr/bin/tpu-task-script > /dev/null
+@TASK_SCRIPT@
+END
+chmod u=rwx,g=rx,o=rx /usr/bin/tpu-task-script
+
+sudo tee /usr/bin/tpu-task-shutdown << 'END' > /dev/null
+#!/bin/bash
+# Grace period, then wait for in-flight transfers to drain before the
+# self-destruct call scales this slice to zero.
+sleep 20; while pgrep -f "tpu-task storage" > /dev/null; do sleep 1; done
+source /opt/task/credentials
+if test "${TPU_WORKER_ID:-0}" != "0"; then exit 0; fi
+(systemctl is-system-running | grep stopping) || tpu-task stop --cloud="$TPU_TASK_CLOUD_PROVIDER" --region="$TPU_TASK_CLOUD_REGION" "$TPU_TASK_IDENTIFIER"
+END
+chmod u=rwx,g=rx,o=rx /usr/bin/tpu-task-shutdown
+
+base64 --decode << END | sudo tee /opt/task/variables > /dev/null
+@VARIABLES@
+END
+base64 --decode << END | sudo tee /opt/task/credentials > /dev/null
+@CREDENTIALS@
+END
+chmod u=rw,g=,o= /opt/task/variables
+chmod u=rw,g=,o= /opt/task/credentials
+
+source /opt/task/credentials
+
+# TPU worker identity from the metadata server: rank + slice topology, so the
+# user script can call jax.distributed.initialize() without any extra wiring.
+TPU_METADATA="http://metadata.google.internal/computeMetadata/v1/instance/attributes"
+export TPU_WORKER_ID="$(curl --silent --header 'Metadata-Flavor: Google' $TPU_METADATA/agent-worker-number || echo 0)"
+export TPU_WORKER_HOSTNAMES="$(curl --silent --header 'Metadata-Flavor: Google' $TPU_METADATA/worker-network-endpoints | tr ',' '\n' | cut -d: -f3 | paste -sd, - || true)"
+export TPU_TASK_MACHINE_IDENTITY="$(uuidgen)-worker$TPU_WORKER_ID"
+{
+  echo "export TPU_WORKER_ID=$TPU_WORKER_ID"
+  echo "export TPU_WORKER_HOSTNAMES=$TPU_WORKER_HOSTNAMES"
+  echo "export TPU_TASK_MACHINE_IDENTITY=$TPU_TASK_MACHINE_IDENTITY"
+} | sudo tee --append /opt/task/credentials > /dev/null
+
+TPU_TASK_LOG_DIRECTORY="$(mktemp --directory)"
+TPU_TASK_DATA_DIRECTORY="/opt/task/directory"
+
+TPU_TASK_START_COMMAND="/bin/bash -lc 'exec /usr/bin/tpu-task-script'"
+TPU_TASK_REMAINING_RUN_TIME=$((@TIMEOUT@-$(date +%s)))
+if (( TPU_TASK_REMAINING_RUN_TIME < 1 )); then
+  TPU_TASK_START_COMMAND="/bin/bash -c 'sleep infinity'"
+  TPU_TASK_REMAINING_RUN_TIME=1
+fi
+
+sudo tee /etc/systemd/system/tpu-task.service > /dev/null <<END
+[Unit]
+  After=default.target
+[Service]
+  Type=simple
+  ExecStart=-$TPU_TASK_START_COMMAND
+  ExecStop=/bin/bash -c 'source /opt/task/credentials; systemctl is-system-running | grep stopping || echo "{\\\\"result\\\\": \\\\"\$SERVICE_RESULT\\\\", \\\\"code\\\\": \\\\"\$EXIT_STATUS\\\\", \\\\"status\\\\": \\\\"\$EXIT_CODE\\\\"}" > "$TPU_TASK_LOG_DIRECTORY/status-$TPU_TASK_MACHINE_IDENTITY" && tpu-task storage copy "$TPU_TASK_LOG_DIRECTORY" "\$TPU_TASK_REMOTE/reports"'
+  ExecStopPost=/usr/bin/tpu-task-shutdown
+  Environment=HOME=/root
+  EnvironmentFile=/opt/task/variables
+  WorkingDirectory=/opt/task/directory
+  RuntimeMaxSec=$TPU_TASK_REMAINING_RUN_TIME
+[Install]
+  WantedBy=default.target
+END
+
+# Install the tpu-task agent (data plane + self-destruct CLI) and JAX for TPU.
+if ! command -v tpu-task 2>&1 > /dev/null; then
+  python3 -m pip install --quiet tpu-task || pip install --quiet tpu-task
+fi
+if ! python3 -c 'import jax' 2> /dev/null; then
+  python3 -m pip install --quiet 'jax[tpu]' --find-links https://storage.googleapis.com/jax-releases/libtpu_releases.html
+fi
+
+# Restore the workdir from the bucket: a respawned (preempted) worker resumes
+# from the last synced checkpoint.
+tpu-task storage copy "$TPU_TASK_REMOTE/data" /opt/task/directory
+
+sudo systemctl daemon-reload
+sudo systemctl enable tpu-task.service --now
+sudo systemctl disable --now apt-daily.timer 2> /dev/null || true
+
+# Log stream: journald task unit → reports/task-{machine}, every 5 s on change.
+while sleep 5; do
+  test -n "$TPU_TASK_MACHINE_LOGS" && journalctl > "$TPU_TASK_LOG_DIRECTORY/machine-$TPU_TASK_MACHINE_IDENTITY"
+  journalctl --all --no-hostname --output=short-iso --quiet --unit=tpu-task --utc | sed 's/^\([0-9-]*\)T\([0-9:]*\)+0000 \S*: \(.*\)/\1T\2Z \3/g' > "$TPU_TASK_LOG_DIRECTORY/task-$TPU_TASK_MACHINE_IDENTITY"
+  NEW_TPU_TASK_LOG_HASH="$(md5sum "$TPU_TASK_LOG_DIRECTORY"/*)"
+  if test "$NEW_TPU_TASK_LOG_HASH" != "$TPU_TASK_LOG_HASH"; then
+    TPU_TASK_LOG_HASH="$NEW_TPU_TASK_LOG_HASH"
+    tpu-task storage sync "$TPU_TASK_LOG_DIRECTORY" "$TPU_TASK_REMOTE/reports"
+  fi
+done &
+
+# Data/checkpoint stream: workdir → bucket, every 10 s when mtimes change.
+# Only worker 0 uploads (all workers share one bucket; checkpoints are
+# written via the task library with per-worker sharding when needed).
+if test "${TPU_WORKER_ID:-0}" = "0"; then
+  while sleep 10; do
+    NEW_TPU_TASK_DATA_EPOCH="$(find "$TPU_TASK_DATA_DIRECTORY" -printf "%T@\n" | sort | tail -1)"
+    if test "$NEW_TPU_TASK_DATA_EPOCH" != "$TPU_TASK_DATA_EPOCH"; then
+      TPU_TASK_DATA_EPOCH="$NEW_TPU_TASK_DATA_EPOCH"
+      tpu-task storage sync "$TPU_TASK_DATA_DIRECTORY" "$TPU_TASK_REMOTE/data"
+    fi
+  done &
+fi

@@ -1,0 +1,30 @@
+from tpu_task.storage.backends import (
+    BACKEND_AZUREBLOB,
+    BACKEND_GCS,
+    BACKEND_LOCAL,
+    BACKEND_S3,
+    Connection,
+    open_backend,
+)
+from tpu_task.storage.filters import (
+    DEFAULT_TRANSFER_EXCLUDES,
+    FilterSet,
+    compile_exclude_list,
+    limit_transfer,
+)
+from tpu_task.storage.sync import (
+    check_storage,
+    delete_storage,
+    logs,
+    reports,
+    status,
+    sync,
+    transfer,
+)
+
+__all__ = [
+    "BACKEND_AZUREBLOB", "BACKEND_GCS", "BACKEND_LOCAL", "BACKEND_S3",
+    "Connection", "open_backend",
+    "DEFAULT_TRANSFER_EXCLUDES", "FilterSet", "compile_exclude_list", "limit_transfer",
+    "check_storage", "delete_storage", "logs", "reports", "status", "sync", "transfer",
+]

@@ -1,0 +1,177 @@
+"""Rclone-style include/exclude filter rules.
+
+The reference's entire data plane rides on rclone's filter semantics
+(/root/reference/task/common/machine/storage.go:123-159 and the fixture tests
+at storage_test.go:55-101). This module reimplements the subset TPI relies on:
+
+* ordered rules, each ``"+ pattern"`` (include) or ``"- pattern"`` (exclude);
+  the FIRST matching rule wins; a path matching no rule is included;
+* ``*`` matches within a path segment, ``**`` across segments, ``?`` one
+  non-separator character, ``[seq]`` character classes, ``{a,b}`` alternation;
+* a pattern starting with ``/`` is anchored at the transfer root; otherwise it
+  matches at any depth (tail match);
+* bare (non ``+/-``) exclude-list entries are implicitly anchored:
+  ``a.txt`` → ``- /a.txt`` (storage.go:130-135).
+
+Default excludes mirror defaultTransferExcludes (storage.go:37-41).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Iterable, List, Sequence
+
+DEFAULT_TRANSFER_EXCLUDES = [
+    "- /main.tf",
+    "- /terraform.tfstate*",
+    "- /.terraform**",
+]
+
+
+def is_filter_rule(rule: str) -> bool:
+    return rule.startswith("+ ") or rule.startswith("- ")
+
+
+def _glob_to_regex(pattern: str) -> str:
+    """Translate an rclone glob to a regex fragment (no anchors)."""
+    out: List[str] = []
+    i = 0
+    n = len(pattern)
+    while i < n:
+        c = pattern[i]
+        if c == "*":
+            if i + 1 < n and pattern[i + 1] == "*":
+                out.append(".*")
+                i += 2
+            else:
+                out.append("[^/]*")
+                i += 1
+        elif c == "?":
+            out.append("[^/]")
+            i += 1
+        elif c == "[":
+            j = i + 1
+            if j < n and pattern[j] in "!^":
+                j += 1
+            if j < n and pattern[j] == "]":
+                j += 1
+            while j < n and pattern[j] != "]":
+                j += 1
+            if j >= n:
+                out.append(re.escape(c))
+                i += 1
+            else:
+                inner = pattern[i + 1:j]
+                if inner.startswith("!"):
+                    inner = "^" + inner[1:]
+                out.append("[" + inner + "]")
+                i = j + 1
+        elif c == "{":
+            j = pattern.find("}", i)
+            if j == -1:
+                out.append(re.escape(c))
+                i += 1
+            else:
+                options = pattern[i + 1:j].split(",")
+                out.append("(?:" + "|".join(_glob_to_regex(o) for o in options) + ")")
+                i = j + 1
+        else:
+            out.append(re.escape(c))
+            i += 1
+    return "".join(out)
+
+
+@dataclass
+class Rule:
+    include: bool
+    pattern: str
+    _file_re: re.Pattern = None  # type: ignore[assignment]
+    _dir_re: re.Pattern = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        pattern = self.pattern
+        directory_only = pattern.endswith("/")
+        if directory_only:
+            pattern = pattern[:-1]
+        if pattern.startswith("/"):
+            prefix = ""
+            pattern = pattern[1:]
+        else:
+            prefix = "(?:.*/)?"
+        body = prefix + _glob_to_regex(pattern)
+        object.__setattr__(self, "_dir_re", re.compile(body + "/?$"))
+        if directory_only:
+            # Directory-only rules match files under the directory.
+            object.__setattr__(self, "_file_re", re.compile(body + "/.*$"))
+        else:
+            object.__setattr__(self, "_file_re", re.compile(body + "$"))
+
+    def matches_file(self, path: str) -> bool:
+        return bool(self._file_re.match(path))
+
+    def matches_dir(self, path: str) -> bool:
+        return bool(self._dir_re.match(path))
+
+
+class FilterSet:
+    """An ordered set of rclone-style rules with first-match-wins semantics."""
+
+    def __init__(self, rules: Iterable[str] = ()):  # raw "+ x" / "- x" strings
+        self.rules: List[Rule] = []
+        for raw in rules:
+            self.add_rule(raw)
+
+    def add_rule(self, raw: str) -> None:
+        if not is_filter_rule(raw):
+            raise ValueError(f"malformed filter rule (want '+ x' or '- x'): {raw!r}")
+        self.rules.append(Rule(include=raw.startswith("+ "), pattern=raw[2:]))
+
+    def includes_file(self, path: str) -> bool:
+        """Decide a file path (relative, no leading slash). Default: include."""
+        path = path.lstrip("/")
+        for rule in self.rules:
+            if rule.matches_file(path):
+                return rule.include
+        return True
+
+    def includes_dir(self, path: str) -> bool:
+        """Decide whether a directory itself transfers (for empty dirs).
+
+        A directory is excluded only when an exclude rule matches the
+        directory path itself; rclone still creates directories whose names
+        don't match any exclude (storage_test.go:70-74: ``- **.txt`` keeps
+        ``/temp``).
+        """
+        path = path.strip("/")
+        if not path:
+            return True
+        for rule in self.rules:
+            if rule.matches_dir(path):
+                return rule.include
+        return True
+
+
+def compile_exclude_list(exclude: Sequence[str] = (), with_defaults: bool = True) -> FilterSet:
+    """Build a FilterSet from a user exclude-list (storage.go:126-138).
+
+    Entries already shaped like rclone rules pass through; bare entries are
+    implicitly anchored excludes (``a.txt`` → ``- /a.txt``).
+    """
+    rules = list(DEFAULT_TRANSFER_EXCLUDES) if with_defaults else []
+    for entry in exclude or ():
+        if not is_filter_rule(entry):
+            entry = "- /" + entry.lstrip("/")
+        rules.append(entry)
+    return FilterSet(rules)
+
+
+def limit_transfer(subdir: str, rules: Sequence[str]) -> List[str]:
+    """Restrict a rule list so only ``subdir`` transfers (storage.go:265-280)."""
+    import posixpath
+
+    dir_ = posixpath.normpath(subdir or ".")
+    if dir_ in (".", "", "/"):
+        return list(rules)
+    dir_ = "/" + dir_.strip("/")
+    return list(rules) + [f"+ {dir_}", f"+ {dir_}/**", "- /**"]

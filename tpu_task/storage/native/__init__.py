@@ -1,0 +1,58 @@
+"""ctypes loader for the native parallel-copy core, with graceful fallback.
+
+Builds ``fastcopy.cpp`` with g++ on first use (cached next to the source);
+if no toolchain is available the Python fallback in ``sync.py`` is used.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SOURCE = os.path.join(_HERE, "fastcopy.cpp")
+_LIBRARY = os.path.join(_HERE, "libfastcopy.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_failed = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _failed
+    with _lock:
+        if _lib is not None or _failed:
+            return _lib
+        try:
+            if (not os.path.exists(_LIBRARY)
+                    or os.path.getmtime(_LIBRARY) < os.path.getmtime(_SOURCE)):
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-pthread", "-std=c++17",
+                     "-o", _LIBRARY, _SOURCE],
+                    check=True, capture_output=True, timeout=120,
+                )
+            lib = ctypes.CDLL(_LIBRARY)
+            lib.tpu_task_copy_files.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+            lib.tpu_task_copy_files.restype = ctypes.c_int
+            _lib = lib
+        except Exception:
+            _failed = True
+        return _lib
+
+
+def copy_files(pairs: List[Tuple[str, str]], threads: int = 8) -> bool:
+    """Copy (src, dst) file pairs in parallel. Returns False if unavailable;
+    raises on partial failure so callers never silently lose data."""
+    lib = _load()
+    if lib is None or not pairs:
+        return lib is not None
+    flat = b"".join(
+        src.encode() + b"\0" + dst.encode() + b"\0" for src, dst in pairs
+    )
+    failures = lib.tpu_task_copy_files(flat, len(pairs), threads)
+    if failures:
+        raise OSError(f"native copy failed for {failures}/{len(pairs)} files")
+    return True

@@ -1,0 +1,151 @@
+"""Data plane: directory transfer, log/status mailbox, storage lifecycle.
+
+Parity with /root/reference/task/common/machine/storage.go — the bucket is the
+*only* communication channel between the orchestrator and the machines running
+the task (SURVEY.md §2.9):
+
+* ``transfer``  — filtered directory copy (storage.go:123-159);
+* ``sync``      — filtered mirror incl. deletions (the on-worker agent loops);
+* ``reports``   — read ``reports/{prefix}-*`` blobs (storage.go:58-93);
+* ``logs``      — task log blobs, one per machine (storage.go:95-97);
+* ``status``    — fold ``reports/status-*`` JSON into counters (storage.go:99-121);
+* ``delete_storage`` / ``check_storage`` — lifecycle (storage.go:161-186, 214-225).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Dict, List, Optional, Sequence
+
+from tpu_task.common.errors import ResourceNotFoundError
+from tpu_task.common.values import Status, StatusCode
+from tpu_task.storage import native
+from tpu_task.storage.backends import Backend, Connection, LocalBackend, open_backend
+from tpu_task.storage.filters import FilterSet, compile_exclude_list, limit_transfer
+
+logger = logging.getLogger("tpu_task")
+
+__all__ = [
+    "transfer", "sync", "reports", "logs", "status", "delete_storage",
+    "check_storage", "Connection", "limit_transfer",
+]
+
+
+def _copy_files(source: Backend, destination: Backend, keys: Sequence[str]) -> None:
+    src_root, dst_root = source.local_root(), destination.local_root()
+    if src_root is not None and dst_root is not None:
+        pairs = [(os.path.join(src_root, key), os.path.join(dst_root, key)) for key in keys]
+        try:
+            if native.copy_files(pairs):
+                return
+        except OSError as error:
+            logger.warning("native copy failed (%s); falling back to python copy", error)
+    for key in keys:
+        destination.write(key, source.read(key))
+
+
+def _transfer(source_remote: str, destination_remote: str, filters: FilterSet,
+              delete_extraneous: bool) -> None:
+    source, _ = open_backend(source_remote)
+    destination, _ = open_backend(destination_remote)
+
+    keys = [key for key in source.list() if filters.includes_file(key)]
+    total_size = 0
+    src_root = source.local_root()
+    if src_root is not None:
+        for key in keys:
+            try:
+                total_size += os.path.getsize(os.path.join(src_root, key))
+            except OSError:
+                pass
+    logger.info("Transferring %.1fMB (%d files)...", total_size / 1e6, len(keys))
+
+    # Mirror directory structure (incl. empty dirs) exactly like rclone's
+    # CopyDir with createEmptySrcDirs=true (storage.go:158).
+    for dir_key in source.listdirs():
+        if filters.includes_dir(dir_key):
+            destination.makedir(dir_key)
+
+    _copy_files(source, destination, keys)
+
+    if delete_extraneous:
+        wanted = set(keys)
+        for key in destination.list():
+            if key not in wanted and filters.includes_file(key):
+                destination.delete(key)
+        if isinstance(destination, LocalBackend):
+            destination.remove_empty_dirs()
+
+
+def transfer(source: str, destination: str, exclude: Sequence[str] = ()) -> None:
+    """Filtered directory copy; exclude entries are bare paths or rclone rules."""
+    _transfer(source, destination, compile_exclude_list(exclude), delete_extraneous=False)
+
+
+def sync(source: str, destination: str, exclude: Sequence[str] = ()) -> None:
+    """Filtered mirror: like transfer, but removes extraneous destination files."""
+    _transfer(source, destination, compile_exclude_list(exclude), delete_extraneous=True)
+
+
+def reports(remote: str, prefix: str) -> List[str]:
+    """Read every ``reports/{prefix}-*`` blob (one per machine)."""
+    backend, _ = open_backend(remote)
+    out: List[str] = []
+    for key in backend.list("reports"):
+        base = key.rsplit("/", 1)[-1]
+        if base.startswith(prefix + "-"):
+            out.append(backend.read(key).decode(errors="replace"))
+    return out
+
+
+def logs(remote: str) -> List[str]:
+    return reports(remote, "task")
+
+
+def status(remote: str, initial_status: Optional[Status] = None) -> Status:
+    """Fold per-machine status JSONs into {running, succeeded, failed} counters.
+
+    The on-worker agent writes ``{"result": $SERVICE_RESULT, "code":
+    $EXIT_STATUS, "status": $EXIT_CODE}`` on task exit
+    (machine-script.sh.tpl:51); keys are matched case-insensitively like Go's
+    encoding/json.
+    """
+    result: Status = dict(initial_status or {})
+    for report in reports(remote, "status"):
+        try:
+            payload = {key.lower(): value for key, value in json.loads(report).items()}
+        except (json.JSONDecodeError, AttributeError) as error:
+            raise ValueError(f"malformed status report: {report!r}") from error
+        code = str(payload.get("code", "") or "")
+        if code:
+            if code == "0":
+                result[StatusCode.SUCCEEDED] = result.get(StatusCode.SUCCEEDED, 0) + 1
+            else:
+                result[StatusCode.FAILED] = result.get(StatusCode.FAILED, 0) + 1
+        elif payload.get("result") == "timeout":
+            result[StatusCode.FAILED] = result.get(StatusCode.FAILED, 0) + 1
+    return result
+
+
+def delete_storage(remote: str) -> None:
+    """Empty the remote (all objects, then empty dirs)."""
+    backend, _ = open_backend(remote)
+    if not backend.exists():
+        raise ResourceNotFoundError(remote)
+    for key in backend.list():
+        backend.delete(key)
+    if isinstance(backend, LocalBackend):
+        backend.remove_empty_dirs()
+
+
+def check_storage(remote: str) -> None:
+    """Verify the remote is accessible by attempting to list it (storage.go:214-225)."""
+    backend, _ = open_backend(remote)
+    try:
+        backend.list()
+    except ResourceNotFoundError:
+        pass
+    except Exception as error:
+        raise RuntimeError(f"failed to access remote storage: {error}") from error
