@@ -1,0 +1,181 @@
+"""Hermetic full-lifecycle tests driving the L4 Task interface — the shape of
+the reference's smoke test (task_smoke_test.go:162-243) with its deliberate
+double-invoke idempotency checks, but runnable with zero cloud credentials
+against the local fake control plane. Also the preemption-recovery test the
+reference cannot express hermetically (SURVEY.md §4)."""
+
+import time
+import uuid
+
+import pytest
+
+from tpu_task.common.cloud import Cloud, Provider
+from tpu_task.common.identifier import Identifier
+from tpu_task.common.values import Environment, StatusCode, Task as TaskSpec, Variables
+from tpu_task import task as task_factory
+
+
+@pytest.fixture
+def cloud(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPU_TASK_LOCAL_ROOT", str(tmp_path / "control-plane"))
+    monkeypatch.setenv("TPU_TASK_LOCAL_LOG_PERIOD", "0.1")
+    monkeypatch.setenv("TPU_TASK_LOCAL_DATA_PERIOD", "0.1")
+    return Cloud(provider=Provider.LOCAL)
+
+
+def poll(task, predicate, timeout=30.0, period=0.2):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        task.read()
+        if predicate(task):
+            return
+        time.sleep(period)
+    raise AssertionError(
+        f"condition not reached; status={task.status()} logs={task.logs()}")
+
+
+def succeeded(task):
+    return task.status().get(StatusCode.SUCCEEDED, 0) >= 1
+
+
+def failed(task):
+    return task.status().get(StatusCode.FAILED, 0) >= 1
+
+
+def test_full_lifecycle_with_idempotency(cloud, tmp_path):
+    """delete → create → create → logs sentinel → status → delete → delete."""
+    sentinel = str(uuid.uuid4())
+    workdir = tmp_path / "work"
+    (workdir / "cache").mkdir(parents=True)
+    (workdir / "cache" / "junk.bin").write_text("excluded")
+    (workdir / "input.txt").write_text("payload-42")
+
+    spec = TaskSpec()
+    spec.environment = Environment(
+        script=f"#!/bin/bash\ncat input.txt\necho {sentinel} $SENTINEL_VAR\n"
+               "mkdir -p output && echo done > output/result.txt\n",
+        variables=Variables({"SENTINEL_VAR": sentinel[:8]}),
+        directory=str(workdir),
+        directory_out="output",
+        exclude_list=["cache/**"],
+    )
+    identifier = Identifier.deterministic("lifecycle-test")
+    task = task_factory.new(cloud, identifier, spec)
+
+    task.delete()          # delete before create: must tolerate NotFound
+    task.create()
+    task.create()          # double-invoke: idempotent
+
+    assert identifier in task_factory.list_tasks(cloud)
+
+    poll(task, succeeded)
+    logs = "".join(task.logs())
+    assert sentinel in logs                 # workdir round-trip + script ran
+    assert sentinel[:8] in logs             # env-var injection
+    assert "payload-42" in logs             # input file present
+
+    task.delete()
+    # Pull-on-delete: output/ downloaded, cache/ still excluded from upload.
+    assert (workdir / "output" / "result.txt").read_text() == "done\n"
+    task.delete()          # double delete: tolerated
+    assert identifier not in task_factory.list_tasks(cloud)
+
+
+def test_failing_task_reports_failed(cloud):
+    spec = TaskSpec()
+    spec.environment = Environment(script="#!/bin/bash\nexit 7\n")
+    task = task_factory.new(cloud, Identifier.deterministic("fail-test"), spec)
+    task.create()
+    try:
+        poll(task, failed)
+        status = task.status()
+        assert status.get(StatusCode.FAILED, 0) == 1
+        assert status.get(StatusCode.SUCCEEDED, 0) == 0
+    finally:
+        task.delete()
+
+
+def test_stop_scales_to_zero(cloud):
+    spec = TaskSpec()
+    spec.environment = Environment(script="#!/bin/bash\nsleep 300\n")
+    task = task_factory.new(cloud, Identifier.deterministic("stop-test"), spec)
+    task.create()
+    try:
+        poll(task, lambda t: t.status().get(StatusCode.ACTIVE, 0) == 1, timeout=10)
+        task.stop()
+        poll(task, lambda t: t.status().get(StatusCode.ACTIVE, 0) == 0, timeout=10)
+        assert task.group.desired() == 0
+    finally:
+        task.delete()
+
+
+def test_self_destruct_on_completion(cloud):
+    """Worker 0 leaves the shutdown marker; the control plane scales to 0 —
+    the `leo stop` self-destruct cycle (machine-script.sh.tpl:10-14)."""
+    spec = TaskSpec()
+    spec.environment = Environment(script="#!/bin/bash\necho quick\n")
+    task = task_factory.new(cloud, Identifier.deterministic("selfdestruct"), spec)
+    task.create()
+    try:
+        poll(task, lambda t: succeeded(t) and t.group.desired() == 0)
+        events = [event.code for event in task.events()]
+        assert "self-destruct" in events
+    finally:
+        task.delete()
+
+
+def test_preemption_recovery_resumes_from_checkpoint(cloud):
+    """Kill a worker mid-task; the reconciler respawns it and the respawned
+    machine restores the bucket checkpoint — ASG spot-recovery semantics
+    (resource_auto_scaling_group.go:64-90) made hermetic and observable."""
+    script = (
+        "#!/bin/bash\n"
+        "if test -f checkpoint; then\n"
+        "  echo resumed-from-$(cat checkpoint)\n"
+        "else\n"
+        "  echo cold-start\n"
+        "  echo epoch-3 > checkpoint\n"
+        "  sync\n"
+        "  sleep 300\n"       # preempted during this sleep
+        "fi\n"
+    )
+    spec = TaskSpec()
+    spec.environment = Environment(script=script)
+    task = task_factory.new(cloud, Identifier.deterministic("preempt-test"), spec)
+    task.create()
+    try:
+        # Wait until the checkpoint reaches the bucket.
+        poll(task, lambda t: "cold-start" in "".join(t.logs()), timeout=15)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            import os
+            if os.path.exists(os.path.join(task.group.bucket, "data", "checkpoint")):
+                break
+            time.sleep(0.1)
+
+        task.preempt(0)
+        poll(task, succeeded, timeout=30)
+        logs = "".join(task.logs())
+        assert "resumed-from-epoch-3" in logs
+        preempt_events = [e.code for e in task.events()]
+        assert "preempt" in preempt_events
+        assert preempt_events.count("launch") >= 2    # original + respawn
+    finally:
+        task.delete()
+
+
+def test_parallelism_runs_n_workers(cloud):
+    spec = TaskSpec()
+    spec.parallelism = 3
+    spec.environment = Environment(script="#!/bin/bash\necho worker-$TPU_WORKER_ID\n")
+    task = task_factory.new(cloud, Identifier.deterministic("parallel-test"), spec)
+    task.create()
+    try:
+        poll(task, lambda t: t.status().get(StatusCode.SUCCEEDED, 0)
+             + t.status().get(StatusCode.FAILED, 0) >= 3, timeout=30)
+        logs = "".join(task.logs())
+        for rank in range(3):
+            assert f"worker-{rank}" in logs
+        assert task.status().get(StatusCode.SUCCEEDED, 0) == 3
+    finally:
+        task.delete()

@@ -1,0 +1,4 @@
+from tpu_task.backends.local.control_plane import MachineGroup, list_groups, local_root
+from tpu_task.backends.local.task import LocalTask, list_local_tasks
+
+__all__ = ["LocalTask", "MachineGroup", "list_groups", "list_local_tasks", "local_root"]

@@ -1,0 +1,303 @@
+"""Hermetic machine-group control plane: a deterministic in-process "ASG".
+
+The reference delegates N-way replication and spot recovery to cloud scaling
+groups (SURVEY.md §2.9) and therefore cannot test them hermetically — the gap
+SURVEY.md §4 calls out. This module is the local equivalent of a scaling
+group: a desired-capacity machine group whose machines are detached
+``local_agent`` subprocesses ("subprocess VMs"), reconciled to the desired
+size on every observation, with preemption (kill) + automatic respawn +
+bucket-restore, self-destruct markers, and an event log.
+
+All state lives under ``{root}/{identifier}/`` so independent CLI invocations
+(create / read / stop / delete) observe the same group, like real cloud
+control planes do.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+import uuid
+from dataclasses import dataclass, field
+from datetime import datetime, timezone
+from typing import Dict, List, Optional
+
+from tpu_task.common.errors import ResourceNotFoundError
+
+DEFAULT_ROOT = os.path.expanduser("~/.tpu-task/local")
+
+
+def local_root() -> str:
+    return os.environ.get("TPU_TASK_LOCAL_ROOT", DEFAULT_ROOT)
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except (ProcessLookupError, PermissionError):
+        return False
+    # A zombie answers kill(0) but is dead; treat it as such or reconcile
+    # would count it against desired capacity forever.
+    try:
+        with open(f"/proc/{pid}/stat") as handle:
+            return handle.read().split()[2] != "Z"
+    except OSError:
+        return True
+
+
+# The worker agent is an orchestrator process: it must never initialize an
+# accelerator. Some environments install accelerator bootstrap hooks into
+# every Python interpreter (sitecustomize on PYTHONPATH keyed on env vars);
+# scrub those for the agent and let it restore them for the user task script,
+# which may legitimately need the TPU.
+ACCELERATOR_BOOTSTRAP_VARS = ("PALLAS_AXON_POOL_IPS",)
+SCRUB_SAVED_PREFIX = "TPU_TASK_SAVED_"
+
+
+def scrub_accelerator_env(env: Dict[str, str]) -> Dict[str, str]:
+    for name in ACCELERATOR_BOOTSTRAP_VARS:
+        if name in env:
+            env[SCRUB_SAVED_PREFIX + name] = env.pop(name)
+    return env
+
+
+def restore_accelerator_env(env: Dict[str, str]) -> Dict[str, str]:
+    for key in [k for k in env if k.startswith(SCRUB_SAVED_PREFIX)]:
+        env[key[len(SCRUB_SAVED_PREFIX):]] = env.pop(key)
+    return env
+
+
+@dataclass
+class Worker:
+    index: int
+    pid: int
+    machine_id: str
+    started_at: float
+
+
+@dataclass
+class GroupState:
+    desired: int = 0
+    parallelism: int = 1
+    timeout_epoch: float = 0.0
+    environment: Dict[str, str] = field(default_factory=dict)
+    log_period: float = 5.0
+    data_period: float = 10.0
+    workers: List[Worker] = field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return {
+            "desired": self.desired,
+            "parallelism": self.parallelism,
+            "timeout_epoch": self.timeout_epoch,
+            "environment": self.environment,
+            "log_period": self.log_period,
+            "data_period": self.data_period,
+            "workers": [worker.__dict__ for worker in self.workers],
+        }
+
+    @classmethod
+    def from_json(cls, payload: dict) -> "GroupState":
+        state = cls(
+            desired=payload.get("desired", 0),
+            parallelism=payload.get("parallelism", 1),
+            timeout_epoch=payload.get("timeout_epoch", 0.0),
+            environment=payload.get("environment", {}),
+            log_period=payload.get("log_period", 5.0),
+            data_period=payload.get("data_period", 10.0),
+        )
+        state.workers = [Worker(**worker) for worker in payload.get("workers", [])]
+        return state
+
+
+class MachineGroup:
+    """A desired-capacity group of subprocess VMs for one task identifier."""
+
+    def __init__(self, identifier: str, root: Optional[str] = None):
+        self.identifier = identifier
+        self.directory = os.path.join(root or local_root(), identifier)
+        self.bucket = os.path.join(self.directory, "bucket")
+        self.script_path = os.path.join(self.directory, "script.sh")
+        self._state_path = os.path.join(self.directory, "group.json")
+        self._events_path = os.path.join(self.directory, "events.log")
+
+    # -- persistence ---------------------------------------------------------
+    def exists(self) -> bool:
+        return os.path.exists(self._state_path)
+
+    def _load(self) -> GroupState:
+        if not self.exists():
+            raise ResourceNotFoundError(self.identifier)
+        with open(self._state_path) as handle:
+            return GroupState.from_json(json.load(handle))
+
+    def _store(self, state: GroupState) -> None:
+        os.makedirs(self.directory, exist_ok=True)
+        tmp = self._state_path + ".tmp"
+        with open(tmp, "w") as handle:
+            json.dump(state.to_json(), handle, indent=2)
+        os.replace(tmp, self._state_path)
+
+    def _log_event(self, code: str, description: str) -> None:
+        os.makedirs(self.directory, exist_ok=True)
+        stamp = datetime.now(timezone.utc).isoformat()
+        with open(self._events_path, "a") as handle:
+            handle.write(json.dumps({"time": stamp, "code": code,
+                                     "description": description}) + "\n")
+
+    def events(self) -> List[dict]:
+        if not os.path.exists(self._events_path):
+            return []
+        with open(self._events_path) as handle:
+            return [json.loads(line) for line in handle if line.strip()]
+
+    # -- lifecycle -----------------------------------------------------------
+    def create(self, script: str, parallelism: int, timeout_epoch: float,
+               environment: Dict[str, str], log_period: float = 5.0,
+               data_period: float = 10.0) -> None:
+        """Idempotent: AlreadyExists → no-op (the reference's discipline,
+        e.g. resource_bucket.go:64-67)."""
+        if self.exists():
+            return
+        os.makedirs(self.bucket, exist_ok=True)
+        with open(self.script_path, "w") as handle:
+            handle.write(script)
+        self._store(GroupState(
+            desired=0, parallelism=parallelism, timeout_epoch=timeout_epoch,
+            environment=environment, log_period=log_period, data_period=data_period,
+        ))
+        self._log_event("create", f"machine group created (parallelism={parallelism})")
+
+    def scale(self, desired: int) -> None:
+        state = self._load()
+        if state.desired != desired:
+            self._log_event("scale", f"desired capacity {state.desired} -> {desired}")
+        state.desired = desired
+        self._store(state)
+        self.reconcile()
+
+    def reconcile(self) -> GroupState:
+        """Converge live workers to the desired capacity.
+
+        This is the explicit reconciliation loop the reference gets "for
+        free" from ASG/MIG/VMSS (SURVEY.md §7 hard-part #1): prune dead
+        workers, honor the self-destruct marker, respawn up to desired
+        (each respawn restores the workdir from the bucket), kill extras.
+        """
+        state = self._load()
+
+        # Self-destruct marker written by worker 0 at task exit.
+        if os.path.exists(os.path.join(self.bucket, "shutdown")) and state.desired > 0:
+            self._log_event("self-destruct", "shutdown marker observed; scaling to 0")
+            state.desired = 0
+
+        alive: List[Worker] = []
+        for worker in state.workers:
+            if _pid_alive(worker.pid):
+                alive.append(worker)
+            else:
+                self._log_event("terminate", f"worker {worker.index} (pid {worker.pid}) exited")
+        state.workers = alive
+
+        while len(state.workers) > state.desired:
+            worker = state.workers.pop()
+            self._kill(worker)
+            self._log_event("scale-in", f"killed worker {worker.index} (pid {worker.pid})")
+
+        used_indices = {worker.index for worker in state.workers}
+        next_index = 0
+        while len(state.workers) < state.desired:
+            while next_index in used_indices:
+                next_index += 1
+            worker = self._spawn(state, next_index)
+            state.workers.append(worker)
+            used_indices.add(next_index)
+            self._log_event("launch", f"worker {worker.index} (pid {worker.pid}) launched")
+
+        self._store(state)
+        return state
+
+    def _spawn(self, state: GroupState, index: int) -> Worker:
+        workdir = os.path.join(self.directory, "workers", str(index))
+        os.makedirs(workdir, exist_ok=True)
+        machine_id = f"{uuid.uuid4().hex[:12]}-worker{index}"
+        env = dict(os.environ)
+        env.update(state.environment)
+        scrub_accelerator_env(env)
+        env["PYTHONPATH"] = os.pathsep.join(
+            filter(None, [os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))))),
+                env.get("PYTHONPATH", "")]))
+        agent_log = open(os.path.join(self.directory, "workers", f"{index}.agent.log"), "ab")
+        try:
+            process = subprocess.Popen(
+                [sys.executable, "-m", "tpu_task.machine.local_agent",
+                 "--remote", self.bucket,
+                 "--directory", workdir,
+                 "--script", self.script_path,
+                 "--machine-id", machine_id,
+                 "--timeout", str(state.timeout_epoch),
+                 "--log-period", str(state.log_period),
+                 "--data-period", str(state.data_period),
+                 "--worker-id", str(index)],
+                env=env, start_new_session=True,
+                stdout=agent_log, stderr=agent_log,
+            )
+        finally:
+            agent_log.close()
+        return Worker(index=index, pid=process.pid, machine_id=machine_id,
+                      started_at=time.time())
+
+    def _kill(self, worker: Worker) -> None:
+        try:
+            os.killpg(worker.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            try:
+                os.kill(worker.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+    def preempt(self, index: int = 0) -> None:
+        """Simulate a spot preemption: hard-kill one worker. The next
+        reconcile respawns it, restoring state from the bucket — the
+        hermetic equivalent of ASG spot-recovery."""
+        state = self._load()
+        for worker in state.workers:
+            if worker.index == index:
+                self._kill(worker)
+                self._log_event("preempt", f"worker {index} (pid {worker.pid}) preempted")
+                return
+        raise ResourceNotFoundError(f"worker {index}")
+
+    def live_workers(self) -> List[Worker]:
+        state = self._load()
+        return [worker for worker in state.workers if _pid_alive(worker.pid)]
+
+    def desired(self) -> int:
+        return self._load().desired
+
+    def delete(self) -> None:
+        """Idempotent: NotFound → no-op."""
+        if not self.exists():
+            if os.path.isdir(self.directory):
+                shutil.rmtree(self.directory, ignore_errors=True)
+            return
+        state = self._load()
+        for worker in state.workers:
+            self._kill(worker)
+        shutil.rmtree(self.directory, ignore_errors=True)
+
+
+def list_groups(root: Optional[str] = None) -> List[str]:
+    base = root or local_root()
+    if not os.path.isdir(base):
+        return []
+    return sorted(
+        name for name in os.listdir(base)
+        if os.path.exists(os.path.join(base, name, "group.json"))
+    )

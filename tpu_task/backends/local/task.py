@@ -1,0 +1,146 @@
+"""Hermetic local task backend: the minimum end-to-end slice with zero cloud.
+
+Task composition parity with the per-cloud packages (e.g.
+/root/reference/task/gcp/task.go): an ordered step plan over resources
+(bucket, machine group), Create/Read/Delete/Start/Stop/Push/Pull/Status/
+Events/Logs, Start/Stop implemented as capacity resize, rollback-friendly
+idempotency (AlreadyExists → no-op, NotFound tolerated on delete).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from datetime import datetime
+from typing import List, Optional
+
+from tpu_task.backends.local.control_plane import MachineGroup, list_groups, local_root
+from tpu_task.common.cloud import Cloud
+from tpu_task.common.errors import ResourceNotFoundError
+from tpu_task.common.identifier import Identifier, WrongIdentifierError
+from tpu_task.common.steps import Step, run_steps
+from tpu_task.common.values import Event, Status, StatusCode
+from tpu_task.common.values import Task as TaskSpec
+from tpu_task.storage import limit_transfer, logs as storage_logs, status as storage_status
+from tpu_task.storage import transfer
+from tpu_task.task import Task
+
+
+class LocalTask(Task):
+    def __init__(self, cloud: Cloud, identifier: Identifier, spec: TaskSpec):
+        self.cloud = cloud
+        self.identifier = identifier
+        self.spec = spec
+        self.group = MachineGroup(identifier.long())
+
+    # -- helpers -------------------------------------------------------------
+    def _timeout_epoch(self) -> float:
+        timeout = self.spec.environment.timeout
+        if timeout is None:
+            return 0.0
+        return time.time() + timeout.total_seconds()
+
+    def _environment(self) -> dict:
+        env = dict(self.spec.environment.variables.enrich())
+        env["TPU_TASK_CLOUD_PROVIDER"] = "local"
+        env["TPU_TASK_CLOUD_REGION"] = str(self.cloud.region)
+        env["TPU_TASK_IDENTIFIER"] = self.identifier.long()
+        env["TPU_TASK_REMOTE"] = self.group.bucket
+        env["TPI_TASK"] = "true"
+        return env
+
+    def _sync_periods(self) -> tuple:
+        log_period = float(os.environ.get("TPU_TASK_LOCAL_LOG_PERIOD", "5"))
+        data_period = float(os.environ.get("TPU_TASK_LOCAL_DATA_PERIOD", "10"))
+        return log_period, data_period
+
+    # -- lifecycle -----------------------------------------------------------
+    def create(self) -> None:
+        log_period, data_period = self._sync_periods()
+        run_steps([
+            Step("Creating machine group...", lambda: self.group.create(
+                script=self.spec.environment.script,
+                parallelism=self.spec.parallelism,
+                timeout_epoch=self._timeout_epoch(),
+                environment=self._environment(),
+                log_period=log_period, data_period=data_period,
+            )),
+            Step("Uploading directory...", self.push),
+            Step("Starting task...", self.start),
+        ])
+
+    def read(self) -> None:
+        state = self.group.reconcile()
+        self.spec.addresses = [f"127.0.0.1#{worker.machine_id}"
+                               for worker in state.workers]
+        self.spec.status = self.status()
+        self.spec.events = self.events()
+
+    def delete(self) -> None:
+        if self.group.exists() and self.spec.environment.directory:
+            try:
+                self.pull()
+            except ResourceNotFoundError:
+                pass
+        self.group.delete()
+
+    def start(self) -> None:
+        self.group.scale(self.spec.parallelism)
+
+    def stop(self) -> None:
+        self.group.scale(0)
+
+    # -- data plane ----------------------------------------------------------
+    def push(self) -> None:
+        if not self.spec.environment.directory:
+            return
+        transfer(self.spec.environment.directory,
+                 os.path.join(self.group.bucket, "data"),
+                 self.spec.environment.exclude_list)
+
+    def pull(self) -> None:
+        if not self.spec.environment.directory:
+            return
+        rules = limit_transfer(self.spec.environment.directory_out,
+                               list(self.spec.environment.exclude_list))
+        transfer(os.path.join(self.group.bucket, "data"),
+                 self.spec.environment.directory, rules)
+
+    # -- observation ---------------------------------------------------------
+    def status(self) -> Status:
+        initial: Status = {StatusCode.ACTIVE: len(self.group.live_workers())}
+        return storage_status(self.group.bucket, initial)
+
+    def events(self) -> List[Event]:
+        return [
+            Event(time=datetime.fromisoformat(event["time"]),
+                  code=event["code"], description=[event["description"]])
+            for event in self.group.events()
+        ]
+
+    def logs(self) -> List[str]:
+        return storage_logs(self.group.bucket)
+
+    def get_identifier(self) -> Identifier:
+        return self.identifier
+
+    def get_addresses(self) -> List[str]:
+        return list(self.spec.addresses)
+
+    # -- test/bench hooks ----------------------------------------------------
+    def preempt(self, index: int = 0) -> None:
+        """Simulate spot preemption of one worker (hermetic recovery tests)."""
+        self.group.preempt(index)
+
+
+def list_local_tasks(cloud: Cloud) -> List[Identifier]:
+    identifiers = []
+    for name in list_groups():
+        try:
+            identifiers.append(Identifier.parse(name))
+        except WrongIdentifierError:
+            continue
+    return identifiers
+
+
+__all__ = ["LocalTask", "list_local_tasks", "local_root"]

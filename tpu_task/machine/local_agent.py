@@ -115,6 +115,11 @@ class Agent:
             storage_transfer(data_remote, self.directory)
 
         env = dict(os.environ)
+        # The agent itself runs with accelerator bootstrap hooks scrubbed
+        # (it must not grab a TPU); the user task gets them back.
+        from tpu_task.backends.local.control_plane import restore_accelerator_env
+
+        restore_accelerator_env(env)
         env["TPU_WORKER_ID"] = str(self.worker_id)
         env["TPU_TASK_MACHINE_IDENTITY"] = self.machine_id
 

@@ -1,0 +1,122 @@
+"""L4 task abstraction: the cloud-agnostic Task interface + provider factory.
+
+Parity with /root/reference/task/task.go:17-67 — the seam the reference's
+smoke test drives directly (task_smoke_test.go:162) and the seam our
+hermetic lifecycle tests drive too.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import List, Optional
+
+from tpu_task.common.cloud import Cloud, Provider
+from tpu_task.common.identifier import Identifier
+from tpu_task.common.ssh import DeterministicSSHKeyPair
+from tpu_task.common.values import Event, Status
+from tpu_task.common.values import Task as TaskSpec
+
+
+class Task(ABC):
+    """Provider-specific task resource (task.go:48-67)."""
+
+    @abstractmethod
+    def create(self) -> None: ...
+
+    @abstractmethod
+    def read(self) -> None: ...
+
+    @abstractmethod
+    def delete(self) -> None: ...
+
+    @abstractmethod
+    def start(self) -> None: ...
+
+    @abstractmethod
+    def stop(self) -> None: ...
+
+    @abstractmethod
+    def push(self) -> None:
+        """Upload the task's working directory to remote storage."""
+
+    @abstractmethod
+    def pull(self) -> None:
+        """Download the output directory from remote storage."""
+
+    @abstractmethod
+    def status(self) -> Status: ...
+
+    @abstractmethod
+    def events(self) -> List[Event]: ...
+
+    @abstractmethod
+    def logs(self) -> List[str]: ...
+
+    @abstractmethod
+    def get_identifier(self) -> Identifier: ...
+
+    @abstractmethod
+    def get_addresses(self) -> List[str]: ...
+
+    def get_key_pair(self) -> Optional[DeterministicSSHKeyPair]:
+        """SSH keypair for the task machines; None for keyless backends
+        (k8s — task/k8s/task.go:330; local)."""
+        return None
+
+
+def new(cloud: Cloud, identifier: Identifier, spec: TaskSpec) -> Task:
+    """Construct a provider-specific task (task.go:32-45)."""
+    if cloud.provider == Provider.LOCAL:
+        from tpu_task.backends.local import LocalTask
+
+        return LocalTask(cloud, identifier, spec)
+    if cloud.provider == Provider.TPU:
+        from tpu_task.backends.tpu import TPUTask
+
+        return TPUTask(cloud, identifier, spec)
+    if cloud.provider == Provider.GCP:
+        from tpu_task.backends.gcp import GCPTask
+
+        return GCPTask(cloud, identifier, spec)
+    if cloud.provider == Provider.K8S:
+        from tpu_task.backends.k8s import K8STask
+
+        return K8STask(cloud, identifier, spec)
+    if cloud.provider == Provider.AWS:
+        from tpu_task.backends.aws import AWSTask
+
+        return AWSTask(cloud, identifier, spec)
+    if cloud.provider == Provider.AZ:
+        from tpu_task.backends.az import AZTask
+
+        return AZTask(cloud, identifier, spec)
+    raise ValueError(f"unknown provider: {cloud.provider!r}")
+
+
+def list_tasks(cloud: Cloud) -> List[Identifier]:
+    """Enumerate task identifiers in the provider account (task.go:17-30)."""
+    if cloud.provider == Provider.LOCAL:
+        from tpu_task.backends.local import list_local_tasks
+
+        return list_local_tasks(cloud)
+    if cloud.provider == Provider.TPU:
+        from tpu_task.backends.tpu import list_tpu_tasks
+
+        return list_tpu_tasks(cloud)
+    if cloud.provider == Provider.GCP:
+        from tpu_task.backends.gcp import list_gcp_tasks
+
+        return list_gcp_tasks(cloud)
+    if cloud.provider == Provider.K8S:
+        from tpu_task.backends.k8s import list_k8s_tasks
+
+        return list_k8s_tasks(cloud)
+    if cloud.provider == Provider.AWS:
+        from tpu_task.backends.aws import list_aws_tasks
+
+        return list_aws_tasks(cloud)
+    if cloud.provider == Provider.AZ:
+        from tpu_task.backends.az import list_az_tasks
+
+        return list_az_tasks(cloud)
+    raise ValueError(f"unknown provider: {cloud.provider!r}")
