@@ -1,0 +1,105 @@
+"""CLI end-to-end against the local backend: create → read --follow (exit
+codes) → list → stop → delete (reference semantics: cmd/leo/)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def env(tmp_path):
+    env = dict(os.environ)
+    env["TPU_TASK_LOCAL_ROOT"] = str(tmp_path / "control-plane")
+    env["TPU_TASK_LOCAL_LOG_PERIOD"] = "0.1"
+    env["TPU_TASK_LOCAL_DATA_PERIOD"] = "0.1"
+    env["PYTHONPATH"] = REPO
+    return env
+
+
+def cli(env, *args, **kwargs):
+    return subprocess.run(
+        [sys.executable, "-m", "tpu_task.cli", "--cloud", "local", *args],
+        capture_output=True, text=True, timeout=60, env=env, **kwargs,
+    )
+
+
+def test_create_read_follow_delete_cycle(env, tmp_path):
+    workdir = tmp_path / "work"
+    workdir.mkdir()
+    result = cli(env, "create", "--name", "cli-test", "--workdir", str(workdir),
+                 "--script", "echo from-the-task")
+    assert result.returncode == 0, result.stderr
+    identifier = result.stdout.strip().splitlines()[-1]
+    assert identifier.startswith("tpi-cli-test-")
+
+    follow = cli(env, "read", identifier, "--follow", "--poll-period", "0.2")
+    assert follow.returncode == 0, follow.stderr
+    assert "from-the-task" in follow.stdout
+
+    listed = cli(env, "list")
+    assert identifier in listed.stdout
+
+    assert cli(env, "delete", identifier).returncode == 0
+    assert identifier not in cli(env, "list").stdout
+    # Idempotent delete.
+    assert cli(env, "delete", identifier).returncode == 0
+
+
+def test_read_follow_exit_code_on_failure(env, tmp_path):
+    workdir = tmp_path / "work"
+    workdir.mkdir()
+    result = cli(env, "create", "--name", "cli-fail", "--workdir", str(workdir),
+                 "--script", "exit 9")
+    identifier = result.stdout.strip().splitlines()[-1]
+    follow = cli(env, "read", identifier, "--follow", "--poll-period", "0.2")
+    assert follow.returncode == 1
+    cli(env, "delete", identifier)
+
+
+def test_create_appends_trailing_command(env, tmp_path):
+    workdir = tmp_path / "work"
+    workdir.mkdir()
+    result = cli(env, "create", "--name", "cli-cmd", "--workdir", str(workdir),
+                 "echo", "trailing args work")
+    identifier = result.stdout.strip().splitlines()[-1]
+    follow = cli(env, "read", identifier, "--follow", "--poll-period", "0.2")
+    assert "trailing args work" in follow.stdout
+    cli(env, "delete", identifier)
+
+
+def test_rollback_on_create_failure(env, tmp_path):
+    """Create failure triggers residual-resource deletion (create.go:122-129)."""
+    result = cli(env, "create", "--name", "cli-rollback",
+                 "--workdir", str(tmp_path / "does-not-exist-at-all"),
+                 "--script", "echo hi")
+    assert result.returncode != 0
+    assert "cli-rollback" not in cli(env, "list").stdout
+
+
+def test_stop_command(env, tmp_path):
+    workdir = tmp_path / "work"
+    workdir.mkdir()
+    result = cli(env, "create", "--name", "cli-stop", "--workdir", str(workdir),
+                 "--script", "sleep 300")
+    identifier = result.stdout.strip().splitlines()[-1]
+    assert cli(env, "stop", identifier).returncode == 0
+    read = cli(env, "read", identifier)
+    assert read.returncode == 0
+    cli(env, "delete", identifier)
+
+
+def test_wrong_identifier_is_error(env):
+    assert cli(env, "read", "garbage-id").returncode == 2
+
+
+def test_storage_subcommand(env, tmp_path):
+    src = tmp_path / "s"
+    src.mkdir()
+    (src / "f.txt").write_text("hello")
+    result = cli(env, "storage", "copy", str(src), str(tmp_path / "d"))
+    assert result.returncode == 0, result.stderr
+    assert (tmp_path / "d" / "f.txt").read_text() == "hello"

@@ -1,0 +1,3 @@
+from tpu_task.cli.main import main
+
+__all__ = ["main"]

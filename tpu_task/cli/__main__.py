@@ -1,0 +1,5 @@
+import sys
+
+from tpu_task.cli.main import main
+
+sys.exit(main())

@@ -1,0 +1,282 @@
+"""tpu-task CLI: create / read / stop / delete / list / storage.
+
+Command surface and semantics mirror the reference's `leo` CLI
+(/root/reference/cmd/leo/): `create` builds a task spec from flags plus
+trailing command args, prints the identifier, and rolls back on failure
+(create.go:65-137); `read` polls logs with delta-printing and maps terminal
+status to exit codes 0/1 (read.go:52-127); `stop` scales to zero — it is also
+what workers invoke to self-destruct (stop.go + machine-script tpl:14);
+`delete` tears everything down after pulling outputs; `list` enumerates task
+identifiers. The extra `storage` subcommand exposes the data plane to the
+on-worker bootstrap script (the role rclone plays in the reference).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import shlex
+import sys
+import time
+from datetime import timedelta
+
+from tpu_task import task as task_factory
+from tpu_task.common.cloud import Cloud, Provider
+from tpu_task.common.errors import ResourceNotFoundError
+from tpu_task.common.identifier import Identifier, WrongIdentifierError
+from tpu_task.common.values import (
+    SPOT_DISABLED,
+    SPOT_ENABLED,
+    Environment,
+    Firewall,
+    FirewallRule,
+    Size,
+    StatusCode,
+    Task as TaskSpec,
+    Variables,
+)
+
+logger = logging.getLogger("tpu_task")
+
+
+def build_cloud(args) -> Cloud:
+    return Cloud(provider=Provider(args.cloud), region=args.region)
+
+
+def build_spec(args, trailing) -> TaskSpec:
+    variables = Variables()
+    for item in args.environment or []:
+        name, sep, value = item.partition("=")
+        variables[name.upper()] = value if sep and value != "" else None
+
+    script = args.script or ""
+    if not script.startswith("#!"):
+        script = "#!/bin/sh\n" + script
+    if trailing:
+        script += "\n" + " ".join(shlex.quote(part) for part in trailing)
+
+    spec = TaskSpec(
+        size=Size(machine=args.machine, storage=args.disk_size),
+        environment=Environment(
+            image=args.image,
+            script=script,
+            variables=variables,
+            directory=args.workdir,
+            directory_out=args.output,
+            exclude_list=args.exclude or [],
+            timeout=timedelta(seconds=args.timeout),
+        ),
+        firewall=Firewall(ingress=FirewallRule(ports=[22])),
+        parallelism=args.parallelism,
+        permission_set=args.permission_set,
+        spot=SPOT_ENABLED if args.spot else SPOT_DISABLED,
+    )
+    return spec
+
+
+def cmd_create(args) -> int:
+    cloud = build_cloud(args)
+    spec = build_spec(args, args.command)
+
+    try:
+        identifier = Identifier.parse(args.name)
+    except WrongIdentifierError:
+        identifier = Identifier.random(args.name)
+
+    tsk = task_factory.new(cloud, identifier, spec)
+    logger.info("Using identifier %s", identifier.long())
+    try:
+        tsk.create()
+    except Exception as error:
+        logger.error("Failed to create a new task: %s", error)
+        logger.warning("Attempting to delete residual resources...")
+        tsk.delete()
+        raise
+    finally:
+        print(identifier.long())
+    return 0
+
+
+def _derive_status(status, parallelism: int) -> str:
+    """Fold counters into queued/running/succeeded/failed (read.go:149-178)."""
+    result = "queued"
+    if status.get(StatusCode.SUCCEEDED, 0) >= parallelism:
+        result = "succeeded"
+    if status.get(StatusCode.FAILED, 0) > 0:
+        result = "failed"
+    if status.get(StatusCode.ACTIVE, 0) >= parallelism:
+        result = "running"
+    return result
+
+
+def cmd_read(args) -> int:
+    cloud = build_cloud(args)
+    spec = TaskSpec()
+    spec.environment = Environment(image="ubuntu")
+    identifier = Identifier.parse(args.name)
+    tsk = task_factory.new(cloud, identifier, spec)
+
+    last = 0
+    first_run = True
+    waiting = False
+    while True:
+        tsk.read()
+
+        lines = []
+        for log in tsk.logs():
+            for line in log.strip("\n").split("\n") if log.strip("\n") else []:
+                if not args.timestamps:
+                    _, _, line = line.partition(" ")
+                lines.append(line)
+
+        if first_run and not lines:
+            print("Waiting for instance", end="", file=sys.stderr, flush=True)
+            waiting = True
+        first_run = False
+        if waiting:
+            print(".", end="", file=sys.stderr, flush=True)
+
+        for event in tsk.events():
+            logger.debug("%s: %s", event.code, " ".join(event.description))
+        status = _derive_status(tsk.status(), args.parallelism)
+
+        delta = "\n".join(lines[last:])
+        if delta:
+            if waiting:
+                print(file=sys.stderr)
+                waiting = False
+            print(delta)
+            last = len(lines)
+
+        if not args.follow:
+            return 0
+        if status == "succeeded":
+            return 0
+        if status == "failed":
+            return 1
+        time.sleep(args.poll_period)
+
+
+def cmd_stop(args) -> int:
+    cloud = build_cloud(args)
+    tsk = task_factory.new(cloud, Identifier.parse(args.name), TaskSpec())
+    tsk.stop()
+    return 0
+
+
+def cmd_delete(args) -> int:
+    cloud = build_cloud(args)
+    spec = TaskSpec()
+    spec.environment = Environment(directory=args.workdir, directory_out=args.output)
+    tsk = task_factory.new(cloud, Identifier.parse(args.name), spec)
+    try:
+        tsk.delete()
+    except ResourceNotFoundError:
+        logger.info("Task %s not found; nothing to delete", args.name)
+    return 0
+
+
+def cmd_list(args) -> int:
+    cloud = build_cloud(args)
+    for identifier in task_factory.list_tasks(cloud):
+        print(identifier.long())
+    return 0
+
+
+def cmd_storage(args) -> int:
+    from tpu_task.storage import sync as storage_sync, transfer as storage_transfer
+
+    if args.storage_command == "copy":
+        storage_transfer(args.source, args.destination, args.exclude or [])
+    elif args.storage_command == "sync":
+        storage_sync(args.source, args.destination, args.exclude or [])
+    else:
+        raise ValueError(args.storage_command)
+    return 0
+
+
+def make_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="tpu-task",
+        description="Run ephemeral ML tasks on Cloud TPU (and other backends) "
+                    "with full-lifecycle orchestration.",
+    )
+    parser.add_argument("--cloud", default="tpu",
+                        choices=[provider.value for provider in Provider],
+                        help="cloud provider backend")
+    parser.add_argument("--region", default="us-central2", help="cloud region")
+    parser.add_argument("--verbose", action="store_true", help="debug logging")
+
+    sub = parser.add_subparsers(dest="subcommand", required=True)
+
+    create = sub.add_parser("create", help="create a task")
+    create.add_argument("--environment", action="append", metavar="NAME=VALUE",
+                        help="environment variables (empty value: inherit/glob)")
+    create.add_argument("--image", default="ubuntu", help="machine image")
+    create.add_argument("--machine", default="m",
+                        help="machine type (e.g. v4-8, v5p-128, s/m/l/xl)")
+    create.add_argument("--name", default="", help="deterministic name")
+    create.add_argument("--output", default="", help="output directory to download")
+    create.add_argument("--exclude", action="append",
+                        help="paths to exclude from uploading and downloading")
+    create.add_argument("--parallelism", type=int, default=1)
+    create.add_argument("--permission-set", default="", dest="permission_set")
+    create.add_argument("--script", default="", help="script to run")
+    create.add_argument("--spot", action="store_true", help="use spot/preemptible capacity")
+    create.add_argument("--disk-size", type=int, default=-1, dest="disk_size",
+                        help="disk size in gigabytes")
+    create.add_argument("--timeout", type=int, default=24 * 60 * 60,
+                        help="timeout in seconds")
+    create.add_argument("--workdir", default=".", help="working directory to upload")
+    create.add_argument("command", nargs=argparse.REMAINDER,
+                        help="command to append to the script")
+    create.set_defaults(func=cmd_create)
+
+    read = sub.add_parser("read", help="read information from an existing task")
+    read.add_argument("name")
+    read.add_argument("--parallelism", type=int, default=1)
+    read.add_argument("--timestamps", action="store_true")
+    read.add_argument("--follow", action="store_true")
+    read.add_argument("--poll-period", type=float, default=3.0, dest="poll_period")
+    read.set_defaults(func=cmd_read)
+
+    stop = sub.add_parser("stop", help="stop a task (scale to zero)")
+    stop.add_argument("name")
+    stop.set_defaults(func=cmd_stop)
+
+    delete = sub.add_parser("delete", help="delete a task and download outputs")
+    delete.add_argument("name")
+    delete.add_argument("--workdir", default="", help="working directory to download into")
+    delete.add_argument("--output", default="", help="output directory to download")
+    delete.set_defaults(func=cmd_delete)
+
+    list_cmd = sub.add_parser("list", help="list tasks")
+    list_cmd.set_defaults(func=cmd_list)
+
+    storage = sub.add_parser("storage", help="data-plane operations (used on workers)")
+    storage_sub = storage.add_subparsers(dest="storage_command", required=True)
+    for verb in ("copy", "sync"):
+        verb_parser = storage_sub.add_parser(verb)
+        verb_parser.add_argument("source")
+        verb_parser.add_argument("destination")
+        verb_parser.add_argument("--exclude", action="append")
+        verb_parser.set_defaults(func=cmd_storage)
+
+    return parser
+
+
+def main(argv=None) -> int:
+    args = make_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(levelname)s %(message)s",
+    )
+    try:
+        return args.func(args)
+    except WrongIdentifierError as error:
+        logger.error("%s", error)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,6 +51,9 @@ def _transfer(source_remote: str, destination_remote: str, filters: FilterSet,
     source, _ = open_backend(source_remote)
     destination, _ = open_backend(destination_remote)
 
+    if not source.exists():
+        raise ResourceNotFoundError(f"transfer source does not exist: {source_remote}")
+
     keys = [key for key in source.list() if filters.includes_file(key)]
     total_size = 0
     src_root = source.local_root()
