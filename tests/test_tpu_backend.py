@@ -1,0 +1,294 @@
+"""TPU backend: accelerator grammar, fake control-plane state machine,
+hermetic full lifecycle over QueuedResources, preemption → re-queue recovery,
+multi-host worker fan-out."""
+
+import os
+import time
+
+import pytest
+
+from tpu_task.backends.tpu import (
+    FakeTpuControlPlane,
+    InvalidAcceleratorError,
+    QueuedResourceSpec,
+    parse_accelerator,
+    resolve_zone,
+)
+from tpu_task.backends.tpu import api as tpu_api
+from tpu_task.common.cloud import Cloud, Provider
+from tpu_task.common.errors import ResourceNotFoundError
+from tpu_task.common.identifier import Identifier
+from tpu_task.common.values import (
+    SPOT_ENABLED,
+    Environment,
+    Size,
+    StatusCode,
+    Task as TaskSpec,
+)
+from tpu_task import task as task_factory
+
+
+# --- accelerator grammar ----------------------------------------------------
+
+@pytest.mark.parametrize("machine,chips,workers", [
+    ("v2-8", 4, 1),
+    ("v3-32", 16, 4),
+    ("v4-8", 4, 1),
+    ("v4-32", 16, 4),       # BASELINE config 5: 4 workers
+    ("v5p-128", 64, 16),
+    ("v5litepod-16", 16, 2),
+    ("v6e-8", 8, 1),
+])
+def test_accelerator_topologies(machine, chips, workers):
+    accelerator = parse_accelerator(machine)
+    assert accelerator.chips == chips
+    assert accelerator.workers == workers
+
+
+def test_generic_size_aliases():
+    assert parse_accelerator("m").type == "v2-8"
+    assert parse_accelerator("xl").type == "v4-8"
+
+
+def test_invalid_accelerators():
+    for bad in ("v99-8", "a100", "v4-7", "v4"):
+        with pytest.raises(InvalidAcceleratorError):
+            parse_accelerator(bad)
+
+
+def test_zone_resolution():
+    assert resolve_zone("us-central2") == "us-central2-b"
+    assert resolve_zone("europe-west4-a") == "europe-west4-a"
+    with pytest.raises(ValueError):
+        resolve_zone("nowhere")
+
+
+# --- fake control plane state machine ---------------------------------------
+
+@pytest.fixture
+def plane(tmp_path):
+    return FakeTpuControlPlane(root=str(tmp_path / "tpu"), run_workers=False)
+
+
+def qr_spec(accelerator="v4-8", node_id="node-1", spot=False):
+    return QueuedResourceSpec(
+        node_id=node_id, accelerator_type=accelerator,
+        runtime_version="tpu-ubuntu2204-base", spot=spot)
+
+
+def test_qr_progresses_to_active(plane):
+    """Each observation is one tick: WAITING at rest, then PROVISIONING,
+    then ACTIVE with a READY node."""
+    plane.create_queued_resource("qr-1", qr_spec())
+    states = [plane.get_queued_resource("qr-1").state for _ in range(3)]
+    assert states == [tpu_api.QR_PROVISIONING, tpu_api.QR_ACTIVE, tpu_api.QR_ACTIVE]
+    node = plane.get_node("node-1")
+    assert node.state == tpu_api.NODE_READY
+    assert node.worker_count == 1
+
+
+def test_qr_create_is_idempotent(plane):
+    plane.create_queued_resource("qr-1", qr_spec())
+    plane.get_queued_resource("qr-1")
+    plane.create_queued_resource("qr-1", qr_spec())  # second create: no reset
+    # Progress continues from PROVISIONING; a reset would restart at WAITING.
+    assert plane.get_queued_resource("qr-1").state == tpu_api.QR_ACTIVE
+
+
+def test_stockout_keeps_waiting(tmp_path):
+    plane = FakeTpuControlPlane(root=str(tmp_path / "tpu"), run_workers=False,
+                                capacity_chips=16)
+    plane.create_queued_resource("qr-big", qr_spec("v4-32", "node-big"))
+    for _ in range(3):
+        plane.get_queued_resource("qr-big")
+    assert plane.get_queued_resource("qr-big").state == tpu_api.QR_ACTIVE
+    # Second slice exceeds 16-chip capacity → queued indefinitely.
+    plane.create_queued_resource("qr-2", qr_spec("v4-32", "node-2"))
+    for _ in range(5):
+        assert plane.get_queued_resource("qr-2").state == tpu_api.QR_WAITING
+    # Capacity frees → granted.
+    plane.delete_node("node-big")
+    plane.get_queued_resource("qr-2")
+    assert plane.get_queued_resource("qr-2").state in (
+        tpu_api.QR_PROVISIONING, tpu_api.QR_ACTIVE)
+
+
+def test_preemption_suspends_and_requeue_recovers(plane):
+    plane.create_queued_resource("qr-1", qr_spec(spot=True))
+    while plane.get_queued_resource("qr-1").state != tpu_api.QR_ACTIVE:
+        pass
+    plane.preempt_node("node-1")
+    assert plane.get_queued_resource("qr-1").state == tpu_api.QR_SUSPENDED
+    plane.requeue("qr-1")
+    states = [plane.get_queued_resource("qr-1").state for _ in range(3)]
+    assert states[-1] == tpu_api.QR_ACTIVE
+    codes = [event["code"] for event in plane.get_queued_resource("qr-1").events]
+    assert "REQUEUE" in codes
+
+
+def test_multihost_node_has_worker_endpoints(plane):
+    plane.create_queued_resource("qr-mh", qr_spec("v4-32", "node-mh"))
+    while plane.get_queued_resource("qr-mh").state != tpu_api.QR_ACTIVE:
+        pass
+    node = plane.get_node("node-mh")
+    assert node.worker_count == 4
+    assert len(set(node.endpoints)) == 4
+
+
+def test_delete_queued_resource_force_deletes_node(plane):
+    plane.create_queued_resource("qr-1", qr_spec())
+    while plane.get_queued_resource("qr-1").state != tpu_api.QR_ACTIVE:
+        pass
+    plane.delete_queued_resource("qr-1", force=True)
+    with pytest.raises(ResourceNotFoundError):
+        plane.get_node("node-1")
+    with pytest.raises(ResourceNotFoundError):
+        plane.delete_queued_resource("qr-1")
+
+
+# --- hermetic TPU task lifecycle --------------------------------------------
+
+@pytest.fixture
+def tpu_cloud(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPU_TASK_FAKE_TPU_ROOT", str(tmp_path / "fake-tpu"))
+    monkeypatch.setenv("TPU_TASK_LOCAL_LOG_PERIOD", "0.1")
+    monkeypatch.setenv("TPU_TASK_LOCAL_DATA_PERIOD", "0.1")
+    return Cloud(provider=Provider.TPU, region="us-central2")
+
+
+def poll(task, predicate, timeout=30.0, period=0.2):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        task.read()
+        if predicate(task):
+            return
+        time.sleep(period)
+    raise AssertionError(f"condition not reached; status={task.status()} "
+                         f"logs={task.logs()}")
+
+
+def test_tpu_full_lifecycle(tpu_cloud, tmp_path):
+    workdir = tmp_path / "work"
+    workdir.mkdir()
+    (workdir / "input.txt").write_text("tpu-payload")
+    spec = TaskSpec(
+        size=Size(machine="v4-8"),
+        environment=Environment(
+            script="#!/bin/bash\ncat input.txt\n"
+                   "mkdir -p output && echo ok > output/r.txt\n",
+            directory=str(workdir), directory_out="output",
+        ),
+    )
+    identifier = Identifier.deterministic("tpu-e2e")
+    task = task_factory.new(tpu_cloud, identifier, spec)
+    task.delete()
+    task.create()
+    task.create()  # idempotent double-invoke
+    try:
+        assert identifier in task_factory.list_tasks(tpu_cloud)
+        poll(task, lambda t: t.status().get(StatusCode.SUCCEEDED, 0) >= 1)
+        assert "tpu-payload" in "".join(task.logs())
+        key_pair = task.get_key_pair()
+        assert key_pair is not None and key_pair.public_string().startswith("ssh-rsa")
+    finally:
+        task.delete()
+    assert (workdir / "output" / "r.txt").read_text() == "ok\n"
+    task.delete()  # double delete tolerated
+    assert identifier not in task_factory.list_tasks(tpu_cloud)
+
+
+def test_tpu_multihost_workers_all_run(tpu_cloud, tmp_path):
+    """A v4-32 slice runs the script on all 4 workers with distinct ranks and
+    shared TPU_WORKER_HOSTNAMES (jax.distributed wiring)."""
+    spec = TaskSpec(
+        size=Size(machine="v4-32"),
+        environment=Environment(
+            script='#!/bin/bash\necho "rank=$TPU_WORKER_ID hosts=$TPU_WORKER_HOSTNAMES"\n'
+                   "sleep 2\n",
+        ),
+    )
+    task = task_factory.new(tpu_cloud, Identifier.deterministic("tpu-multihost"), spec)
+    task.create()
+    try:
+        # While the slice is alive: all 4 worker endpoints exported.
+        poll(task, lambda t: len(t.get_addresses()) == 4, timeout=15)
+        poll(task, lambda t: t.status().get(StatusCode.SUCCEEDED, 0) >= 4)
+        logs = "".join(task.logs())
+        for rank in range(4):
+            assert f"rank={rank}" in logs
+        assert logs.count("10.130.0.1,10.130.0.2,10.130.0.3,10.130.0.4") >= 4
+    finally:
+        task.delete()
+
+
+def test_tpu_preemption_recovery_mttr(tpu_cloud, tmp_path):
+    """Spot slice preempted mid-task → reconciler re-queues → respawned slice
+    restores the checkpoint from the bucket and succeeds. MTTR measurable
+    from the recovery events."""
+    script = (
+        "#!/bin/bash\n"
+        "if test -f checkpoint; then\n"
+        "  echo resumed-from-$(cat checkpoint)\n"
+        "else\n"
+        "  echo cold-start\n"
+        "  echo step-40 > checkpoint\n"
+        "  sleep 300\n"
+        "fi\n"
+    )
+    spec = TaskSpec(
+        size=Size(machine="v4-8"),
+        environment=Environment(script=script),
+        spot=SPOT_ENABLED,
+    )
+    task = task_factory.new(tpu_cloud, Identifier.deterministic("tpu-preempt"), spec)
+    task.create()
+    try:
+        poll(task, lambda t: "cold-start" in "".join(t.logs()), timeout=15)
+        bucket = task._bucket_dir
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if os.path.exists(os.path.join(bucket, "data", "checkpoint")):
+                break
+            time.sleep(0.1)
+
+        preempt_time = time.time()
+        task.client.preempt_node(task._qr_name(0))
+        poll(task, lambda t: "resumed-from-step-40" in "".join(t.logs()), timeout=30)
+        mttr = time.time() - preempt_time
+        assert mttr < 30
+        codes = [event.code for event in task.events()]
+        assert "recover" in codes or "REQUEUE" in codes
+    finally:
+        task.delete()
+
+
+def test_tpu_cli_end_to_end(tpu_cloud, tmp_path, monkeypatch):
+    """The CLI drives the TPU backend hermetically (cloud=tpu + fake plane)."""
+    import subprocess
+    import sys
+
+    workdir = tmp_path / "w"
+    workdir.mkdir()
+    env = dict(os.environ)
+    env["TPU_TASK_FAKE_TPU_ROOT"] = os.environ["TPU_TASK_FAKE_TPU_ROOT"]
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    result = subprocess.run(
+        [sys.executable, "-m", "tpu_task.cli", "--cloud", "tpu",
+         "create", "--name", "cli-tpu", "--machine", "v4-8",
+         "--workdir", str(workdir), "--script", "echo via-cli-on-tpu"],
+        capture_output=True, text=True, timeout=60, env=env)
+    assert result.returncode == 0, result.stderr
+    identifier = result.stdout.strip().splitlines()[-1]
+
+    follow = subprocess.run(
+        [sys.executable, "-m", "tpu_task.cli", "--cloud", "tpu",
+         "read", identifier, "--follow", "--poll-period", "0.2"],
+        capture_output=True, text=True, timeout=60, env=env)
+    assert follow.returncode == 0, follow.stderr
+    assert "via-cli-on-tpu" in follow.stdout
+
+    assert subprocess.run(
+        [sys.executable, "-m", "tpu_task.cli", "--cloud", "tpu",
+         "delete", identifier],
+        capture_output=True, text=True, timeout=60, env=env).returncode == 0

@@ -1,0 +1,20 @@
+from tpu_task.backends.tpu.accelerators import (
+    Accelerator,
+    InvalidAcceleratorError,
+    parse_accelerator,
+)
+from tpu_task.backends.tpu.api import (
+    FakeTpuControlPlane,
+    NodeInfo,
+    QueuedResourceInfo,
+    QueuedResourceSpec,
+    RestTpuClient,
+)
+from tpu_task.backends.tpu.task import TPUTask, list_tpu_tasks, resolve_zone
+
+__all__ = [
+    "Accelerator", "InvalidAcceleratorError", "parse_accelerator",
+    "FakeTpuControlPlane", "NodeInfo", "QueuedResourceInfo",
+    "QueuedResourceSpec", "RestTpuClient",
+    "TPUTask", "list_tpu_tasks", "resolve_zone",
+]

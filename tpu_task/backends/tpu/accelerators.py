@@ -1,0 +1,98 @@
+"""Cloud TPU accelerator-type grammar.
+
+Replaces the reference's GPU size maps (e.g.
+/root/reference/task/gcp/resources/resource_instance_template.go:72-107) with
+the TPU accelerator grammar: ``v{gen}-{size}`` (``v2-8``, ``v4-32``,
+``v5p-128``, ``v5litepod-16``, ``v6e-8``...). The parse result carries the
+slice topology facts the orchestrator needs: how many TPU-VM workers (hosts)
+a slice has — multi-host fan-out (SSH, per-worker logs) and
+``jax.distributed`` wiring depend on it.
+
+Per-generation host shapes (public Cloud TPU docs):
+  v2/v3:        size = TensorCores, 8 cores (4 chips) per host
+  v4/v5p:       size = TensorCores, 8 cores (4 chips) per host
+  v5litepod/v5e: size = chips, 8 chips per host
+  v6e:          size = chips, 8 chips per host (single-host up to 8)
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Dict
+
+# Generic sizes kept for reference parity (`s`/`m`/`l`/`xl` — the reference's
+# cloud-agnostic grammar) → smallest sensible TPU slices.
+GENERIC_SIZES: Dict[str, str] = {
+    "s": "v2-8",
+    "m": "v2-8",
+    "l": "v3-8",
+    "xl": "v4-8",
+}
+
+_TPU_RE = re.compile(r"^(v[0-9]+[a-z]*(?:pod)?)-([0-9]+)$")
+
+# cores-or-chips per host, and whether the size counts cores or chips.
+_GENERATIONS = {
+    "v2": dict(per_host=8, unit="cores", cores_per_chip=2, runtime="tpu-ubuntu2204-base"),
+    "v3": dict(per_host=8, unit="cores", cores_per_chip=2, runtime="tpu-ubuntu2204-base"),
+    "v4": dict(per_host=8, unit="cores", cores_per_chip=2, runtime="tpu-ubuntu2204-base"),
+    "v5p": dict(per_host=8, unit="cores", cores_per_chip=2, runtime="v2-alpha-tpuv5"),
+    "v5litepod": dict(per_host=8, unit="chips", cores_per_chip=1, runtime="v2-alpha-tpuv5-lite"),
+    "v5e": dict(per_host=8, unit="chips", cores_per_chip=1, runtime="v2-alpha-tpuv5-lite"),
+    "v6e": dict(per_host=8, unit="chips", cores_per_chip=1, runtime="v2-alpha-tpuv6e"),
+}
+
+
+class InvalidAcceleratorError(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class Accelerator:
+    """A parsed TPU accelerator type."""
+
+    type: str          # canonical accelerator type, e.g. "v4-32"
+    generation: str    # "v4"
+    size: int          # trailing number (cores for v2-v5p, chips for v5e/v6e)
+    chips: int         # total chips in the slice
+    workers: int       # TPU-VM hosts in the slice (SSH/log fan-out width)
+    runtime_version: str  # default TPU software version
+
+    @property
+    def cores(self) -> int:
+        info = _GENERATIONS[self.generation]
+        return self.chips * info["cores_per_chip"]
+
+
+def parse_accelerator(machine: str) -> Accelerator:
+    """Parse a machine string: TPU type, or generic s/m/l/xl alias."""
+    machine = GENERIC_SIZES.get(machine, machine)
+    match = _TPU_RE.match(machine)
+    if not match:
+        raise InvalidAcceleratorError(
+            f"not a TPU accelerator type: {machine!r} "
+            f"(want e.g. v4-8, v5p-128, v5litepod-16, or one of {sorted(GENERIC_SIZES)})"
+        )
+    generation, size_str = match.group(1), match.group(2)
+    if generation not in _GENERATIONS:
+        raise InvalidAcceleratorError(f"unknown TPU generation: {generation!r}")
+    size = int(size_str)
+    info = _GENERATIONS[generation]
+    if info["unit"] == "cores":
+        if size % info["cores_per_chip"]:
+            raise InvalidAcceleratorError(f"core count must be even: {machine!r}")
+        chips = size // info["cores_per_chip"]
+        chips_per_host = info["per_host"] // info["cores_per_chip"]
+    else:
+        chips = size
+        chips_per_host = info["per_host"]
+    workers = max(1, (chips + chips_per_host - 1) // chips_per_host)
+    return Accelerator(
+        type=f"{generation}-{size}",
+        generation=generation,
+        size=size,
+        chips=chips,
+        workers=workers,
+        runtime_version=info["runtime"],
+    )

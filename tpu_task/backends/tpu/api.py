@@ -1,0 +1,532 @@
+"""Cloud TPU control-plane client: interface, REST implementation, fake.
+
+The QueuedResource/Node API replaces the reference's InstanceTemplate +
+ManagedInstanceGroup pair (/root/reference/task/gcp/resources/
+resource_instance_template.go, resource_instance_group_manager.go): a
+QueuedResource is the request for TPU capacity (queued until granted — the
+spot/stockout realities the MIG hides), and the Node is the granted slice of
+one or more TPU-VM workers.
+
+Two implementations:
+
+* ``RestTpuClient`` — the real ``tpu.googleapis.com/v2`` surface (urllib,
+  token auth via service account or metadata server). Only touched on real
+  clouds.
+* ``FakeTpuControlPlane`` — a deterministic, file-backed state machine with
+  the same observable behavior (states, queueing, preemption, stockouts),
+  optionally *executing* node workers as local agent subprocesses so the
+  whole TPU path runs hermetically. This is the fake control-plane layer the
+  reference lacks (SURVEY.md §4) — preemption/requeue logic is unit-testable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Protocol
+
+from tpu_task.common.errors import ResourceNotFoundError
+
+# -- data model ---------------------------------------------------------------
+
+# QueuedResource states (subset of the real API's).
+QR_WAITING = "WAITING_FOR_RESOURCES"
+QR_PROVISIONING = "PROVISIONING"
+QR_ACTIVE = "ACTIVE"
+QR_SUSPENDING = "SUSPENDING"
+QR_SUSPENDED = "SUSPENDED"
+QR_FAILED = "FAILED"
+
+# Node states.
+NODE_CREATING = "CREATING"
+NODE_READY = "READY"
+NODE_PREEMPTED = "PREEMPTED"
+NODE_DELETING = "DELETING"
+
+
+@dataclass
+class QueuedResourceSpec:
+    node_id: str
+    accelerator_type: str
+    runtime_version: str
+    startup_script: str = ""
+    metadata: Dict[str, str] = field(default_factory=dict)
+    labels: Dict[str, str] = field(default_factory=dict)
+    spot: bool = False
+    service_account: str = ""
+    network: str = "default"
+    zone: str = ""
+
+
+@dataclass
+class NodeInfo:
+    name: str
+    state: str
+    accelerator_type: str
+    endpoints: List[str] = field(default_factory=list)  # one per worker host
+    worker_count: int = 1
+    health: str = ""
+
+
+@dataclass
+class QueuedResourceInfo:
+    name: str
+    state: str
+    spec: QueuedResourceSpec
+    node_name: str = ""
+    events: List[dict] = field(default_factory=list)
+
+
+class TpuClient(Protocol):
+    def create_queued_resource(self, name: str, spec: QueuedResourceSpec) -> None: ...
+
+    def get_queued_resource(self, name: str) -> QueuedResourceInfo: ...
+
+    def delete_queued_resource(self, name: str, force: bool = True) -> None: ...
+
+    def list_queued_resources(self) -> List[str]: ...
+
+    def get_node(self, name: str) -> NodeInfo: ...
+
+    def delete_node(self, name: str) -> None: ...
+
+
+# -- fake control plane -------------------------------------------------------
+
+class FakeTpuControlPlane:
+    """File-backed deterministic QueuedResource/Node state machine.
+
+    State transitions advance on observation (each ``get_*`` call is one
+    tick), so tests are fully deterministic without wall-clock dependence:
+
+      QR:  WAITING_FOR_RESOURCES → PROVISIONING → ACTIVE
+      Node: CREATING → READY (workers spawn if execution is enabled)
+
+    Knobs:
+      * ``capacity``: concurrent chips available; requests beyond it stay
+        WAITING (stockout behavior spot capacity really has).
+      * ``preempt(name)``: node → PREEMPTED, QR → SUSPENDED (what a real
+        spot reclaim looks like through the API).
+      * ``run_workers``: execute each node worker as a local-agent
+        subprocess with TPU_WORKER_ID/TPU_WORKER_HOSTNAMES set.
+    """
+
+    def __init__(self, root: Optional[str] = None, capacity_chips: int = 4096,
+                 run_workers: bool = True, ticks_to_provision: int = 1,
+                 ticks_to_active: int = 1):
+        self.root = root or os.environ.get(
+            "TPU_TASK_FAKE_TPU_ROOT",
+            os.path.join(os.path.expanduser("~/.tpu-task"), "fake-tpu"))
+        self.capacity_chips = capacity_chips
+        self.run_workers = run_workers
+        self.ticks_to_provision = ticks_to_provision
+        self.ticks_to_active = ticks_to_active
+        os.makedirs(self.root, exist_ok=True)
+
+    # -- persistence ----------------------------------------------------------
+    def _qr_path(self, name: str) -> str:
+        return os.path.join(self.root, "queued_resources", name + ".json")
+
+    def _node_path(self, name: str) -> str:
+        return os.path.join(self.root, "nodes", name + ".json")
+
+    def _load(self, path: str) -> dict:
+        if not os.path.exists(path):
+            raise ResourceNotFoundError(path)
+        with open(path) as handle:
+            return json.load(handle)
+
+    def _store(self, path: str, payload: dict) -> None:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as handle:
+            json.dump(payload, handle, indent=2)
+        os.replace(tmp, path)
+
+    # -- queued resources -----------------------------------------------------
+    def create_queued_resource(self, name: str, spec: QueuedResourceSpec) -> None:
+        path = self._qr_path(name)
+        if os.path.exists(path):
+            return  # AlreadyExists → idempotent no-op
+        self._store(path, {
+            "name": name,
+            "state": QR_WAITING,
+            "ticks": 0,
+            "spec": spec.__dict__,
+            "node_name": spec.node_id,
+            "events": [self._event("CREATE", f"queued resource {name} accepted")],
+        })
+
+    def get_queued_resource(self, name: str) -> QueuedResourceInfo:
+        payload = self._load(self._qr_path(name))
+        payload = self._tick_qr(payload)
+        spec = QueuedResourceSpec(**payload["spec"])
+        return QueuedResourceInfo(
+            name=payload["name"], state=payload["state"], spec=spec,
+            node_name=payload.get("node_name", ""), events=payload.get("events", []),
+        )
+
+    def delete_queued_resource(self, name: str, force: bool = True) -> None:
+        path = self._qr_path(name)
+        if not os.path.exists(path):
+            raise ResourceNotFoundError(name)
+        payload = self._load(path)
+        node_name = payload.get("node_name", "")
+        if node_name and os.path.exists(self._node_path(node_name)):
+            if not force:
+                raise RuntimeError("queued resource has an active node; use force")
+            self.delete_node(node_name)
+        os.remove(path)
+
+    def list_queued_resources(self) -> List[str]:
+        directory = os.path.join(self.root, "queued_resources")
+        if not os.path.isdir(directory):
+            return []
+        return sorted(name[:-5] for name in os.listdir(directory) if name.endswith(".json"))
+
+    def _tick_qr(self, payload: dict) -> dict:
+        payload["ticks"] = payload.get("ticks", 0) + 1
+        state = payload["state"]
+        spec = payload["spec"]
+        if state == QR_WAITING:
+            if self._chips_in_use() + self._spec_chips(spec) <= self.capacity_chips:
+                if payload["ticks"] >= self.ticks_to_provision:
+                    payload["state"] = QR_PROVISIONING
+                    payload["ticks"] = 0
+                    payload["events"].append(self._event(
+                        "PROVISION", "capacity granted; provisioning node"))
+        elif state == QR_PROVISIONING:
+            if payload["ticks"] >= self.ticks_to_active:
+                payload["state"] = QR_ACTIVE
+                payload["ticks"] = 0
+                payload["events"].append(self._event("ACTIVE", "node provisioned"))
+                self._create_node(payload)
+        elif state == QR_ACTIVE:
+            node_path = self._node_path(payload["node_name"])
+            if os.path.exists(node_path):
+                node = self._load(node_path)
+                if node["state"] == NODE_PREEMPTED:
+                    payload["state"] = QR_SUSPENDED
+                    payload["events"].append(self._event(
+                        "SUSPEND", "node preempted; queued resource suspended"))
+        self._store(self._qr_path(payload["name"]), payload)
+        return payload
+
+    def _spec_chips(self, spec: dict) -> int:
+        from tpu_task.backends.tpu.accelerators import parse_accelerator
+
+        return parse_accelerator(spec["accelerator_type"]).chips
+
+    def _chips_in_use(self) -> int:
+        total = 0
+        for name in self.list_nodes():
+            node = self._load(self._node_path(name))
+            if node["state"] in (NODE_CREATING, NODE_READY):
+                total += self._spec_chips({"accelerator_type": node["accelerator_type"]})
+        return total
+
+    @staticmethod
+    def _event(code: str, description: str) -> dict:
+        from datetime import datetime, timezone
+
+        return {"time": datetime.now(timezone.utc).isoformat(),
+                "code": code, "description": description}
+
+    # -- nodes ----------------------------------------------------------------
+    def _create_node(self, qr_payload: dict) -> None:
+        from tpu_task.backends.tpu.accelerators import parse_accelerator
+
+        spec = qr_payload["spec"]
+        name = qr_payload["node_name"]
+        accelerator = parse_accelerator(spec["accelerator_type"])
+        workers = []
+        for index in range(accelerator.workers):
+            workers.append({
+                "index": index,
+                "endpoint": f"10.130.0.{index + 1}",
+                "pid": 0,
+                "machine_id": f"{uuid.uuid4().hex[:12]}-worker{index}",
+            })
+        node = {
+            "name": name,
+            "state": NODE_READY,
+            "accelerator_type": spec["accelerator_type"],
+            "spot": spec.get("spot", False),
+            "workers": workers,
+            "metadata": spec.get("metadata", {}),
+            "startup_script": spec.get("startup_script", ""),
+        }
+        self._store(self._node_path(name), node)
+        if self.run_workers:
+            self._spawn_workers(node)
+
+    def _spawn_workers(self, node: dict) -> None:
+        """Execute the node's workers as local agents (hermetic execution).
+
+        The fake control plane understands the same metadata contract the
+        real bootstrap uses: ``metadata["tpu-task-remote"]`` (bucket),
+        ``metadata["tpu-task-script-b64"]`` (task script), and sync periods.
+        """
+        import base64
+
+        metadata = node.get("metadata", {})
+        remote = metadata.get("tpu-task-remote", "")
+        script_b64 = metadata.get("tpu-task-script-b64", "")
+        if not remote or not script_b64:
+            return
+        node_dir = os.path.join(self.root, "node-exec", node["name"])
+        os.makedirs(node_dir, exist_ok=True)
+        script_path = os.path.join(node_dir, "task.sh")
+        with open(script_path, "w") as handle:
+            handle.write(base64.b64decode(script_b64).decode())
+        hostnames = ",".join(worker["endpoint"] for worker in node["workers"])
+        for worker in node["workers"]:
+            workdir = os.path.join(node_dir, f"worker{worker['index']}")
+            os.makedirs(workdir, exist_ok=True)
+            env = dict(os.environ)
+            for key, value in metadata.items():
+                if key.startswith("tpu-task-env-"):
+                    env[key[len("tpu-task-env-"):]] = value
+            from tpu_task.backends.local.control_plane import scrub_accelerator_env
+
+            scrub_accelerator_env(env)
+            env["TPU_WORKER_HOSTNAMES"] = hostnames
+            env["PYTHONPATH"] = os.pathsep.join(filter(None, [
+                os.path.dirname(os.path.dirname(os.path.dirname(
+                    os.path.dirname(os.path.abspath(__file__))))),
+                env.get("PYTHONPATH", "")]))
+            agent_log = open(os.path.join(node_dir, f"worker{worker['index']}.agent.log"), "ab")
+            try:
+                process = subprocess.Popen(
+                    [sys.executable, "-m", "tpu_task.machine.local_agent",
+                     "--remote", remote,
+                     "--directory", workdir,
+                     "--script", script_path,
+                     "--machine-id", worker["machine_id"],
+                     "--timeout", metadata.get("tpu-task-timeout", "0"),
+                     "--log-period", metadata.get("tpu-task-log-period", "5"),
+                     "--data-period", metadata.get("tpu-task-data-period", "10"),
+                     "--worker-id", str(worker["index"])],
+                    env=env, start_new_session=True,
+                    stdout=agent_log, stderr=agent_log,
+                )
+            finally:
+                agent_log.close()
+            worker["pid"] = process.pid
+
+    def get_node(self, name: str) -> NodeInfo:
+        payload = self._load(self._node_path(name))
+        return NodeInfo(
+            name=payload["name"],
+            state=payload["state"],
+            accelerator_type=payload["accelerator_type"],
+            endpoints=[worker["endpoint"] for worker in payload["workers"]],
+            worker_count=len(payload["workers"]),
+            health="HEALTHY" if payload["state"] == NODE_READY else "",
+        )
+
+    def delete_node(self, name: str) -> None:
+        path = self._node_path(name)
+        if not os.path.exists(path):
+            raise ResourceNotFoundError(name)
+        payload = self._load(path)
+        self._kill_workers(payload)
+        os.remove(path)
+        shutil.rmtree(os.path.join(self.root, "node-exec", name), ignore_errors=True)
+
+    def list_nodes(self) -> List[str]:
+        directory = os.path.join(self.root, "nodes")
+        if not os.path.isdir(directory):
+            return []
+        return sorted(name[:-5] for name in os.listdir(directory) if name.endswith(".json"))
+
+    def _kill_workers(self, node: dict) -> None:
+        import signal
+
+        for worker in node.get("workers", []):
+            pid = worker.get("pid") or 0
+            if pid:
+                try:
+                    os.killpg(pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    try:
+                        os.kill(pid, signal.SIGKILL)
+                    except (ProcessLookupError, PermissionError):
+                        pass
+
+    # -- fault injection ------------------------------------------------------
+    def preempt_node(self, name: str) -> None:
+        """Spot reclaim: kill the node's workers, mark PREEMPTED."""
+        payload = self._load(self._node_path(name))
+        self._kill_workers(payload)
+        payload["state"] = NODE_PREEMPTED
+        for worker in payload["workers"]:
+            worker["pid"] = 0
+        self._store(self._node_path(name), payload)
+
+    def requeue(self, qr_name: str) -> None:
+        """Re-queue a SUSPENDED queued resource (delete node, back to WAITING).
+
+        This is the operation the orchestrator's recovery reconciler performs —
+        the TPU equivalent of the ASG respawning a spot instance."""
+        payload = self._load(self._qr_path(qr_name))
+        node_name = payload.get("node_name", "")
+        if node_name and os.path.exists(self._node_path(node_name)):
+            self.delete_node(node_name)
+        payload["state"] = QR_WAITING
+        payload["ticks"] = 0
+        payload["events"].append(self._event("REQUEUE", "re-queued after preemption"))
+        self._store(self._qr_path(payload["name"]), payload)
+
+
+# -- REST client --------------------------------------------------------------
+
+class RestTpuClient:
+    """Real Cloud TPU v2 API client (gated: requires network + credentials).
+
+    API shapes per https://cloud.google.com/tpu/docs/reference/rest/v2.
+    """
+
+    def __init__(self, project: str, zone: str, credentials_json: str = ""):
+        self.project = project
+        self.zone = zone
+        self.credentials_json = credentials_json
+        self._token: Optional[str] = None
+
+    # -- plumbing -------------------------------------------------------------
+    def _parent(self) -> str:
+        return f"projects/{self.project}/locations/{self.zone}"
+
+    def _access_token(self) -> str:
+        from tpu_task.storage.backends import (
+            _gcs_token_from_metadata,
+            _gcs_token_from_service_account,
+        )
+
+        if self._token is None:
+            if self.credentials_json:
+                self._token = _gcs_token_from_service_account(self.credentials_json)
+            else:
+                self._token = _gcs_token_from_metadata()
+        return self._token
+
+    def _request(self, method: str, path: str, payload: Optional[dict] = None) -> dict:
+        import urllib.error
+        import urllib.request
+
+        url = f"https://tpu.googleapis.com/v2/{path}"
+        data = json.dumps(payload).encode() if payload is not None else None
+        request = urllib.request.Request(url, data=data, method=method)
+        request.add_header("Authorization", "Bearer " + self._access_token())
+        request.add_header("Content-Type", "application/json")
+        try:
+            with urllib.request.urlopen(request, timeout=60) as response:
+                return json.loads(response.read() or b"{}")
+        except urllib.error.HTTPError as error:
+            if error.code == 404:
+                raise ResourceNotFoundError(path) from error
+            raise
+
+    def _wait_operation(self, operation: dict, timeout: float = 900.0) -> dict:
+        """Exponential-backoff LRO poller, 2 s → 32 s (the reference's GCP op
+        waiter — task/gcp/resources/common.go:15-35)."""
+        delay = 2.0
+        deadline = time.time() + timeout
+        while not operation.get("done"):
+            if time.time() > deadline:
+                raise TimeoutError(f"operation timed out: {operation.get('name')}")
+            time.sleep(delay)
+            delay = min(delay * 2, 32.0)
+            operation = self._request("GET", operation["name"])
+        if "error" in operation:
+            raise RuntimeError(f"operation failed: {operation['error']}")
+        return operation
+
+    # -- queued resources -----------------------------------------------------
+    def create_queued_resource(self, name: str, spec: QueuedResourceSpec) -> None:
+        body = {
+            "tpu": {
+                "nodeSpec": [{
+                    "parent": self._parent(),
+                    "nodeId": spec.node_id,
+                    "node": {
+                        "acceleratorType": spec.accelerator_type,
+                        "runtimeVersion": spec.runtime_version,
+                        "networkConfig": {"network": spec.network,
+                                          "enableExternalIps": True},
+                        "metadata": {
+                            "startup-script": spec.startup_script,
+                            **spec.metadata,
+                        },
+                        "labels": spec.labels,
+                        **({"serviceAccount": {"email": spec.service_account}}
+                           if spec.service_account else {}),
+                        **({"schedulingConfig": {"preemptible": True, "spot": True}}
+                           if spec.spot else {}),
+                    },
+                }],
+            },
+        }
+        try:
+            operation = self._request(
+                "POST", f"{self._parent()}/queuedResources?queuedResourceId={name}", body)
+            self._wait_operation(operation)
+        except RuntimeError as error:
+            if "ALREADY_EXISTS" not in str(error):
+                raise
+
+    def get_queued_resource(self, name: str) -> QueuedResourceInfo:
+        payload = self._request("GET", f"{self._parent()}/queuedResources/{name}")
+        state = payload.get("state", {}).get("state", QR_WAITING)
+        node_id = ""
+        spec_payload = payload.get("tpu", {}).get("nodeSpec", [])
+        spec = QueuedResourceSpec(node_id="", accelerator_type="", runtime_version="")
+        if spec_payload:
+            node_id = spec_payload[0].get("nodeId", "")
+            node = spec_payload[0].get("node", {})
+            spec = QueuedResourceSpec(
+                node_id=node_id,
+                accelerator_type=node.get("acceleratorType", ""),
+                runtime_version=node.get("runtimeVersion", ""),
+                spot=bool(node.get("schedulingConfig", {}).get("spot")),
+            )
+        return QueuedResourceInfo(name=name, state=state, spec=spec, node_name=node_id)
+
+    def delete_queued_resource(self, name: str, force: bool = True) -> None:
+        operation = self._request(
+            "DELETE", f"{self._parent()}/queuedResources/{name}?force={str(force).lower()}")
+        self._wait_operation(operation)
+
+    def list_queued_resources(self) -> List[str]:
+        payload = self._request("GET", f"{self._parent()}/queuedResources")
+        return sorted(item["name"].rsplit("/", 1)[-1]
+                      for item in payload.get("queuedResources", []))
+
+    # -- nodes ----------------------------------------------------------------
+    def get_node(self, name: str) -> NodeInfo:
+        payload = self._request("GET", f"{self._parent()}/nodes/{name}")
+        endpoints = [endpoint.get("ipAddress", "")
+                     for endpoint in payload.get("networkEndpoints", [])]
+        return NodeInfo(
+            name=name,
+            state=payload.get("state", ""),
+            accelerator_type=payload.get("acceleratorType", ""),
+            endpoints=endpoints,
+            worker_count=max(1, len(endpoints)),
+            health=payload.get("health", ""),
+        )
+
+    def delete_node(self, name: str) -> None:
+        operation = self._request("DELETE", f"{self._parent()}/nodes/{name}")
+        self._wait_operation(operation)
+
+    def list_nodes(self) -> List[str]:
+        payload = self._request("GET", f"{self._parent()}/nodes")
+        return sorted(item["name"].rsplit("/", 1)[-1]
+                      for item in payload.get("nodes", []))

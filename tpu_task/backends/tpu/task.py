@@ -1,0 +1,419 @@
+"""TPU task backend: Cloud TPU slices as the machine substrate.
+
+Composition parity with /root/reference/task/gcp/task.go — ordered step plan
+over resources, Start/Stop as capacity changes, Read aggregating states into
+Status/Addresses/Events — but TPU-first:
+
+* the scaling-group pair (InstanceTemplate + MIG) becomes N **QueuedResources**
+  (``parallelism`` = number of slices; each slice is 1..W TPU-VM workers from
+  the accelerator topology);
+* spot recovery is an **explicit reconciler**: a SUSPENDED queued resource
+  (preempted node) is deleted and re-queued on every Read — the loop the
+  reference delegates to ASG/MIG auto-healing (SURVEY.md §7 hard part #1);
+  recovery events (with timestamps) make preemption-recovery MTTR measurable;
+* the bootstrap is a startup-script rendered by ``machine.render_script``
+  (real mode) or the metadata contract executed by the fake control plane
+  (hermetic mode).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import time
+from datetime import datetime, timezone
+from typing import Dict, List, Optional
+
+from tpu_task.backends.tpu.accelerators import Accelerator, parse_accelerator
+from tpu_task.backends.tpu.api import (
+    QR_ACTIVE,
+    QR_PROVISIONING,
+    QR_SUSPENDED,
+    QR_WAITING,
+    FakeTpuControlPlane,
+    NodeInfo,
+    QueuedResourceInfo,
+    QueuedResourceSpec,
+    RestTpuClient,
+)
+from tpu_task.common.cloud import Cloud
+from tpu_task.common.errors import ResourceNotFoundError
+from tpu_task.common.identifier import Identifier, WrongIdentifierError
+from tpu_task.common.ssh import DeterministicSSHKeyPair
+from tpu_task.common.steps import Step, run_steps
+from tpu_task.common.values import Event, Status, StatusCode
+from tpu_task.common.values import Task as TaskSpec
+from tpu_task.machine import render_script
+from tpu_task.storage import (
+    delete_storage,
+    limit_transfer,
+    logs as storage_logs,
+    status as storage_status,
+    transfer,
+)
+from tpu_task.task import Task
+
+# Generic region → TPU zone map (the reference's region maps, client.go:47-52).
+REGIONS: Dict[str, str] = {
+    "us-east": "us-east1-d",
+    "us-west": "us-west4-a",
+    "us-central1": "us-central1-a",
+    "us-central2": "us-central2-b",
+    "eu-west": "europe-west4-a",
+    "eu-north": "europe-north2-b",
+    "ap-northeast": "asia-northeast1-b",
+}
+
+
+def resolve_zone(region: str) -> str:
+    if region in REGIONS:
+        return REGIONS[region]
+    # Already zone-shaped ("us-central2-b").
+    if region.count("-") >= 2:
+        return region
+    raise ValueError(f"cannot resolve TPU zone for region {region!r}")
+
+
+def fake_mode() -> bool:
+    return bool(os.environ.get("TPU_TASK_FAKE_TPU_ROOT"))
+
+
+class TPUTask(Task):
+    def __init__(self, cloud: Cloud, identifier: Identifier, spec: TaskSpec):
+        self.cloud = cloud
+        self.identifier = identifier
+        self.spec = spec
+        self.accelerator: Accelerator = parse_accelerator(spec.size.machine or "v2-8")
+        self.zone = resolve_zone(str(cloud.region))
+        self._events: List[Event] = []
+        # Recovery events survive across reads — they are the MTTR record.
+        self._recovery_events: List[Event] = []
+
+        if fake_mode():
+            self.client = FakeTpuControlPlane()
+            self._bucket_dir = os.path.join(self.client.root, "buckets", identifier.long())
+        else:
+            credentials = ""
+            if cloud.credentials.gcp:
+                credentials = cloud.credentials.gcp.application_credentials
+            project = ""
+            if credentials:
+                project = json.loads(credentials).get("project_id", "")
+            self.client = RestTpuClient(project=project, zone=self.zone,
+                                        credentials_json=credentials)
+            self._bucket_dir = ""
+
+    # -- resources ------------------------------------------------------------
+    def _qr_name(self, index: int) -> str:
+        return f"{self.identifier.long()}-{index}"
+
+    def _remote(self) -> str:
+        """Bucket connection string (StorageCredentials.ConnectionString parity)."""
+        if self.spec.remote_storage is not None:
+            config = dict(self.spec.remote_storage.config)
+            from tpu_task.storage import Connection
+
+            return str(Connection(backend="googlecloudstorage",
+                                  container=self.spec.remote_storage.container,
+                                  path=self.spec.remote_storage.path,
+                                  config=config))
+        if fake_mode():
+            return self._bucket_dir
+        config = {}
+        if self.cloud.credentials.gcp and self.cloud.credentials.gcp.application_credentials:
+            config["service_account_credentials"] = \
+                self.cloud.credentials.gcp.application_credentials
+        from tpu_task.storage import Connection
+
+        return str(Connection(backend="googlecloudstorage",
+                              container=self.identifier.long(), config=config))
+
+    def _credentials_env(self) -> Dict[str, str]:
+        """Env map injected into workers (data_source_credentials.go:30-49)."""
+        env = {
+            "TPU_TASK_REMOTE": self._remote(),
+            "TPU_TASK_CLOUD_PROVIDER": "tpu",
+            "TPU_TASK_CLOUD_REGION": str(self.cloud.region),
+            "TPU_TASK_IDENTIFIER": self.identifier.long(),
+        }
+        if self.cloud.credentials.gcp and self.cloud.credentials.gcp.application_credentials:
+            env["GOOGLE_APPLICATION_CREDENTIALS_DATA"] = \
+                self.cloud.credentials.gcp.application_credentials
+        return env
+
+    def _timeout_epoch(self) -> Optional[datetime]:
+        timeout = self.spec.environment.timeout
+        if timeout is None:
+            return None
+        return datetime.fromtimestamp(time.time() + timeout.total_seconds(),
+                                      tz=timezone.utc)
+
+    def _qr_spec(self) -> QueuedResourceSpec:
+        variables = self.spec.environment.variables
+        startup = render_script(
+            self.spec.environment.script, self._credentials_env(), variables,
+            self._timeout_epoch(),
+        )
+        metadata = {
+            # Contract consumed by the fake control plane's worker executor;
+            # harmless extra metadata on real nodes.
+            "tpu-task-remote": self._remote(),
+            "tpu-task-script-b64": base64.b64encode(
+                self.spec.environment.script.encode()).decode(),
+            "tpu-task-timeout": str(int(self._timeout_epoch().timestamp())
+                                    if self._timeout_epoch() else 0),
+            "tpu-task-log-period": os.environ.get("TPU_TASK_LOCAL_LOG_PERIOD", "5"),
+            "tpu-task-data-period": os.environ.get("TPU_TASK_LOCAL_DATA_PERIOD", "10"),
+        }
+        for name, value in {**self._credentials_env(),
+                            **variables.enrich()}.items():
+            metadata[f"tpu-task-env-{name}"] = value
+        return QueuedResourceSpec(
+            node_id="",  # set per queued resource
+            accelerator_type=self.accelerator.type,
+            runtime_version=self.spec.environment.image
+            if self.spec.environment.image not in ("", "ubuntu", "nvidia")
+            else self.accelerator.runtime_version,
+            startup_script=startup,
+            metadata=metadata,
+            labels=dict(self.cloud.tags),
+            spot=self.spec.spot >= 0,
+            service_account=self.spec.permission_set,
+        )
+
+    # -- lifecycle ------------------------------------------------------------
+    def create(self) -> None:
+        run_steps([
+            Step(f"Parsing accelerator {self.accelerator.type} "
+                 f"({self.accelerator.chips} chips, {self.accelerator.workers} workers)...",
+                 lambda: None),
+            Step("Creating storage bucket...", self._create_bucket),
+            Step("Uploading directory...", self.push),
+            Step("Submitting queued resources...", self.start),
+        ])
+
+    def _create_bucket(self) -> None:
+        if fake_mode():
+            os.makedirs(self._bucket_dir, exist_ok=True)
+            return
+        # Real mode: create the GCS bucket via the JSON API (idempotent).
+        from tpu_task.storage.backends import GCSBackend
+
+        backend = GCSBackend(self.identifier.long(),
+                             config=self._storage_config())
+        if backend.exists():
+            return
+        import urllib.request
+
+        project = self.client.project  # type: ignore[union-attr]
+        url = f"https://storage.googleapis.com/storage/v1/b?project={project}"
+        body = json.dumps({"name": self.identifier.long(),
+                           "location": self.zone.rsplit("-", 1)[0]}).encode()
+        request = urllib.request.Request(url, data=body, method="POST")
+        request.add_header("Authorization", "Bearer " + backend._access_token())
+        request.add_header("Content-Type", "application/json")
+        urllib.request.urlopen(request, timeout=60)
+
+    def _storage_config(self) -> Dict[str, str]:
+        if self.cloud.credentials.gcp and self.cloud.credentials.gcp.application_credentials:
+            return {"service_account_credentials":
+                    self.cloud.credentials.gcp.application_credentials}
+        return {}
+
+    def start(self) -> None:
+        spec = self._qr_spec()
+        for index in range(self.spec.parallelism):
+            qr_spec = QueuedResourceSpec(**{**spec.__dict__,
+                                            "node_id": self._qr_name(index)})
+            self.client.create_queued_resource(self._qr_name(index), qr_spec)
+
+    def stop(self) -> None:
+        for index in range(max(self.spec.parallelism, len(self._existing_qrs()))):
+            try:
+                self.client.delete_queued_resource(self._qr_name(index), force=True)
+            except ResourceNotFoundError:
+                pass
+
+    def _existing_qrs(self) -> List[str]:
+        prefix = self.identifier.long() + "-"
+        return [name for name in self.client.list_queued_resources()
+                if name.startswith(prefix)]
+
+    def read(self) -> None:
+        # Self-destruct: worker 0 leaves a shutdown marker in the bucket at
+        # task exit (alongside calling `tpu-task stop` directly when it has
+        # credentials); observing it releases the TPU capacity
+        # (machine-script.sh.tpl:10-14 semantics).
+        if self._shutdown_requested() and self._existing_qrs():
+            self._recovery_events.append(Event(
+                time=datetime.now(timezone.utc), code="self-destruct",
+                description=["shutdown marker observed; releasing slices"]))
+            self.stop()
+
+        addresses: List[str] = []
+        running = 0
+        self._events = []
+        for name in self._existing_qrs():
+            try:
+                info = self.client.get_queued_resource(name)
+            except ResourceNotFoundError:
+                continue
+            for event in info.events:
+                self._events.append(Event(
+                    time=datetime.fromisoformat(event["time"]),
+                    code=event["code"], description=[event["description"]]))
+            if info.state == QR_SUSPENDED and self.spec.spot >= 0:
+                self._recover(info)
+                continue
+            if info.state == QR_ACTIVE and info.node_name:
+                try:
+                    node = self.client.get_node(info.node_name)
+                except ResourceNotFoundError:
+                    continue
+                if node.state == "READY":
+                    running += 1
+                    addresses.extend(node.endpoints)
+        self.spec.addresses = addresses
+        self.spec.status = self.status(running=running)
+        self.spec.events = self.events()
+
+    def _shutdown_requested(self) -> bool:
+        from tpu_task.storage.backends import open_backend
+
+        try:
+            backend, _ = open_backend(self._remote())
+            backend.read("shutdown")
+            return True
+        except Exception:
+            return False
+
+    def _recover(self, info: QueuedResourceInfo) -> None:
+        """The preemption-recovery reconciler: SUSPENDED → delete → re-queue.
+
+        Workers of the re-granted node restore their workdir from the bucket
+        (render_script / local agent restore path), so user scripts resume
+        from the last synced checkpoint — ASG-respawn semantics made explicit.
+        """
+        self._recovery_events.append(Event(
+            time=datetime.now(timezone.utc), code="recover",
+            description=[f"re-queueing preempted {info.name}"]))
+        spec = info.spec
+        if not spec.accelerator_type:
+            spec = QueuedResourceSpec(**{**self._qr_spec().__dict__,
+                                         "node_id": info.name})
+        try:
+            self.client.delete_queued_resource(info.name, force=True)
+        except ResourceNotFoundError:
+            pass
+        self.client.create_queued_resource(info.name, spec)
+
+    def delete(self) -> None:
+        if self.spec.environment.directory:
+            try:
+                self.pull()
+            except ResourceNotFoundError:
+                pass
+        self.stop()
+        try:
+            delete_storage(self._remote())
+        except ResourceNotFoundError:
+            pass
+        if fake_mode() and os.path.isdir(self._bucket_dir):
+            import shutil
+
+            shutil.rmtree(self._bucket_dir, ignore_errors=True)
+
+    # -- data plane -----------------------------------------------------------
+    def push(self) -> None:
+        if not self.spec.environment.directory:
+            return
+        transfer(self.spec.environment.directory,
+                 self._data_remote(),
+                 self.spec.environment.exclude_list)
+
+    def pull(self) -> None:
+        if not self.spec.environment.directory:
+            return
+        rules = limit_transfer(self.spec.environment.directory_out,
+                               list(self.spec.environment.exclude_list))
+        transfer(self._data_remote(), self.spec.environment.directory, rules)
+
+    def _data_remote(self) -> str:
+        remote = self._remote()
+        if remote.startswith(":"):
+            from tpu_task.storage import Connection
+
+            conn = Connection.parse(remote)
+            conn.path = (conn.path or "") + "/data"
+            return str(conn)
+        return os.path.join(remote, "data")
+
+    # -- observation ----------------------------------------------------------
+    def status(self, running: Optional[int] = None) -> Status:
+        if running is None:
+            running = 0
+            for name in self._existing_qrs():
+                try:
+                    info = self.client.get_queued_resource(name)
+                    if info.state == QR_ACTIVE and info.node_name:
+                        node = self.client.get_node(info.node_name)
+                        if node.state == "READY":
+                            running += 1
+                except ResourceNotFoundError:
+                    continue
+        initial: Status = {StatusCode.ACTIVE: running}
+        try:
+            return storage_status(self._remote(), initial)
+        except ResourceNotFoundError:
+            return initial
+
+    def events(self) -> List[Event]:
+        return list(self._events) + list(self._recovery_events)
+
+    def logs(self) -> List[str]:
+        try:
+            return storage_logs(self._remote())
+        except ResourceNotFoundError:
+            return []
+
+    def get_identifier(self) -> Identifier:
+        return self.identifier
+
+    def get_addresses(self) -> List[str]:
+        return list(self.spec.addresses)
+
+    def get_key_pair(self) -> Optional[DeterministicSSHKeyPair]:
+        """Deterministic keypair from the cloud secret (client.go:92 parity)."""
+        secret = ""
+        if self.cloud.credentials.gcp:
+            secret = self.cloud.credentials.gcp.application_credentials
+        if not secret:
+            if not fake_mode():
+                return None
+            secret = "fake-tpu-control-plane"
+        return DeterministicSSHKeyPair(secret, self.identifier.long())
+
+
+def list_tpu_tasks(cloud: Cloud) -> List[Identifier]:
+    if fake_mode():
+        client = FakeTpuControlPlane()
+    else:
+        credentials = ""
+        if cloud.credentials.gcp:
+            credentials = cloud.credentials.gcp.application_credentials
+        project = json.loads(credentials).get("project_id", "") if credentials else ""
+        client = RestTpuClient(project=project, zone=resolve_zone(str(cloud.region)),
+                               credentials_json=credentials)
+    identifiers = []
+    seen = set()
+    for name in client.list_queued_resources():
+        base = name.rsplit("-", 1)[0]
+        if base in seen:
+            continue
+        seen.add(base)
+        try:
+            identifiers.append(Identifier.parse(base))
+        except WrongIdentifierError:
+            continue
+    return identifiers
