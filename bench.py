@@ -1,0 +1,114 @@
+"""Headline benchmark: apply → task-done wall-clock for a JAX MNIST task.
+
+Mirrors BASELINE.md config 1/2: a 2-epoch JAX MNIST training script is run
+through the FULL task lifecycle — create (provision + push workdir) → agent
+executes under supervision with log/status/data sync loops → status polled to
+`succeeded` → delete (pull outputs + teardown) — against the hermetic local
+control plane, end to end, exactly the path the cloud backends share.
+
+Baseline: the reference has no published numbers (BASELINE.md); its
+create-phase budget is the 15-minute default timeout
+(/root/reference/iterative/resource_task.go:197-202). vs_baseline is
+wall-clock / 900 s — lower is better.
+
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent
+sys.path.insert(0, str(REPO))
+
+BASELINE_SECONDS = 900.0  # reference default create timeout budget
+
+MNIST_SCRIPT = """#!/usr/bin/env python3
+import os, sys
+sys.path.insert(0, os.environ["TPU_TASK_REPO"])
+import jax
+from tpu_task.ml.models import mnist
+from tpu_task.ml import save_checkpoint
+
+x, y = mnist.synthetic_mnist(jax.random.PRNGKey(0), n=2048)
+params = mnist.init_mlp(jax.random.PRNGKey(1))
+grad = jax.jit(jax.grad(mnist.loss_fn))
+for epoch in range(2):
+    for i in range(0, len(x), 256):
+        g = grad(params, x[i:i+256], y[i:i+256])
+        params = jax.tree.map(lambda p, g: p - 0.1 * g, params, g)
+    save_checkpoint("checkpoints", epoch, params)
+    print(f"epoch {epoch} acc {mnist.accuracy(params, x, y):.3f}", flush=True)
+os.makedirs("output", exist_ok=True)
+with open("output/final_acc.txt", "w") as f:
+    f.write(f"{mnist.accuracy(params, x, y):.4f}\\n")
+"""
+
+
+def main() -> int:
+    from tpu_task import task as task_factory
+    from tpu_task.common.cloud import Cloud, Provider
+    from tpu_task.common.identifier import Identifier
+    from tpu_task.common.values import Environment, StatusCode, Task as TaskSpec, Variables
+
+    tmp = Path(tempfile.mkdtemp(prefix="tpu-task-bench-"))
+    os.environ["TPU_TASK_LOCAL_ROOT"] = str(tmp / "control-plane")
+    os.environ["TPU_TASK_LOCAL_LOG_PERIOD"] = "0.5"
+    os.environ["TPU_TASK_LOCAL_DATA_PERIOD"] = "0.5"
+
+    workdir = tmp / "work"
+    workdir.mkdir(parents=True)
+    (workdir / "train.py").write_text(MNIST_SCRIPT)
+
+    spec = TaskSpec()
+    spec.environment = Environment(
+        script="#!/bin/bash\npython3 train.py\n",
+        variables=Variables({"TPU_TASK_REPO": str(REPO)}),
+        directory=str(workdir),
+        directory_out="output",
+    )
+    cloud = Cloud(provider=Provider.LOCAL)
+    task = task_factory.new(cloud, Identifier.random("bench"), spec)
+
+    start = time.monotonic()
+    task.create()
+    deadline = time.monotonic() + 600
+    status = {}
+    while time.monotonic() < deadline:
+        task.read()
+        status = task.status()
+        if status.get(StatusCode.SUCCEEDED, 0) >= 1:
+            break
+        if status.get(StatusCode.FAILED, 0) >= 1:
+            print("".join(task.logs()), file=sys.stderr)
+            raise SystemExit("bench task failed")
+        time.sleep(0.25)
+    else:
+        print("".join(task.logs()), file=sys.stderr)
+        raise SystemExit("bench task timed out")
+    task.delete()
+    elapsed = time.monotonic() - start
+
+    acc_file = workdir / "output" / "final_acc.txt"
+    if not acc_file.exists():
+        raise SystemExit("output was not pulled on delete")
+
+    print(json.dumps({
+        "metric": "apply→task-done wall-clock (2-epoch JAX MNIST, full lifecycle)",
+        "value": round(elapsed, 2),
+        "unit": "s",
+        "vs_baseline": round(elapsed / BASELINE_SECONDS, 4),
+    }))
+    import shutil
+
+    shutil.rmtree(tmp, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

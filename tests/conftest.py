@@ -8,9 +8,21 @@ Must run before any ``import jax`` anywhere in the test session.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, not setdefault: the axon TPU tunnel exports JAX_PLATFORMS=axon,
+# which would put the hermetic suite on one real chip instead of 8 CPU devices.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+if not os.environ.get("TPU_TASK_TEST_REAL_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # The axon sitecustomize imports jax at interpreter startup, baking the
+    # env in before this file runs; update the live config too. jax itself
+    # is optional — the orchestrator tests run without it.
+    try:
+        import jax
+    except ImportError:
+        pass
+    else:
+        jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

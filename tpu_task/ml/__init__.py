@@ -1,0 +1,27 @@
+"""TPU-native compute stack: the task library user scripts import.
+
+The reference orchestrates machines and leaves all compute to the user script
+(SURVEY.md §2.9: no collective ops, no tensor parallelism anywhere in
+/root/reference). For a TPU-native framework the compute stack is
+first-class: this package provides the mesh/sharding utilities, a flagship
+transformer LM with dp/fsdp/tp shardings, ring attention for sequence
+parallelism, pallas TPU kernels, and a checkpoint-to-workdir helper that
+makes the orchestrator's continuous data sync (machine-script.sh.tpl:118-124
+semantics) meaningful for training jobs.
+"""
+
+from tpu_task.ml.checkpoint import latest_step, restore_checkpoint, save_checkpoint
+from tpu_task.ml.parallel.mesh import (
+    balanced_mesh_shape,
+    distributed_init_from_env,
+    make_mesh,
+)
+
+__all__ = [
+    "balanced_mesh_shape",
+    "distributed_init_from_env",
+    "latest_step",
+    "make_mesh",
+    "restore_checkpoint",
+    "save_checkpoint",
+]

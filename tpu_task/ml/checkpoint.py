@@ -1,0 +1,94 @@
+"""Checkpoint-to-workdir: what makes the orchestrator's 10 s data sync useful.
+
+The reference's recovery story is "user script checkpoints into the workdir,
+the agent syncs the workdir to the bucket every 10 s, a respawned machine
+restores the workdir before restarting" (machine-script.sh.tpl:89,118-124 and
+docs/resources/task.md:33-42 — the epoch-file pattern). This module is the
+user-script half of that contract for JAX pytrees:
+
+* atomic writes (temp file + rename) so the sync loop never ships a torn file;
+* monotonically numbered steps + a LATEST pointer written last;
+* restore returns the template pytree's structure/dtypes/shardings.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import tempfile
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+_STEP_RE = re.compile(r"^ckpt-(\d+)\.npz$")
+
+
+def save_checkpoint(directory, step: int, tree: Any) -> Path:
+    """Write ``ckpt-{step}.npz`` atomically, then update LATEST."""
+    directory = Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    leaves = [np.asarray(l) for l in jax.tree.leaves(tree)]
+    arrays = {f"leaf_{i}": a for i, a in enumerate(leaves)}
+
+    final = directory / f"ckpt-{step}.npz"
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, final)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+    pointer = directory / "LATEST.tmp"
+    pointer.write_text(json.dumps({"step": step, "file": final.name}))
+    os.replace(pointer, directory / "LATEST")
+    return final
+
+
+def latest_step(directory) -> Optional[int]:
+    """Highest complete checkpoint step in ``directory``, or None."""
+    directory = Path(directory)
+    pointer = directory / "LATEST"
+    if pointer.exists():
+        try:
+            meta = json.loads(pointer.read_text())
+            if (directory / meta["file"]).exists():
+                return int(meta["step"])
+        except (ValueError, KeyError):
+            pass
+    steps = [
+        int(m.group(1))
+        for p in (directory.iterdir() if directory.is_dir() else [])
+        if (m := _STEP_RE.match(p.name))
+    ]
+    return max(steps) if steps else None
+
+
+def restore_checkpoint(directory, template: Any, step: Optional[int] = None) -> Any:
+    """Restore into ``template``'s structure (dtypes/shardings preserved)."""
+    directory = Path(directory)
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {directory}")
+    with np.load(directory / f"ckpt-{step}.npz") as data:
+        arrays = [data[f"leaf_{i}"] for i in range(len(data.files))]
+    leaves, treedef = jax.tree.flatten(template)
+    if len(arrays) != len(leaves):
+        raise ValueError(
+            f"checkpoint has {len(arrays)} leaves, template has {len(leaves)}"
+        )
+    restored = []
+    for arr, leaf in zip(arrays, leaves):
+        if hasattr(leaf, "sharding") and hasattr(leaf, "dtype"):
+            restored.append(
+                jax.device_put(arr.astype(leaf.dtype), leaf.sharding)
+            )
+        else:
+            restored.append(arr)
+    return jax.tree.unflatten(treedef, restored)

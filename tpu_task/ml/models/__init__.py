@@ -1,0 +1,17 @@
+"""Model zoo: flagship transformer LM + MNIST reference models."""
+
+from tpu_task.ml.models.transformer import (
+    TransformerConfig,
+    apply as transformer_apply,
+    init as transformer_init,
+    loss_fn as transformer_loss,
+    param_pspecs,
+)
+
+__all__ = [
+    "TransformerConfig",
+    "transformer_apply",
+    "transformer_init",
+    "transformer_loss",
+    "param_pspecs",
+]

@@ -1,0 +1,48 @@
+"""MNIST reference models — the workload of the baseline configs.
+
+BASELINE.md configs 1-2 run "2-epoch MNIST" through the task lifecycle; this
+module is the model those task scripts import. Includes a synthetic-data
+generator so benchmarks run with zero network egress.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def init_mlp(rng, d_in: int = 784, d_hidden: int = 256, n_classes: int = 10) -> Dict[str, Any]:
+    k1, k2 = jax.random.split(rng)
+    return {
+        "w1": jax.random.normal(k1, (d_in, d_hidden), jnp.float32) * (d_in ** -0.5),
+        "b1": jnp.zeros((d_hidden,), jnp.float32),
+        "w2": jax.random.normal(k2, (d_hidden, n_classes), jnp.float32) * (d_hidden ** -0.5),
+        "b2": jnp.zeros((n_classes,), jnp.float32),
+    }
+
+
+def apply_mlp(params, x):
+    h = jax.nn.relu(x @ params["w1"] + params["b1"])
+    return h @ params["w2"] + params["b2"]
+
+
+def loss_fn(params, x, y):
+    logits = apply_mlp(params, x)
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, y[:, None], axis=-1)[:, 0]
+    return nll.mean()
+
+
+def accuracy(params, x, y):
+    return (apply_mlp(params, x).argmax(-1) == y).mean()
+
+
+def synthetic_mnist(rng, n: int = 4096) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Linearly-separable-ish synthetic digits: class-dependent mean + noise."""
+    k1, k2 = jax.random.split(rng)
+    y = jax.random.randint(k1, (n,), 0, 10)
+    protos = jax.random.normal(jax.random.PRNGKey(0), (10, 784)) * 2.0
+    x = protos[y] + jax.random.normal(k2, (n, 784))
+    return x, y

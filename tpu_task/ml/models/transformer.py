@@ -1,0 +1,158 @@
+"""Flagship decoder-only transformer LM, pure-functional JAX.
+
+TPU-first design choices: bfloat16 activations with float32 params and
+softmax; rotary positions computed inside the traced function (static
+shapes); attention through the fused op in ``tpu_task.ml.ops.attention``;
+every parameter annotated with *logical* axes so one rules table
+(``tpu_task.ml.parallel.sharding``) lays it out over a dp/fsdp/tp mesh.
+
+The reference has no model code at all (SURVEY.md §2.9) — this is the task
+library its user scripts would have to bring themselves.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpu_task.ml.ops.attention import dot_product_attention
+from tpu_task.ml.parallel.sharding import logical_to_mesh_axes
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32768
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    d_head: int = 64
+    d_ff: int = 1408
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def d_attn(self) -> int:
+        return self.n_heads * self.d_head
+
+
+# -- init --------------------------------------------------------------------
+
+def _dense(key, shape, scale):
+    return jax.random.normal(key, shape, dtype=jnp.float32) * scale
+
+
+def init(rng, cfg: TransformerConfig) -> Params:
+    keys = iter(jax.random.split(rng, 2 + 7 * cfg.n_layers))
+    scale = cfg.d_model ** -0.5
+    params: Params = {
+        "embed": _dense(next(keys), (cfg.vocab_size, cfg.d_model), 1.0),
+        "unembed": _dense(next(keys), (cfg.d_model, cfg.vocab_size), scale),
+        "final_norm": jnp.ones((cfg.d_model,), jnp.float32),
+        "layers": [],
+    }
+    for _ in range(cfg.n_layers):
+        params["layers"].append({
+            "attn_norm": jnp.ones((cfg.d_model,), jnp.float32),
+            "wq": _dense(next(keys), (cfg.d_model, cfg.d_attn), scale),
+            "wk": _dense(next(keys), (cfg.d_model, cfg.d_attn), scale),
+            "wv": _dense(next(keys), (cfg.d_model, cfg.d_attn), scale),
+            "wo": _dense(next(keys), (cfg.d_attn, cfg.d_model), scale),
+            "mlp_norm": jnp.ones((cfg.d_model,), jnp.float32),
+            "w_gate": _dense(next(keys), (cfg.d_model, cfg.d_ff), scale),
+            "w_up": _dense(next(keys), (cfg.d_model, cfg.d_ff), scale),
+            "w_down": _dense(next(keys), (cfg.d_ff, cfg.d_model), cfg.d_ff ** -0.5),
+        })
+    return params
+
+
+def param_logical_axes(cfg: TransformerConfig) -> Params:
+    layer = {
+        "attn_norm": ("norm",),
+        "wq": ("embed", "heads"),
+        "wk": ("embed", "heads"),
+        "wv": ("embed", "heads"),
+        "wo": ("heads", "embed"),
+        "mlp_norm": ("norm",),
+        "w_gate": ("embed", "mlp"),
+        "w_up": ("embed", "mlp"),
+        "w_down": ("mlp", "embed"),
+    }
+    return {
+        "embed": ("vocab", "embed"),
+        "unembed": ("embed", "vocab"),
+        "final_norm": ("norm",),
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+    }
+
+
+def param_pspecs(cfg: TransformerConfig, mesh=None, rules=None) -> Params:
+    axes = param_logical_axes(cfg)
+    return jax.tree.map(
+        lambda a: logical_to_mesh_axes(a, rules=rules, mesh=mesh),
+        axes,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
+# -- forward -----------------------------------------------------------------
+
+def _rmsnorm(x, scale):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x.astype(jnp.float32) * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale.astype(x.dtype)
+
+
+def _rope(x, theta: float):
+    """Rotary embedding over (batch, seq, heads, head_dim)."""
+    _, seq, _, d = x.shape
+    half = d // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = jnp.arange(seq, dtype=jnp.float32)[:, None] * freqs[None, :]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
+def _block(x, layer, cfg: TransformerConfig, attn_fn):
+    b, s, _ = x.shape
+    h = _rmsnorm(x, layer["attn_norm"])
+    q = (h @ layer["wq"].astype(cfg.dtype)).reshape(b, s, cfg.n_heads, cfg.d_head)
+    k = (h @ layer["wk"].astype(cfg.dtype)).reshape(b, s, cfg.n_heads, cfg.d_head)
+    v = (h @ layer["wv"].astype(cfg.dtype)).reshape(b, s, cfg.n_heads, cfg.d_head)
+    q, k = _rope(q, cfg.rope_theta), _rope(k, cfg.rope_theta)
+    attn = attn_fn(q, k, v)
+    x = x + attn.reshape(b, s, cfg.d_attn) @ layer["wo"].astype(cfg.dtype)
+
+    h = _rmsnorm(x, layer["mlp_norm"])
+    gate = jax.nn.silu(h @ layer["w_gate"].astype(cfg.dtype))
+    up = h @ layer["w_up"].astype(cfg.dtype)
+    x = x + (gate * up) @ layer["w_down"].astype(cfg.dtype)
+    return x
+
+
+def apply(params: Params, cfg: TransformerConfig, tokens, attn_fn=None):
+    """tokens: (batch, seq) int32 → logits (batch, seq, vocab) float32."""
+    if attn_fn is None:
+        attn_fn = lambda q, k, v: dot_product_attention(q, k, v, True)
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    for layer in params["layers"]:
+        x = _block(x, layer, cfg, attn_fn)
+    x = _rmsnorm(x, params["final_norm"])
+    return (x @ params["unembed"].astype(cfg.dtype)).astype(jnp.float32)
+
+
+def loss_fn(params: Params, cfg: TransformerConfig, tokens, attn_fn=None):
+    """Next-token cross-entropy; tokens (batch, seq)."""
+    logits = apply(params, cfg, tokens[:, :-1], attn_fn=attn_fn)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()

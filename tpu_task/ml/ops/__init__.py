@@ -1,0 +1,5 @@
+"""TPU kernels (pallas) and their XLA reference implementations."""
+
+from tpu_task.ml.ops.attention import dot_product_attention, mha_reference
+
+__all__ = ["dot_product_attention", "mha_reference"]

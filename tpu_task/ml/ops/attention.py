@@ -1,0 +1,144 @@
+"""Fused attention: pallas flash kernel on TPU, XLA reference elsewhere.
+
+Forward is a flash-attention pallas kernel (online softmax, blocked over the
+query sequence, MXU-shaped tiles); backward recomputes through the XLA
+reference implementation (rematerialisation — trades FLOPs for the O(S²)
+attention matrix that would otherwise live in HBM).
+
+Shapes follow (batch, seq, heads, head_dim) throughout.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def mha_reference(q, k, v, causal: bool = True):
+    """Plain XLA attention — the numerical ground truth for the kernels."""
+    *_, d = q.shape
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(d)
+    if causal:
+        q_len, k_len = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((q_len, k_len), dtype=bool), k=k_len - q_len)
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, q_offset_blocks: int):
+    """One (batch*head, q_block) grid cell: online softmax over kv blocks.
+
+    q_ref: (block_q, d); k_ref/v_ref: (seq_k, d); o_ref: (block_q, d).
+    """
+    from jax.experimental import pallas as pl
+
+    block_q, d = q_ref.shape
+    seq_k = k_ref.shape[0]
+    q = q_ref[...].astype(jnp.float32) / math.sqrt(d)
+
+    q_block_idx = pl.program_id(1)
+    q_start = (q_block_idx + q_offset_blocks) * block_q
+
+    m = jnp.full((block_q,), NEG_INF, dtype=jnp.float32)
+    l = jnp.zeros((block_q,), dtype=jnp.float32)
+    acc = jnp.zeros((block_q, d), dtype=jnp.float32)
+
+    num_k_blocks = seq_k // block_k
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k_blk = k_ref[pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
+        s = q @ k_blk.T  # (block_q, block_k)
+        if causal:
+            q_pos = q_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        correction = jnp.exp(m - m_new)
+        l_new = l * correction + p.sum(axis=-1)
+        acc_new = acc * correction[:, None] + p @ v_blk
+        return m_new, l_new, acc_new
+
+    m, l, acc = lax.fori_loop(0, num_k_blocks, body, (m, l, acc))
+    o_ref[...] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def flash_attention(
+    q, k, v, causal: bool = True, *, block_q: int = 256, block_k: int = 256,
+    interpret: bool = False,
+):
+    """Pallas flash attention forward. q: (b, sq, h, d), k/v: (b, sk, h, d)."""
+    from jax.experimental import pallas as pl
+
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError(f"seq lengths ({sq},{sk}) must divide blocks ({block_q},{block_k})")
+    if causal and sq != sk:
+        raise ValueError(
+            f"causal flash attention requires sq == sk (prefix-aligned mask); "
+            f"got ({sq},{sk}) — use mha_reference for cross-length causal")
+
+    # Fold heads into the leading grid dim: (b*h, seq, d).
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+
+    # For cross-chunk (ring) use the caller aligns positions itself; here
+    # q offset 0 matches self-attention and sq == sk causal semantics.
+    kernel = functools.partial(
+        _flash_kernel, block_k=block_k, causal=causal, q_offset_blocks=0
+    )
+    grid = (b * h, sq // block_q)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda bh, qb: (bh, qb, 0)),
+            pl.BlockSpec((None, sk, d), lambda bh, qb: (bh, 0, 0)),
+            pl.BlockSpec((None, sk, d), lambda bh, qb: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda bh, qb: (bh, qb, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+def _use_pallas() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def dot_product_attention(q, k, v, causal: bool = True):
+    """Attention with a flash forward on TPU and recompute backward."""
+    # Flash path only for self-attention shapes: its causal mask is
+    # prefix-aligned (q_pos >= k_pos), matching mha_reference's suffix-aligned
+    # tril only when sq == sk.
+    if (_use_pallas() and q.shape[1] == k.shape[1] and q.shape[1] % 128 == 0):
+        return flash_attention(q, k, v, causal, block_q=128, block_k=128)
+    return mha_reference(q, k, v, causal)
+
+
+def _dpa_fwd(q, k, v, causal):
+    return dot_product_attention(q, k, v, causal), (q, k, v)
+
+
+def _dpa_bwd(causal, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: mha_reference(q, k, v, causal), q, k, v)
+    return vjp(g)
+
+
+dot_product_attention.defvjp(_dpa_fwd, _dpa_bwd)

@@ -1,0 +1,21 @@
+"""Parallelism: device meshes, sharding rules, sequence-parallel attention."""
+
+from tpu_task.ml.parallel.mesh import (
+    balanced_mesh_shape,
+    distributed_init_from_env,
+    make_mesh,
+)
+from tpu_task.ml.parallel.sharding import (
+    logical_to_mesh_axes,
+    named_sharding,
+    shard_pytree,
+)
+
+__all__ = [
+    "balanced_mesh_shape",
+    "distributed_init_from_env",
+    "logical_to_mesh_axes",
+    "make_mesh",
+    "named_sharding",
+    "shard_pytree",
+]

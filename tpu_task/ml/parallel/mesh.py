@@ -1,0 +1,117 @@
+"""Device-mesh construction and multi-host JAX bring-up.
+
+The orchestrator exports ``TPU_TASK_COORDINATOR`` / ``TPU_TASK_NUM_WORKERS`` /
+``TPU_TASK_WORKER_ID`` on every TPU-VM worker (the TPU-native analog of the
+reference's only rank mechanism, K8s IndexedCompletion —
+/root/reference/task/k8s/resources/resource_job.go:135-140).
+``distributed_init_from_env`` turns those into ``jax.distributed.initialize``
+so a user script gets a global view of every chip in the slice.
+
+Meshes carry the standard axis vocabulary:
+
+* ``dp``   — pure data parallelism (params replicated)
+* ``fsdp`` — data parallelism with parameter sharding (ZeRO-3 style)
+* ``tp``   — tensor (model) parallelism inside each layer
+* ``sp``   — sequence/context parallelism (ring attention)
+
+XLA inserts the collectives; shardings ride ICI within a slice.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def balanced_mesh_shape(n_devices: int, n_axes: int = 3) -> Tuple[int, ...]:
+    """Factor ``n_devices`` into ``n_axes`` near-equal power-of-two-ish factors.
+
+    Greedy: repeatedly divide by the largest prime factor, assigning to the
+    currently smallest axis. For 8 devices / 3 axes → (2, 2, 2).
+    """
+    if n_devices < 1:
+        raise ValueError("n_devices must be >= 1")
+    axes = [1] * n_axes
+    remaining = n_devices
+    while remaining > 1:
+        # smallest prime factor
+        factor = next(
+            (p for p in range(2, int(math.isqrt(remaining)) + 1) if remaining % p == 0),
+            remaining,
+        )
+        axes[axes.index(min(axes))] *= factor
+        remaining //= factor
+    return tuple(sorted(axes, reverse=True))
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    *,
+    axis_names: Sequence[str] = ("dp", "fsdp", "tp"),
+    axis_sizes: Optional[Sequence[int]] = None,
+    devices: Optional[Sequence] = None,
+):
+    """Build a ``jax.sharding.Mesh`` over the first ``n_devices`` devices.
+
+    ``axis_sizes`` defaults to a balanced factorization of the device count.
+    """
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            if n_devices > len(devices):
+                raise ValueError(
+                    f"asked for {n_devices} devices, have {len(devices)}"
+                )
+            devices = devices[:n_devices]
+    n = len(devices)
+    if axis_sizes is None:
+        axis_sizes = balanced_mesh_shape(n, len(axis_names))
+    if math.prod(axis_sizes) != n:
+        raise ValueError(f"axis sizes {axis_sizes} != {n} devices")
+    dev_array = np.asarray(devices).reshape(axis_sizes)
+    return jax.sharding.Mesh(dev_array, tuple(axis_names))
+
+
+def worker_env(worker_id: int, num_workers: int, coordinator: str) -> dict:
+    """The env-var contract the orchestrator writes on each TPU-VM worker."""
+    return {
+        "TPU_TASK_WORKER_ID": str(worker_id),
+        "TPU_TASK_NUM_WORKERS": str(num_workers),
+        "TPU_TASK_COORDINATOR": coordinator,
+    }
+
+
+def distributed_init_from_env(environ=None) -> bool:
+    """Call ``jax.distributed.initialize`` from orchestrator env vars.
+
+    Returns True if multi-host init happened, False for single-host (no env
+    or one worker). Safe to call unconditionally at the top of a user script.
+    """
+    env = os.environ if environ is None else environ
+    num_workers = int(env.get("TPU_TASK_NUM_WORKERS", "1"))
+    if num_workers <= 1:
+        return False
+    coordinator = env.get("TPU_TASK_COORDINATOR")
+    worker_id = env.get("TPU_TASK_WORKER_ID")
+    if not coordinator or worker_id is None:
+        return False
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_workers,
+        process_id=int(worker_id),
+    )
+    return True
+
+
+def local_batch_slice(global_batch: int, mesh) -> int:
+    """Per-process batch size for a mesh whose batch axes span processes."""
+    import jax
+
+    return global_batch // jax.process_count()

@@ -1,0 +1,107 @@
+"""Ring attention: exact attention over sequence-sharded q/k/v.
+
+Long-context path: the sequence axis is sharded over the ``sp`` mesh axis;
+each device holds one q chunk and streams k/v chunks around the ring with
+``lax.ppermute`` (ICI neighbor exchange), folding each block into an online
+softmax accumulator. Communication overlaps compute and per-device memory is
+O(seq/P) — the standard blockwise/ring construction (Liu et al.).
+
+Causality across chunks is decided by global chunk index: a source chunk
+entirely in the future is masked out, the diagonal chunk gets the local
+triangular mask, past chunks attend fully.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, mask, m, l, acc):
+    """Fold one k/v block into the online-softmax accumulator.
+
+    q: (b, sq, h, d); k/v: (b, sk, h, d); mask: (sq, sk) bool or None.
+    m, l: (b, h, sq); acc: (b, sq, h, d). All accumulators float32.
+    """
+    d = q.shape[-1]
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) / math.sqrt(d)
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # Fully-masked rows keep m == NEG_INF; exp(s - NEG_INF) would overflow,
+    # so clamp the shift for those rows (their p is 0 anyway).
+    shift = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+    p = jnp.exp(s - shift[..., None])
+    if mask is not None:
+        p = jnp.where(mask[None, None], p, 0.0)
+    correction = jnp.exp(m - shift)
+    l_new = l * correction + p.sum(axis=-1)
+    acc_new = acc * correction.transpose(0, 2, 1)[..., None] + jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v.astype(jnp.float32)
+    )
+    return m_new, l_new, acc_new
+
+
+def ring_attention_shard(q, k, v, axis_name: str = "sp", causal: bool = True):
+    """Per-shard body: call inside ``shard_map`` with seq sharded on axis_name.
+
+    q/k/v: local chunks (batch, chunk, heads, head_dim).
+    """
+    axis_size = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+
+    # pvary: mark the fresh accumulators as device-varying over the ring axis
+    # so the fori_loop carry type matches after the first fold (JAX ≥0.8
+    # tracks varying manual axes through shard_map).
+    m = lax.pvary(jnp.full((b, h, sq), NEG_INF, jnp.float32), (axis_name,))
+    l = lax.pvary(jnp.zeros((b, h, sq), jnp.float32), (axis_name,))
+    acc = lax.pvary(jnp.zeros((b, sq, h, d), jnp.float32), (axis_name,))
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def body(step, carry):
+        k_cur, v_cur, m, l, acc = carry
+        src_idx = (my_idx - step) % axis_size
+        sk = k_cur.shape[1]
+        if causal:
+            q_pos = my_idx * sq + lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+            k_pos = src_idx * sk + lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+            mask = q_pos >= k_pos
+        else:
+            mask = None
+        m, l, acc = _block_attn(q, k_cur, v_cur, mask, m, l, acc)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return k_nxt, v_nxt, m, l, acc
+
+    k_fin, v_fin, m, l, acc = lax.fori_loop(0, axis_size, body, (k, v, m, l, acc))
+    del k_fin, v_fin
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    out = acc / safe_l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, axis_name: str = "sp", causal: bool = True):
+    """Global-view ring attention: q/k/v (batch, seq, heads, head_dim).
+
+    Shards the sequence over ``axis_name`` with shard_map and runs the ring.
+    """
+    spec = PartitionSpec(None, axis_name, None, None)
+    fn = jax.shard_map(
+        functools.partial(ring_attention_shard, axis_name=axis_name, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

@@ -1,0 +1,80 @@
+"""Logical-axis sharding rules (t5x/maxtext style).
+
+Model code annotates parameters with *logical* axis names; one rules table
+maps those to mesh axes. Changing the parallelism layout means changing the
+table, not the model.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+# logical axis -> mesh axis (or None = replicate).
+# fsdp shards the "long" parameter axis; tp shards heads/mlp.
+DEFAULT_RULES: Dict[str, Optional[object]] = {
+    "batch": ("dp", "fsdp"),   # activation batch spans both data axes
+    "seq": None,               # sequence replicated (ring attention uses "sp")
+    "vocab": "tp",
+    "embed": "fsdp",
+    "heads": "tp",
+    "head_dim": None,
+    "kv": None,
+    "mlp": "tp",
+    "norm": None,
+    "expert": "ep",
+}
+
+
+def logical_to_mesh_axes(
+    logical_axes: Sequence[Optional[str]],
+    rules: Optional[Dict[str, Optional[object]]] = None,
+    mesh=None,
+) -> PartitionSpec:
+    """Map a tuple of logical axis names to a PartitionSpec.
+
+    Mesh axes not present in ``mesh`` (when given) are dropped to None so the
+    same model code runs on meshes without e.g. an ``ep`` axis.
+    """
+    rules = DEFAULT_RULES if rules is None else rules
+    mesh_axis_names = set(mesh.axis_names) if mesh is not None else None
+
+    def resolve(name: Optional[str]):
+        if name is None:
+            return None
+        target = rules.get(name)
+        if target is None:
+            return None
+        if isinstance(target, tuple):
+            if mesh_axis_names is not None:
+                target = tuple(t for t in target if t in mesh_axis_names)
+            return target if target else None
+        if mesh_axis_names is not None and target not in mesh_axis_names:
+            return None
+        return target
+
+    return PartitionSpec(*(resolve(a) for a in logical_axes))
+
+
+def named_sharding(mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def shard_pytree(tree, pspec_tree, mesh):
+    """Place every leaf of ``tree`` per the matching PartitionSpec leaf."""
+    return jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        tree,
+        pspec_tree,
+        is_leaf=lambda x: x is None,
+    )
+
+
+def pspecs_to_shardings(pspec_tree, mesh):
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        pspec_tree,
+        is_leaf=lambda x: isinstance(x, PartitionSpec) or x is None,
+    )

@@ -1,0 +1,115 @@
+"""Sharded training step for the flagship transformer.
+
+One jitted function: loss → grads → optax update, with parameter/optimizer
+shardings derived from the model's logical axes and activations sharded over
+the data axes. XLA inserts the psum/reduce-scatter collectives implied by the
+shardings; buffers are donated so the update is in-place in HBM.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from tpu_task.ml.models import transformer
+from tpu_task.ml.parallel.sharding import logical_to_mesh_axes
+
+
+class TrainState(NamedTuple):
+    step: jax.Array
+    params: Any
+    opt_state: Any
+
+
+def make_optimizer(lr: float = 3e-4, weight_decay: float = 0.01):
+    return optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=weight_decay),
+    )
+
+
+def init_state(rng, cfg: transformer.TransformerConfig, optimizer=None) -> TrainState:
+    optimizer = optimizer or make_optimizer()
+    params = transformer.init(rng, cfg)
+    opt_state = optimizer.init(params)
+    return TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state)
+
+
+def state_pspecs(state: TrainState, cfg: transformer.TransformerConfig, mesh) -> TrainState:
+    """PartitionSpecs for a TrainState: optimizer moments follow the params."""
+    p_specs = transformer.param_pspecs(cfg, mesh=mesh)
+
+    # optax adamw state mirrors the param pytree inside ScaleByAdamState; map
+    # any leaf whose shape matches a param leaf to that param's spec,
+    # replicating scalars (counts, schedules).
+    shape_to_spec = {}
+    for leaf, spec in zip(
+        jax.tree.leaves(state.params),
+        jax.tree.leaves(p_specs, is_leaf=lambda x: isinstance(x, PartitionSpec)),
+    ):
+        shape_to_spec.setdefault(jnp.shape(leaf), spec)
+
+    def spec_for(leaf):
+        return shape_to_spec.get(jnp.shape(leaf), PartitionSpec())
+
+    opt_specs = jax.tree.map(spec_for, state.opt_state)
+    return TrainState(
+        step=PartitionSpec(),
+        params=p_specs,
+        opt_state=opt_specs,
+    )
+
+
+def shard_state(state: TrainState, cfg, mesh) -> Tuple[TrainState, TrainState]:
+    """Place a TrainState on the mesh; returns (sharded_state, pspecs)."""
+    specs = state_pspecs(state, cfg, mesh)
+    sharded = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        state, specs,
+        is_leaf=lambda x: isinstance(x, PartitionSpec),
+    )
+    return sharded, specs
+
+
+def make_train_step(cfg: transformer.TransformerConfig, optimizer=None, mesh=None,
+                    attn_fn=None, donate: bool = True):
+    """Build the jitted (state, batch) → (state, metrics) step.
+
+    With a mesh, in/out shardings pin the state layout and shard the batch
+    over the data axes; single-device otherwise.
+    """
+    optimizer = optimizer or make_optimizer()
+
+    def step(state: TrainState, tokens):
+        loss, grads = jax.value_and_grad(transformer.loss_fn)(
+            state.params, cfg, tokens, attn_fn=attn_fn
+        )
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        gnorm = optax.global_norm(grads)
+        new_state = TrainState(step=state.step + 1, params=params, opt_state=opt_state)
+        return new_state, {"loss": loss, "grad_norm": gnorm}
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+    batch_spec = logical_to_mesh_axes(("batch", "seq"), mesh=mesh)
+
+    def jit_with_state(state: TrainState):
+        specs = state_pspecs(state, cfg, mesh)
+        state_shardings = jax.tree.map(
+            lambda s: NamedSharding(mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, PartitionSpec),
+        )
+        return jax.jit(
+            step,
+            in_shardings=(state_shardings, NamedSharding(mesh, batch_spec)),
+            out_shardings=(state_shardings, NamedSharding(mesh, PartitionSpec())),
+            donate_argnums=(0,) if donate else (),
+        )
+
+    return jit_with_state
