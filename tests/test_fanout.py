@@ -1,0 +1,98 @@
+"""Multi-host fan-out: parallel exec across slice workers (SURVEY.md §7
+stage 5 — no reference analog beyond K8s IndexedCompletion)."""
+
+import time
+
+import pytest
+
+from tpu_task import task as task_factory
+from tpu_task.common.cloud import Cloud, Provider
+from tpu_task.common.identifier import Identifier
+from tpu_task.common.values import Environment, Size, StatusCode, Task as TaskSpec
+from tpu_task.machine.fanout import ExecResult, LocalTransport, fan_out
+
+
+def test_fan_out_runs_on_all_workers(tmp_path):
+    dirs = []
+    for i in range(4):
+        d = tmp_path / f"w{i}"
+        d.mkdir()
+        (d / "tag.txt").write_text(f"worker-{i}\n")
+        dirs.append(str(d))
+    results = fan_out(dirs, "cat tag.txt", LocalTransport(), timeout=10)
+    assert [r.worker_id for r in results] == [0, 1, 2, 3]
+    for i, r in enumerate(results):
+        assert r.ok and r.stdout == f"worker-{i}\n"
+
+
+def test_fan_out_isolates_failures(tmp_path):
+    dirs = []
+    for i in range(3):
+        d = tmp_path / f"w{i}"
+        d.mkdir()
+        dirs.append(str(d))
+    results = fan_out(dirs, 'test "$(basename "$PWD")" != w1', LocalTransport())
+    assert [r.returncode for r in results] == [0, 1, 0]
+    assert not results[1].ok
+
+
+def test_fan_out_empty():
+    assert fan_out([], "true", LocalTransport()) == []
+
+
+def test_fan_out_timeout(tmp_path):
+    d = tmp_path / "w0"
+    d.mkdir()
+    results = fan_out([str(d)], "sleep 30", LocalTransport(), timeout=0.5)
+    assert results[0].returncode == 124
+    assert "timeout" in results[0].stderr
+
+
+@pytest.fixture
+def tpu_cloud(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPU_TASK_FAKE_TPU_ROOT", str(tmp_path / "fake-tpu"))
+    monkeypatch.setenv("TPU_TASK_LOCAL_LOG_PERIOD", "0.1")
+    monkeypatch.setenv("TPU_TASK_LOCAL_DATA_PERIOD", "0.1")
+    return Cloud(provider=Provider.TPU, region="us-central2")
+
+
+def poll(task, predicate, timeout=30.0, period=0.2):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        task.read()
+        if predicate(task):
+            return
+        time.sleep(period)
+    raise AssertionError(f"condition not reached; status={task.status()}")
+
+
+def test_exec_on_workers_and_distributed_env(tpu_cloud, tmp_path):
+    """exec fans out to every worker of a live slice; every worker got the
+    jax.distributed contract (rank / world size / coordinator)."""
+    spec = TaskSpec(
+        size=Size(machine="v4-32"),  # 4 workers
+        environment=Environment(
+            # Long sleep keeps workers alive through the exec; the rank lines
+            # reach the log stream while the task is still running.
+            script='#!/bin/bash\n'
+                   'echo "rank=$TPU_TASK_WORKER_ID of=$TPU_TASK_NUM_WORKERS '
+                   'coord=$TPU_TASK_COORDINATOR"\n'
+                   "sleep 120\n",
+        ),
+    )
+    task = task_factory.new(tpu_cloud, Identifier.deterministic("fanout-exec"), spec)
+    task.create()
+    try:
+        poll(task, lambda t: len(t.get_addresses()) == 4, timeout=15)
+        results = task.exec_on_workers("pwd && echo fanned-out")
+        assert len(results) == 4
+        assert all(r.ok and "fanned-out" in r.stdout for r in results)
+
+        def all_ranks_logged(t):
+            logs = "".join(t.logs())
+            return all(f"rank={rank} of=4 coord=10.130.0.1:8476" in logs
+                       for rank in range(4))
+
+        poll(task, all_ranks_logged)
+    finally:
+        task.delete()

@@ -113,6 +113,74 @@ def test_stockout_keeps_waiting(tmp_path):
         tpu_api.QR_PROVISIONING, tpu_api.QR_ACTIVE)
 
 
+def test_no_overcommit_while_provisioning(tmp_path):
+    """Two WAITING requests must not both pass the capacity check before
+    either node materializes (PROVISIONING holds capacity)."""
+    plane = FakeTpuControlPlane(root=str(tmp_path / "tpu"), run_workers=False,
+                                capacity_chips=4)
+    plane.create_queued_resource("qr-a", qr_spec("v2-8", "node-a"))
+    plane.create_queued_resource("qr-b", qr_spec("v2-8", "node-b"))
+    states = set()
+    for _ in range(6):
+        states = {plane.get_queued_resource("qr-a").state,
+                  plane.get_queued_resource("qr-b").state}
+    assert tpu_api.QR_ACTIVE in states
+    assert tpu_api.QR_WAITING in states  # one of them never got capacity
+
+
+def test_preempt_kills_running_worker_processes(tmp_path, monkeypatch):
+    """Worker PIDs persist to the node record; preemption really kills the
+    agent subprocesses (no orphans corrupting the bucket post-preemption)."""
+    import json as json_module
+    import os as os_module
+
+    monkeypatch.setenv("TPU_TASK_LOCAL_LOG_PERIOD", "0.1")
+    monkeypatch.setenv("TPU_TASK_LOCAL_DATA_PERIOD", "0.1")
+    plane = FakeTpuControlPlane(root=str(tmp_path / "tpu"), run_workers=True)
+    bucket = tmp_path / "bucket"
+    bucket.mkdir()
+    import base64
+
+    spec = qr_spec()
+    spec.metadata = {
+        "tpu-task-remote": str(bucket),
+        "tpu-task-script-b64": base64.b64encode(
+            b"#!/bin/bash\nsleep 300\n").decode(),
+        "tpu-task-log-period": "0.1",
+        "tpu-task-data-period": "0.1",
+    }
+    plane.create_queued_resource("qr-1", spec)
+    while plane.get_queued_resource("qr-1").state != tpu_api.QR_ACTIVE:
+        time.sleep(0.05)
+    node = json_module.loads(
+        (tmp_path / "tpu" / "nodes" / "node-1.json").read_text())
+    pids = [w["pid"] for w in node["workers"]]
+    assert all(pid > 0 for pid in pids), "worker pids must be persisted"
+    plane.preempt_node("node-1")
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        alive = [pid for pid in pids if _pid_alive(pid)]
+        if not alive:
+            break
+        time.sleep(0.1)
+    assert not alive, f"agent processes survived preemption: {alive}"
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    # Killed-but-unreaped children of this test process show as zombies.
+    try:
+        with open(f"/proc/{pid}/stat") as handle:
+            return handle.read().split(") ")[-1].split()[0] != "Z"
+    except OSError:
+        return False
+
+
 def test_preemption_suspends_and_requeue_recovers(plane):
     plane.create_queued_resource("qr-1", qr_spec(spot=True))
     while plane.get_queued_resource("qr-1").state != tpu_api.QR_ACTIVE:

@@ -31,7 +31,7 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Protocol
 
-from tpu_task.common.errors import ResourceNotFoundError
+from tpu_task.common.errors import ResourceAlreadyExistsError, ResourceNotFoundError
 
 # -- data model ---------------------------------------------------------------
 
@@ -229,6 +229,17 @@ class FakeTpuControlPlane:
             node = self._load(self._node_path(name))
             if node["state"] in (NODE_CREATING, NODE_READY):
                 total += self._spec_chips({"accelerator_type": node["accelerator_type"]})
+        # PROVISIONING queued resources hold capacity before their node
+        # materializes; without this, several WAITING requests could all pass
+        # the capacity check and overcommit the plane.
+        directory = os.path.join(self.root, "queued_resources")
+        if os.path.isdir(directory):
+            for entry in os.listdir(directory):
+                if not entry.endswith(".json"):
+                    continue
+                payload = self._load(os.path.join(directory, entry))
+                if payload["state"] == QR_PROVISIONING:
+                    total += self._spec_chips(payload["spec"])
         return total
 
     @staticmethod
@@ -265,6 +276,9 @@ class FakeTpuControlPlane:
         self._store(self._node_path(name), node)
         if self.run_workers:
             self._spawn_workers(node)
+            # _spawn_workers filled in worker PIDs; persist them so
+            # preempt/delete can actually kill the agent processes.
+            self._store(self._node_path(name), node)
 
     def _spawn_workers(self, node: dict) -> None:
         """Execute the node's workers as local agents (hermetic execution).
@@ -297,6 +311,11 @@ class FakeTpuControlPlane:
 
             scrub_accelerator_env(env)
             env["TPU_WORKER_HOSTNAMES"] = hostnames
+            # jax.distributed contract, mirroring the real bootstrap template.
+            env["TPU_WORKER_ID"] = str(worker["index"])
+            env["TPU_TASK_WORKER_ID"] = str(worker["index"])
+            env["TPU_TASK_NUM_WORKERS"] = str(len(node["workers"]))
+            env["TPU_TASK_COORDINATOR"] = node["workers"][0]["endpoint"] + ":8476"
             env["PYTHONPATH"] = os.pathsep.join(filter(None, [
                 os.path.dirname(os.path.dirname(os.path.dirname(
                     os.path.dirname(os.path.abspath(__file__))))),
@@ -431,6 +450,8 @@ class RestTpuClient:
         except urllib.error.HTTPError as error:
             if error.code == 404:
                 raise ResourceNotFoundError(path) from error
+            if error.code == 409:
+                raise ResourceAlreadyExistsError(path) from error
             raise
 
     def _wait_operation(self, operation: dict, timeout: float = 900.0) -> dict:
@@ -477,6 +498,8 @@ class RestTpuClient:
             operation = self._request(
                 "POST", f"{self._parent()}/queuedResources?queuedResourceId={name}", body)
             self._wait_operation(operation)
+        except ResourceAlreadyExistsError:
+            pass  # idempotent create: AlreadyExists → no-op (HTTP 409)
         except RuntimeError as error:
             if "ALREADY_EXISTS" not in str(error):
                 raise

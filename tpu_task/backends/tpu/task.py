@@ -245,7 +245,7 @@ class TPUTask(Task):
         # task exit (alongside calling `tpu-task stop` directly when it has
         # credentials); observing it releases the TPU capacity
         # (machine-script.sh.tpl:10-14 semantics).
-        if self._shutdown_requested() and self._existing_qrs():
+        if self._existing_qrs() and self._shutdown_requested():
             self._recovery_events.append(Event(
                 time=datetime.now(timezone.utc), code="self-destruct",
                 description=["shutdown marker observed; releasing slices"]))
@@ -299,7 +299,9 @@ class TPUTask(Task):
             time=datetime.now(timezone.utc), code="recover",
             description=[f"re-queueing preempted {info.name}"]))
         spec = info.spec
-        if not spec.accelerator_type:
+        if not spec.accelerator_type or not spec.startup_script:
+            # REST reads return a sparse spec (no bootstrap/metadata);
+            # re-render locally so the recovered node actually runs the task.
             spec = QueuedResourceSpec(**{**self._qr_spec().__dict__,
                                          "node_id": info.name})
         try:
@@ -382,6 +384,54 @@ class TPUTask(Task):
 
     def get_addresses(self) -> List[str]:
         return list(self.spec.addresses)
+
+    # -- multi-host fan-out ---------------------------------------------------
+    def worker_addresses(self) -> List[str]:
+        """Every TPU-VM worker endpoint across the task's slices, rank order."""
+        addresses: List[str] = []
+        for name in self._existing_qrs():
+            try:
+                info = self.client.get_queued_resource(name)
+                if info.state == QR_ACTIVE and info.node_name:
+                    node = self.client.get_node(info.node_name)
+                    if node.state == "READY":
+                        addresses.extend(node.endpoints)
+            except ResourceNotFoundError:
+                continue
+        return addresses
+
+    def exec_on_workers(self, command: str, timeout: float = 60.0):
+        """Run a command on all slice workers concurrently (SSH fan-out;
+        hermetic LocalTransport against the fake control plane's per-worker
+        workdirs in fake mode)."""
+        from tpu_task.machine.fanout import LocalTransport, SSHTransport, fan_out
+
+        if fake_mode():
+            directories: List[str] = []
+            for name in self._existing_qrs():
+                try:
+                    info = self.client.get_queued_resource(name)
+                except ResourceNotFoundError:
+                    continue
+                if info.state != QR_ACTIVE or not info.node_name:
+                    continue
+                node_dir = os.path.join(self.client.root, "node-exec", info.node_name)
+                if not os.path.isdir(node_dir):
+                    continue
+                worker_entries = [
+                    entry for entry in os.listdir(node_dir)
+                    if entry.startswith("worker") and entry[6:].isdigit() and
+                    os.path.isdir(os.path.join(node_dir, entry))
+                ]
+                # Numeric sort: lexicographic would put worker10 before worker2.
+                worker_entries.sort(key=lambda entry: int(entry[6:]))
+                directories.extend(
+                    os.path.join(node_dir, entry) for entry in worker_entries
+                )
+            return fan_out(directories, command, LocalTransport(), timeout=timeout)
+        key_pair = self.get_key_pair()
+        transport = SSHTransport(key_pair.private_string() if key_pair else "")
+        return fan_out(self.worker_addresses(), command, transport, timeout=timeout)
 
     def get_key_pair(self) -> Optional[DeterministicSSHKeyPair]:
         """Deterministic keypair from the cloud secret (client.go:92 parity)."""

@@ -5,5 +5,9 @@ class ResourceNotFoundError(Exception):
     """Raised when a cloud resource does not exist (reference NotFoundError)."""
 
 
+class ResourceAlreadyExistsError(Exception):
+    """Raised when a cloud resource already exists; Create treats it as a no-op."""
+
+
 class ResourceNotImplementedError(Exception):
     """Raised when a resource method is not implemented (reference NotImplementedError)."""

@@ -1,0 +1,118 @@
+"""Multi-host fan-out: run a command on every TPU-VM worker of a slice.
+
+The reference only ever SSHes into a single machine (task/common/ssh/
+connection.go:10 — one-shot exec); a TPU slice is 1..N worker hosts that all
+need bootstrap, debugging, and log collection. This module executes a command
+on all workers concurrently (thread pool; the work is network-bound) and
+returns per-worker results.
+
+Transports:
+
+* ``SSHTransport`` — the real path: the system ``ssh`` binary with the
+  task's deterministic private key. Host-key checking is disabled, the same
+  documented trade-off as the reference (connection.go:22-23 FIXME).
+* ``LocalTransport`` — hermetic path: "workers" are local directories (the
+  fake control plane's per-worker workdirs); exec is a local subprocess.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import tempfile
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import List, Optional, Protocol, Sequence
+
+
+@dataclass
+class ExecResult:
+    worker_id: int
+    address: str
+    returncode: int
+    stdout: str
+    stderr: str
+
+    @property
+    def ok(self) -> bool:
+        return self.returncode == 0
+
+
+class Transport(Protocol):
+    def run(self, address: str, command: str, timeout: float) -> tuple: ...
+
+
+class SSHTransport:
+    """Remote exec over the system ssh binary with an in-memory private key."""
+
+    def __init__(self, private_key_pem: str, username: str = "ubuntu",
+                 connect_timeout: int = 10):
+        self.private_key_pem = private_key_pem
+        self.username = username
+        self.connect_timeout = connect_timeout
+
+    def run(self, address: str, command: str, timeout: float) -> tuple:
+        fd, key_path = tempfile.mkstemp(prefix="tpu-task-key-")
+        try:
+            with os.fdopen(fd, "w") as handle:
+                handle.write(self.private_key_pem)
+            os.chmod(key_path, 0o600)
+            proc = subprocess.run(
+                [
+                    "ssh",
+                    "-i", key_path,
+                    "-o", "StrictHostKeyChecking=no",
+                    "-o", "UserKnownHostsFile=/dev/null",
+                    "-o", f"ConnectTimeout={self.connect_timeout}",
+                    "-o", "BatchMode=yes",
+                    f"{self.username}@{address}",
+                    command,
+                ],
+                capture_output=True, text=True, timeout=timeout,
+            )
+            return proc.returncode, proc.stdout, proc.stderr
+        finally:
+            os.unlink(key_path)
+
+
+class LocalTransport:
+    """Hermetic exec: the address is a working directory on this machine."""
+
+    def __init__(self, env: Optional[dict] = None):
+        self.env = env
+
+    def run(self, address: str, command: str, timeout: float) -> tuple:
+        env = dict(os.environ)
+        if self.env:
+            env.update(self.env)
+        proc = subprocess.run(
+            ["/bin/bash", "-c", command],
+            cwd=address, capture_output=True, text=True, timeout=timeout,
+            env=env,
+        )
+        return proc.returncode, proc.stdout, proc.stderr
+
+
+def fan_out(
+    addresses: Sequence[str],
+    command: str,
+    transport: Transport,
+    timeout: float = 60.0,
+    max_parallel: int = 32,
+) -> List[ExecResult]:
+    """Run ``command`` on every worker concurrently; results by worker index."""
+
+    def one(item) -> ExecResult:
+        index, address = item
+        try:
+            returncode, stdout, stderr = transport.run(address, command, timeout)
+        except subprocess.TimeoutExpired:
+            return ExecResult(index, address, 124, "", f"timeout after {timeout}s")
+        except OSError as error:
+            return ExecResult(index, address, 255, "", str(error))
+        return ExecResult(index, address, returncode, stdout, stderr)
+
+    if not addresses:
+        return []
+    with ThreadPoolExecutor(max_workers=min(max_parallel, len(addresses))) as pool:
+        return list(pool.map(one, enumerate(addresses)))

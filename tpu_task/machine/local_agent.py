@@ -176,14 +176,17 @@ class Agent:
                 "code": str(code),
                 "status": str(code),
             }
-        self._sync_logs()
-        self._write_report("status", json.dumps(report))
+        # Final data sync BEFORE the status report: the report is what makes
+        # clients observe a terminal status, and delete→pull may follow it
+        # immediately — data uploaded after it would be lost to the pull.
         if self.worker_id == 0:
             try:
                 storage_sync(self.directory, data_remote)
             except Exception as error:
                 self._append_log(f"final data sync error: {error}\n")
-                self._sync_logs()
+        self._sync_logs()
+        self._write_report("status", json.dumps(report))
+        if self.worker_id == 0:
             # Self-destruct signal: the control plane scales the group to zero
             # when it sees this marker (the hermetic `leo stop` equivalent).
             with open(os.path.join(self.remote, "shutdown"), "w") as handle:

@@ -44,10 +44,20 @@ TPU_METADATA="http://metadata.google.internal/computeMetadata/v1/instance/attrib
 export TPU_WORKER_ID="$(curl --silent --header 'Metadata-Flavor: Google' $TPU_METADATA/agent-worker-number || echo 0)"
 export TPU_WORKER_HOSTNAMES="$(curl --silent --header 'Metadata-Flavor: Google' $TPU_METADATA/worker-network-endpoints | tr ',' '\n' | cut -d: -f3 | paste -sd, - || true)"
 export TPU_TASK_MACHINE_IDENTITY="$(uuidgen)-worker$TPU_WORKER_ID"
+# jax.distributed contract (tpu_task.ml.parallel.mesh.distributed_init_from_env):
+# rank, world size, and coordinator = worker 0's endpoint.
+export TPU_TASK_WORKER_ID="$TPU_WORKER_ID"
+TPU_TASK_NUM_WORKERS="$(echo "$TPU_WORKER_HOSTNAMES" | tr ',' '\n' | grep -c .)"
+test "$TPU_TASK_NUM_WORKERS" -ge 1 2> /dev/null || TPU_TASK_NUM_WORKERS=1
+export TPU_TASK_NUM_WORKERS
+export TPU_TASK_COORDINATOR="$(echo "$TPU_WORKER_HOSTNAMES" | cut -d, -f1):8476"
 {
   echo "export TPU_WORKER_ID=$TPU_WORKER_ID"
   echo "export TPU_WORKER_HOSTNAMES=$TPU_WORKER_HOSTNAMES"
   echo "export TPU_TASK_MACHINE_IDENTITY=$TPU_TASK_MACHINE_IDENTITY"
+  echo "export TPU_TASK_WORKER_ID=$TPU_TASK_WORKER_ID"
+  echo "export TPU_TASK_NUM_WORKERS=$TPU_TASK_NUM_WORKERS"
+  echo "export TPU_TASK_COORDINATOR=$TPU_TASK_COORDINATOR"
 } | sudo tee --append /opt/task/credentials > /dev/null
 
 TPU_TASK_LOG_DIRECTORY="$(mktemp --directory)"
@@ -66,7 +76,7 @@ sudo tee /etc/systemd/system/tpu-task.service > /dev/null <<END
 [Service]
   Type=simple
   ExecStart=-$TPU_TASK_START_COMMAND
-  ExecStop=/bin/bash -c 'source /opt/task/credentials; systemctl is-system-running | grep stopping || echo "{\\\\"result\\\\": \\\\"\$SERVICE_RESULT\\\\", \\\\"code\\\\": \\\\"\$EXIT_STATUS\\\\", \\\\"status\\\\": \\\\"\$EXIT_CODE\\\\"}" > "$TPU_TASK_LOG_DIRECTORY/status-$TPU_TASK_MACHINE_IDENTITY" && tpu-task storage copy "$TPU_TASK_LOG_DIRECTORY" "\$TPU_TASK_REMOTE/reports"'
+  ExecStop=/bin/bash -c 'source /opt/task/credentials; if test "\$TPU_WORKER_ID" = "0"; then tpu-task storage sync "$TPU_TASK_DATA_DIRECTORY" "\$TPU_TASK_REMOTE/data"; fi; systemctl is-system-running | grep stopping || echo "{\\\\"result\\\\": \\\\"\$SERVICE_RESULT\\\\", \\\\"code\\\\": \\\\"\$EXIT_STATUS\\\\", \\\\"status\\\\": \\\\"\$EXIT_CODE\\\\"}" > "$TPU_TASK_LOG_DIRECTORY/status-$TPU_TASK_MACHINE_IDENTITY" && tpu-task storage copy "$TPU_TASK_LOG_DIRECTORY" "\$TPU_TASK_REMOTE/reports"'
   ExecStopPost=/usr/bin/tpu-task-shutdown
   Environment=HOME=/root
   EnvironmentFile=/opt/task/variables
