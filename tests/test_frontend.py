@@ -1,0 +1,202 @@
+"""HCL parser + declarative apply/refresh/destroy tests (reference:
+iterative/resource_task.go lifecycle semantics, cmd/leo/root.go HCL bridge)."""
+
+import json
+import time
+
+import pytest
+
+from tpu_task.common.values import StatusCode
+from tpu_task.frontend import apply, destroy, load_tasks, refresh
+from tpu_task.frontend.declarative import State, build_cloud, build_spec
+from tpu_task.frontend.hcl import HclError, parse_hcl
+
+EXAMPLE_TF = '''
+# Example mirroring the reference's docs/resources/task.md usage.
+resource "iterative_task" "example" {
+  cloud       = "tpu"
+  region      = "us-central2"
+  machine     = "v4-32"
+  disk_size   = 50
+  spot        = 0
+  parallelism = 2
+  timeout     = 3600
+
+  environment = { GREETING = "hello", INHERITED = "" }
+  tags        = { team = "ml" }
+
+  storage {
+    workdir = "."
+    output  = "results"
+    exclude = ["cache/**"]
+  }
+
+  script = <<-END
+    #!/bin/bash
+    echo "$GREETING world"
+  END
+}
+'''
+
+
+def test_parse_example():
+    root = parse_hcl(EXAMPLE_TF)
+    block = root.find("resource")[0]
+    assert block.labels == ["iterative_task", "example"]
+    assert block.body["machine"] == "v4-32"
+    assert block.body["spot"] == 0
+    assert block.body["parallelism"] == 2
+    assert block.body["environment"] == {"GREETING": "hello", "INHERITED": ""}
+    assert block.find("storage")[0].body["output"] == "results"
+    script = block.body["script"]
+    assert script.startswith("#!/bin/bash")
+    assert 'echo "$GREETING world"' in script
+
+
+def test_parse_errors():
+    with pytest.raises(HclError):
+        parse_hcl('resource "x" { a = }')
+    with pytest.raises(HclError):
+        parse_hcl("a = <<EOF\nnever terminated")
+    with pytest.raises(HclError):
+        parse_hcl("💥")
+
+
+def test_parse_comments_and_types():
+    root = parse_hcl('''
+      // line comment
+      /* block
+         comment */
+      a = "str"        # trailing
+      b = -3.5
+      c = [1, 2, 3]
+      d = true
+      e = null
+    ''')
+    assert root.body == {"a": "str", "b": -3.5, "c": [1, 2, 3],
+                         "d": True, "e": None}
+
+
+def test_build_spec_mapping(tmp_path):
+    (tmp_path / "main.tf").write_text(EXAMPLE_TF)
+    defn = load_tasks(tmp_path)[0]
+    cloud = build_cloud(defn)
+    assert cloud.provider.value == "tpu"
+    assert cloud.tags == {"team": "ml"}
+    spec = build_spec(defn)
+    assert spec.size.machine == "v4-32"
+    assert spec.size.storage == 50
+    assert float(spec.spot) == 0.0
+    assert spec.parallelism == 2
+    assert spec.environment.timeout.total_seconds() == 3600
+    assert spec.environment.variables["GREETING"] == "hello"
+    assert spec.environment.variables["INHERITED"] is None  # glob/inherit
+    assert spec.environment.variables["TPI_TASK"] == "true"
+    assert "CI_*" in spec.environment.variables
+    assert spec.environment.directory_out == "results"
+    assert spec.environment.exclude_list == ["cache/**"]
+    assert spec.firewall.ingress.ports == [22, 80]
+
+
+LOCAL_TF = '''
+resource "iterative_task" "demo" {
+  cloud   = "local"
+  name    = "frontend-demo"
+  timeout = 300
+  storage {
+    workdir = "work"
+    output  = "output"
+  }
+  script = <<-END
+    #!/bin/bash
+    cat input.txt
+    mkdir -p output && echo done > output/result.txt
+  END
+}
+'''
+
+
+@pytest.fixture
+def config_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPU_TASK_LOCAL_ROOT", str(tmp_path / "control-plane"))
+    monkeypatch.setenv("TPU_TASK_LOCAL_LOG_PERIOD", "0.1")
+    monkeypatch.setenv("TPU_TASK_LOCAL_DATA_PERIOD", "0.1")
+    config = tmp_path / "config"
+    work = config / "work"
+    work.mkdir(parents=True)
+    (config / "main.tf").write_text(LOCAL_TF)
+    (work / "input.txt").write_text("tf-payload")
+    return config
+
+
+def wait_status(config_dir, name, code, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        outputs = refresh(config_dir)[name]
+        if outputs["status"].get(code.value, 0) >= 1:
+            return outputs
+        time.sleep(0.2)
+    raise AssertionError(f"status {code} not reached: {outputs}")
+
+
+def test_apply_refresh_destroy_lifecycle(config_dir):
+    results = apply(config_dir)
+    assert "demo" in results
+
+    state = State(config_dir)
+    identifier = state.identifier("demo")
+    assert identifier and identifier.startswith("tpi-frontend-demo-")
+
+    # apply is idempotent: same identifier, no duplicate task.
+    apply(config_dir)
+    assert State(config_dir).identifier("demo") == identifier
+
+    wait_status(config_dir, "demo", StatusCode.SUCCEEDED)
+
+    destroyed = destroy(config_dir)
+    assert destroyed == ["demo"]
+    assert State(config_dir).identifier("demo") is None
+    assert (config_dir / "work" / "output" / "result.txt").read_text() == "done\n"
+    # destroy with nothing applied: no-op
+    assert destroy(config_dir) == []
+
+
+def test_string_escapes_single_pass():
+    # "C:\\new" must decode to a literal backslash + 'new', not backslash+\n.
+    assert parse_hcl(r'a = "C:\\new"').body["a"] == "C:\\new"
+    assert parse_hcl(r'a = "tab\there"').body["a"] == "tab\there"
+    assert parse_hcl(r'a = "say \"hi\""').body["a"] == 'say "hi"'
+
+
+def test_destroy_is_state_driven(config_dir):
+    """A resource removed from config (or all .tf files gone) is still
+    destroyed from state — Terraform semantics."""
+    apply(config_dir)
+    identifier = State(config_dir).identifier("demo")
+    assert identifier
+    (config_dir / "main.tf").unlink()          # user deletes the config
+    assert destroy(config_dir) == ["demo"]
+    assert State(config_dir).identifier("demo") is None
+    from tpu_task import task as task_factory
+    from tpu_task.common.cloud import Cloud, Provider
+
+    assert task_factory.list_tasks(Cloud(provider=Provider.LOCAL)) == []
+
+
+def test_apply_rollback_on_failure(config_dir, monkeypatch):
+    """A create that blows up deletes what it made and clears state."""
+    from tpu_task.backends.local.task import LocalTask
+
+    real_start = LocalTask.start
+
+    def boom(self):
+        raise RuntimeError("injected create failure")
+
+    monkeypatch.setattr(LocalTask, "start", boom)
+    with pytest.raises(RuntimeError, match="injected"):
+        apply(config_dir)
+    assert State(config_dir).identifier("demo") is None
+    from tpu_task import task as task_factory
+    from tpu_task.common.cloud import Cloud, Provider
+
+    assert task_factory.list_tasks(Cloud(provider=Provider.LOCAL)) == []

@@ -183,6 +183,51 @@ def cmd_list(args) -> int:
     return 0
 
 
+def cmd_declarative(args) -> int:
+    """apply / refresh / destroy over .tf task definitions."""
+    import json as json_module
+
+    from tpu_task import frontend
+
+    if args.verb == "apply":
+        results = frontend.apply(args.directory)
+    elif args.verb == "refresh":
+        results = frontend.refresh(args.directory)
+    else:
+        destroyed = frontend.destroy(args.directory)
+        for name in destroyed:
+            print(f"destroyed {name}")
+        return 0
+    print(json_module.dumps(results, indent=2, default=str))
+    return 0
+
+
+def cmd_exec(args) -> int:
+    """Fan a command out to every worker of a running task."""
+    cloud = build_cloud(args)
+    identifier = Identifier.parse(args.name)
+    task = task_factory.new(cloud, identifier, TaskSpec())
+    command_parts = list(args.command)
+    if command_parts and command_parts[0] == "--":
+        command_parts = command_parts[1:]
+    command = " ".join(command_parts) or "true"
+    if not hasattr(task, "exec_on_workers"):
+        logger.error("exec is not supported by the %s backend", args.cloud)
+        return 1
+    task.read()
+    results = task.exec_on_workers(command, timeout=args.timeout)
+    worst = 0
+    for result in results:
+        prefix = f"[worker {result.worker_id} {result.address}]"
+        for line in (result.stdout + result.stderr).splitlines():
+            print(f"{prefix} {line}")
+        # Signal deaths surface as negative returncodes; fold them to failure.
+        code = result.returncode if result.returncode > 0 else (
+            1 if result.returncode != 0 else 0)
+        worst = max(worst, code)
+    return worst
+
+
 def cmd_storage(args) -> int:
     from tpu_task.storage import sync as storage_sync, transfer as storage_transfer
 
@@ -252,6 +297,22 @@ def make_parser() -> argparse.ArgumentParser:
 
     list_cmd = sub.add_parser("list", help="list tasks")
     list_cmd.set_defaults(func=cmd_list)
+
+    for verb, help_text in (
+        ("apply", "create every task defined in a main.tf-style config"),
+        ("refresh", "re-read applied tasks and print their outputs"),
+        ("destroy", "delete every applied task (downloads outputs first)"),
+    ):
+        decl = sub.add_parser(verb, help=help_text)
+        decl.add_argument("directory", nargs="?", default=".",
+                          help="directory containing .tf files")
+        decl.set_defaults(func=cmd_declarative, verb=verb)
+
+    exec_cmd = sub.add_parser("exec", help="run a command on every worker of a task")
+    exec_cmd.add_argument("name")
+    exec_cmd.add_argument("--timeout", type=float, default=60.0)
+    exec_cmd.add_argument("command", nargs=argparse.REMAINDER)
+    exec_cmd.set_defaults(func=cmd_exec)
 
     storage = sub.add_parser("storage", help="data-plane operations (used on workers)")
     storage_sub = storage.add_subparsers(dest="storage_command", required=True)

@@ -1,0 +1,14 @@
+"""Declarative front-end: HCL task definitions + apply/destroy lifecycle.
+
+The reference ships two front-ends over one task core: a Terraform provider
+(iterative/resource_task.go) and the `leo` CLI that *reads the same main.tf*
+to default its flags (cmd/leo/root.go:79-137). This package supplies both
+roles: an HCL subset parser and an apply/refresh/destroy engine with a local
+state file, so `main.tf`-style definitions drive the TPU backends directly —
+no Terraform binary required.
+"""
+
+from tpu_task.frontend.declarative import apply, destroy, load_tasks, refresh
+from tpu_task.frontend.hcl import HclError, parse_hcl
+
+__all__ = ["apply", "destroy", "load_tasks", "refresh", "parse_hcl", "HclError"]

@@ -1,0 +1,297 @@
+"""Declarative apply/refresh/destroy over `main.tf`-style task definitions.
+
+The Terraform-provider role of the reference (iterative/resource_task.go)
+without a Terraform binary: parse `resource "iterative_task"` blocks, build
+the cloud-agnostic TaskSpec exactly like resourceTaskBuild
+(resource_task.go:328-443 — ingress 22/80 forced, TPI_TASK=true + CI env-var
+globs injected, identifier from state → name → random), create with
+rollback-on-failure (resource_task.go:220-230), export computed attributes
+(addresses/status/events/logs/ssh keys) on refresh, and keep identifiers in
+a JSON state file so apply/destroy are idempotent across invocations.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from dataclasses import dataclass, field
+from datetime import timedelta
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+from tpu_task import task as task_factory
+from tpu_task.common.cloud import Cloud, Provider
+from tpu_task.common.identifier import Identifier
+from tpu_task.common.values import (
+    SPOT_DISABLED,
+    Environment,
+    Firewall,
+    FirewallRule,
+    RemoteStorage,
+    Size,
+    Spot,
+    Task as TaskSpec,
+    Variables,
+)
+from tpu_task.frontend.hcl import Block, HclError, parse_hcl
+
+logger = logging.getLogger("tpu_task.frontend")
+
+STATE_FILE = "tpu-task.state.json"
+TASK_RESOURCE_TYPES = ("iterative_task", "tpu_task")
+
+
+@dataclass
+class TaskDefinition:
+    name: str          # resource label
+    attrs: Dict[str, Any]
+    storage: Dict[str, Any] = field(default_factory=dict)
+
+
+def load_tasks(directory) -> List[TaskDefinition]:
+    """Parse every .tf file in ``directory`` and collect task resources."""
+    directory = Path(directory)
+    paths = sorted(directory.glob("*.tf"))
+    if not paths:
+        raise HclError(f"no .tf files in {directory}")
+    tasks: List[TaskDefinition] = []
+    for path in paths:
+        root = parse_hcl(path.read_text())
+        for block in root.find("resource"):
+            if len(block.labels) != 2:
+                raise HclError(f"{path.name}: resource needs 2 labels")
+            rtype, label = block.labels
+            if rtype not in TASK_RESOURCE_TYPES:
+                logger.warning("ignoring unsupported resource type %r", rtype)
+                continue
+            storage: Dict[str, Any] = {}
+            for nested in block.find("storage"):
+                storage.update(nested.body)
+            tasks.append(TaskDefinition(name=label, attrs=dict(block.body),
+                                        storage=storage))
+    return tasks
+
+
+def build_cloud(defn: TaskDefinition) -> Cloud:
+    cloud_name = defn.attrs.get("cloud")
+    if not cloud_name:
+        raise HclError(f"task {defn.name!r}: 'cloud' is required")
+    return Cloud(provider=Provider(str(cloud_name)),
+                 region=str(defn.attrs.get("region", "us-west")),
+                 tags={str(k): str(v)
+                       for k, v in (defn.attrs.get("tags") or {}).items()})
+
+
+def build_spec(defn: TaskDefinition) -> TaskSpec:
+    """Schema → TaskSpec mapping (resourceTaskBuild parity)."""
+    attrs = defn.attrs
+    variables = Variables()
+    for key, value in (attrs.get("environment") or {}).items():
+        variables[str(key)] = None if value in (None, "") else str(value)
+    # TPI_TASK marker + CI context globs (resource_task.go:343-349).
+    variables["TPI_TASK"] = "true"
+    for glob_key in ("CI_*", "GITHUB_*", "BITBUCKET_*", "CML_*", "REPO_TOKEN"):
+        variables.setdefault(glob_key, None)
+
+    timeout_seconds = attrs.get("timeout", 24 * 3600)
+    environment = Environment(
+        image=str(attrs.get("image", "")) or "",
+        script=str(attrs.get("script", "")),
+        variables=variables,
+        timeout=timedelta(seconds=float(timeout_seconds))
+        if timeout_seconds else None,
+        directory=str(defn.storage.get("workdir", "") or ""),
+        directory_out=str(defn.storage.get("output", "") or ""),
+        exclude_list=[str(x) for x in defn.storage.get("exclude", [])],
+    )
+
+    # Forced ingress 22/80 (resource_task.go:414-418).
+    firewall = Firewall(ingress=FirewallRule(ports=[22, 80]))
+
+    spec = TaskSpec(
+        size=Size(machine=str(attrs.get("machine", "m")),
+                  storage=int(attrs.get("disk_size", -1))),
+        environment=environment,
+        firewall=firewall,
+        permission_set=str(attrs.get("permission_set", "")),
+        spot=Spot(float(attrs.get("spot", SPOT_DISABLED))),
+        parallelism=int(attrs.get("parallelism", 1)),
+    )
+    container = defn.storage.get("container")
+    if container:
+        spec.remote_storage = RemoteStorage(
+            container=str(container),
+            path=str(defn.storage.get("container_path", "") or ""),
+            config={str(k): str(v) for k, v in
+                    (defn.storage.get("container_opts") or {}).items()},
+        )
+    return spec
+
+
+# -- state --------------------------------------------------------------------
+
+class State:
+    """identifier-per-resource state file (the provider's d.SetId role)."""
+
+    def __init__(self, directory):
+        self.path = Path(directory) / STATE_FILE
+        self.data: Dict[str, Any] = {"resources": {}}
+        if self.path.exists():
+            self.data = json.loads(self.path.read_text())
+
+    def save(self) -> None:
+        tmp = self.path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(self.data, indent=2, default=str))
+        os.replace(tmp, self.path)
+
+    def identifier(self, name: str) -> Optional[str]:
+        entry = self.data["resources"].get(name)
+        return entry["identifier"] if entry else None
+
+    def entry(self, name: str) -> Optional[Dict[str, Any]]:
+        return self.data["resources"].get(name)
+
+    def names(self) -> List[str]:
+        return list(self.data["resources"])
+
+    def set(self, name: str, identifier: str, outputs: Dict[str, Any],
+            cloud: Optional[Cloud] = None) -> None:
+        entry: Dict[str, Any] = {"identifier": identifier, "outputs": outputs}
+        if cloud is not None:
+            entry["cloud"] = cloud.provider.value
+            entry["region"] = str(cloud.region)
+        self.data["resources"][name] = entry
+        self.save()
+
+    def remove(self, name: str) -> None:
+        self.data["resources"].pop(name, None)
+        self.save()
+
+
+def _resolve_identifier(defn: TaskDefinition, state: State) -> Identifier:
+    """State → explicit name → CI run id → random (resource_task.go:426-441)."""
+    from_state = state.identifier(defn.name)
+    if from_state:
+        return Identifier.parse(from_state)
+    explicit = defn.attrs.get("name")
+    if explicit:
+        return Identifier.deterministic(str(explicit))
+    run_id = os.environ.get("GITHUB_RUN_ID") or os.environ.get("CI_PIPELINE_ID")
+    if run_id:
+        return Identifier.deterministic(f"{defn.name}-{run_id}")
+    return Identifier.random(defn.name)
+
+
+def _computed_outputs(task) -> Dict[str, Any]:
+    status = {str(code.value): count for code, count in task.status().items()}
+    key_pair = task.get_key_pair()
+    return {
+        "addresses": task.get_addresses(),
+        "status": status,
+        "events": [f"{e.time} [{e.code}] {' '.join(e.description)}"
+                   for e in task.events()],
+        "ssh_public_key": key_pair.public_string() if key_pair else "",
+    }
+
+
+# -- verbs --------------------------------------------------------------------
+
+def apply(directory) -> Dict[str, Dict[str, Any]]:
+    """Create (or adopt) every task in the config; rollback on failure."""
+    state = State(directory)
+    results: Dict[str, Dict[str, Any]] = {}
+    for defn in load_tasks(directory):
+        cloud = build_cloud(defn)
+        spec = build_spec(defn)
+        _chdir_relative(spec, directory)
+        identifier = _resolve_identifier(defn, state)
+        task = task_factory.new(cloud, identifier, spec)
+        logger.info("applying %s (%s)", defn.name, identifier.long())
+        try:
+            task.create()
+        except Exception:
+            # Rollback delete on create failure (resource_task.go:221-229).
+            logger.exception("create failed for %s; rolling back", defn.name)
+            try:
+                task.delete()
+            finally:
+                state.remove(defn.name)
+            raise
+        task.read()
+        outputs = _computed_outputs(task)
+        state.set(defn.name, identifier.long(), outputs, cloud=cloud)
+        results[defn.name] = outputs
+    return results
+
+
+def _state_task(name: str, state: State, defns: Dict[str, TaskDefinition],
+                directory):
+    """Rebuild a task from state, preferring config when the block still
+    exists — destroy/refresh are driven by STATE (Terraform semantics), so
+    resources removed from the config are still reachable."""
+    entry = state.entry(name)
+    if not entry:
+        return None
+    defn = defns.get(name)
+    if defn is not None:
+        cloud = build_cloud(defn)
+        spec = build_spec(defn)
+        _chdir_relative(spec, directory)
+    else:
+        # Orphaned state entry: enough context is stored to tear it down
+        # (outputs can no longer be pulled to a workdir we don't know).
+        cloud = Cloud(provider=Provider(entry.get("cloud", "local")),
+                      region=str(entry.get("region", "us-west")))
+        spec = TaskSpec()
+    return task_factory.new(cloud, Identifier.parse(entry["identifier"]), spec)
+
+
+def _load_defns(directory) -> Dict[str, TaskDefinition]:
+    try:
+        return {defn.name: defn for defn in load_tasks(directory)}
+    except HclError:
+        return {}
+
+
+def refresh(directory) -> Dict[str, Dict[str, Any]]:
+    """Re-read every applied task; update stored outputs."""
+    state = State(directory)
+    defns = _load_defns(directory)
+    results: Dict[str, Dict[str, Any]] = {}
+    for name in state.names():
+        task = _state_task(name, state, defns, directory)
+        if task is None:
+            continue
+        task.read()
+        outputs = _computed_outputs(task)
+        entry = state.entry(name)
+        state.set(name, entry["identifier"], outputs,
+                  cloud=Cloud(provider=Provider(entry["cloud"]),
+                              region=entry["region"])
+                  if entry.get("cloud") else None)
+        results[name] = outputs
+    return results
+
+
+def destroy(directory) -> List[str]:
+    """Delete every applied task (pull outputs first — Task.Delete semantics)."""
+    state = State(directory)
+    defns = _load_defns(directory)
+    destroyed: List[str] = []
+    for name in state.names():
+        task = _state_task(name, state, defns, directory)
+        if task is None:
+            continue
+        logger.info("destroying %s (%s)", name, state.identifier(name))
+        task.delete()
+        state.remove(name)
+        destroyed.append(name)
+    return destroyed
+
+
+def _chdir_relative(spec: TaskSpec, directory) -> None:
+    """Workdir paths in configs are relative to the config directory."""
+    if spec.environment.directory and not os.path.isabs(spec.environment.directory):
+        spec.environment.directory = str(
+            (Path(directory) / spec.environment.directory).resolve())

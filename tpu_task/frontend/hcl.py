@@ -1,0 +1,196 @@
+"""Minimal HCL2 subset parser — enough for `main.tf` task definitions.
+
+Covers the constructs the reference's CLI bridge consumes from real-world
+TPI configs (cmd/leo/root.go:79-137 reads `iterative_task` attributes via
+viper's HCL support): blocks with string labels, attribute assignment,
+strings with escapes, heredocs (`<<EOF` / `<<-EOF`), numbers, booleans,
+null, lists, object/map literals, nested blocks, and `#`/`//`/`/* */`
+comments. Interpolation is NOT evaluated: `"${...}"` stays literal text.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class HclError(ValueError):
+    pass
+
+
+@dataclass
+class Block:
+    type: str
+    labels: List[str]
+    body: Dict[str, Any] = field(default_factory=dict)
+    blocks: List["Block"] = field(default_factory=list)
+
+    def find(self, block_type: str) -> List["Block"]:
+        return [b for b in self.blocks if b.type == block_type]
+
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+)
+  | (?P<comment>\#[^\n]*|//[^\n]*|/\*.*?\*/)
+  | (?P<heredoc><<-?\s*(?P<tag>[A-Za-z_][A-Za-z0-9_]*)\n)
+  | (?P<string>"(?:\\.|[^"\\])*")
+  | (?P<number>-?\d+(?:\.\d+)?)
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_.-]*)
+  | (?P<punct>[={}\[\],:()])
+    """,
+    re.VERBOSE | re.DOTALL,
+)
+
+
+@dataclass
+class _Token:
+    kind: str
+    value: Any
+    pos: int
+
+
+def _tokenize(text: str) -> List[_Token]:
+    tokens: List[_Token] = []
+    index = 0
+    while index < len(text):
+        match = _TOKEN_RE.match(text, index)
+        if not match:
+            line = text.count("\n", 0, index) + 1
+            raise HclError(f"line {line}: unexpected character {text[index]!r}")
+        if match.lastgroup in ("ws", "comment"):
+            index = match.end()
+            continue
+        if match.group("heredoc"):
+            tag = match.group("tag")
+            indent_strip = match.group("heredoc").startswith("<<-")
+            end_re = re.compile(
+                rf"^\s*{re.escape(tag)}\s*$", re.MULTILINE)
+            end = end_re.search(text, match.end())
+            if not end:
+                raise HclError(f"unterminated heredoc <<{tag}")
+            content = text[match.end():end.start()]
+            if indent_strip:
+                lines = content.split("\n")
+                indents = [len(l) - len(l.lstrip()) for l in lines if l.strip()]
+                strip = min(indents) if indents else 0
+                content = "\n".join(l[strip:] if len(l) >= strip else l
+                                    for l in lines)
+            tokens.append(_Token("string", content, index))
+            index = end.end()
+            continue
+        kind = match.lastgroup
+        value: Any = match.group(kind)
+        if kind == "string":
+            # Single-pass unescape: sequential .replace would corrupt
+            # escaped backslashes followed by n/t/" (e.g. "C:\\new").
+            value = re.sub(
+                r"\\(.)",
+                lambda m: {"n": "\n", "t": "\t"}.get(m.group(1), m.group(1)),
+                value[1:-1],
+            )
+        elif kind == "number":
+            value = float(value) if "." in value else int(value)
+        tokens.append(_Token(kind, value, index))
+        index = match.end()
+    tokens.append(_Token("eof", None, len(text)))
+    return tokens
+
+
+class _Parser:
+    def __init__(self, tokens: List[_Token], text: str):
+        self.tokens = tokens
+        self.text = text
+        self.index = 0
+
+    def peek(self) -> _Token:
+        return self.tokens[self.index]
+
+    def next(self) -> _Token:
+        token = self.tokens[self.index]
+        self.index += 1
+        return token
+
+    def error(self, message: str, token: _Token) -> HclError:
+        line = self.text.count("\n", 0, token.pos) + 1
+        return HclError(f"line {line}: {message} (got {token.value!r})")
+
+    def expect(self, kind: str, value: Optional[str] = None) -> _Token:
+        token = self.next()
+        if token.kind != kind or (value is not None and token.value != value):
+            raise self.error(f"expected {value or kind}", token)
+        return token
+
+    # body := (attribute | block)*
+    def parse_body(self, top_level: bool) -> Tuple[Dict[str, Any], List[Block]]:
+        attrs: Dict[str, Any] = {}
+        blocks: List[Block] = []
+        while True:
+            token = self.peek()
+            if token.kind == "eof" or (token.kind == "punct" and token.value == "}"):
+                return attrs, blocks
+            if token.kind != "ident":
+                raise self.error("expected attribute or block name", token)
+            name = self.next().value
+            token = self.peek()
+            if token.kind == "punct" and token.value == "=":
+                self.next()
+                attrs[name] = self.parse_value()
+            else:
+                blocks.append(self.parse_block(name))
+
+    def parse_block(self, block_type: str) -> Block:
+        labels: List[str] = []
+        while self.peek().kind in ("string", "ident") :
+            labels.append(self.next().value)
+        self.expect("punct", "{")
+        attrs, blocks = self.parse_body(top_level=False)
+        self.expect("punct", "}")
+        return Block(type=block_type, labels=labels, body=attrs, blocks=blocks)
+
+    def parse_value(self) -> Any:
+        token = self.next()
+        if token.kind in ("string", "number"):
+            return token.value
+        if token.kind == "ident":
+            if token.value == "true":
+                return True
+            if token.value == "false":
+                return False
+            if token.value == "null":
+                return None
+            # bare identifier (e.g. a traversal) → keep as string
+            return token.value
+        if token.kind == "punct" and token.value == "[":
+            items: List[Any] = []
+            while not (self.peek().kind == "punct" and self.peek().value == "]"):
+                items.append(self.parse_value())
+                if self.peek().kind == "punct" and self.peek().value == ",":
+                    self.next()
+            self.next()
+            return items
+        if token.kind == "punct" and token.value == "{":
+            mapping: Dict[str, Any] = {}
+            while not (self.peek().kind == "punct" and self.peek().value == "}"):
+                key_token = self.next()
+                if key_token.kind not in ("ident", "string"):
+                    raise self.error("expected object key", key_token)
+                sep = self.next()
+                if sep.kind != "punct" or sep.value not in ("=", ":"):
+                    raise self.error("expected '=' or ':'", sep)
+                mapping[key_token.value] = self.parse_value()
+                if self.peek().kind == "punct" and self.peek().value == ",":
+                    self.next()
+            self.next()
+            return mapping
+        raise self.error("expected value", token)
+
+
+def parse_hcl(text: str) -> Block:
+    """Parse HCL text into a root Block (type="", labels=[])."""
+    parser = _Parser(_tokenize(text), text)
+    attrs, blocks = parser.parse_body(top_level=True)
+    if parser.peek().kind != "eof":
+        raise parser.error("trailing content", parser.peek())
+    return Block(type="", labels=[], body=attrs, blocks=blocks)
