@@ -1,0 +1,189 @@
+"""GCP / K8s / AWS / Azure backends: size grammars, validators, manifest
+rendering, and hermetic lifecycle through the shared scaling-group plane."""
+
+import json
+import time
+
+import pytest
+
+from tpu_task import task as task_factory
+from tpu_task.backends.aws import (
+    resolve_aws_machine,
+    resolve_aws_region,
+    validate_instance_profile_arn,
+)
+from tpu_task.backends.az import resolve_az_machine, resolve_az_region, validate_arm_id
+from tpu_task.backends.gcp import parse_gcp_machine, resolve_gcp_zone
+from tpu_task.backends.k8s import parse_k8s_machine, render_manifests
+from tpu_task.common.cloud import Cloud, Provider
+from tpu_task.common.identifier import Identifier
+from tpu_task.common.values import (
+    Environment,
+    Size,
+    StatusCode,
+    Task as TaskSpec,
+)
+
+# --- grammars ---------------------------------------------------------------
+
+def test_gcp_machine_grammar():
+    m = parse_gcp_machine("m+v100")
+    assert m.machine_type == "custom-8-65536-ext"
+    assert m.accelerator_type == "nvidia-tesla-v100"
+    assert m.accelerator_count == 1
+    assert parse_gcp_machine("m").machine_type == "e2-custom-8-32768"
+    assert parse_gcp_machine("n1-standard-4+nvidia-tesla-t4*2").accelerator_count == 2
+    with pytest.raises(ValueError):
+        parse_gcp_machine("bad+grammar*0")
+
+
+def test_gcp_zone_resolution():
+    assert resolve_gcp_zone("us-west") == "us-west1-b"
+    assert resolve_gcp_zone("europe-west4-a") == "europe-west4-a"
+    with pytest.raises(ValueError):
+        resolve_gcp_zone("nowhere")
+
+
+def test_aws_machine_and_region():
+    assert resolve_aws_machine("m") == "m5.2xlarge"
+    assert resolve_aws_machine("m+v100") == "p3.xlarge"
+    assert resolve_aws_machine("g5.xlarge") == "g5.xlarge"
+    with pytest.raises(ValueError):
+        resolve_aws_machine("not a type")
+    assert resolve_aws_region("us-east") == "us-east-1"
+    assert resolve_aws_region("ap-southeast-2") == "ap-southeast-2"
+    with pytest.raises(ValueError):
+        resolve_aws_region("moon")
+
+
+def test_aws_arn_validation():
+    validate_instance_profile_arn("")
+    validate_instance_profile_arn(
+        "arn:aws:iam::123456789012:instance-profile/my-profile")
+    with pytest.raises(ValueError):
+        validate_instance_profile_arn("arn:aws:iam::12:role/x")
+
+
+def test_az_machine_region_arm():
+    assert resolve_az_machine("l+v100") == "Standard_NC12s_v3"
+    assert resolve_az_region("eu-west") == "westeurope"
+    validate_arm_id("")
+    good = ("/subscriptions/12345678-1234-1234-1234-123456789abc"
+            "/resourceGroups/rg/providers/Microsoft.ManagedIdentity"
+            "/userAssignedIdentities/uid")
+    assert validate_arm_id(good + "," + good) == [good, good]
+    with pytest.raises(ValueError):
+        validate_arm_id("/subscriptions/nope")
+
+
+def test_k8s_machine_grammar():
+    r = parse_k8s_machine("m+v100")
+    assert (r.cpu, r.memory_mb, r.accelerator, r.gpu_count) == (8, 64000, "nvidia", 1)
+    assert r.limits()["nvidia.com/gpu"] == "1"
+    assert r.node_selector() == {"accelerator": "nvidia"}
+    plain = parse_k8s_machine("m")
+    assert plain.limits() == {"cpu": "8", "memory": "32000M"}
+    with pytest.raises(ValueError):
+        parse_k8s_machine("eight-lots")
+
+
+# --- k8s manifests ----------------------------------------------------------
+
+def test_k8s_manifests_indexed_job():
+    spec = TaskSpec(size=Size(machine="m+t4", storage=30),
+                    environment=Environment(script="#!/bin/sh\necho hi\n"),
+                    parallelism=3)
+    config_map, pvc, job = render_manifests(
+        "tpi-test-3z4xlzwq-3u0vweb4", spec, region="disktype=ssd,zone=a")
+    assert config_map["data"]["script"].startswith("#!/bin/sh")
+    assert pvc["spec"]["accessModes"] == ["ReadWriteMany"]
+    js = job["spec"]
+    assert js["parallelism"] == js["completions"] == 3
+    assert js["completionMode"] == "Indexed"
+    assert js["backoffLimit"] == 2147483647
+    assert js["activeDeadlineSeconds"] == 24 * 3600
+    pod = js["template"]["spec"]
+    assert pod["nodeSelector"] == {"disktype": "ssd", "zone": "a",
+                                   "accelerator": "nvidia"}
+    limits = pod["containers"][0]["resources"]["limits"]
+    assert limits == {"cpu": "4", "memory": "16000M",
+                      "ephemeral-storage": "30G", "nvidia.com/gpu": "1"}
+
+
+def test_k8s_manifests_single_pod():
+    spec = TaskSpec(environment=Environment(script="x", timeout=None))
+    _, pvc, job = render_manifests("tpi-a-b-c", spec)
+    assert pvc["spec"]["accessModes"] == ["ReadWriteOnce"]
+    assert "completionMode" not in job["spec"]
+    assert "activeDeadlineSeconds" not in job["spec"]
+
+
+# --- hermetic lifecycle through each backend --------------------------------
+
+@pytest.fixture
+def hermetic(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPU_TASK_LOCAL_ROOT", str(tmp_path / "groups"))
+    monkeypatch.setenv("TPU_TASK_LOCAL_LOG_PERIOD", "0.1")
+    monkeypatch.setenv("TPU_TASK_LOCAL_DATA_PERIOD", "0.1")
+    monkeypatch.delenv("KUBECONFIG", raising=False)
+    monkeypatch.delenv("KUBECONFIG_DATA", raising=False)
+    return tmp_path
+
+
+def poll(task, predicate, timeout=30.0, period=0.2):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        task.read()
+        if predicate(task):
+            return
+        time.sleep(period)
+    raise AssertionError(f"not reached; status={task.status()} logs={task.logs()}")
+
+
+@pytest.mark.parametrize("provider,machine,region", [
+    ("gcp", "m", "us-west"),
+    ("k8s", "m", ""),
+    ("aws", "m", "us-east"),
+    ("az", "m", "us-west"),
+])
+def test_backend_lifecycle(hermetic, provider, machine, region):
+    cloud = Cloud(provider=Provider(provider), region=region)
+    spec = TaskSpec(
+        size=Size(machine=machine),
+        environment=Environment(
+            script="#!/bin/bash\necho backend=$TPU_TASK_CLOUD_PROVIDER\n"),
+    )
+    identifier = Identifier.deterministic(f"{provider}-lc")
+    task = task_factory.new(cloud, identifier, spec)
+    task.delete()
+    task.create()
+    task.create()  # idempotent
+    try:
+        assert identifier in task_factory.list_tasks(cloud)
+        poll(task, lambda t: t.status().get(StatusCode.SUCCEEDED, 0) >= 1)
+        assert f"backend={provider}" in "".join(task.logs())
+    finally:
+        task.delete()
+    assert identifier not in task_factory.list_tasks(cloud)
+
+
+def test_gcp_tpu_machine_routes_to_tpu_backend(tmp_path, monkeypatch):
+    """cloud=gcp machine=v4-8 provisions via the Cloud TPU control plane —
+    the north-star retarget (BASELINE.json)."""
+    monkeypatch.setenv("TPU_TASK_FAKE_TPU_ROOT", str(tmp_path / "fake-tpu"))
+    from tpu_task.backends.tpu import TPUTask
+
+    cloud = Cloud(provider=Provider.GCP, region="us-central2")
+    spec = TaskSpec(size=Size(machine="v4-8"),
+                    environment=Environment(script="#!/bin/bash\ntrue\n"))
+    task = task_factory.new(cloud, Identifier.deterministic("gcp-tpu"), spec)
+    assert isinstance(task, TPUTask)
+
+
+def test_gcp_rejects_spot_bid(hermetic):
+    from tpu_task.common.values import Spot
+
+    cloud = Cloud(provider=Provider.GCP, region="us-west")
+    spec = TaskSpec(size=Size(machine="m"), spot=Spot(0.5))
+    with pytest.raises(ValueError, match="bidding"):
+        task_factory.new(cloud, Identifier.deterministic("gcp-spot"), spec)

@@ -200,3 +200,61 @@ def test_apply_rollback_on_failure(config_dir, monkeypatch):
     from tpu_task.common.cloud import Cloud, Provider
 
     assert task_factory.list_tasks(Cloud(provider=Provider.LOCAL)) == []
+
+
+def test_reapply_failure_keeps_adopted_task(config_dir, monkeypatch):
+    """A transient failure on RE-apply must not delete the live task."""
+    from tpu_task.backends.local.task import LocalTask
+    from tpu_task import task as task_factory
+    from tpu_task.common.cloud import Cloud, Provider
+
+    apply(config_dir)
+    identifier = State(config_dir).identifier("demo")
+    cloud = Cloud(provider=Provider.LOCAL)
+    assert len(task_factory.list_tasks(cloud)) == 1
+
+    def boom(self):
+        raise RuntimeError("transient control-plane error")
+
+    monkeypatch.setattr(LocalTask, "start", boom)
+    with pytest.raises(RuntimeError, match="transient"):
+        apply(config_dir)
+    # still in state, still alive
+    assert State(config_dir).identifier("demo") == identifier
+    assert len(task_factory.list_tasks(cloud)) == 1
+    monkeypatch.undo()
+    destroy(config_dir)
+
+
+def test_identifier_persisted_before_create(config_dir, monkeypatch):
+    """d.SetId-before-Create parity: a crash after create leaves the
+    identifier traceable in state even if read never ran."""
+    from tpu_task.backends.local.task import LocalTask
+
+    def boom(self):
+        raise RuntimeError("read exploded")
+
+    monkeypatch.setattr(LocalTask, "read", boom)
+    results = apply(config_dir)   # read failure is survivable
+    assert results["demo"] == {}
+    assert State(config_dir).identifier("demo") is not None
+    monkeypatch.undo()
+    destroy(config_dir)
+
+
+def test_duplicate_labels_rejected(config_dir):
+    (config_dir / "extra.tf").write_text(LOCAL_TF)
+    with pytest.raises(HclError, match="duplicate"):
+        load_tasks(config_dir)
+
+
+def test_exclude_string_coerced(tmp_path):
+    (tmp_path / "main.tf").write_text('''
+      resource "iterative_task" "t" {
+        cloud = "local"
+        storage { workdir = "." exclude = "cache/**" }
+        script = "x"
+      }
+    ''')
+    defn = load_tasks(tmp_path)[0]
+    assert build_spec(defn).environment.exclude_list == ["cache/**"]

@@ -1,0 +1,119 @@
+"""Telemetry + log formatter tests (reference: iterative/utils/
+analytics_test.go, logger_test.go)."""
+
+import json
+import logging
+import threading
+
+import pytest
+
+from tpu_task.common.values import StatusCode
+from tpu_task.utils import telemetry
+from tpu_task.utils.logger import (
+    TaskFormatter,
+    format_logs,
+    format_machine,
+    format_status,
+)
+
+
+# --- telemetry ---------------------------------------------------------------
+
+def test_user_id_deterministic_and_anonymized(monkeypatch):
+    monkeypatch.delenv("GITHUB_ACTIONS", raising=False)
+    monkeypatch.delenv("CI", raising=False)
+    first, second = telemetry.user_id(), telemetry.user_id()
+    assert first == second
+    assert len(first) > 20
+    import getpass, socket
+
+    raw = f"{getpass.getuser()}@{socket.gethostname()}"
+    assert raw not in first  # anonymized, not raw identity
+
+
+def test_ci_user_id(monkeypatch):
+    monkeypatch.setenv("GITHUB_ACTIONS", "true")
+    monkeypatch.setenv("GITHUB_ACTOR", "octocat")
+    ci_id = telemetry.user_id()
+    monkeypatch.setenv("GITHUB_ACTOR", "other")
+    assert telemetry.user_id() != ci_id
+    assert telemetry.guess_ci() == "github"
+
+
+def test_payload_error_type_only(monkeypatch):
+    monkeypatch.delenv("GITHUB_ACTIONS", raising=False)
+    try:
+        raise ValueError("secret-path-/root/key.pem")
+    except ValueError as error:
+        payload = telemetry.event_payload("cli_create", error,
+                                          {"cloud": "tpu"})
+    assert payload["error"] == "ValueError"
+    assert "secret-path" not in json.dumps(payload)
+    assert payload["backend"] == "tpu"
+    assert payload["tool_name"] == "tpu-task"
+
+
+def test_opt_out_blocks_send(monkeypatch):
+    monkeypatch.setenv("TPU_TASK_TELEMETRY_URL", "http://127.0.0.1:1/x")
+    monkeypatch.setenv("TPU_TASK_DO_NOT_TRACK", "1")
+    telemetry.send_event("cli_test")
+    assert not telemetry._pending
+    monkeypatch.delenv("TPU_TASK_DO_NOT_TRACK")
+    monkeypatch.setenv("ITERATIVE_DO_NOT_TRACK", "1")  # reference opt-out honored
+    telemetry.send_event("cli_test")
+    assert not telemetry._pending
+
+
+def test_no_endpoint_no_send(monkeypatch):
+    monkeypatch.delenv("TPU_TASK_TELEMETRY_URL", raising=False)
+    telemetry.send_event("cli_test")
+    assert not telemetry._pending
+
+
+def test_send_and_drain(monkeypatch):
+    monkeypatch.setenv("TPU_TASK_TELEMETRY_URL", "http://127.0.0.1:1/x")
+    monkeypatch.delenv("TPU_TASK_DO_NOT_TRACK", raising=False)
+    monkeypatch.delenv("ITERATIVE_DO_NOT_TRACK", raising=False)
+    telemetry.send_event("cli_test")   # connection refused, swallowed
+    telemetry.wait_for_telemetry()
+    assert not telemetry._pending
+
+
+# --- logger ------------------------------------------------------------------
+
+def record(message, level=logging.INFO):
+    return logging.LogRecord("t", level, "f", 1, message, (), None)
+
+
+def test_formatter_colors_and_prefix():
+    formatter = TaskFormatter(color=True)
+    out = formatter.format(record("hello"))
+    assert out.startswith("\x1b[36mTPU-TASK [INFO]\x1b[0m hello")
+    plain = TaskFormatter(color=False).format(record("hello"))
+    assert plain == "TPU-TASK [INFO] hello"
+
+
+def test_formatter_multiline_prefixes_every_line():
+    formatter = TaskFormatter(color=True)
+    out = formatter.format(record("a\nb"))
+    assert out.count("TPU-TASK [INFO]") == 2
+
+
+def test_format_machine():
+    assert format_machine("gcp", "v4-8", "us-central2") == "gcp v4-8 in us-central2"
+    assert "(Spot 0.500000/h)" in format_machine("aws", "m", "us-east", 0.5)
+
+
+def test_format_status_transitions():
+    assert "queued" in format_status({}, 1, color=False)
+    assert "running" in format_status({StatusCode.ACTIVE: 1}, 1, color=False)
+    assert "successfully" in format_status({StatusCode.SUCCEEDED: 2}, 2, color=False)
+    # failures dominate
+    assert "errors" in format_status(
+        {StatusCode.SUCCEEDED: 2, StatusCode.FAILED: 1}, 2, color=False)
+
+
+def test_format_logs_indexed_prefixes():
+    out = format_logs(["one\ntwo", "three"], color=False)
+    assert "LOG 0 >> one" in out and "LOG 0 >> two" in out
+    assert "LOG 1 >> three" in out

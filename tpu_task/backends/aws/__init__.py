@@ -1,0 +1,19 @@
+from tpu_task.backends.aws.task import (
+    AWS_REGIONS,
+    AWS_SIZES,
+    AWSTask,
+    list_aws_tasks,
+    resolve_aws_machine,
+    resolve_aws_region,
+    validate_instance_profile_arn,
+)
+
+__all__ = [
+    "AWS_REGIONS",
+    "AWS_SIZES",
+    "AWSTask",
+    "list_aws_tasks",
+    "resolve_aws_machine",
+    "resolve_aws_region",
+    "validate_instance_profile_arn",
+]

@@ -1,0 +1,99 @@
+"""AWS backend: reference-parity semantics on the hermetic control plane.
+
+Size map and region map mirror /root/reference/task/aws/resources/
+resource_launch_template.go:61-73 and task/aws/client/client.go:22-27; the
+instance-profile ARN validator mirrors data_source_permission_set.go:15-40.
+Spot semantics (ASG MixedInstancesPolicy, resource_auto_scaling_group.go:
+64-90): any spot >= 0 is accepted — >0 is the max bid, 0 means 100% spot at
+on-demand cap. The real EC2/S3 control plane is not wired in this round
+(the framework's north star is Cloud TPU — SURVEY.md §7 stage 7); lifecycle
+semantics run end-to-end on the hermetic scaling-group plane so a future
+REST client drops into a tested seam.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List
+
+from tpu_task.backends.group_task import GroupBackedTask
+from tpu_task.common.cloud import Cloud
+from tpu_task.common.identifier import Identifier, WrongIdentifierError
+
+AWS_SIZES: Dict[str, str] = {
+    "s": "t2.micro",
+    "m": "m5.2xlarge",
+    "l": "m5.8xlarge",
+    "xl": "m5.16xlarge",
+    "m+t4": "g4dn.xlarge",
+    "m+k80": "p2.xlarge",
+    "l+k80": "p2.8xlarge",
+    "xl+k80": "p2.16xlarge",
+    "m+v100": "p3.xlarge",
+    "l+v100": "p3.8xlarge",
+    "xl+v100": "p3.16xlarge",
+}
+
+AWS_REGIONS: Dict[str, str] = {
+    "us-east": "us-east-1",
+    "us-west": "us-west-1",
+    "eu-north": "eu-north-1",
+    "eu-west": "eu-west-1",
+}
+
+_INSTANCE_TYPE_RE = re.compile(r"^[a-z0-9]+\.[a-z0-9]+$")
+_ARN_RE = re.compile(r"^arn:aws[a-z-]*:iam::\d{12}:instance-profile/[\w+=,.@-]+$")
+
+
+def resolve_aws_machine(machine: str) -> str:
+    machine = AWS_SIZES.get(machine, machine)
+    if not _INSTANCE_TYPE_RE.match(machine):
+        raise ValueError(f"invalid EC2 instance type: {machine!r}")
+    return machine
+
+
+def resolve_aws_region(region: str) -> str:
+    region = str(region)
+    if region in AWS_REGIONS:
+        return AWS_REGIONS[region]
+    if re.match(r"^[a-z]{2}(-[a-z]+)+-\d$", region):
+        return region
+    raise ValueError(f"cannot resolve AWS region {region!r}")
+
+
+def validate_instance_profile_arn(arn: str) -> str:
+    """Instance-profile ARN check (data_source_permission_set.go:15-40)."""
+    if arn and not _ARN_RE.match(arn):
+        raise ValueError(f"invalid instance profile ARN: {arn!r}")
+    return arn
+
+
+class AWSTask(GroupBackedTask):
+    provider_name = "aws"
+
+    def validate(self) -> None:
+        self.instance_type = resolve_aws_machine(self.spec.size.machine or "m")
+        self.region = resolve_aws_region(str(self.cloud.region))
+        validate_instance_profile_arn(self.spec.permission_set)
+
+    def extra_environment(self) -> Dict[str, str]:
+        env: Dict[str, str] = {}
+        creds = self.cloud.credentials.aws
+        if creds and creds.access_key_id:
+            env["AWS_ACCESS_KEY_ID"] = creds.access_key_id
+            env["AWS_SECRET_ACCESS_KEY"] = creds.secret_access_key
+            if creds.session_token:
+                env["AWS_SESSION_TOKEN"] = creds.session_token
+        return env
+
+
+def list_aws_tasks(cloud: Cloud) -> List[Identifier]:
+    from tpu_task.backends.local.control_plane import list_groups
+
+    identifiers = []
+    for name in list_groups():
+        try:
+            identifiers.append(Identifier.parse(name))
+        except WrongIdentifierError:
+            continue
+    return identifiers

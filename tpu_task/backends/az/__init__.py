@@ -1,0 +1,19 @@
+from tpu_task.backends.az.task import (
+    AZ_REGIONS,
+    AZ_SIZES,
+    AZTask,
+    list_az_tasks,
+    resolve_az_machine,
+    resolve_az_region,
+    validate_arm_id,
+)
+
+__all__ = [
+    "AZ_REGIONS",
+    "AZ_SIZES",
+    "AZTask",
+    "list_az_tasks",
+    "resolve_az_machine",
+    "resolve_az_region",
+    "validate_arm_id",
+]

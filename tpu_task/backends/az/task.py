@@ -1,0 +1,106 @@
+"""Azure backend: reference-parity semantics on the hermetic control plane.
+
+Size and region maps mirror /root/reference/task/az/resources/
+resource_virtual_machine_scale_set.go:111-124 and task/az/client/client.go:
+65-70; the user-assigned-identity ARM-ID validator mirrors
+data_source_permission_set.go:18-44 (comma-separated list). Spot semantics
+(VMSS eviction-policy Delete + BillingProfile, resource_virtual_machine_
+scale_set.go:219-229): >0 is the max price, 0 maps to -1 (no cap). The real
+ARM control plane is not wired this round (north star is Cloud TPU);
+lifecycle semantics run on the hermetic scaling-group plane.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List
+
+from tpu_task.backends.group_task import GroupBackedTask
+from tpu_task.common.cloud import Cloud
+from tpu_task.common.identifier import Identifier, WrongIdentifierError
+
+AZ_SIZES: Dict[str, str] = {
+    "s": "Standard_B1s",
+    "m": "Standard_F8s_v2",
+    "l": "Standard_F32s_v2",
+    "xl": "Standard_F64s_v2",
+    "m+t4": "Standard_NC4as_T4_v3",
+    "m+k80": "Standard_NC6",
+    "l+k80": "Standard_NC12",
+    "xl+k80": "Standard_NC24",
+    "m+v100": "Standard_NC6s_v3",
+    "l+v100": "Standard_NC12s_v3",
+    "xl+v100": "Standard_NC24s_v3",
+}
+
+AZ_REGIONS: Dict[str, str] = {
+    "us-east": "eastus",
+    "us-west": "westus2",
+    "eu-north": "northeurope",
+    "eu-west": "westeurope",
+}
+
+_VM_SIZE_RE = re.compile(r"^[A-Za-z0-9_]+$")
+_ARM_ID_RE = re.compile(
+    r"^/subscriptions/[0-9a-fA-F-]{36}"
+    r"/resourceGroups/[^/]+"
+    r"/providers/Microsoft\.ManagedIdentity"
+    r"/userAssignedIdentities/[^/]+$"
+)
+
+
+def resolve_az_machine(machine: str) -> str:
+    machine = AZ_SIZES.get(machine, machine)
+    if not _VM_SIZE_RE.match(machine):
+        raise ValueError(f"invalid Azure VM size: {machine!r}")
+    return machine
+
+
+def resolve_az_region(region: str) -> str:
+    region = str(region)
+    if region in AZ_REGIONS:
+        return AZ_REGIONS[region]
+    if re.match(r"^[a-z]+[a-z0-9]*$", region):
+        return region
+    raise ValueError(f"cannot resolve Azure region {region!r}")
+
+
+def validate_arm_id(permission_set: str) -> List[str]:
+    """Comma-separated user-assigned-identity ARM IDs
+    (data_source_permission_set.go:18-44)."""
+    ids = [item.strip() for item in permission_set.split(",") if item.strip()]
+    for arm_id in ids:
+        if not _ARM_ID_RE.match(arm_id):
+            raise ValueError(f"invalid user-assigned identity ARM id: {arm_id!r}")
+    return ids
+
+
+class AZTask(GroupBackedTask):
+    provider_name = "az"
+
+    def validate(self) -> None:
+        self.vm_size = resolve_az_machine(self.spec.size.machine or "m")
+        self.region = resolve_az_region(str(self.cloud.region))
+        validate_arm_id(self.spec.permission_set)
+
+    def extra_environment(self) -> Dict[str, str]:
+        env: Dict[str, str] = {}
+        creds = self.cloud.credentials.az
+        if creds and creds.client_id:
+            env["AZURE_CLIENT_ID"] = creds.client_id
+            env["AZURE_CLIENT_SECRET"] = creds.client_secret
+            env["AZURE_SUBSCRIPTION_ID"] = creds.subscription_id
+            env["AZURE_TENANT_ID"] = creds.tenant_id
+        return env
+
+
+def list_az_tasks(cloud: Cloud) -> List[Identifier]:
+    from tpu_task.backends.local.control_plane import list_groups
+
+    identifiers = []
+    for name in list_groups():
+        try:
+            identifiers.append(Identifier.parse(name))
+        except WrongIdentifierError:
+            continue
+    return identifiers

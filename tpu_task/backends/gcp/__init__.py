@@ -1,0 +1,19 @@
+from tpu_task.backends.gcp.machines import (
+    GCP_REGIONS,
+    GCP_SIZES,
+    GceMachine,
+    parse_gcp_machine,
+    resolve_gcp_zone,
+)
+from tpu_task.backends.gcp.task import GCPTask, list_gcp_tasks, new_gcp_task
+
+__all__ = [
+    "GCP_REGIONS",
+    "GCP_SIZES",
+    "GCPTask",
+    "GceMachine",
+    "list_gcp_tasks",
+    "new_gcp_task",
+    "parse_gcp_machine",
+    "resolve_gcp_zone",
+]

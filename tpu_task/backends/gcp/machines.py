@@ -1,0 +1,65 @@
+"""GCE machine grammar: generic sizes + ``{type}+{accelerator}*{count}``.
+
+Parity with /root/reference/task/gcp/resources/resource_instance_template.go:
+72-107 (size map + accelerator grammar) and task/gcp/client/client.go:47-52
+(region → zone map).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+GCP_SIZES: Dict[str, str] = {
+    "s": "g1-small",
+    "m": "e2-custom-8-32768",
+    "l": "e2-custom-32-131072",
+    "xl": "n2-custom-64-262144",
+    "m+t4": "n1-standard-4+nvidia-tesla-t4*1",
+    "m+k80": "custom-8-53248+nvidia-tesla-k80*1",
+    "l+k80": "custom-32-131072+nvidia-tesla-k80*4",
+    "xl+k80": "custom-64-212992-ext+nvidia-tesla-k80*8",
+    "m+v100": "custom-8-65536-ext+nvidia-tesla-v100*1",
+    "l+v100": "custom-32-262144-ext+nvidia-tesla-v100*4",
+    "xl+v100": "custom-64-524288-ext+nvidia-tesla-v100*8",
+}
+
+GCP_REGIONS: Dict[str, str] = {
+    "us-east": "us-east1-c",
+    "us-west": "us-west1-b",
+    "eu-north": "europe-north1-a",
+    "eu-west": "europe-west1-d",
+}
+
+_MACHINE_RE = re.compile(r"^([^+]+)(?:\+([^*]+)\*([1-9]\d*))?$")
+
+
+@dataclass(frozen=True)
+class GceMachine:
+    machine_type: str
+    accelerator_type: str = ""
+    accelerator_count: int = 0
+
+
+def parse_gcp_machine(machine: str) -> GceMachine:
+    """Resolve a generic size alias then parse the accelerator grammar
+    (resource_instance_template.go:92-107)."""
+    machine = GCP_SIZES.get(machine, machine)
+    match = _MACHINE_RE.match(machine)
+    if not match:
+        raise ValueError(f"invalid machine type: {machine!r}")
+    machine_type, accel, count = match.group(1), match.group(2), match.group(3)
+    return GceMachine(
+        machine_type=machine_type,
+        accelerator_type=accel or "",
+        accelerator_count=int(count) if count else 0,
+    )
+
+
+def resolve_gcp_zone(region: str) -> str:
+    if region in GCP_REGIONS:
+        return GCP_REGIONS[region]
+    if region.count("-") >= 2:  # already zone-shaped
+        return region
+    raise ValueError(f"cannot resolve GCP zone for region {region!r}")

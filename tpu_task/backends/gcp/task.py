@@ -1,0 +1,96 @@
+"""GCP backend: TPU accelerator types route to the Cloud TPU control plane;
+GCE machine types run hermetically.
+
+The reference's GCP path (task/gcp/task.go: InstanceTemplate + MIG) is
+exactly what this framework re-targets at Cloud TPU (SURVEY.md north star):
+``cloud=gcp machine=v4-8`` provisions a QueuedResource-backed TPU slice —
+the real control plane — while GPU/CPU GCE machine types (``m``,
+``m+v100*1``…) validate against the reference's size/zone grammar and run on
+the hermetic scaling-group plane. Spot semantics follow the reference:
+``spot > 0`` is rejected because GCP preemptible capacity has no bid price
+(resource_instance_template.go:110-113).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from tpu_task.backends.gcp.machines import parse_gcp_machine, resolve_gcp_zone
+from tpu_task.backends.group_task import GroupBackedTask
+from tpu_task.backends.tpu.accelerators import InvalidAcceleratorError
+from tpu_task.common.cloud import Cloud
+from tpu_task.common.identifier import Identifier
+from tpu_task.common.values import Task as TaskSpec
+from tpu_task.task import Task
+
+
+def _is_tpu_machine(machine: str) -> bool:
+    """Explicit TPU accelerator types only — generic aliases (s/m/l/xl) keep
+    the reference's GCE meaning under cloud=gcp; the TPU backend has its own
+    alias table for cloud=tpu."""
+    from tpu_task.backends.tpu.accelerators import _TPU_RE, parse_accelerator
+
+    if not _TPU_RE.match(machine):
+        return False
+    try:
+        parse_accelerator(machine)
+        return True
+    except InvalidAcceleratorError:
+        return False
+
+
+def new_gcp_task(cloud: Cloud, identifier: Identifier, spec: TaskSpec) -> Task:
+    """cloud=gcp factory: TPU accelerators → TPU backend, else GCE semantics."""
+    if spec.size.machine and _is_tpu_machine(spec.size.machine):
+        from tpu_task.backends.tpu import TPUTask
+
+        return TPUTask(cloud, identifier, spec)
+    return GCPTask(cloud, identifier, spec)
+
+
+class GCPTask(GroupBackedTask):
+    provider_name = "gcp"
+
+    def validate(self) -> None:
+        self.machine = parse_gcp_machine(self.spec.size.machine or "m")
+        self.zone = resolve_gcp_zone(str(self.cloud.region))
+        if self.spec.spot > 0:
+            # GCP preemptible instances have no bid price
+            # (resource_instance_template.go:110-113).
+            raise ValueError(
+                "GCP preemptible instances don't support bidding "
+                "(set spot = 0 for auto pricing)")
+
+    def extra_environment(self) -> Dict[str, str]:
+        env: Dict[str, str] = {}
+        if self.cloud.credentials.gcp and \
+                self.cloud.credentials.gcp.application_credentials:
+            env["GOOGLE_APPLICATION_CREDENTIALS_DATA"] = \
+                self.cloud.credentials.gcp.application_credentials
+        return env
+
+
+def list_gcp_tasks(cloud: Cloud) -> List[Identifier]:
+    """Union of TPU-provisioned and hermetic-group task identifiers."""
+    from tpu_task.backends.local.control_plane import list_groups
+    from tpu_task.backends.tpu.task import fake_mode, list_tpu_tasks
+    from tpu_task.common.identifier import WrongIdentifierError
+
+    identifiers: List[Identifier] = []
+    seen = set()
+    import os
+
+    if fake_mode() or os.environ.get("GOOGLE_APPLICATION_CREDENTIALS_DATA"):
+        for identifier in list_tpu_tasks(cloud):
+            if identifier.long() not in seen:
+                seen.add(identifier.long())
+                identifiers.append(identifier)
+    for name in list_groups():
+        try:
+            identifier = Identifier.parse(name)
+        except WrongIdentifierError:
+            continue
+        if identifier.long() not in seen:
+            seen.add(identifier.long())
+            identifiers.append(identifier)
+    return identifiers

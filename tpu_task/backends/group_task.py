@@ -1,0 +1,150 @@
+"""Shared base for scaling-group-backed task backends.
+
+The reference's per-cloud packages all compose the same shape — a scaling
+group at desired capacity N, a storage container, a rendered bootstrap — and
+differ in size grammars, region maps, credential env and the cloud control
+plane (task/{aws,gcp,az,k8s}/task.go). This base carries the common lifecycle
+over the hermetic ``MachineGroup`` control plane (subprocess workers, file
+bucket) so every backend's *semantics* — size parsing, spot policy, env
+injection, rank assignment — are exercised end-to-end without cloud
+credentials; real control planes are wired per backend where available
+(TPU: QueuedResources; others land incrementally).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from datetime import datetime
+from typing import Dict, List
+
+from tpu_task.backends.local.control_plane import MachineGroup
+from tpu_task.common.cloud import Cloud
+from tpu_task.common.errors import ResourceNotFoundError
+from tpu_task.common.identifier import Identifier
+from tpu_task.common.steps import Step, run_steps
+from tpu_task.common.values import Event, Status, StatusCode
+from tpu_task.common.values import Task as TaskSpec
+from tpu_task.storage import limit_transfer, logs as storage_logs
+from tpu_task.storage import status as storage_status, transfer
+from tpu_task.task import Task
+
+
+class GroupBackedTask(Task):
+    """Hermetic scaling-group lifecycle; subclasses set provider semantics."""
+
+    provider_name = "local"
+
+    def __init__(self, cloud: Cloud, identifier: Identifier, spec: TaskSpec):
+        self.cloud = cloud
+        self.identifier = identifier
+        self.spec = spec
+        self.validate()
+        self.group = MachineGroup(identifier.long())
+
+    # -- hooks ----------------------------------------------------------------
+    def validate(self) -> None:
+        """Parse/validate machine size, region, spot policy. Raise on error."""
+
+    def extra_environment(self) -> Dict[str, str]:
+        """Provider-specific env (credentials etc.) injected into workers."""
+        return {}
+
+    # -- common plumbing -------------------------------------------------------
+    def _timeout_epoch(self) -> float:
+        timeout = self.spec.environment.timeout
+        if timeout is None:
+            return 0.0
+        return time.time() + timeout.total_seconds()
+
+    def _environment(self) -> dict:
+        env = dict(self.spec.environment.variables.enrich())
+        env.update(self.extra_environment())
+        env["TPU_TASK_CLOUD_PROVIDER"] = self.provider_name
+        env["TPU_TASK_CLOUD_REGION"] = str(self.cloud.region)
+        env["TPU_TASK_IDENTIFIER"] = self.identifier.long()
+        env["TPU_TASK_REMOTE"] = self.group.bucket
+        env["TPI_TASK"] = "true"
+        return env
+
+    def _sync_periods(self) -> tuple:
+        log_period = float(os.environ.get("TPU_TASK_LOCAL_LOG_PERIOD", "5"))
+        data_period = float(os.environ.get("TPU_TASK_LOCAL_DATA_PERIOD", "10"))
+        return log_period, data_period
+
+    # -- lifecycle -------------------------------------------------------------
+    def create(self) -> None:
+        log_period, data_period = self._sync_periods()
+        run_steps([
+            Step("Creating machine group...", lambda: self.group.create(
+                script=self.spec.environment.script,
+                parallelism=self.spec.parallelism,
+                timeout_epoch=self._timeout_epoch(),
+                environment=self._environment(),
+                log_period=log_period, data_period=data_period,
+            )),
+            Step("Uploading directory...", self.push),
+            Step("Starting task...", self.start),
+        ])
+
+    def read(self) -> None:
+        state = self.group.reconcile()
+        self.spec.addresses = [f"127.0.0.1#{worker.machine_id}"
+                               for worker in state.workers]
+        self.spec.status = self.status()
+        self.spec.events = self.events()
+
+    def delete(self) -> None:
+        if self.group.exists() and self.spec.environment.directory:
+            try:
+                self.pull()
+            except ResourceNotFoundError:
+                pass
+        self.group.delete()
+
+    def start(self) -> None:
+        self.group.scale(self.spec.parallelism)
+
+    def stop(self) -> None:
+        self.group.scale(0)
+
+    # -- data plane ------------------------------------------------------------
+    def push(self) -> None:
+        if not self.spec.environment.directory:
+            return
+        transfer(self.spec.environment.directory,
+                 os.path.join(self.group.bucket, "data"),
+                 self.spec.environment.exclude_list)
+
+    def pull(self) -> None:
+        if not self.spec.environment.directory:
+            return
+        rules = limit_transfer(self.spec.environment.directory_out,
+                               list(self.spec.environment.exclude_list))
+        transfer(os.path.join(self.group.bucket, "data"),
+                 self.spec.environment.directory, rules)
+
+    # -- observation -----------------------------------------------------------
+    def status(self) -> Status:
+        initial: Status = {StatusCode.ACTIVE: len(self.group.live_workers())}
+        return storage_status(self.group.bucket, initial)
+
+    def events(self) -> List[Event]:
+        return [
+            Event(time=datetime.fromisoformat(event["time"]),
+                  code=event["code"], description=[event["description"]])
+            for event in self.group.events()
+        ]
+
+    def logs(self) -> List[str]:
+        return storage_logs(self.group.bucket)
+
+    def get_identifier(self) -> Identifier:
+        return self.identifier
+
+    def get_addresses(self) -> List[str]:
+        return list(self.spec.addresses)
+
+    def preempt(self, index: int = 0) -> None:
+        """Simulate spot preemption of one worker (hermetic recovery tests)."""
+        self.group.preempt(index)

@@ -1,0 +1,12 @@
+from tpu_task.backends.k8s.machines import K8S_SIZES, K8sResources, parse_k8s_machine
+from tpu_task.backends.k8s.manifests import render_manifests
+from tpu_task.backends.k8s.task import K8STask, list_k8s_tasks
+
+__all__ = [
+    "K8S_SIZES",
+    "K8STask",
+    "K8sResources",
+    "list_k8s_tasks",
+    "parse_k8s_machine",
+    "render_manifests",
+]

@@ -1,0 +1,78 @@
+"""Kubernetes size grammar: ``{cpu}-{memoryMB}[+{accelerator}*{count}]``.
+
+Parity with /root/reference/task/k8s/resources/resource_job.go:71-124 —
+generic aliases, the cpu-memory regex, GPU limits via ``nvidia.com/gpu``
+with an ``accelerator`` node selector, and the region attribute as a
+comma-separated node-selector label list (resource_job.go:42-48).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict
+
+K8S_SIZES: Dict[str, str] = {
+    "s": "1-1000",
+    "m": "8-32000",
+    "l": "32-128000",
+    "xl": "64-256000",
+    "m+t4": "4-16000+nvidia*1",
+    "m+k80": "4-64000+nvidia*1",
+    "l+k80": "32-512000+nvidia*8",
+    "xl+k80": "64-768000+nvidia*16",
+    "m+v100": "8-64000+nvidia*1",
+    "l+v100": "32-256000+nvidia*4",
+    "xl+v100": "64-512000+nvidia*8",
+}
+
+K8S_IMAGES: Dict[str, str] = {
+    "ubuntu": "ubuntu",
+    "nvidia": "nvidia/cuda:11.3.1-cudnn8-runtime-ubuntu20.04",
+}
+
+_SIZE_RE = re.compile(r"^(\d+)-(\d+)(?:\+([^*]+)\*([1-9]\d*))?$")
+
+
+@dataclass(frozen=True)
+class K8sResources:
+    cpu: int
+    memory_mb: int
+    accelerator: str = ""
+    gpu_count: int = 0
+
+    def limits(self, disk_gb: int = -1) -> Dict[str, str]:
+        limits = {"cpu": str(self.cpu), "memory": f"{self.memory_mb}M"}
+        if disk_gb > 0:
+            limits["ephemeral-storage"] = f"{disk_gb}G"
+        if self.gpu_count > 0:
+            limits["nvidia.com/gpu"] = str(self.gpu_count)
+        return limits
+
+    def node_selector(self) -> Dict[str, str]:
+        if self.gpu_count > 0 and self.accelerator:
+            return {"accelerator": self.accelerator}
+        return {}
+
+
+def parse_k8s_machine(machine: str) -> K8sResources:
+    machine = K8S_SIZES.get(machine, machine)
+    match = _SIZE_RE.match(machine)
+    if not match:
+        raise ValueError(f"invalid k8s machine size: {machine!r}")
+    return K8sResources(
+        cpu=int(match.group(1)),
+        memory_mb=int(match.group(2)),
+        accelerator=match.group(3) or "",
+        gpu_count=int(match.group(4)) if match.group(4) else 0,
+    )
+
+
+def parse_node_selectors(region: str) -> Dict[str, str]:
+    """Region = comma-separated ``key=value`` node-selector labels."""
+    selectors: Dict[str, str] = {}
+    for item in str(region or "").split(","):
+        key, sep, value = item.partition("=")
+        if sep and value:
+            selectors[key.strip()] = value.strip()
+    return selectors

@@ -1,0 +1,112 @@
+"""Kubernetes manifest rendering: ConfigMap + PVC + indexed Job.
+
+The object shapes mirror /root/reference/task/k8s/task.go and
+resources/resource_job.go: the task script travels in a ConfigMap mounted at
+/script, the workdir in a PVC (RWX when parallelism > 1 —
+resource_persistent_volume_claim.go:41-44), and the Job runs with
+parallelism == completions, **Indexed completion mode when parallelism > 1**
+(resource_job.go:135-140 — the rank mechanism), BackoffLimit high for
+restart-on-failure (resource_job.go:130), and ActiveDeadlineSeconds as the
+timeout (resource_job.go:142). Rendered as plain dicts (JSON == YAML subset)
+so they golden-test cleanly and feed kubectl directly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from tpu_task.backends.k8s.machines import (
+    K8S_IMAGES,
+    K8sResources,
+    parse_k8s_machine,
+    parse_node_selectors,
+)
+from tpu_task.common.values import Task as TaskSpec
+
+MAX_BACKOFF = 2147483647  # reference uses math.MaxInt32
+
+
+def render_manifests(identifier: str, spec: TaskSpec, namespace: str = "default",
+                     region: str = "") -> List[Dict[str, Any]]:
+    resources = parse_k8s_machine(spec.size.machine or "m")
+    selectors = parse_node_selectors(region)
+    selectors.update(resources.node_selector())
+
+    image = spec.environment.image or "ubuntu"
+    image = K8S_IMAGES.get(image, image)
+
+    labels = {"tpu-task": identifier}
+    env = [{"name": name, "value": value}
+           for name, value in sorted(spec.environment.variables.enrich().items())]
+    env.append({"name": "TPI_TASK", "value": "true"})
+
+    config_map = {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {"name": f"{identifier}-script", "namespace": namespace,
+                     "labels": labels},
+        "data": {"script": spec.environment.script},
+    }
+
+    pvc = {
+        "apiVersion": "v1",
+        "kind": "PersistentVolumeClaim",
+        "metadata": {"name": f"{identifier}-workdir", "namespace": namespace,
+                     "labels": labels},
+        "spec": {
+            # RWX once multiple pods share the workdir
+            # (resource_persistent_volume_claim.go:41-44).
+            "accessModes": ["ReadWriteMany" if spec.parallelism > 1
+                            else "ReadWriteOnce"],
+            "resources": {"requests": {
+                "storage": f"{spec.size.storage if spec.size.storage > 0 else 10}Gi",
+            }},
+        },
+    }
+
+    timeout = spec.environment.timeout
+    job_spec: Dict[str, Any] = {
+        "parallelism": spec.parallelism,
+        "completions": spec.parallelism,
+        "backoffLimit": MAX_BACKOFF,
+        "template": {
+            "metadata": {"labels": labels},
+            "spec": {
+                "restartPolicy": "Never",
+                "terminationGracePeriodSeconds": 30,
+                **({"nodeSelector": selectors} if selectors else {}),
+                "containers": [{
+                    "name": "task",
+                    "image": image,
+                    "command": ["/bin/sh", "-c", "exec /script/script"],
+                    "env": env,
+                    "resources": {"limits": resources.limits(spec.size.storage)},
+                    "workingDir": "/workdir",
+                    "volumeMounts": [
+                        {"name": "script", "mountPath": "/script"},
+                        {"name": "workdir", "mountPath": "/workdir"},
+                    ],
+                }],
+                "volumes": [
+                    {"name": "script", "configMap": {
+                        "name": f"{identifier}-script", "defaultMode": 0o755}},
+                    {"name": "workdir", "persistentVolumeClaim": {
+                        "claimName": f"{identifier}-workdir"}},
+                ],
+            },
+        },
+    }
+    if timeout is not None:
+        job_spec["activeDeadlineSeconds"] = int(timeout.total_seconds())
+    if spec.parallelism > 1:
+        # Indexed completions give each pod a stable rank
+        # (resource_job.go:135-140); JOB_COMPLETION_INDEX is injected by k8s.
+        job_spec["completionMode"] = "Indexed"
+
+    job = {
+        "apiVersion": "batch/v1",
+        "kind": "Job",
+        "metadata": {"name": identifier, "namespace": namespace, "labels": labels},
+        "spec": job_spec,
+    }
+    return [config_map, pvc, job]

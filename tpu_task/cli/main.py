@@ -308,10 +308,14 @@ def make_parser() -> argparse.ArgumentParser:
                           help="directory containing .tf files")
         decl.set_defaults(func=cmd_declarative, verb=verb)
 
-    exec_cmd = sub.add_parser("exec", help="run a command on every worker of a task")
+    exec_cmd = sub.add_parser(
+        "exec", help="run a command on every worker of a task",
+        epilog="separate the command with '--': tpu-task exec NAME -- hostname")
     exec_cmd.add_argument("name")
     exec_cmd.add_argument("--timeout", type=float, default=60.0)
-    exec_cmd.add_argument("command", nargs=argparse.REMAINDER)
+    # nargs="*" (not REMAINDER): flags after the task name still parse as
+    # flags; everything after a "--" separator is the worker command.
+    exec_cmd.add_argument("command", nargs="*")
     exec_cmd.set_defaults(func=cmd_exec)
 
     storage = sub.add_parser("storage", help="data-plane operations (used on workers)")
@@ -327,16 +331,25 @@ def make_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
+    from tpu_task.utils.logger import configure_logging
+    from tpu_task.utils.telemetry import send_event, wait_for_telemetry
+
     args = make_parser().parse_args(argv)
-    logging.basicConfig(
-        level=logging.DEBUG if args.verbose else logging.INFO,
-        format="%(levelname)s %(message)s",
-    )
+    configure_logging(verbose=args.verbose)
+    action = f"cli_{args.subcommand}"
     try:
-        return args.func(args)
+        result = args.func(args)
+        send_event(action, extra={"cloud": getattr(args, "cloud", "")})
+        return result
     except WrongIdentifierError as error:
         logger.error("%s", error)
+        send_event(action, error, extra={"cloud": getattr(args, "cloud", "")})
         return 2
+    except Exception as error:
+        send_event(action, error, extra={"cloud": getattr(args, "cloud", "")})
+        raise
+    finally:
+        wait_for_telemetry()
 
 
 if __name__ == "__main__":

@@ -68,6 +68,10 @@ def load_tasks(directory) -> List[TaskDefinition]:
             storage: Dict[str, Any] = {}
             for nested in block.find("storage"):
                 storage.update(nested.body)
+            if any(task.name == label for task in tasks):
+                raise HclError(
+                    f"duplicate resource label {label!r} — each task needs a "
+                    f"unique name (state is keyed by label)")
             tasks.append(TaskDefinition(name=label, attrs=dict(block.body),
                                         storage=storage))
     return tasks
@@ -81,6 +85,13 @@ def build_cloud(defn: TaskDefinition) -> Cloud:
                  region=str(defn.attrs.get("region", "us-west")),
                  tags={str(k): str(v)
                        for k, v in (defn.attrs.get("tags") or {}).items()})
+
+
+def _string_list(value) -> List[str]:
+    """A bare string is one pattern, not an iterable of characters."""
+    if isinstance(value, str):
+        return [value]
+    return [str(item) for item in value]
 
 
 def build_spec(defn: TaskDefinition) -> TaskSpec:
@@ -103,7 +114,7 @@ def build_spec(defn: TaskDefinition) -> TaskSpec:
         if timeout_seconds else None,
         directory=str(defn.storage.get("workdir", "") or ""),
         directory_out=str(defn.storage.get("output", "") or ""),
-        exclude_list=[str(x) for x in defn.storage.get("exclude", [])],
+        exclude_list=_string_list(defn.storage.get("exclude", [])),
     )
 
     # Forced ingress 22/80 (resource_task.go:414-418).
@@ -205,21 +216,37 @@ def apply(directory) -> Dict[str, Dict[str, Any]]:
         cloud = build_cloud(defn)
         spec = build_spec(defn)
         _chdir_relative(spec, directory)
+        adopted = state.identifier(defn.name) is not None
         identifier = _resolve_identifier(defn, state)
         task = task_factory.new(cloud, identifier, spec)
         logger.info("applying %s (%s)", defn.name, identifier.long())
+        # Persist the identifier BEFORE create (the provider's d.SetId-first
+        # order, resource_task.go:220): a crash between create and the state
+        # write must not orphan a billing resource.
+        state.set(defn.name, identifier.long(), {}, cloud=cloud)
         try:
             task.create()
         except Exception:
-            # Rollback delete on create failure (resource_task.go:221-229).
+            if adopted:
+                # Re-apply on an existing task: never roll back a resource
+                # this invocation didn't create.
+                logger.exception("create failed for existing %s; keeping it",
+                                 defn.name)
+                raise
+            # Rollback delete on fresh-create failure (resource_task.go:
+            # 221-229); keep the state entry if the rollback itself fails so
+            # the half-created resource stays traceable.
             logger.exception("create failed for %s; rolling back", defn.name)
-            try:
-                task.delete()
-            finally:
-                state.remove(defn.name)
+            task.delete()
+            state.remove(defn.name)
             raise
-        task.read()
-        outputs = _computed_outputs(task)
+        try:
+            task.read()
+            outputs = _computed_outputs(task)
+        except Exception:
+            logger.exception("read after create failed for %s; task is "
+                             "created and recorded in state", defn.name)
+            outputs = {}
         state.set(defn.name, identifier.long(), outputs, cloud=cloud)
         results[defn.name] = outputs
     return results

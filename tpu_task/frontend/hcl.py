@@ -44,6 +44,33 @@ _TOKEN_RE = re.compile(
 )
 
 
+_ESCAPES = {"n": "\n", "t": "\t", "r": "\r", '"': '"', "\\": "\\"}
+
+
+def _unescape_string(raw: str, pos: int, text: str) -> str:
+    """Single-pass HCL string unescape; unknown escapes are errors, not
+    silent corruption."""
+    out: List[str] = []
+    i = 0
+    while i < len(raw):
+        char = raw[i]
+        if char != "\\":
+            out.append(char)
+            i += 1
+            continue
+        escape = raw[i + 1] if i + 1 < len(raw) else ""
+        if escape in _ESCAPES:
+            out.append(_ESCAPES[escape])
+            i += 2
+        elif escape == "u" and re.match(r"[0-9a-fA-F]{4}", raw[i + 2:i + 6]):
+            out.append(chr(int(raw[i + 2:i + 6], 16)))
+            i += 6
+        else:
+            line = text.count("\n", 0, pos) + 1
+            raise HclError(f"line {line}: invalid escape sequence \\{escape}")
+    return "".join(out)
+
+
 @dataclass
 class _Token:
     kind: str
@@ -65,8 +92,10 @@ def _tokenize(text: str) -> List[_Token]:
         if match.group("heredoc"):
             tag = match.group("tag")
             indent_strip = match.group("heredoc").startswith("<<-")
+            # [ \t] only: \s would span newlines and swallow trailing blank
+            # lines of the heredoc body into the terminator match.
             end_re = re.compile(
-                rf"^\s*{re.escape(tag)}\s*$", re.MULTILINE)
+                rf"^[ \t]*{re.escape(tag)}[ \t]*$", re.MULTILINE)
             end = end_re.search(text, match.end())
             if not end:
                 raise HclError(f"unterminated heredoc <<{tag}")
@@ -83,13 +112,7 @@ def _tokenize(text: str) -> List[_Token]:
         kind = match.lastgroup
         value: Any = match.group(kind)
         if kind == "string":
-            # Single-pass unescape: sequential .replace would corrupt
-            # escaped backslashes followed by n/t/" (e.g. "C:\\new").
-            value = re.sub(
-                r"\\(.)",
-                lambda m: {"n": "\n", "t": "\t"}.get(m.group(1), m.group(1)),
-                value[1:-1],
-            )
+            value = _unescape_string(value[1:-1], index, text)
         elif kind == "number":
             value = float(value) if "." in value else int(value)
         tokens.append(_Token(kind, value, index))

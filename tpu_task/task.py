@@ -75,9 +75,9 @@ def new(cloud: Cloud, identifier: Identifier, spec: TaskSpec) -> Task:
 
         return TPUTask(cloud, identifier, spec)
     if cloud.provider == Provider.GCP:
-        from tpu_task.backends.gcp import GCPTask
+        from tpu_task.backends.gcp import new_gcp_task
 
-        return GCPTask(cloud, identifier, spec)
+        return new_gcp_task(cloud, identifier, spec)
     if cloud.provider == Provider.K8S:
         from tpu_task.backends.k8s import K8STask
 

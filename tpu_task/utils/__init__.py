@@ -1,0 +1,2 @@
+"""Cross-cutting utilities: telemetry, log formatting (reference:
+iterative/utils/)."""

@@ -1,0 +1,82 @@
+"""ANSI log formatter + task-state renderers.
+
+Parity with /root/reference/iterative/utils/logger.go: a colored
+``TPI [LEVEL]`` prefix formatter and the instance/status/logs renderers the
+provider logs through (formatSchemaInstance/Status/Logs, logger.go:62-104).
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+from typing import Dict, List, Optional
+
+from tpu_task.common.values import Status, StatusCode
+
+COLORS: Dict[str, int] = {
+    "DEBUG": 34,     # blue
+    "INFO": 36,      # cyan
+    "WARNING": 33,   # yellow
+    "ERROR": 31,     # red
+    "CRITICAL": 35,  # magenta
+    "SUCCESS": 32,   # green
+    "FOREGROUND": 39,
+}
+
+
+class TaskFormatter(logging.Formatter):
+    """``TPI [LEVEL]``-style colored prefix (logger.go:26-45)."""
+
+    def __init__(self, color: Optional[bool] = None):
+        super().__init__()
+        self.color = sys.stderr.isatty() if color is None else color
+
+    def format(self, record: logging.LogRecord) -> str:
+        level = record.levelname
+        message = record.getMessage()
+        if not self.color:
+            return f"TPU-TASK [{level}] {message}"
+        color = COLORS.get(level, COLORS["FOREGROUND"])
+        prefix = f"\x1b[{color}mTPU-TASK [{level}]\x1b[0m"
+        return "\n".join(f"{prefix} {line}" for line in message.split("\n"))
+
+
+def format_machine(cloud: str, machine: str, region: str, spot: float = -1) -> str:
+    """``gcp v4-8 (Spot …/h) in us-central2`` (formatSchemaInstance)."""
+    spot_text = f" (Spot {spot:f}/h)" if spot > 0 else ""
+    return f"{cloud} {machine}{spot_text} in {region}"
+
+
+def format_status(status: Status, parallelism: int = 1, color: bool = True) -> str:
+    """Queued/running/succeeded/failed one-liner (formatSchemaStatus)."""
+    text, color_name = "Status: queued", "DEBUG"
+    if status.get(StatusCode.ACTIVE, 0) >= parallelism:
+        text, color_name = "Status: running", "WARNING"
+    if status.get(StatusCode.SUCCEEDED, 0) >= parallelism:
+        text, color_name = "Status: completed successfully", "SUCCESS"
+    if status.get(StatusCode.FAILED, 0) > 0:
+        text, color_name = "Status: completed with errors", "ERROR"
+    if not color:
+        return text
+    return f"\x1b[{COLORS[color_name]}m{text} \x1b[1m•\x1b[0m"
+
+
+def format_logs(logs: List[str], color: bool = True) -> str:
+    """Per-machine ``LOG {i} >>`` prefixed streams (formatSchemaLogs)."""
+    blocks = []
+    for index, log in enumerate(logs):
+        if color:
+            prefix = f"\x1b[{COLORS['FOREGROUND']}mLOG {index} >> "
+        else:
+            prefix = f"LOG {index} >> "
+        lines = log.strip("\n").split("\n")
+        blocks.append("\n".join(prefix + line for line in lines))
+    return "\n".join(blocks)
+
+
+def configure_logging(verbose: bool = False, color: Optional[bool] = None) -> None:
+    handler = logging.StreamHandler()
+    handler.setFormatter(TaskFormatter(color=color))
+    root = logging.getLogger()
+    root.handlers[:] = [handler]
+    root.setLevel(logging.DEBUG if verbose else logging.INFO)

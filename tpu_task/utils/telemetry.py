@@ -1,0 +1,151 @@
+"""Anonymous usage telemetry — reference semantics, privacy-first.
+
+Parity with /root/reference/iterative/utils/analytics.go: deterministic
+scrypt-anonymized user/group IDs (analytics.go:208-292), CI detection, event
+payloads that carry only the error *type*, never the message
+(analytics.go:347-350), async send with a drain hook
+(WaitForAnalyticsAndHandlePanics, :420-433), and opt-out env vars (:356).
+
+Differences by design: no hardcoded collector — events are sent only when
+``TPU_TASK_TELEMETRY_URL`` is configured (zero-egress safe default), and both
+``TPU_TASK_DO_NOT_TRACK`` and the reference's ``ITERATIVE_DO_NOT_TRACK``
+opt out.
+"""
+
+from __future__ import annotations
+
+import base64
+import getpass
+import hashlib
+import json
+import os
+import platform
+import socket
+import subprocess
+import threading
+import uuid
+from typing import Any, Dict, List, Optional
+
+VERSION = "0.1.0"
+OPT_OUT_VARS = ("TPU_TASK_DO_NOT_TRACK", "ITERATIVE_DO_NOT_TRACK")
+
+_pending: List[threading.Thread] = []
+_lock = threading.Lock()
+
+
+def do_not_track() -> bool:
+    return any(os.environ.get(name) for name in OPT_OUT_VARS)
+
+
+def guess_ci() -> str:
+    """CI provider detection (analytics.go guessCI)."""
+    if os.environ.get("GITHUB_ACTIONS"):
+        return "github"
+    if os.environ.get("GITLAB_CI"):
+        return "gitlab"
+    if os.environ.get("BITBUCKET_BUILD_NUMBER"):
+        return "bitbucket"
+    if os.environ.get("CI"):
+        return "unknown"
+    return ""
+
+
+def is_ci() -> bool:
+    return bool(guess_ci())
+
+
+def _scrypt_id(raw: str) -> str:
+    """Deterministic anonymized ID: scrypt with fixed salt → base64
+    (analytics.go deterministic/scrypt pattern)."""
+    derived = hashlib.scrypt(
+        raw.encode(), salt=b"tpu-task-telemetry", n=1 << 14, r=8, p=1,
+        maxmem=64 * 1024 * 1024, dklen=32)
+    return base64.urlsafe_b64encode(derived).decode().rstrip("=")
+
+
+def user_id() -> str:
+    """Anonymized user identity: CI actor in CI, user@host otherwise."""
+    ci = guess_ci()
+    if ci == "github":
+        raw = os.environ.get("GITHUB_ACTOR", "")
+    elif ci == "gitlab":
+        raw = " ".join(os.environ.get(name, "") for name in
+                       ("GITLAB_USER_NAME", "GITLAB_USER_LOGIN", "GITLAB_USER_ID"))
+    elif ci == "bitbucket":
+        raw = os.environ.get("BITBUCKET_STEP_TRIGGERER_UUID", "")
+    else:
+        try:
+            raw = f"{getpass.getuser()}@{socket.gethostname()}"
+        except Exception:
+            raw = str(uuid.getnode())
+    return _scrypt_id(raw or str(uuid.getnode()))
+
+
+def group_id() -> str:
+    """Anonymized project identity from the git remote (analytics.go GroupId)."""
+    try:
+        remote = subprocess.run(
+            ["git", "config", "--get", "remote.origin.url"],
+            capture_output=True, text=True, timeout=5).stdout.strip()
+    except (OSError, subprocess.TimeoutExpired):
+        remote = ""
+    if not remote:
+        return ""
+    return _scrypt_id(remote)
+
+
+def event_payload(action: str, error: Optional[BaseException] = None,
+                  extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    extra = dict(extra or {})
+    extra["ci"] = guess_ci()
+    payload: Dict[str, Any] = {
+        "user_id": user_id(),
+        "group_id": group_id(),
+        "action": action,
+        "interface": "cli",
+        "tool_name": "tpu-task",
+        "tool_version": VERSION,
+        "os_name": platform.system().lower(),
+        "os_version": platform.release(),
+        "backend": extra.get("cloud", ""),
+        "extra": extra,
+    }
+    if error is not None:
+        # Error TYPE only — messages may contain paths/secrets
+        # (analytics.go:347-350).
+        payload["error"] = type(error).__name__
+    return payload
+
+
+def send_event(action: str, error: Optional[BaseException] = None,
+               extra: Optional[Dict[str, Any]] = None) -> None:
+    """Fire-and-forget event; no-op without an endpoint or with opt-out."""
+    endpoint = os.environ.get("TPU_TASK_TELEMETRY_URL", "")
+    if not endpoint or do_not_track():
+        return
+    payload = event_payload(action, error, extra)
+
+    def post():
+        import urllib.request
+
+        try:
+            request = urllib.request.Request(
+                endpoint, data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"}, method="POST")
+            urllib.request.urlopen(request, timeout=5)
+        except Exception:
+            pass  # telemetry must never break the tool
+
+    thread = threading.Thread(target=post, daemon=True)
+    with _lock:
+        _pending.append(thread)
+    thread.start()
+
+
+def wait_for_telemetry(timeout: float = 5.0) -> None:
+    """Drain in-flight events (WaitForAnalyticsAndHandlePanics parity)."""
+    with _lock:
+        threads = list(_pending)
+        _pending.clear()
+    for thread in threads:
+        thread.join(timeout=timeout)
