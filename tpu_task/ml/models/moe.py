@@ -1,0 +1,146 @@
+"""Mixture-of-Experts FFN with expert parallelism over the ``ep`` mesh axis.
+
+Experts are sharded one-per-group across ``ep``; tokens are routed top-1
+(switch-style) with a capacity factor, exchanged via all_to_all inside
+``shard_map``, processed by the local experts, and returned. Router
+jitter/aux-loss keep the load balanced. The dense path
+(``tpu_task.ml.models.transformer``) stays untouched — MoE is an opt-in
+block with the same (batch, seq, d_model) contract.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    d_model: int = 64
+    d_ff: int = 128
+    n_experts: int = 4
+    capacity_factor: float = 1.25
+    router_noise: float = 0.0
+
+
+def init(rng, cfg: MoEConfig) -> Dict[str, Any]:
+    k_router, k_in, k_out = jax.random.split(rng, 3)
+    scale_in = cfg.d_model ** -0.5
+    return {
+        "router": jax.random.normal(k_router, (cfg.d_model, cfg.n_experts),
+                                    jnp.float32) * scale_in,
+        # Experts stacked on a leading axis — logical axis "expert" → ep.
+        "w_in": jax.random.normal(k_in, (cfg.n_experts, cfg.d_model, cfg.d_ff),
+                                  jnp.float32) * scale_in,
+        "w_out": jax.random.normal(k_out, (cfg.n_experts, cfg.d_ff, cfg.d_model),
+                                   jnp.float32) * (cfg.d_ff ** -0.5),
+    }
+
+
+def param_logical_axes() -> Dict[str, Tuple]:
+    return {
+        "router": ("embed", None),
+        "w_in": ("expert", "embed", "mlp"),
+        "w_out": ("expert", "mlp", "embed"),
+    }
+
+
+def _route(x, router, cfg: MoEConfig, rng=None):
+    """Top-1 routing: returns (expert_index, gate, aux_loss) per token."""
+    logits = x @ router  # (tokens, n_experts)
+    if cfg.router_noise > 0 and rng is not None:
+        logits = logits + cfg.router_noise * jax.random.normal(
+            rng, logits.shape, logits.dtype)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert_index = jnp.argmax(probs, axis=-1)
+    gate = jnp.take_along_axis(probs, expert_index[:, None], axis=-1)[:, 0]
+    # Switch-transformer load-balancing aux loss.
+    density = jnp.mean(jax.nn.one_hot(expert_index, cfg.n_experts), axis=0)
+    density_proxy = jnp.mean(probs, axis=0)
+    aux_loss = cfg.n_experts * jnp.sum(density * density_proxy)
+    return expert_index, gate, aux_loss
+
+
+def apply_dense(params, cfg: MoEConfig, x, rng=None):
+    """Single-device reference: dispatch via one-hot matmuls (no a2a)."""
+    b, s, d = x.shape
+    tokens = x.reshape(b * s, d)
+    expert_index, gate, aux_loss = _route(tokens, params["router"], cfg, rng)
+    one_hot = jax.nn.one_hot(expert_index, cfg.n_experts, dtype=x.dtype)
+    # (experts, tokens, d): every expert sees its tokens, zeros elsewhere.
+    dispatched = jnp.einsum("te,td->etd", one_hot, tokens)
+    hidden = jax.nn.silu(jnp.einsum("etd,edf->etf", dispatched, params["w_in"]))
+    out = jnp.einsum("etf,efd->etd", hidden, params["w_out"])
+    combined = jnp.einsum("etd,te->td", out, one_hot) * gate[:, None].astype(x.dtype)
+    return combined.reshape(b, s, d), aux_loss
+
+
+def apply_sharded(params, cfg: MoEConfig, x, mesh, axis_name: str = "ep",
+                  rng=None):
+    """Expert-parallel forward: tokens sharded over ep, experts one group
+    each, all_to_all token exchange both ways."""
+    n_shards = mesh.shape[axis_name]
+    if cfg.n_experts % n_shards:
+        raise ValueError(f"n_experts {cfg.n_experts} not divisible by "
+                         f"ep={n_shards}")
+    experts_per_shard = cfg.n_experts // n_shards
+
+    def shard_fn(router, w_in, w_out, x_local):
+        b, s, d = x_local.shape
+        tokens = x_local.reshape(b * s, d)
+        n_tokens = tokens.shape[0]
+        # Decorrelate router jitter across shards: each shard's tokens are
+        # distinct, so identical noise would defeat the jitter's purpose.
+        shard_rng = None if rng is None else jax.random.fold_in(
+            rng, lax.axis_index(axis_name))
+        expert_index, gate, aux_loss = _route(tokens, router, cfg, shard_rng)
+        capacity = max(1, int(cfg.capacity_factor * n_tokens / cfg.n_experts))
+
+        # Position of each token within its expert's capacity buffer:
+        # 0-based arrival order among tokens routed to the same expert.
+        one_hot = jax.nn.one_hot(expert_index, cfg.n_experts, dtype=jnp.int32)
+        position = jnp.sum(jnp.cumsum(one_hot, axis=0) * one_hot, axis=-1) - 1
+        keep = position < capacity
+
+        # Dispatch buffer: (n_experts, capacity, d).
+        buffer = jnp.zeros((cfg.n_experts, capacity, d), x_local.dtype)
+        safe_pos = jnp.where(keep, position, 0)
+        buffer = buffer.at[expert_index, safe_pos].add(
+            tokens * keep[:, None].astype(tokens.dtype))
+
+        # all_to_all: (n_experts, cap, d) → exchange expert groups so each
+        # shard holds its experts' tokens from EVERY shard:
+        # (experts_per_shard * n_shards_tokens, cap, d).
+        grouped = buffer.reshape(n_shards, experts_per_shard, capacity, d)
+        exchanged = lax.all_to_all(grouped, axis_name, split_axis=0,
+                                   concat_axis=0, tiled=False)
+        # exchanged: (n_shards, experts_per_shard, capacity, d) where leading
+        # axis is source shard.
+        hidden = jax.nn.silu(jnp.einsum("xecd,edf->xecf", exchanged, w_in))
+        out = jnp.einsum("xecf,efd->xecd", hidden, w_out)
+        # Return tokens to their source shards.
+        returned = lax.all_to_all(out, axis_name, split_axis=0,
+                                  concat_axis=0, tiled=False)
+        returned = returned.reshape(cfg.n_experts, capacity, d)
+
+        combined = returned[expert_index, safe_pos] * \
+            keep[:, None].astype(tokens.dtype) * \
+            gate[:, None].astype(tokens.dtype)
+        aux = lax.pmean(aux_loss, axis_name)
+        return combined.reshape(b, s, d), aux
+
+    token_spec = PartitionSpec(axis_name, None, None)   # batch sharded on ep
+    expert_spec = PartitionSpec(axis_name, None, None)  # experts sharded on ep
+    fn = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(PartitionSpec(None, None), expert_spec, expert_spec,
+                  token_spec),
+        out_specs=(token_spec, PartitionSpec()),
+    )
+    return fn(params["router"], params["w_in"], params["w_out"], x)

@@ -77,6 +77,22 @@ def make_mesh(
     return jax.sharding.Mesh(dev_array, tuple(axis_names))
 
 
+def pvary(x, axis_names):
+    """Mark ``x`` as device-varying over ``axis_names`` inside shard_map.
+
+    Wraps ``lax.pcast(..., to='varying')`` (new name) with a fallback to the
+    deprecated ``lax.pvary`` on older jax.
+    """
+    from jax import lax
+
+    if hasattr(lax, "pcast"):
+        try:
+            return lax.pcast(x, axis_names, to="varying")
+        except TypeError:
+            pass
+    return lax.pvary(x, axis_names)
+
+
 def worker_env(worker_id: int, num_workers: int, coordinator: str) -> dict:
     """The env-var contract the orchestrator writes on each TPU-VM worker."""
     return {

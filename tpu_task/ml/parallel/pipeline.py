@@ -1,0 +1,87 @@
+"""GPipe-style pipeline parallelism over a ``pp`` mesh axis.
+
+Layers are split into P contiguous stages, one per device along ``pp``;
+the batch is split into M microbatches that stream through the stages with
+``lax.ppermute`` hand-offs. The schedule runs M + P - 1 ticks (fill + drain);
+bubble fraction (P-1)/(M+P-1) shrinks as M grows. Activations and outputs
+stay static-shaped (a single rolling buffer per stage) so XLA compiles one
+program per stage — no data-dependent Python control flow.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    stage_params: Any,
+    x: jnp.ndarray,
+    mesh,
+    n_microbatches: int,
+    axis_name: str = "pp",
+):
+    """Run ``x`` through P pipeline stages.
+
+    ``stage_params``: pytree whose leaves have a leading axis of size P
+    (one slice per stage — sharded over ``axis_name``).
+    ``stage_fn(params_slice, x_mb) -> y_mb`` must preserve the microbatch
+    shape (it is one stage's chunk of layers).
+    ``x``: (batch, ...) with batch divisible by ``n_microbatches``.
+    """
+    n_stages = mesh.shape[axis_name]
+    batch = x.shape[0]
+    if batch % n_microbatches:
+        raise ValueError(f"batch {batch} not divisible by microbatches "
+                         f"{n_microbatches}")
+    mb = batch // n_microbatches
+    micro = x.reshape(n_microbatches, mb, *x.shape[1:])
+
+    def shard_fn(params_slice, micro_local):
+        # params_slice leaves: (1, ...) — this stage's slice; drop the axis.
+        params_stage = jax.tree.map(lambda p: p[0], params_slice)
+        stage = lax.axis_index(axis_name)
+        ticks = n_microbatches + n_stages - 1
+        perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+        from tpu_task.ml.parallel.mesh import pvary
+
+        carry = pvary(jnp.zeros_like(micro_local[0]), (axis_name,))
+        outputs = pvary(jnp.zeros_like(micro_local), (axis_name,))
+
+        def tick(t, state):
+            carry, outputs = state
+            mb_index = jnp.clip(t, 0, n_microbatches - 1)
+            inject = micro_local[mb_index]
+            inp = jnp.where(stage == 0, inject, carry)
+            out = stage_fn(params_stage, inp)
+            # Last stage banks its result for microbatch t - (P-1).
+            out_index = jnp.clip(t - (n_stages - 1), 0, n_microbatches - 1)
+            is_valid = (stage == n_stages - 1) & (t >= n_stages - 1)
+            outputs = jnp.where(
+                is_valid,
+                outputs.at[out_index].set(out),
+                outputs)
+            carry = lax.ppermute(out, axis_name, perm)
+            return carry, outputs
+
+        _, outputs = lax.fori_loop(0, ticks, tick, (carry, outputs))
+        # Only the last stage holds real outputs; psum replicates them.
+        mask = (stage == n_stages - 1).astype(outputs.dtype)
+        return lax.psum(outputs * mask, axis_name)
+
+    fn = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(
+            PartitionSpec(axis_name),   # prefix: every param leaf stage-sharded
+            PartitionSpec(),            # microbatches replicated
+        ),
+        out_specs=PartitionSpec(),      # outputs replicated
+    )
+    return fn(stage_params, micro).reshape(batch, *x.shape[1:])

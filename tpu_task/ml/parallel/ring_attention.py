@@ -64,9 +64,11 @@ def ring_attention_shard(q, k, v, axis_name: str = "sp", causal: bool = True):
     # pvary: mark the fresh accumulators as device-varying over the ring axis
     # so the fori_loop carry type matches after the first fold (JAX ≥0.8
     # tracks varying manual axes through shard_map).
-    m = lax.pvary(jnp.full((b, h, sq), NEG_INF, jnp.float32), (axis_name,))
-    l = lax.pvary(jnp.zeros((b, h, sq), jnp.float32), (axis_name,))
-    acc = lax.pvary(jnp.zeros((b, sq, h, d), jnp.float32), (axis_name,))
+    from tpu_task.ml.parallel.mesh import pvary
+
+    m = pvary(jnp.full((b, h, sq), NEG_INF, jnp.float32), (axis_name,))
+    l = pvary(jnp.zeros((b, h, sq), jnp.float32), (axis_name,))
+    acc = pvary(jnp.zeros((b, sq, h, d), jnp.float32), (axis_name,))
 
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
