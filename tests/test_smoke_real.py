@@ -1,0 +1,80 @@
+"""Real-cloud smoke tests — gated, skipped by default.
+
+The reference's slowest test layer (task/task_smoke_test.go, `make smoke`):
+full lifecycle against a REAL control plane with deliberate double-invoke
+idempotency, enabled per provider via env vars. Same pattern here:
+
+    SMOKE_TEST_ENABLE_TPU=1 GOOGLE_APPLICATION_CREDENTIALS_DATA='{...}' \
+        python -m pytest tests/test_smoke_real.py -m smoke -q
+
+``SMOKE_TEST_SWEEP=1`` deletes any leftover tasks first (the reference's
+always-run sweep job, smoke.yml:96-101).
+"""
+
+import os
+import time
+import uuid
+
+import pytest
+
+from tpu_task import task as task_factory
+from tpu_task.common.cloud import Cloud, Provider
+from tpu_task.common.identifier import Identifier
+from tpu_task.common.values import Environment, Size, StatusCode, Task as TaskSpec
+
+pytestmark = pytest.mark.smoke
+
+ENABLED = bool(os.environ.get("SMOKE_TEST_ENABLE_TPU"))
+HAS_CREDS = bool(os.environ.get("GOOGLE_APPLICATION_CREDENTIALS_DATA"))
+
+
+@pytest.mark.skipif(not (ENABLED and HAS_CREDS),
+                    reason="real-TPU smoke disabled (set SMOKE_TEST_ENABLE_TPU "
+                           "+ GOOGLE_APPLICATION_CREDENTIALS_DATA)")
+def test_tpu_real_lifecycle(tmp_path):
+    from tpu_task.common.cloud import Credentials, GCPCredentials
+
+    cloud = Cloud(
+        provider=Provider.TPU,
+        region=os.environ.get("SMOKE_TEST_TPU_REGION", "us-central2"),
+        credentials=Credentials(gcp=GCPCredentials.from_env()),
+    )
+
+    if os.environ.get("SMOKE_TEST_SWEEP"):
+        for identifier in task_factory.list_tasks(cloud):
+            task_factory.new(cloud, identifier, TaskSpec()).delete()
+
+    sentinel = str(uuid.uuid4())
+    workdir = tmp_path / "work"
+    workdir.mkdir()
+    (workdir / "input.txt").write_text("smoke-payload")
+    spec = TaskSpec(
+        size=Size(machine=os.environ.get("SMOKE_TEST_TPU_MACHINE", "v2-8")),
+        environment=Environment(
+            script=f"#!/bin/bash\ncat input.txt\necho {sentinel}\n"
+                   "mkdir -p output && echo ok > output/r.txt\n",
+            directory=str(workdir), directory_out="output",
+        ),
+    )
+    identifier = Identifier.random("smoke")
+    task = task_factory.new(cloud, identifier, spec)
+    task.delete()            # NotFound tolerated
+    task.create()
+    task.create()            # double-invoke idempotency (smoke_test.go:180)
+    try:
+        deadline = time.time() + 25 * 60
+        while time.time() < deadline:
+            task.read()
+            status = task.status()
+            if status.get(StatusCode.SUCCEEDED, 0) >= 1:
+                break
+            assert status.get(StatusCode.FAILED, 0) == 0, task.logs()
+            time.sleep(10)
+        else:
+            raise AssertionError(f"timeout; logs={task.logs()}")
+        logs = "".join(task.logs())
+        assert sentinel in logs and "smoke-payload" in logs
+    finally:
+        task.delete()
+        task.delete()        # double delete tolerated
+    assert (workdir / "output" / "r.txt").exists()

@@ -116,6 +116,13 @@ class Backend:
         """Filesystem root if this backend is local (enables native fast copy)."""
         return None
 
+    def list_meta(self, prefix: str = "") -> Optional[Dict[str, Tuple[int, float]]]:
+        """{key: (size_bytes, mtime_epoch)} when cheap to produce, else None.
+
+        Enables incremental sync (copy only changed files — rclone's
+        size+modtime check); None falls back to copying everything."""
+        return None
+
 
 class LocalBackend(Backend):
     def __init__(self, root: str):
@@ -158,6 +165,28 @@ class LocalBackend(Backend):
         os.makedirs(os.path.dirname(path), exist_ok=True)
         with open(path, "wb") as handle:
             handle.write(data)
+
+    def set_mtime(self, key: str, mtime: float) -> None:
+        try:
+            os.utime(self._abs(key), (mtime, mtime))
+        except OSError:
+            pass
+
+    def list_meta(self, prefix: str = "") -> Optional[Dict[str, Tuple[int, float]]]:
+        base = self._abs(prefix) if prefix else self.root
+        if not os.path.isdir(base):
+            return {}
+        meta: Dict[str, Tuple[int, float]] = {}
+        for dirpath, _dirnames, filenames in os.walk(base):
+            for name in filenames:
+                full = os.path.join(dirpath, name)
+                try:
+                    stat = os.stat(full)
+                except OSError:
+                    continue
+                key = os.path.relpath(full, self.root).replace(os.sep, "/")
+                meta[key] = (stat.st_size, stat.st_mtime)
+        return meta
 
     def delete(self, key: str) -> None:
         path = self._abs(key)
@@ -243,6 +272,35 @@ class GCSBackend(Backend):
             page_token = payload.get("nextPageToken", "")
             if not page_token:
                 return sorted(keys)
+
+    def list_meta(self, prefix: str = "") -> Optional[Dict[str, Tuple[int, float]]]:
+        import urllib.parse
+        from datetime import datetime
+
+        full_prefix = self._key(prefix)
+        meta: Dict[str, Tuple[int, float]] = {}
+        page_token = ""
+        while True:
+            url = (f"https://storage.googleapis.com/storage/v1/b/{self.container}/o"
+                   f"?prefix={urllib.parse.quote(full_prefix, safe='')}"
+                   f"&fields=items(name,size,updated),nextPageToken")
+            if page_token:
+                url += f"&pageToken={page_token}"
+            payload = json.loads(self._request("GET", url))
+            for item in payload.get("items", []):
+                name = item["name"]
+                if self.prefix:
+                    name = name[len(self.prefix):].lstrip("/")
+                updated = 0.0
+                try:
+                    updated = datetime.fromisoformat(
+                        item.get("updated", "").replace("Z", "+00:00")).timestamp()
+                except ValueError:
+                    pass
+                meta[name] = (int(item.get("size", 0)), updated)
+            page_token = payload.get("nextPageToken", "")
+            if not page_token:
+                return meta
 
     def read(self, key: str) -> bytes:
         import urllib.error
@@ -373,6 +431,12 @@ def open_backend(remote: str) -> Tuple[Backend, Connection]:
         return LocalBackend(conn.path or "."), conn
     if conn.backend == BACKEND_GCS:
         return GCSBackend(conn.container, conn.path, conn.config), conn
-    if conn.backend in (BACKEND_S3, BACKEND_AZUREBLOB):
-        return _UnavailableBackend(conn.backend), conn
+    if conn.backend == BACKEND_S3:
+        from tpu_task.storage.cloud_backends import S3Backend
+
+        return S3Backend(conn.container, conn.path, conn.config), conn
+    if conn.backend == BACKEND_AZUREBLOB:
+        from tpu_task.storage.cloud_backends import AzureBlobBackend
+
+        return AzureBlobBackend(conn.container, conn.path, conn.config), conn
     raise ValueError(f"unknown storage backend: {conn.backend!r}")

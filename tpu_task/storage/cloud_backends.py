@@ -1,0 +1,260 @@
+"""S3 and Azure Blob object-store backends (REST, stdlib urllib only).
+
+The flat Backend contract (list/read/write/delete by key) that the sync
+engine drives — the role rclone's s3/azureblob remotes play for the
+reference (storage.go:19-24). Credentials arrive inline in the connection
+string exactly like the reference's bucket connstrings
+(resource_bucket.go:160-173: access_key_id/secret_access_key/session_token/
+region; resource_blob_container.go:83: account/key).
+
+Network calls happen lazily per operation; constructing a backend is free, so
+hermetic environments never touch the network unless a cloud remote is
+actually used.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Dict, List, Optional
+
+from xml.sax.saxutils import unescape as _xml_unescape
+
+from tpu_task.common.errors import ResourceNotFoundError
+from tpu_task.storage.backends import Backend
+from tpu_task.storage.signing import (
+    EMPTY_SHA256,
+    azure_shared_key_auth,
+    canonical_query,
+    sigv4_sign,
+)
+
+
+def _amz_now() -> str:
+    return time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+
+
+def _http(request: urllib.request.Request) -> bytes:
+    try:
+        with urllib.request.urlopen(request, timeout=60) as response:
+            return response.read()
+    except urllib.error.HTTPError as error:
+        if error.code == 404:
+            raise ResourceNotFoundError(request.full_url) from error
+        raise
+
+
+class S3Backend(Backend):
+    """Amazon S3 via SigV4 REST (virtual-hosted-style addressing)."""
+
+    def __init__(self, container: str, path: str = "",
+                 config: Optional[Dict[str, str]] = None):
+        config = config or {}
+        self.bucket = container
+        self.prefix = (path or "").strip("/")
+        self.region = config.get("region", "us-east-1")
+        self.access_key = config.get("access_key_id", "")
+        self.secret_key = config.get("secret_access_key", "")
+        self.session_token = config.get("session_token", "")
+        self.host = config.get(
+            "endpoint", f"{container}.s3.{self.region}.amazonaws.com")
+
+    def _key(self, key: str) -> str:
+        full = f"{self.prefix}/{key}" if self.prefix else key
+        return "/" + full.lstrip("/")
+
+    def _request(self, method: str, path: str, query: Dict[str, str],
+                 body: bytes = b"") -> bytes:
+        payload_hash = hashlib.sha256(body).hexdigest() if body else EMPTY_SHA256
+        headers = sigv4_sign(
+            method, self.host, path, query, {}, payload_hash,
+            self.access_key, self.secret_key, self.region, "s3",
+            _amz_now(), self.session_token)
+        url = f"https://{self.host}{urllib.parse.quote(path, safe='/-_.~')}"
+        if query:
+            url += "?" + canonical_query(query)
+        request = urllib.request.Request(url, data=body or None, method=method)
+        for name, value in headers.items():
+            request.add_header(name, value)
+        return _http(request)
+
+    def list(self, prefix: str = "") -> List[str]:
+        full_prefix = self._key(prefix).lstrip("/")
+        keys: List[str] = []
+        token = ""
+        while True:
+            query = {"list-type": "2", "prefix": full_prefix}
+            if token:
+                query["continuation-token"] = token
+            body = self._request("GET", "/", query).decode()
+            keys.extend(_xml_unescape(k) for k in re.findall(r"<Key>([^<]+)</Key>", body))
+            match = re.search(r"<NextContinuationToken>([^<]+)</NextContinuationToken>", body)
+            if not match:
+                break
+            token = match.group(1)
+        strip = (self.prefix + "/") if self.prefix else ""
+        return [key[len(strip):] if strip and key.startswith(strip) else key
+                for key in keys]
+
+    def list_meta(self, prefix: str = "") -> Optional[Dict[str, tuple]]:
+        from datetime import datetime
+
+        full_prefix = self._key(prefix).lstrip("/")
+        meta: Dict[str, tuple] = {}
+        token = ""
+        while True:
+            query = {"list-type": "2", "prefix": full_prefix}
+            if token:
+                query["continuation-token"] = token
+            body = self._request("GET", "/", query).decode()
+            for match in re.finditer(
+                    r"<Key>([^<]+)</Key>\s*<LastModified>([^<]+)</LastModified>"
+                    r".*?<Size>(\d+)</Size>", body, re.DOTALL):
+                key, modified, size = match.groups()
+                key = _xml_unescape(key)
+                strip = (self.prefix + "/") if self.prefix else ""
+                if strip and key.startswith(strip):
+                    key = key[len(strip):]
+                stamp = 0.0
+                try:
+                    stamp = datetime.fromisoformat(
+                        modified.replace("Z", "+00:00")).timestamp()
+                except ValueError:
+                    pass
+                meta[key] = (int(size), stamp)
+            token_match = re.search(
+                r"<NextContinuationToken>([^<]+)</NextContinuationToken>", body)
+            if not token_match:
+                return meta
+            token = token_match.group(1)
+
+    def read(self, key: str) -> bytes:
+        return self._request("GET", self._key(key), {})
+
+    def write(self, key: str, data: bytes) -> None:
+        self._request("PUT", self._key(key), {}, body=data)
+
+    def delete(self, key: str) -> None:
+        self._request("DELETE", self._key(key), {})
+
+    def exists(self) -> bool:
+        try:
+            self._request("GET", "/", {"list-type": "2", "max-keys": "1"})
+            return True
+        except (ResourceNotFoundError, urllib.error.HTTPError):
+            return False
+
+
+
+class AzureBlobBackend(Backend):
+    """Azure Blob Storage via Shared Key REST."""
+
+    API_VERSION = "2021-08-06"
+
+    def __init__(self, container: str, path: str = "",
+                 config: Optional[Dict[str, str]] = None):
+        config = config or {}
+        self.account = config.get("account", "")
+        self.key = config.get("key", "")
+        self.container = container
+        self.prefix = (path or "").strip("/")
+        self.host = config.get("endpoint",
+                               f"{self.account}.blob.core.windows.net")
+
+    def _blob_path(self, key: str) -> str:
+        full = f"{self.prefix}/{key}" if self.prefix else key
+        return f"/{self.container}/{full.lstrip('/')}"
+
+    def _request(self, method: str, path: str, query: Dict[str, str],
+                 body: bytes = b"", extra_headers: Optional[Dict[str, str]] = None) -> bytes:
+        headers = {
+            "x-ms-date": time.strftime("%a, %d %b %Y %H:%M:%S GMT", time.gmtime()),
+            "x-ms-version": self.API_VERSION,
+            **(extra_headers or {}),
+        }
+        content_length = str(len(body)) if body else ""
+        auth = azure_shared_key_auth(
+            self.account, self.key, method, path, query, headers,
+            content_length)
+        url = f"https://{self.host}{urllib.parse.quote(path, safe='/-_.~')}"
+        if query:
+            url += "?" + urllib.parse.urlencode(query)
+        request = urllib.request.Request(url, data=body or None, method=method)
+        for name, value in headers.items():
+            request.add_header(name, value)
+        request.add_header("Authorization", auth)
+        return _http(request)
+
+    def list(self, prefix: str = "") -> List[str]:
+        full_prefix = (self.prefix + "/" + prefix.lstrip("/")) if self.prefix else prefix
+        names: List[str] = []
+        marker = ""
+        while True:
+            query = {"restype": "container", "comp": "list",
+                     "prefix": full_prefix}
+            if marker:
+                query["marker"] = marker
+            body = self._request("GET", f"/{self.container}", query).decode()
+            names.extend(_xml_unescape(n) for n in re.findall(r"<Name>([^<]+)</Name>", body))
+            match = re.search(r"<NextMarker>([^<]+)</NextMarker>", body)
+            if not match:
+                break
+            marker = match.group(1)
+        strip = (self.prefix + "/") if self.prefix else ""
+        return [name[len(strip):] if strip and name.startswith(strip) else name
+                for name in names]
+
+    def list_meta(self, prefix: str = "") -> Optional[Dict[str, tuple]]:
+        from email.utils import parsedate_to_datetime
+
+        full_prefix = (self.prefix + "/" + prefix.lstrip("/")) if self.prefix else prefix
+        meta: Dict[str, tuple] = {}
+        marker = ""
+        while True:
+            query = {"restype": "container", "comp": "list",
+                     "prefix": full_prefix}
+            if marker:
+                query["marker"] = marker
+            body = self._request("GET", f"/{self.container}", query).decode()
+            for match in re.finditer(
+                    r"<Name>([^<]+)</Name>.*?<Last-Modified>([^<]+)</Last-Modified>"
+                    r".*?<Content-Length>(\d+)</Content-Length>", body, re.DOTALL):
+                name, modified, size = match.groups()
+                name = _xml_unescape(name)
+                strip = (self.prefix + "/") if self.prefix else ""
+                if strip and name.startswith(strip):
+                    name = name[len(strip):]
+                stamp = 0.0
+                try:
+                    stamp = parsedate_to_datetime(modified).timestamp()
+                except (TypeError, ValueError):
+                    pass
+                meta[name] = (int(size), stamp)
+            marker_match = re.search(r"<NextMarker>([^<]+)</NextMarker>", body)
+            if not marker_match:
+                return meta
+            marker = marker_match.group(1)
+
+    def read(self, key: str) -> bytes:
+        return self._request("GET", self._blob_path(key), {})
+
+    def write(self, key: str, data: bytes) -> None:
+        self._request("PUT", self._blob_path(key), {}, body=data,
+                      extra_headers={"x-ms-blob-type": "BlockBlob"})
+
+    def delete(self, key: str) -> None:
+        self._request("DELETE", self._blob_path(key), {})
+
+    def exists(self) -> bool:
+        try:
+            self._request("GET", f"/{self.container}",
+                          {"restype": "container", "comp": "list",
+                           "maxresults": "1"})
+            return True
+        except (ResourceNotFoundError, urllib.error.HTTPError):
+            return False
+

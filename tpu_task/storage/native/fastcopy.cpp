@@ -92,6 +92,12 @@ bool copy_one(const char* src, const char* dst, std::vector<char>& buffer) {
 
   close(in);
   if (close(out) != 0) ok = false;
+  if (ok) {
+    // Preserve the source modtime so incremental sync (size+modtime)
+    // recognises the copy as up to date.
+    struct timespec times[2] = {st.st_atim, st.st_mtim};
+    utimensat(AT_FDCWD, dst, times, 0);
+  }
   return ok;
 }
 

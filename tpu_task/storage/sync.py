@@ -33,7 +33,8 @@ __all__ = [
 ]
 
 
-def _copy_files(source: Backend, destination: Backend, keys: Sequence[str]) -> None:
+def _copy_files(source: Backend, destination: Backend, keys: Sequence[str],
+                src_meta=None) -> None:
     src_root, dst_root = source.local_root(), destination.local_root()
     if src_root is not None and dst_root is not None:
         pairs = [(os.path.join(src_root, key), os.path.join(dst_root, key)) for key in keys]
@@ -44,6 +45,35 @@ def _copy_files(source: Backend, destination: Backend, keys: Sequence[str]) -> N
             logger.warning("native copy failed (%s); falling back to python copy", error)
     for key in keys:
         destination.write(key, source.read(key))
+        # Preserve modtimes so the incremental diff (size+modtime) converges.
+        if src_meta and key in src_meta and hasattr(destination, "set_mtime"):
+            destination.set_mtime(key, src_meta[key][1])
+
+
+def _changed_keys(keys: Sequence[str], src_meta, dst_meta,
+                  mtimes_preserved: bool) -> Sequence[str]:
+    """Incremental sync: rclone's size+modtime check (skip up-to-date files).
+
+    Falls back to copying everything when either side can't produce cheap
+    metadata. With preserved modtimes (local↔local), any modtime difference
+    beyond filesystem granularity means changed; for object stores — whose
+    listed time is the upload time, always later than the source mtime —
+    only a source newer than the stored copy triggers a re-upload (the
+    rclone caveat for providers without mtime metadata)."""
+    if src_meta is None or dst_meta is None:
+        return keys
+    changed = []
+    for key in keys:
+        src = src_meta.get(key)
+        dst = dst_meta.get(key)
+        if src is None or dst is None or src[0] != dst[0]:
+            changed.append(key)
+        elif mtimes_preserved:
+            if abs(dst[1] - src[1]) > 0.002:
+                changed.append(key)
+        elif dst[1] < src[1] - 0.002:
+            changed.append(key)
+    return changed
 
 
 def _transfer(source_remote: str, destination_remote: str, filters: FilterSet,
@@ -54,15 +84,12 @@ def _transfer(source_remote: str, destination_remote: str, filters: FilterSet,
     if not source.exists():
         raise ResourceNotFoundError(f"transfer source does not exist: {source_remote}")
 
-    keys = [key for key in source.list() if filters.includes_file(key)]
-    total_size = 0
-    src_root = source.local_root()
-    if src_root is not None:
-        for key in keys:
-            try:
-                total_size += os.path.getsize(os.path.join(src_root, key))
-            except OSError:
-                pass
+    # One metadata sweep per side per tick: keys, sizes, and the incremental
+    # diff all come from the same listing.
+    src_meta = source.list_meta()
+    all_keys = sorted(src_meta) if src_meta is not None else source.list()
+    keys = [key for key in all_keys if filters.includes_file(key)]
+    total_size = sum(src_meta[key][0] for key in keys) if src_meta else 0
     logger.info("Transferring %.1fMB (%d files)...", total_size / 1e6, len(keys))
 
     # Mirror directory structure (incl. empty dirs) exactly like rclone's
@@ -71,7 +98,10 @@ def _transfer(source_remote: str, destination_remote: str, filters: FilterSet,
         if filters.includes_dir(dir_key):
             destination.makedir(dir_key)
 
-    _copy_files(source, destination, keys)
+    dst_meta = destination.list_meta() if src_meta is not None else None
+    mtimes_preserved = hasattr(destination, "set_mtime")
+    changed = _changed_keys(keys, src_meta, dst_meta, mtimes_preserved)
+    _copy_files(source, destination, changed, src_meta)
 
     if delete_extraneous:
         wanted = set(keys)
