@@ -244,9 +244,9 @@ def test_incremental_sync_skips_up_to_date(tmp_path, monkeypatch):
     copied = []
     real = sync_mod._copy_files
 
-    def spy(source, destination, keys):
+    def spy(source, destination, keys, src_meta=None):
         copied.extend(keys)
-        return real(source, destination, keys)
+        return real(source, destination, keys, src_meta)
 
     monkeypatch.setattr(sync_mod, "_copy_files", spy)
     sync(str(src), str(dst))
