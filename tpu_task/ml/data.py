@@ -47,8 +47,8 @@ def prefetch_to_device(iterator: Iterable, sharding=None, depth: int = 2):
     def place(batch):
         if sharding is None:
             return jax.tree.map(jax.numpy.asarray, batch)
-        return jax.tree.map(
-            lambda leaf: jax.device_put(leaf, sharding), batch)
+        # device_put accepts a matching pytree of shardings directly.
+        return jax.device_put(batch, sharding)
 
     queue = collections.deque()
     iterator = iter(iterator)

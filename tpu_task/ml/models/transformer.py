@@ -101,6 +101,41 @@ def param_pspecs(cfg: TransformerConfig, mesh=None, rules=None) -> Params:
 
 # -- forward -----------------------------------------------------------------
 
+@jax.custom_vjp
+def embed_lookup(table, tokens):
+    """Embedding gather with a matmul backward.
+
+    The forward is a cheap gather; the backward computes the table gradient
+    as a one-hot einsum instead of a scatter-add — a contraction the SPMD
+    partitioner reshards efficiently when the table is (vocab=tp, embed=fsdp)
+    sharded and the cotangent is batch-sharded (scatter forces an
+    involuntary full rematerialization there).
+    """
+    return table[tokens]
+
+
+def _embed_fwd(table, tokens):
+    # Keep the table in residuals only for its static shape/dtype; it is a
+    # live parameter either way, so this costs no extra HBM.
+    return table[tokens], (tokens, table)
+
+
+def _embed_bwd(res, g):
+    tokens, table = res
+    onehot = jax.nn.one_hot(tokens, table.shape[0], dtype=g.dtype)
+    # Accumulate in float32 at full precision — the scatter-add this
+    # replaces was exact, so the matmul must not truncate to bf16.
+    d_table = jnp.einsum(
+        "...v,...d->vd", onehot, g,
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    ).astype(table.dtype)
+    return d_table, None
+
+
+embed_lookup.defvjp(_embed_fwd, _embed_bwd)
+
+
 def _rmsnorm(x, scale):
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
     return (x.astype(jnp.float32) * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale.astype(x.dtype)
@@ -142,7 +177,7 @@ def apply(params: Params, cfg: TransformerConfig, tokens, attn_fn=None):
     """tokens: (batch, seq) int32 → logits (batch, seq, vocab) float32."""
     if attn_fn is None:
         attn_fn = lambda q, k, v: dot_product_attention(q, k, v, True)
-    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = embed_lookup(params["embed"].astype(cfg.dtype), tokens)
     for layer in params["layers"]:
         x = _block(x, layer, cfg, attn_fn)
     x = _rmsnorm(x, params["final_norm"])

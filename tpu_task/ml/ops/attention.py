@@ -1,11 +1,20 @@
-"""Fused attention: pallas flash kernel on TPU, XLA reference elsewhere.
+"""Fused attention: Pallas flash kernels (forward AND backward) on TPU.
 
 Forward is a flash-attention pallas kernel (online softmax, blocked over the
-query sequence, MXU-shaped tiles); backward recomputes through the XLA
-reference implementation (rematerialisation — trades FLOPs for the O(S²)
-attention matrix that would otherwise live in HBM).
+query sequence, MXU-shaped tiles) that also emits the per-row logsumexp.
+Backward is a pair of pallas kernels (dq; dk/dv) that recompute attention
+probabilities block-by-block from the saved logsumexp — the O(S²) attention
+matrix never materializes in HBM in either direction.
+
+The kernels support a static ``q_offset`` (global position of q row 0
+relative to k col 0) so causal masking works for sq != sk and for ring
+attention's off-diagonal blocks. ``block_attention_fwd``/``block_attention_bwd``
+are the block primitives the ring (sequence-parallel) path folds over.
 
 Shapes follow (batch, seq, heads, head_dim) throughout.
+
+Reference has no attention code at all (SURVEY.md §2.9) — this implements the
+flash-attention construction (Dao et al.) TPU-natively.
 """
 
 from __future__ import annotations
@@ -18,6 +27,19 @@ import jax.numpy as jnp
 from jax import lax
 
 NEG_INF = -1e30
+
+# TPU vector lanes: per-row statistics (lse, delta) are stored broadcast over
+# a 128-wide trailing dim because Mosaic requires the last block dim to be a
+# multiple of 128 (same layout as jax's reference TPU flash kernels).
+LANES = 128
+
+
+def _vma(*arrays):
+    """Union of the inputs' varying-mesh-axes (for pallas under shard_map)."""
+    out = frozenset()
+    for a in arrays:
+        out = out | getattr(jax.typeof(a), "vma", frozenset())
+    return out
 
 
 def mha_reference(q, k, v, causal: bool = True):
@@ -32,10 +54,16 @@ def mha_reference(q, k, v, causal: bool = True):
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, q_offset_blocks: int):
+# -- forward kernel ----------------------------------------------------------
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref,
+                      block_k: int, causal: bool, q_offset: int):
     """One (batch*head, q_block) grid cell: online softmax over kv blocks.
 
-    q_ref: (block_q, d); k_ref/v_ref: (seq_k, d); o_ref: (block_q, d).
+    q_ref: (block_q, d); k_ref/v_ref: (seq_k, d); o_ref: (block_q, d);
+    optional lse_ref: (block_q, LANES) float32 logsumexp of the scaled
+    scores per q row, broadcast across lanes (only when the caller needs
+    it for a backward pass — the primal path skips the extra HBM write).
     """
     from jax.experimental import pallas as pl
 
@@ -43,14 +71,21 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, q_o
     seq_k = k_ref.shape[0]
     q = q_ref[...].astype(jnp.float32) / math.sqrt(d)
 
-    q_block_idx = pl.program_id(1)
-    q_start = (q_block_idx + q_offset_blocks) * block_q
+    q_start = pl.program_id(1) * block_q + q_offset
 
     m = jnp.full((block_q,), NEG_INF, dtype=jnp.float32)
     l = jnp.zeros((block_q,), dtype=jnp.float32)
     acc = jnp.zeros((block_q, d), dtype=jnp.float32)
 
     num_k_blocks = seq_k // block_k
+    if causal:
+        # q row r attends k cols <= q_start + r: blocks past the diagonal of
+        # the *last* q row in this block contribute nothing.
+        hi = jnp.clip(
+            (q_start + block_q - 1) // block_k + 1, 0, num_k_blocks
+        )
+    else:
+        hi = num_k_blocks
 
     def body(kb, carry):
         m, l, acc = carry
@@ -62,46 +97,68 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, q_o
             k_pos = kb * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         m_new = jnp.maximum(m, s.max(axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        correction = jnp.exp(m - m_new)
+        # Fully-masked rows keep m == NEG_INF; clamp the shift so exp stays 0.
+        shift = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+        p = jnp.exp(s - shift[:, None])
+        if causal:
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        correction = jnp.exp(jnp.where(m <= NEG_INF / 2, NEG_INF, m) - shift)
         l_new = l * correction + p.sum(axis=-1)
         acc_new = acc * correction[:, None] + p @ v_blk
         return m_new, l_new, acc_new
 
-    m, l, acc = lax.fori_loop(0, num_k_blocks, body, (m, l, acc))
-    o_ref[...] = (acc / l[:, None]).astype(o_ref.dtype)
+    m, l, acc = lax.fori_loop(0, hi, body, (m, l, acc))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[...] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    if maybe_lse_ref:
+        (lse_ref,) = maybe_lse_ref
+        shift = jnp.where(m <= NEG_INF / 2, 0.0, m)
+        lse = jnp.where(l == 0.0, NEG_INF, shift + jnp.log(l_safe))
+        lse_ref[...] = jnp.broadcast_to(lse[:, None], (block_q, LANES))
 
 
 def flash_attention(
-    q, k, v, causal: bool = True, *, block_q: int = 256, block_k: int = 256,
-    interpret: bool = False,
+    q, k, v, causal: bool = True, *, q_offset=None,
+    block_q: int = 256, block_k: int = 256,
+    interpret: bool = False, return_lse: bool = False,
 ):
-    """Pallas flash attention forward. q: (b, sq, h, d), k/v: (b, sk, h, d)."""
+    """Pallas flash attention forward. q: (b, sq, h, d), k/v: (b, sk, h, d).
+
+    ``q_offset`` is the global position of q row 0 relative to k col 0; the
+    default ``sk - sq`` matches :func:`mha_reference`'s suffix-aligned causal
+    mask (equal for self-attention). With ``return_lse`` also returns the
+    float32 per-row logsumexp with shape (b, h, sq).
+    """
     from jax.experimental import pallas as pl
 
     b, sq, h, d = q.shape
     sk = k.shape[1]
+    if q_offset is None:
+        q_offset = sk - sq
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     if sq % block_q or sk % block_k:
-        raise ValueError(f"seq lengths ({sq},{sk}) must divide blocks ({block_q},{block_k})")
-    if causal and sq != sk:
         raise ValueError(
-            f"causal flash attention requires sq == sk (prefix-aligned mask); "
-            f"got ({sq},{sk}) — use mha_reference for cross-length causal")
+            f"seq lengths ({sq},{sk}) must divide blocks ({block_q},{block_k})")
 
     # Fold heads into the leading grid dim: (b*h, seq, d).
     qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
     vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
 
-    # For cross-chunk (ring) use the caller aligns positions itself; here
-    # q offset 0 matches self-attention and sq == sk causal semantics.
+    vma = _vma(q, k, v)
     kernel = functools.partial(
-        _flash_kernel, block_k=block_k, causal=causal, q_offset_blocks=0
+        _flash_fwd_kernel, block_k=block_k, causal=causal, q_offset=q_offset
     )
     grid = (b * h, sq // block_q)
-    out = pl.pallas_call(
+    out_specs = [pl.BlockSpec((None, block_q, d), lambda bh, qb: (bh, qb, 0))]
+    out_shape = [jax.ShapeDtypeStruct((b * h, sq, d), q.dtype, vma=vma)]
+    if return_lse:
+        out_specs.append(
+            pl.BlockSpec((None, block_q, LANES), lambda bh, qb: (bh, qb, 0)))
+        out_shape.append(
+            jax.ShapeDtypeStruct((b * h, sq, LANES), jnp.float32, vma=vma))
+    results = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -109,36 +166,332 @@ def flash_attention(
             pl.BlockSpec((None, sk, d), lambda bh, qb: (bh, 0, 0)),
             pl.BlockSpec((None, sk, d), lambda bh, qb: (bh, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda bh, qb: (bh, qb, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    out = results[0].reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    if return_lse:
+        return out, results[1][..., 0].reshape(b, h, sq)
+    return out
 
+
+# -- backward kernels --------------------------------------------------------
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, block_k: int, causal: bool, q_offset: int):
+    """dq for one q block: recompute p from lse, stream kv blocks.
+
+    q_ref/do_ref/dq_ref: (block_q, d); k_ref/v_ref: (seq_k, d);
+    lse_ref/delta_ref: (block_q, LANES) lane-broadcast row stats.
+    """
+    from jax.experimental import pallas as pl
+
+    block_q, d = q_ref.shape
+    seq_k = k_ref.shape[0]
+    scale = 1.0 / math.sqrt(d)
+    q = q_ref[...].astype(jnp.float32) * scale
+    do = do_ref[...].astype(jnp.float32)
+    lse = lse_ref[...][:, 0]
+    delta = delta_ref[...][:, 0]
+    q_start = pl.program_id(1) * block_q + q_offset
+
+    num_k_blocks = seq_k // block_k
+    if causal:
+        hi = jnp.clip((q_start + block_q - 1) // block_k + 1, 0, num_k_blocks)
+    else:
+        hi = num_k_blocks
+
+    lse_safe = jnp.where(lse <= NEG_INF / 2, 0.0, lse)
+
+    def body(kb, dq):
+        k_blk = k_ref[pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
+        s = q @ k_blk.T
+        if causal:
+            q_pos = q_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            valid = q_pos >= k_pos
+        else:
+            valid = None
+        p = jnp.exp(s - lse_safe[:, None])
+        if valid is not None:
+            p = jnp.where(valid, p, 0.0)
+        dp = do @ v_blk.T
+        ds = p * (dp - delta[:, None])
+        return dq + ds @ k_blk
+    dq = lax.fori_loop(0, hi, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[...] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, block_q: int, causal: bool,
+                          q_offset: int):
+    """dk/dv for one kv block: stream q blocks, recompute p from lse.
+
+    k_ref/v_ref/dk_ref/dv_ref: (block_kv, d); q_ref/do_ref: (seq_q, d);
+    lse_ref/delta_ref: (seq_q, LANES) lane-broadcast row stats.
+    """
+    from jax.experimental import pallas as pl
+
+    block_kv, d = k_ref.shape
+    seq_q = q_ref.shape[0]
+    scale = 1.0 / math.sqrt(d)
+    k = k_ref[...].astype(jnp.float32)
+    v = v_ref[...].astype(jnp.float32)
+    k_start = pl.program_id(1) * block_kv
+
+    num_q_blocks = seq_q // block_q
+    if causal:
+        # Only q rows with q_pos >= k_start can attend this kv block.
+        lo = jnp.clip((k_start - q_offset) // block_q, 0, num_q_blocks)
+    else:
+        lo = 0
+
+    def body(qb, carry):
+        dk, dv = carry
+        q_blk = q_ref[pl.dslice(qb * block_q, block_q), :].astype(jnp.float32) * scale
+        do_blk = do_ref[pl.dslice(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.dslice(qb * block_q, block_q), :][:, 0]
+        delta = delta_ref[pl.dslice(qb * block_q, block_q), :][:, 0]
+        s = q_blk @ k.T  # (block_q, block_kv)
+        if causal:
+            q_pos = qb * block_q + q_offset + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0)
+            k_pos = k_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 1)
+            valid = q_pos >= k_pos
+        else:
+            valid = None
+        lse_safe = jnp.where(lse <= NEG_INF / 2, 0.0, lse)
+        p = jnp.exp(s - lse_safe[:, None])
+        if valid is not None:
+            p = jnp.where(valid, p, 0.0)
+        dv = dv + p.T @ do_blk
+        dp = do_blk @ v.T
+        ds = p * (dp - delta[:, None])
+        dk = dk + ds.T @ q_blk
+        return dk, dv
+
+    dk, dv = lax.fori_loop(
+        lo, num_q_blocks, body,
+        (jnp.zeros((block_kv, d), jnp.float32),
+         jnp.zeros((block_kv, d), jnp.float32)),
+    )
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+
+def flash_attention_bwd(
+    q, k, v, o, lse, do, causal: bool = True, *, q_offset=None,
+    block_q: int = 256, block_k: int = 256, interpret: bool = False,
+):
+    """Pallas flash attention backward: (dq, dk, dv).
+
+    ``lse``: (b, h, sq) float32 from the forward pass. Recomputes attention
+    probabilities per block — O(seq·d) memory, no S² matrix.
+    """
+    # delta_i = sum_d dO_i · O_i — the softmax-normalization term of ds.
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    ).transpose(0, 2, 1)  # (b, h, sq)
+    return _flash_bwd_with_stats(
+        q, k, v, do, lse, delta, causal, q_offset=q_offset,
+        block_q=block_q, block_k=block_k, interpret=interpret)
+
+
+# -- block primitives (used standalone and by ring attention) ----------------
+
+def block_attention_fwd(q, k, v, causal: bool, *, q_offset=None,
+                        impl: str = "xla", interpret: bool = False,
+                        block_q: int = 256, block_k: int = 256):
+    """(o, lse) for one attention block pair; ``impl`` = "xla" | "pallas".
+
+    o: (b, sq, h, d) in q.dtype (rows with no valid keys are 0);
+    lse: (b, h, sq) float32 (NEG_INF for fully-masked rows).
+    """
+    if impl == "pallas":
+        return flash_attention(
+            q, k, v, causal, q_offset=q_offset, block_q=block_q,
+            block_k=block_k, interpret=interpret, return_lse=True)
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if q_offset is None:
+        q_offset = sk - sq
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / math.sqrt(d)
+    if causal:
+        q_pos = q_offset + lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        k_pos = lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where((q_pos >= k_pos)[None, None], s, NEG_INF)
+    m = s.max(axis=-1)
+    shift = jnp.where(m <= NEG_INF / 2, 0.0, m)
+    p = jnp.exp(s - shift[..., None])
+    if causal:
+        p = jnp.where((q_pos >= k_pos)[None, None], p, 0.0)
+    l = p.sum(axis=-1)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p / l_safe[..., None],
+                   v.astype(jnp.float32))
+    lse = jnp.where(l == 0.0, NEG_INF, shift + jnp.log(l_safe))
+    return o.astype(q.dtype), lse
+
+
+def block_attention_bwd(q, k, v, do, lse, delta, causal: bool, *,
+                        q_offset=None, impl: str = "xla",
+                        interpret: bool = False,
+                        block_q: int = 256, block_k: int = 256):
+    """(dq, dk, dv) for one block pair given global lse/delta.
+
+    ``delta``: (b, h, sq) float32 = rowsum(dO · O) over the *global* output.
+    Contributions are exact partial sums: summing over all kv blocks of a row
+    reproduces the full gradient.
+    """
+    if impl == "pallas":
+        return _flash_bwd_with_stats(
+            q, k, v, do, lse, delta, causal, q_offset=q_offset,
+            block_q=block_q, block_k=block_k, interpret=interpret)
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if q_offset is None:
+        q_offset = sk - sq
+    scale = 1.0 / math.sqrt(d)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        q_pos = q_offset + lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        k_pos = lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        valid = (q_pos >= k_pos)[None, None]
+    else:
+        valid = None
+    lse_safe = jnp.where(lse <= NEG_INF / 2, 0.0, lse)
+    p = jnp.exp(s - lse_safe[..., None])
+    if valid is not None:
+        p = jnp.where(valid, p, 0.0)
+    do32 = do.astype(jnp.float32)
+    dv = jnp.einsum("bhqk,bqhd->bkhd", p, do32)
+    dp = jnp.einsum("bqhd,bkhd->bhqk", do32, v.astype(jnp.float32))
+    ds = p * (dp - delta[..., None])
+    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, k.astype(jnp.float32)) * scale
+    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, q.astype(jnp.float32)) * scale
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _flash_bwd_with_stats(q, k, v, do, lse, delta, causal, *, q_offset,
+                          block_q, block_k, interpret):
+    """Pallas backward given externally-computed (lse, delta)."""
+    from jax.experimental import pallas as pl
+
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if q_offset is None:
+        q_offset = sk - sq
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError(
+            f"seq lengths ({sq},{sk}) must divide blocks ({block_q},{block_k})")
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    dof = do.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    # Lane-broadcast the per-row stats (Mosaic block layout; see LANES).
+    lsef = jnp.broadcast_to(
+        lse.reshape(b * h, sq)[..., None], (b * h, sq, LANES))
+    deltaf = jnp.broadcast_to(
+        delta.reshape(b * h, sq)[..., None], (b * h, sq, LANES))
+    vma = _vma(q, k, v, do)
+
+    dq_kernel = functools.partial(
+        _flash_bwd_dq_kernel, block_k=block_k, causal=causal, q_offset=q_offset)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda bh, qb: (bh, qb, 0)),
+            pl.BlockSpec((None, sk, d), lambda bh, qb: (bh, 0, 0)),
+            pl.BlockSpec((None, sk, d), lambda bh, qb: (bh, 0, 0)),
+            pl.BlockSpec((None, block_q, d), lambda bh, qb: (bh, qb, 0)),
+            pl.BlockSpec((None, block_q, LANES), lambda bh, qb: (bh, qb, 0)),
+            pl.BlockSpec((None, block_q, LANES), lambda bh, qb: (bh, qb, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda bh, qb: (bh, qb, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype, vma=vma),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, deltaf)
+
+    dkv_kernel = functools.partial(
+        _flash_bwd_dkv_kernel, block_q=block_q, causal=causal, q_offset=q_offset)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(b * h, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((None, sq, d), lambda bh, kb: (bh, 0, 0)),
+            pl.BlockSpec((None, block_k, d), lambda bh, kb: (bh, kb, 0)),
+            pl.BlockSpec((None, block_k, d), lambda bh, kb: (bh, kb, 0)),
+            pl.BlockSpec((None, sq, d), lambda bh, kb: (bh, 0, 0)),
+            pl.BlockSpec((None, sq, LANES), lambda bh, kb: (bh, 0, 0)),
+            pl.BlockSpec((None, sq, LANES), lambda bh, kb: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, d), lambda bh, kb: (bh, kb, 0)),
+            pl.BlockSpec((None, block_k, d), lambda bh, kb: (bh, kb, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype, vma=vma),
+            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype, vma=vma),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, deltaf)
+
+    unflatten = lambda x, s: x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    return unflatten(dq, sq), unflatten(dk, sk), unflatten(dv, sk)
+
+
+# -- fused op with custom vjp ------------------------------------------------
 
 def _use_pallas() -> bool:
     return jax.default_backend() == "tpu"
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _pallas_ok(q, k, causal: bool, block: int = 128) -> bool:
+    if q.shape[1] % block or k.shape[1] % block:
+        return False
+    # Causal with sq > sk leaves leading q rows with zero valid keys —
+    # attention over the empty set. The flash kernel zeroes those rows while
+    # mha_reference softmaxes uniform garbage; keep one semantics per call
+    # by routing the degenerate case to the fallback on every backend.
+    return not causal or q.shape[1] <= k.shape[1]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _pallas_attention(q, k, v, causal, interpret):
+    return flash_attention(q, k, v, causal, block_q=128, block_k=128,
+                           interpret=interpret)
+
+
+def _pa_fwd(q, k, v, causal, interpret):
+    o, lse = flash_attention(q, k, v, causal, block_q=128, block_k=128,
+                             interpret=interpret, return_lse=True)
+    return o, (q, k, v, o, lse)
+
+
+def _pa_bwd(causal, interpret, res, g):
+    q, k, v, o, lse = res
+    return flash_attention_bwd(q, k, v, o, lse, g, causal,
+                               block_q=128, block_k=128, interpret=interpret)
+
+
+_pallas_attention.defvjp(_pa_fwd, _pa_bwd)
+
+
 def dot_product_attention(q, k, v, causal: bool = True):
-    """Attention with a flash forward on TPU and recompute backward."""
-    # Flash path only for self-attention shapes: its causal mask is
-    # prefix-aligned (q_pos >= k_pos), matching mha_reference's suffix-aligned
-    # tril only when sq == sk.
-    if (_use_pallas() and q.shape[1] == k.shape[1] and q.shape[1] % 128 == 0):
-        return flash_attention(q, k, v, causal, block_q=128, block_k=128)
-    return mha_reference(q, k, v, causal)
+    """Attention: flash kernels (fwd+bwd) on TPU, remat XLA elsewhere.
 
-
-def _dpa_fwd(q, k, v, causal):
-    return dot_product_attention(q, k, v, causal), (q, k, v)
-
-
-def _dpa_bwd(causal, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: mha_reference(q, k, v, causal), q, k, v)
-    return vjp(g)
-
-
-dot_product_attention.defvjp(_dpa_fwd, _dpa_bwd)
+    The XLA fallback is wrapped in ``jax.checkpoint`` so its backward also
+    recomputes instead of saving the S² attention matrix.
+    """
+    if _use_pallas() and _pallas_ok(q, k, causal):
+        return _pallas_attention(q, k, v, causal, False)
+    return jax.checkpoint(
+        lambda q, k, v: mha_reference(q, k, v, causal))(q, k, v)

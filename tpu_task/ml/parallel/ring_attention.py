@@ -2,108 +2,197 @@
 
 Long-context path: the sequence axis is sharded over the ``sp`` mesh axis;
 each device holds one q chunk and streams k/v chunks around the ring with
-``lax.ppermute`` (ICI neighbor exchange), folding each block into an online
-softmax accumulator. Communication overlaps compute and per-device memory is
+``lax.ppermute`` (ICI neighbor exchange), folding each block's (output,
+logsumexp) pair into a running softmax combination. Per-device memory is
 O(seq/P) — the standard blockwise/ring construction (Liu et al.).
 
-Causality across chunks is decided by global chunk index: a source chunk
-entirely in the future is masked out, the diagonal chunk gets the local
-triangular mask, past chunks attend fully.
+Per-block compute goes through ``tpu_task.ml.ops.attention``'s block
+primitives: the Pallas flash kernel on TPU (``impl="pallas"``), plain XLA
+elsewhere. The backward pass is a custom VJP that runs the ring again,
+circulating dk/dv accumulators alongside their k/v blocks — the gradient for
+each k/v chunk arrives back at its owner after one full rotation, and no
+device ever materializes more than one remote chunk.
+
+Causality across chunks is decided by global chunk index: the diagonal chunk
+(step 0) gets the local triangular mask, past chunks attend fully, future
+chunks are computed-and-discarded (weight 0) to keep the collective schedule
+uniform.
+
+Reference has no sequence parallelism at all (SURVEY.md §5 "long-context:
+absent") — this is new TPU-first capability.
 """
 
 from __future__ import annotations
 
 import functools
-import math
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import NamedSharding, PartitionSpec
+from jax.sharding import PartitionSpec
 
-NEG_INF = -1e30
+from tpu_task.ml.ops.attention import (
+    NEG_INF,
+    block_attention_bwd,
+    block_attention_fwd,
+)
 
 
-def _block_attn(q, k, v, mask, m, l, acc):
-    """Fold one k/v block into the online-softmax accumulator.
+def _fold(o, lse, o_b, lse_b):
+    """Combine two (output, logsumexp) pairs of the same q rows.
 
-    q: (b, sq, h, d); k/v: (b, sk, h, d); mask: (sq, sk) bool or None.
-    m, l: (b, h, sq); acc: (b, sq, h, d). All accumulators float32.
+    o/o_b: (b, sq, h, d); lse/lse_b: (b, h, sq). All-masked rows carry
+    lse == NEG_INF and zero output; folding them is a no-op.
     """
-    d = q.shape[-1]
-    s = jnp.einsum(
-        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
-    ) / math.sqrt(d)
-    if mask is not None:
-        s = jnp.where(mask[None, None], s, NEG_INF)
-    m_new = jnp.maximum(m, s.max(axis=-1))
-    # Fully-masked rows keep m == NEG_INF; exp(s - NEG_INF) would overflow,
-    # so clamp the shift for those rows (their p is 0 anyway).
-    shift = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
-    p = jnp.exp(s - shift[..., None])
-    if mask is not None:
-        p = jnp.where(mask[None, None], p, 0.0)
-    correction = jnp.exp(m - shift)
-    l_new = l * correction + p.sum(axis=-1)
-    acc_new = acc * correction.transpose(0, 2, 1)[..., None] + jnp.einsum(
-        "bhqk,bkhd->bqhd", p, v.astype(jnp.float32)
-    )
-    return m_new, l_new, acc_new
+    m = jnp.maximum(lse, lse_b)
+    m_safe = jnp.where(m <= NEG_INF / 2, 0.0, m)
+    w1 = jnp.exp(lse - m_safe)
+    w2 = jnp.exp(lse_b - m_safe)
+    denom = w1 + w2
+    denom_safe = jnp.where(denom == 0.0, 1.0, denom)
+    to_o = lambda w: (w / denom_safe).transpose(0, 2, 1)[..., None]
+    o_new = o * to_o(w1) + o_b.astype(jnp.float32) * to_o(w2)
+    lse_new = jnp.where(denom == 0.0, NEG_INF, m_safe + jnp.log(denom_safe))
+    return o_new, lse_new
 
 
-def ring_attention_shard(q, k, v, axis_name: str = "sp", causal: bool = True):
-    """Per-shard body: call inside ``shard_map`` with seq sharded on axis_name.
-
-    q/k/v: local chunks (batch, chunk, heads, head_dim).
-    """
+def _ring_fwd_impl(q, k, v, axis_name, causal, impl, interpret):
     axis_size = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
-    b, sq, h, d = q.shape
 
-    # pvary: mark the fresh accumulators as device-varying over the ring axis
-    # so the fori_loop carry type matches after the first fold (JAX ≥0.8
-    # tracks varying manual axes through shard_map).
-    from tpu_task.ml.parallel.mesh import pvary
-
-    m = pvary(jnp.full((b, h, sq), NEG_INF, jnp.float32), (axis_name,))
-    l = pvary(jnp.zeros((b, h, sq), jnp.float32), (axis_name,))
-    acc = pvary(jnp.zeros((b, sq, h, d), jnp.float32), (axis_name,))
-
+    block = functools.partial(
+        block_attention_fwd, impl=impl, interpret=interpret)
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
+    # Prefetch the first remote chunk, then compute the local (diagonal)
+    # chunk while it is in flight — every block compute below reads only
+    # chunks already on-device, so ICI transfers overlap attention compute.
+    k_cur = lax.ppermute(k, axis_name, perm)
+    v_cur = lax.ppermute(v, axis_name, perm)
+    o_b, lse_b = block(q, k, v, causal, q_offset=0)
+    o = o_b.astype(jnp.float32)
+    lse = lse_b
+
     def body(step, carry):
-        k_cur, v_cur, m, l, acc = carry
-        src_idx = (my_idx - step) % axis_size
-        sk = k_cur.shape[1]
-        if causal:
-            q_pos = my_idx * sq + lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
-            k_pos = src_idx * sk + lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
-            mask = q_pos >= k_pos
-        else:
-            mask = None
-        m, l, acc = _block_attn(q, k_cur, v_cur, mask, m, l, acc)
+        k_cur, v_cur, o, lse = carry
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
-        return k_nxt, v_nxt, m, l, acc
+        src_idx = (my_idx - step) % axis_size
+        o_b, lse_b = block(q, k_cur, v_cur, False, q_offset=0)
+        if causal:
+            keep = src_idx < my_idx  # past chunk: full; future: discard
+            lse_b = jnp.where(keep, lse_b, NEG_INF)
+            o_b = jnp.where(keep, o_b, 0.0)
+        o, lse = _fold(o, lse, o_b, lse_b)
+        return k_nxt, v_nxt, o, lse
 
-    k_fin, v_fin, m, l, acc = lax.fori_loop(0, axis_size, body, (k, v, m, l, acc))
-    del k_fin, v_fin
-    safe_l = jnp.where(l == 0.0, 1.0, l)
-    out = acc / safe_l.transpose(0, 2, 1)[..., None]
-    return out.astype(q.dtype)
+    _, _, o, lse = lax.fori_loop(1, axis_size, body, (k_cur, v_cur, o, lse))
+    return o.astype(q.dtype), lse
 
 
-def ring_attention(q, k, v, mesh, axis_name: str = "sp", causal: bool = True):
+def _ring_bwd_impl(q, k, v, o, lse, do, axis_name, causal, impl, interpret):
+    """Ring backward: dk/dv accumulators circulate with their k/v blocks."""
+    axis_size = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    ).transpose(0, 2, 1)  # (b, h, sq)
+
+    block_bwd = functools.partial(
+        block_attention_bwd, impl=impl, interpret=interpret)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    # Same prefetch schedule as the forward: permutes are issued before the
+    # block compute they overlap with. dk/dv accumulators ride one hop behind
+    # their k/v chunks — the handoff received in step t belongs to the chunk
+    # computed in step t, so only the cheap add waits on the transfer.
+    k_cur = lax.ppermute(k, axis_name, perm)
+    v_cur = lax.ppermute(v, axis_name, perm)
+    dq_b, dk_b, dv_b = block_bwd(q, k, v, do, lse, delta, causal, q_offset=0)
+    dq = dq_b.astype(jnp.float32)
+    dk_acc = dk_b.astype(jnp.float32)
+    dv_acc = dv_b.astype(jnp.float32)
+
+    def body(step, carry):
+        k_cur, v_cur, dk_acc, dv_acc, dq = carry
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        dk_in = lax.ppermute(dk_acc, axis_name, perm)
+        dv_in = lax.ppermute(dv_acc, axis_name, perm)
+        src_idx = (my_idx - step) % axis_size
+        dq_b, dk_b, dv_b = block_bwd(
+            q, k_cur, v_cur, do, lse, delta, False, q_offset=0)
+        if causal:
+            keep = src_idx < my_idx
+            dq_b = jnp.where(keep, dq_b, 0.0)
+            dk_b = jnp.where(keep, dk_b, 0.0)
+            dv_b = jnp.where(keep, dv_b, 0.0)
+        return (k_nxt, v_nxt,
+                dk_in + dk_b.astype(jnp.float32),
+                dv_in + dv_b.astype(jnp.float32),
+                dq + dq_b.astype(jnp.float32))
+
+    _, _, dk_acc, dv_acc, dq = lax.fori_loop(
+        1, axis_size, body, (k_cur, v_cur, dk_acc, dv_acc, dq))
+    # After the loop the accumulator for chunk j sits at device j-1: one
+    # more hop brings every dk/dv home to its k/v owner.
+    dk = lax.ppermute(dk_acc, axis_name, perm)
+    dv = lax.ppermute(dv_acc, axis_name, perm)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_shard(q, k, v, axis_name, causal, impl, interpret):
+    o, _ = _ring_fwd_impl(q, k, v, axis_name, causal, impl, interpret)
+    return o
+
+
+def _ring_shard_fwd(q, k, v, axis_name, causal, impl, interpret):
+    o, lse = _ring_fwd_impl(q, k, v, axis_name, causal, impl, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _ring_shard_bwd(axis_name, causal, impl, interpret, res, do):
+    q, k, v, o, lse = res
+    return _ring_bwd_impl(
+        q, k, v, o, lse, do, axis_name, causal, impl, interpret)
+
+
+_ring_shard.defvjp(_ring_shard_fwd, _ring_shard_bwd)
+
+
+def _default_impl() -> str:
+    return "pallas" if jax.default_backend() == "tpu" else "xla"
+
+
+def ring_attention_shard(q, k, v, axis_name: str = "sp", causal: bool = True,
+                         impl: str | None = None, interpret: bool = False):
+    """Per-shard body: call inside ``shard_map`` with seq sharded on axis_name.
+
+    q/k/v: local chunks (batch, chunk, heads, head_dim). Differentiable:
+    the VJP re-runs the ring, circulating dk/dv with their blocks.
+    """
+    if impl is None:
+        impl = _default_impl()
+    return _ring_shard(q, k, v, axis_name, causal, impl, interpret)
+
+
+def ring_attention(q, k, v, mesh, axis_name: str = "sp", causal: bool = True,
+                   impl: str | None = None, interpret: bool = False):
     """Global-view ring attention: q/k/v (batch, seq, heads, head_dim).
 
     Shards the sequence over ``axis_name`` with shard_map and runs the ring.
     """
     spec = PartitionSpec(None, axis_name, None, None)
     fn = jax.shard_map(
-        functools.partial(ring_attention_shard, axis_name=axis_name, causal=causal),
+        functools.partial(ring_attention_shard, axis_name=axis_name,
+                          causal=causal, impl=impl, interpret=interpret),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        # pallas interpret mode can't track varying manual axes through its
+        # HLO interpreter; the check stays on for the compiled TPU path.
+        check_vma=not interpret,
     )
     return fn(q, k, v)

@@ -43,20 +43,26 @@ def state_pspecs(state: TrainState, cfg: transformer.TransformerConfig, mesh) ->
     """PartitionSpecs for a TrainState: optimizer moments follow the params."""
     p_specs = transformer.param_pspecs(cfg, mesh=mesh)
 
-    # optax adamw state mirrors the param pytree inside ScaleByAdamState; map
-    # any leaf whose shape matches a param leaf to that param's spec,
-    # replicating scalars (counts, schedules).
-    shape_to_spec = {}
-    for leaf, spec in zip(
-        jax.tree.leaves(state.params),
-        jax.tree.leaves(p_specs, is_leaf=lambda x: isinstance(x, PartitionSpec)),
-    ):
-        shape_to_spec.setdefault(jnp.shape(leaf), spec)
+    # optax state embeds copies of the param pytree (ScaleByAdamState.mu/.nu,
+    # trace terms, ...). Map each optimizer leaf to the param spec whose tree
+    # path is a suffix of the leaf's path — structural, so two same-shaped
+    # params with different layouts can't collide. Scalars (counts,
+    # schedules) fall through to replicated.
+    param_paths = {}
+    for path, spec in jax.tree_util.tree_flatten_with_path(
+        p_specs, is_leaf=lambda x: isinstance(x, PartitionSpec)
+    )[0]:
+        param_paths[tuple(str(k) for k in path)] = spec
 
-    def spec_for(leaf):
-        return shape_to_spec.get(jnp.shape(leaf), PartitionSpec())
+    def spec_for(path, leaf):
+        keys = tuple(str(k) for k in path)
+        for start in range(len(keys)):  # longest suffix first
+            spec = param_paths.get(keys[start:])
+            if spec is not None and jnp.ndim(leaf) == len(spec):
+                return spec
+        return PartitionSpec()
 
-    opt_specs = jax.tree.map(spec_for, state.opt_state)
+    opt_specs = jax.tree_util.tree_map_with_path(spec_for, state.opt_state)
     return TrainState(
         step=PartitionSpec(),
         params=p_specs,
