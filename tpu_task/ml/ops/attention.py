@@ -34,6 +34,21 @@ NEG_INF = -1e30
 LANES = 128
 
 
+def _dot_nt(a, b):  # a @ b.T with f32 accumulation
+    return lax.dot_general(a, b, (((1,), (1,)), ((), ())),
+                           preferred_element_type=jnp.float32)
+
+
+def _dot_nn(a, b):  # a @ b with f32 accumulation
+    return lax.dot_general(a, b, (((1,), (0,)), ((), ())),
+                           preferred_element_type=jnp.float32)
+
+
+def _dot_tn(a, b):  # a.T @ b with f32 accumulation
+    return lax.dot_general(a, b, (((0,), (0,)), ((), ())),
+                           preferred_element_type=jnp.float32)
+
+
 def _vma(*arrays):
     """Union of the inputs' varying-mesh-axes (for pallas under shard_map)."""
     out = frozenset()
@@ -69,7 +84,11 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref,
 
     block_q, d = q_ref.shape
     seq_k = k_ref.shape[0]
-    q = q_ref[...].astype(jnp.float32) / math.sqrt(d)
+    # Keep inputs in their storage dtype (bf16 on TPU) and accumulate the
+    # matmuls in f32 via preferred_element_type — f32 MXU passes are several
+    # times slower than bf16 ones.
+    q = q_ref[...]
+    scale = 1.0 / math.sqrt(d)
 
     q_start = pl.program_id(1) * block_q + q_offset
 
@@ -89,9 +108,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref,
 
     def body(kb, carry):
         m, l, acc = carry
-        k_blk = k_ref[pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
-        s = q @ k_blk.T  # (block_q, block_k)
+        k_blk = k_ref[pl.dslice(kb * block_k, block_k), :]
+        v_blk = v_ref[pl.dslice(kb * block_k, block_k), :]
+        s = _dot_nt(q, k_blk) * scale  # (block_q, block_k) f32
         if causal:
             q_pos = q_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             k_pos = kb * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
@@ -104,7 +123,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref,
             p = jnp.where(q_pos >= k_pos, p, 0.0)
         correction = jnp.exp(jnp.where(m <= NEG_INF / 2, NEG_INF, m) - shift)
         l_new = l * correction + p.sum(axis=-1)
-        acc_new = acc * correction[:, None] + p @ v_blk
+        acc_new = acc * correction[:, None] + _dot_nn(
+            p.astype(v_blk.dtype), v_blk)
         return m_new, l_new, acc_new
 
     m, l, acc = lax.fori_loop(0, hi, body, (m, l, acc))
@@ -119,7 +139,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref,
 
 def flash_attention(
     q, k, v, causal: bool = True, *, q_offset=None,
-    block_q: int = 256, block_k: int = 256,
+    block_q: int = 512, block_k: int = 512,
     interpret: bool = False, return_lse: bool = False,
 ):
     """Pallas flash attention forward. q: (b, sq, h, d), k/v: (b, sk, h, d).
@@ -190,8 +210,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     block_q, d = q_ref.shape
     seq_k = k_ref.shape[0]
     scale = 1.0 / math.sqrt(d)
-    q = q_ref[...].astype(jnp.float32) * scale
-    do = do_ref[...].astype(jnp.float32)
+    q = q_ref[...]  # storage dtype; f32 accumulation via the dots below
+    do = do_ref[...]
     lse = lse_ref[...][:, 0]
     delta = delta_ref[...][:, 0]
     q_start = pl.program_id(1) * block_q + q_offset
@@ -205,9 +225,9 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     lse_safe = jnp.where(lse <= NEG_INF / 2, 0.0, lse)
 
     def body(kb, dq):
-        k_blk = k_ref[pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
-        s = q @ k_blk.T
+        k_blk = k_ref[pl.dslice(kb * block_k, block_k), :]
+        v_blk = v_ref[pl.dslice(kb * block_k, block_k), :]
+        s = _dot_nt(q, k_blk) * scale
         if causal:
             q_pos = q_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             k_pos = kb * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
@@ -217,9 +237,9 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p = jnp.exp(s - lse_safe[:, None])
         if valid is not None:
             p = jnp.where(valid, p, 0.0)
-        dp = do @ v_blk.T
+        dp = _dot_nt(do, v_blk)
         ds = p * (dp - delta[:, None])
-        return dq + ds @ k_blk
+        return dq + _dot_nn(ds.astype(k_blk.dtype), k_blk)
     dq = lax.fori_loop(0, hi, body, jnp.zeros((block_q, d), jnp.float32))
     dq_ref[...] = (dq * scale).astype(dq_ref.dtype)
 
@@ -237,8 +257,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     block_kv, d = k_ref.shape
     seq_q = q_ref.shape[0]
     scale = 1.0 / math.sqrt(d)
-    k = k_ref[...].astype(jnp.float32)
-    v = v_ref[...].astype(jnp.float32)
+    k = k_ref[...]  # storage dtype; f32 accumulation via the dots below
+    v = v_ref[...]
     k_start = pl.program_id(1) * block_kv
 
     num_q_blocks = seq_q // block_q
@@ -250,11 +270,11 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     def body(qb, carry):
         dk, dv = carry
-        q_blk = q_ref[pl.dslice(qb * block_q, block_q), :].astype(jnp.float32) * scale
-        do_blk = do_ref[pl.dslice(qb * block_q, block_q), :].astype(jnp.float32)
+        q_blk = q_ref[pl.dslice(qb * block_q, block_q), :]
+        do_blk = do_ref[pl.dslice(qb * block_q, block_q), :]
         lse = lse_ref[pl.dslice(qb * block_q, block_q), :][:, 0]
         delta = delta_ref[pl.dslice(qb * block_q, block_q), :][:, 0]
-        s = q_blk @ k.T  # (block_q, block_kv)
+        s = _dot_nt(q_blk, k) * scale  # (block_q, block_kv)
         if causal:
             q_pos = qb * block_q + q_offset + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 0)
@@ -267,10 +287,11 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p = jnp.exp(s - lse_safe[:, None])
         if valid is not None:
             p = jnp.where(valid, p, 0.0)
-        dv = dv + p.T @ do_blk
-        dp = do_blk @ v.T
+        pc = p.astype(do_blk.dtype)
+        dv = dv + _dot_tn(pc, do_blk)
+        dp = _dot_nt(do_blk, v)
         ds = p * (dp - delta[:, None])
-        dk = dk + ds.T @ q_blk
+        dk = dk + _dot_tn(ds.astype(q_blk.dtype), q_blk)
         return dk, dv
 
     dk, dv = lax.fori_loop(
@@ -278,13 +299,13 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         (jnp.zeros((block_kv, d), jnp.float32),
          jnp.zeros((block_kv, d), jnp.float32)),
     )
-    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dk_ref[...] = (dk * scale).astype(dk_ref.dtype)
     dv_ref[...] = dv.astype(dv_ref.dtype)
 
 
 def flash_attention_bwd(
     q, k, v, o, lse, do, causal: bool = True, *, q_offset=None,
-    block_q: int = 256, block_k: int = 256, interpret: bool = False,
+    block_q: int = 512, block_k: int = 512, interpret: bool = False,
 ):
     """Pallas flash attention backward: (dq, dk, dv).
 
@@ -304,7 +325,7 @@ def flash_attention_bwd(
 
 def block_attention_fwd(q, k, v, causal: bool, *, q_offset=None,
                         impl: str = "xla", interpret: bool = False,
-                        block_q: int = 256, block_k: int = 256):
+                        block_q: int = 512, block_k: int = 512):
     """(o, lse) for one attention block pair; ``impl`` = "xla" | "pallas".
 
     o: (b, sq, h, d) in q.dtype (rows with no valid keys are 0);
@@ -340,7 +361,7 @@ def block_attention_fwd(q, k, v, causal: bool, *, q_offset=None,
 def block_attention_bwd(q, k, v, do, lse, delta, causal: bool, *,
                         q_offset=None, impl: str = "xla",
                         interpret: bool = False,
-                        block_q: int = 256, block_k: int = 256):
+                        block_q: int = 512, block_k: int = 512):
     """(dq, dk, dv) for one block pair given global lse/delta.
 
     ``delta``: (b, h, sq) float32 = rowsum(dO · O) over the *global* output.
@@ -454,6 +475,14 @@ def _use_pallas() -> bool:
     return jax.default_backend() == "tpu"
 
 
+def _pick_block(s: int) -> int:
+    """Largest MXU-friendly block dividing s (512 wins on v5e; see bench)."""
+    for b in (512, 256, 128):
+        if s % b == 0:
+            return b
+    return s
+
+
 def _pallas_ok(q, k, causal: bool, block: int = 128) -> bool:
     if q.shape[1] % block or k.shape[1] % block:
         return False
@@ -466,20 +495,23 @@ def _pallas_ok(q, k, causal: bool, block: int = 128) -> bool:
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _pallas_attention(q, k, v, causal, interpret):
-    return flash_attention(q, k, v, causal, block_q=128, block_k=128,
-                           interpret=interpret)
+    return flash_attention(
+        q, k, v, causal, block_q=_pick_block(q.shape[1]),
+        block_k=_pick_block(k.shape[1]), interpret=interpret)
 
 
 def _pa_fwd(q, k, v, causal, interpret):
-    o, lse = flash_attention(q, k, v, causal, block_q=128, block_k=128,
-                             interpret=interpret, return_lse=True)
+    o, lse = flash_attention(
+        q, k, v, causal, block_q=_pick_block(q.shape[1]),
+        block_k=_pick_block(k.shape[1]), interpret=interpret, return_lse=True)
     return o, (q, k, v, o, lse)
 
 
 def _pa_bwd(causal, interpret, res, g):
     q, k, v, o, lse = res
-    return flash_attention_bwd(q, k, v, o, lse, g, causal,
-                               block_q=128, block_k=128, interpret=interpret)
+    return flash_attention_bwd(
+        q, k, v, o, lse, g, causal, block_q=_pick_block(q.shape[1]),
+        block_k=_pick_block(k.shape[1]), interpret=interpret)
 
 
 _pallas_attention.defvjp(_pa_fwd, _pa_bwd)
