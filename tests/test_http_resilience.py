@@ -1,0 +1,371 @@
+"""Fault-injection tests for the REST resilience layer.
+
+A scripted fake transport plays 500s, 429s (with Retry-After), connection
+drops, and expired tokens against the shared http layer, the GCS backend,
+and the Cloud TPU client — the failure modes a >1 h real-cloud lifecycle
+actually hits. Role in the reference: the cloud SDKs' built-in retry/refresh
+(SURVEY.md §2.2-2.3); here we own it, so we test it.
+"""
+
+import io
+import json
+import urllib.error
+
+import pytest
+
+from tpu_task.storage.http_util import OAuthToken, authorized_send, send
+
+
+class FakeResponse:
+    def __init__(self, body=b"", headers=None):
+        self._body = body
+        self.headers = headers or {}
+
+    def read(self):
+        return self._body
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class FakeTransport:
+    """Plays a script of responses; records every request it sees.
+
+    Script entries: ("ok", body[, headers]) | ("http", code[, headers]) |
+    ("conn",).
+    """
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.requests = []
+
+    def __call__(self, request, timeout=None):
+        self.requests.append(request)
+        if not self.script:
+            raise AssertionError("transport script exhausted")
+        entry = self.script.pop(0)
+        kind = entry[0]
+        if kind == "ok":
+            body = entry[1] if len(entry) > 1 else b""
+            headers = entry[2] if len(entry) > 2 else {}
+            return FakeResponse(body, headers)
+        if kind == "http":
+            code = entry[1]
+            headers = entry[2] if len(entry) > 2 else {}
+            import email.message
+
+            message = email.message.Message()
+            for key, value in headers.items():
+                message[key] = value
+            raise urllib.error.HTTPError(
+                request.full_url, code, "err", message, io.BytesIO(b""))
+        if kind == "conn":
+            raise urllib.error.URLError("connection reset")
+        raise AssertionError(f"unknown script entry {entry!r}")
+
+
+class FakeSleep:
+    def __init__(self):
+        self.calls = []
+
+    def __call__(self, seconds):
+        self.calls.append(seconds)
+
+
+def test_send_retries_5xx_then_succeeds():
+    transport = FakeTransport([("http", 500), ("http", 503), ("ok", b"done")])
+    sleep = FakeSleep()
+    body = send("GET", "https://x/y", urlopen=transport, sleep=sleep)
+    assert body == b"done"
+    assert len(transport.requests) == 3
+    assert sleep.calls == [0.5, 1.0]  # exponential backoff
+
+
+def test_send_honors_retry_after():
+    transport = FakeTransport([
+        ("http", 429, {"Retry-After": "3"}), ("ok", b"ok")])
+    sleep = FakeSleep()
+    send("GET", "https://x/y", urlopen=transport, sleep=sleep)
+    assert sleep.calls == [3.0]
+
+
+def test_send_retries_connection_errors():
+    transport = FakeTransport([("conn",), ("conn",), ("ok", b"ok")])
+    assert send("GET", "https://x/y", urlopen=transport,
+                sleep=FakeSleep()) == b"ok"
+
+
+def test_send_gives_up_after_max_retries():
+    transport = FakeTransport([("http", 500)] * 6)
+    with pytest.raises(urllib.error.HTTPError):
+        send("GET", "https://x/y", urlopen=transport, sleep=FakeSleep())
+    assert len(transport.requests) == 6  # 1 + 5 retries
+
+
+def test_send_does_not_retry_client_errors():
+    transport = FakeTransport([("http", 403)])
+    with pytest.raises(urllib.error.HTTPError):
+        send("GET", "https://x/y", urlopen=transport, sleep=FakeSleep())
+    assert len(transport.requests) == 1
+
+
+def test_oauth_token_caches_and_refreshes_on_expiry():
+    clock = [1000.0]
+    fetches = []
+
+    def fetch():
+        fetches.append(clock[0])
+        return f"tok-{len(fetches)}", 3600.0
+
+    token = OAuthToken(fetch, early=60.0, now=lambda: clock[0])
+    assert token.get() == "tok-1"
+    assert token.get() == "tok-1"          # cached
+    clock[0] += 3550.0                     # inside the 60 s early-refresh window
+    assert token.get() == "tok-2"
+    assert len(fetches) == 2
+
+
+def test_authorized_send_refreshes_once_on_401():
+    fetches = []
+
+    def fetch():
+        fetches.append(1)
+        return f"tok-{len(fetches)}", 3600.0
+
+    token = OAuthToken(fetch)
+    transport = FakeTransport([("http", 401), ("ok", b"ok")])
+    body = authorized_send(token, "GET", "https://x/y", urlopen=transport,
+                           sleep=FakeSleep())
+    assert body == b"ok"
+    assert len(fetches) == 2  # initial + forced refresh
+    auths = [r.get_header("Authorization") for r in transport.requests]
+    assert auths == ["Bearer tok-1", "Bearer tok-2"]
+
+
+# -- GCS backend through the fake transport -----------------------------------
+
+
+def _gcs(transport):
+    from tpu_task.storage.backends import GCSBackend
+
+    backend = GCSBackend("bkt", "pfx")
+    backend._token._fetch = lambda: ("tok", 3600.0)
+    backend._urlopen = transport
+    backend._sleep = FakeSleep()
+    return backend
+
+
+def test_gcs_read_retries_then_succeeds():
+    transport = FakeTransport([("http", 502), ("ok", b"payload")])
+    assert _gcs(transport).read("a/b.txt") == b"payload"
+
+
+def test_gcs_read_404_maps_to_not_found():
+    from tpu_task.common.errors import ResourceNotFoundError
+
+    transport = FakeTransport([("http", 404)])
+    with pytest.raises(ResourceNotFoundError):
+        _gcs(transport).read("missing")
+
+
+def test_gcs_small_write_single_request():
+    transport = FakeTransport([("ok", b"{}")])
+    _gcs(transport).write("small.bin", b"x" * 128)
+    assert len(transport.requests) == 1
+    assert b"uploadType=media" in transport.requests[0].full_url.encode()
+
+
+def test_gcs_large_write_resumable_chunks():
+    from tpu_task.storage.backends import GCSBackend
+
+    size = GCSBackend.RESUMABLE_THRESHOLD + GCSBackend.UPLOAD_CHUNK // 2
+    transport = FakeTransport([
+        ("ok", b"", {"Location": "https://gcs/session-123"}),  # initiate
+        ("http", 308),                                         # chunk 1
+        ("ok", b"{}"),                                         # final chunk
+    ])
+    _gcs(transport).write("ckpt.bin", b"z" * size)
+    assert "uploadType=resumable" in transport.requests[0].full_url
+    chunk1, chunk2 = transport.requests[1], transport.requests[2]
+    assert chunk1.full_url == "https://gcs/session-123"
+    assert chunk1.get_header("Content-range") == \
+        f"bytes 0-{GCSBackend.UPLOAD_CHUNK - 1}/{size}"
+    assert chunk2.get_header("Content-range") == \
+        f"bytes {GCSBackend.UPLOAD_CHUNK}-{size - 1}/{size}"
+
+
+def test_gcs_resumable_chunk_retries_on_503():
+    from tpu_task.storage.backends import GCSBackend
+
+    size = GCSBackend.RESUMABLE_THRESHOLD + 1
+    transport = FakeTransport([
+        ("ok", b"", {"Location": "https://gcs/session-9"}),
+        ("http", 308),        # chunk 1 accepted
+        ("http", 503),        # final chunk fails once
+        ("ok", b"{}"),        # retried fine
+    ])
+    _gcs(transport).write("ckpt.bin", b"z" * size)
+    assert len(transport.requests) == 4
+
+
+def test_gcs_expired_token_mid_lifecycle():
+    """401 on a read → token invalidated, refetched, request replayed."""
+    from tpu_task.storage.backends import GCSBackend
+
+    tokens = iter([("old", 3600.0), ("new", 3600.0)])
+    backend = GCSBackend("bkt")
+    backend._token._fetch = lambda: next(tokens)
+    transport = FakeTransport([("http", 401), ("ok", b"data")])
+    backend._urlopen = transport
+    backend._sleep = FakeSleep()
+    assert backend.read("k") == b"data"
+    assert transport.requests[1].get_header("Authorization") == "Bearer new"
+
+
+# -- S3 / Azure through the fake transport ------------------------------------
+
+
+def test_s3_request_retries_5xx():
+    from tpu_task.storage.cloud_backends import S3Backend
+
+    backend = S3Backend("bkt", config={"access_key_id": "AK",
+                                       "secret_access_key": "SK"})
+    transport = FakeTransport([("http", 503), ("ok", b"data")])
+    backend._urlopen = transport
+    backend._sleep = FakeSleep()
+    assert backend.read("k") == b"data"
+    assert len(transport.requests) == 2
+
+
+def test_azure_request_retries_connection_error():
+    from tpu_task.storage.cloud_backends import AzureBlobBackend
+
+    backend = AzureBlobBackend(
+        "ctr", config={"account": "acct", "key": "a2V5"})
+    transport = FakeTransport([("conn",), ("ok", b"data")])
+    backend._urlopen = transport
+    backend._sleep = FakeSleep()
+    assert backend.read("k") == b"data"
+    assert len(transport.requests) == 2
+
+
+# -- Cloud TPU REST client through the fake transport -------------------------
+
+
+def _tpu(transport):
+    from tpu_task.backends.tpu.api import RestTpuClient
+
+    client = RestTpuClient("proj", "us-central2-b")
+    client._token._fetch = lambda: ("tok", 3600.0)
+    client._urlopen = transport
+    client._sleep = FakeSleep()
+    return client
+
+
+def test_tpu_client_retries_5xx():
+    transport = FakeTransport([
+        ("http", 500),
+        ("ok", json.dumps({"state": {"state": "ACTIVE"},
+                           "tpu": {"nodeSpec": []}}).encode()),
+    ])
+    info = _tpu(transport).get_queued_resource("qr-1")
+    assert info.state == "ACTIVE"
+    assert len(transport.requests) == 2
+
+
+def test_tpu_client_409_is_idempotent_create():
+    from tpu_task.backends.tpu.api import QueuedResourceSpec
+
+    transport = FakeTransport([("http", 409)])
+    _tpu(transport).create_queued_resource(
+        "qr-1", QueuedResourceSpec(node_id="n", accelerator_type="v4-8",
+                                   runtime_version="tpu-ubuntu2204-base"))
+    assert len(transport.requests) == 1  # no crash, no retry loop
+
+
+def test_tpu_client_token_refresh_on_401():
+    from tpu_task.backends.tpu.api import RestTpuClient
+
+    tokens = iter([("stale", 3600.0), ("fresh", 3600.0)])
+    client = RestTpuClient("proj", "us-central2-b")
+    client._token._fetch = lambda: next(tokens)
+    transport = FakeTransport([
+        ("http", 401),
+        ("ok", json.dumps({"nodes": []}).encode()),
+    ])
+    client._urlopen = transport
+    client._sleep = FakeSleep()
+    assert client.list_nodes() == []
+    assert transport.requests[1].get_header("Authorization") == "Bearer fresh"
+
+
+# -- parallel cloud copy ------------------------------------------------------
+
+
+class MemoryBackend:
+    """Minimal non-local Backend double (local_root None → cloud path)."""
+
+    def __init__(self):
+        self.objects = {}
+
+    def list(self, prefix=""):
+        return sorted(k for k in self.objects if k.startswith(prefix))
+
+    def list_meta(self, prefix=""):
+        return {k: (len(v), 0.0) for k, v in self.objects.items()
+                if k.startswith(prefix)}
+
+    def listdirs(self):
+        return []
+
+    def makedir(self, key):
+        pass
+
+    def read(self, key):
+        return self.objects[key]
+
+    def write(self, key, data):
+        self.objects[key] = data
+
+    def delete(self, key):
+        self.objects.pop(key, None)
+
+    def exists(self):
+        return True
+
+    def local_root(self):
+        return None
+
+
+def test_parallel_cloud_copy_moves_every_file():
+    from tpu_task.storage.sync import _copy_files
+
+    src, dst = MemoryBackend(), MemoryBackend()
+    keys = [f"f{i:03d}" for i in range(40)]
+    for key in keys:
+        src.objects[key] = key.encode()
+    _copy_files(src, dst, keys)
+    assert dst.objects == src.objects
+
+
+def test_parallel_copy_propagates_worker_errors():
+    from tpu_task.storage.sync import _copy_files
+
+    src, dst = MemoryBackend(), MemoryBackend()
+    for i in range(10):
+        src.objects[f"f{i}"] = b"x"
+
+    boom = RuntimeError("copy failed")
+
+    class FailingDst(MemoryBackend):
+        def write(self, key, data):
+            if key == "f7":
+                raise boom
+            super().write(key, data)
+
+    dst = FailingDst()
+    with pytest.raises(RuntimeError, match="copy failed"):
+        _copy_files(src, dst, sorted(src.objects))

@@ -413,40 +413,43 @@ class RestTpuClient:
     """
 
     def __init__(self, project: str, zone: str, credentials_json: str = ""):
+        from tpu_task.storage.http_util import OAuthToken
+
         self.project = project
         self.zone = zone
         self.credentials_json = credentials_json
-        self._token: Optional[str] = None
+        self._token = OAuthToken(self._fetch_token)
+        self._urlopen = None  # test hook: injectable transport
+        self._sleep = None    # test hook: injectable backoff sleep
 
     # -- plumbing -------------------------------------------------------------
     def _parent(self) -> str:
         return f"projects/{self.project}/locations/{self.zone}"
 
-    def _access_token(self) -> str:
+    def _fetch_token(self):
         from tpu_task.storage.backends import (
             _gcs_token_from_metadata,
             _gcs_token_from_service_account,
         )
 
-        if self._token is None:
-            if self.credentials_json:
-                self._token = _gcs_token_from_service_account(self.credentials_json)
-            else:
-                self._token = _gcs_token_from_metadata()
-        return self._token
+        if self.credentials_json:
+            return _gcs_token_from_service_account(self.credentials_json)
+        return _gcs_token_from_metadata()
 
     def _request(self, method: str, path: str, payload: Optional[dict] = None) -> dict:
         import urllib.error
-        import urllib.request
+
+        from tpu_task.storage.http_util import authorized_send
 
         url = f"https://tpu.googleapis.com/v2/{path}"
         data = json.dumps(payload).encode() if payload is not None else None
-        request = urllib.request.Request(url, data=data, method=method)
-        request.add_header("Authorization", "Bearer " + self._access_token())
-        request.add_header("Content-Type", "application/json")
         try:
-            with urllib.request.urlopen(request, timeout=60) as response:
-                return json.loads(response.read() or b"{}")
+            body = authorized_send(
+                self._token, method, url, data=data,
+                headers={"Content-Type": "application/json"},
+                urlopen=self._urlopen,
+                sleep=self._sleep or time.sleep)
+            return json.loads(body or b"{}")
         except urllib.error.HTTPError as error:
             if error.code == 404:
                 raise ResourceNotFoundError(path) from error

@@ -204,16 +204,20 @@ class TPUTask(Task):
                              config=self._storage_config())
         if backend.exists():
             return
-        import urllib.request
-
         project = self.client.project  # type: ignore[union-attr]
         url = f"https://storage.googleapis.com/storage/v1/b?project={project}"
         body = json.dumps({"name": self.identifier.long(),
                            "location": self.zone.rsplit("-", 1)[0]}).encode()
-        request = urllib.request.Request(url, data=body, method="POST")
-        request.add_header("Authorization", "Bearer " + backend._access_token())
-        request.add_header("Content-Type", "application/json")
-        urllib.request.urlopen(request, timeout=60)
+        # Routed through the backend's authorized retry layer (token refresh,
+        # 429/5xx backoff); 409 = bucket already exists, the idempotent path.
+        import urllib.error
+
+        try:
+            backend._request("POST", url, data=body,
+                             headers={"Content-Type": "application/json"})
+        except urllib.error.HTTPError as error:
+            if error.code != 409:
+                raise
 
     def _storage_config(self) -> Dict[str, str]:
         if self.cloud.credentials.gcp and self.cloud.credentials.gcp.application_credentials:

@@ -218,35 +218,47 @@ class GCSBackend(Backend):
     (``service_account_credentials``), then the TPU-VM/GCE metadata server.
     Network calls only happen when methods are invoked, keeping construction
     hermetic for tests.
+
+    Resilience: every request goes through the shared retry/backoff layer
+    (429/5xx, Retry-After, one forced re-auth on 401 — see
+    :mod:`tpu_task.storage.http_util`); the token is cached with expiry so
+    >1 h lifecycles keep authenticating. Objects above
+    ``RESUMABLE_THRESHOLD`` upload via the resumable protocol in
+    ``UPLOAD_CHUNK`` pieces, each independently retried — a flaky link
+    can't force a whole checkpoint re-upload.
     """
 
+    RESUMABLE_THRESHOLD = 8 * 1024 * 1024
+    UPLOAD_CHUNK = 8 * 1024 * 1024  # multiple of 256 KiB per GCS spec
+
     def __init__(self, container: str, path: str = "", config: Optional[Dict[str, str]] = None):
+        from tpu_task.storage.http_util import OAuthToken
+
         self.container = container
         self.prefix = path.strip("/")
         self.config = config or {}
-        self._token: Optional[str] = None
+        self._token = OAuthToken(self._fetch_token)
+        self._urlopen = None  # test hook: injectable transport
+        self._sleep = None    # test hook: injectable backoff sleep
 
     # -- auth ---------------------------------------------------------------
-    def _access_token(self) -> str:
-        if self._token:
-            return self._token
+    def _fetch_token(self) -> Tuple[str, float]:
         creds = self.config.get("service_account_credentials", "")
         if creds:
-            self._token = _gcs_token_from_service_account(creds)
-        else:
-            self._token = _gcs_token_from_metadata()
-        return self._token
+            return _gcs_token_from_service_account(creds)
+        return _gcs_token_from_metadata()
 
     def _request(self, method: str, url: str, data: Optional[bytes] = None,
-                 headers: Optional[Dict[str, str]] = None) -> bytes:
-        import urllib.request
+                 headers: Optional[Dict[str, str]] = None,
+                 ok_statuses: Tuple[int, ...] = ()) -> bytes:
+        import time
 
-        request = urllib.request.Request(url, data=data, method=method)
-        request.add_header("Authorization", "Bearer " + self._access_token())
-        for key, value in (headers or {}).items():
-            request.add_header(key, value)
-        with urllib.request.urlopen(request, timeout=60) as response:
-            return response.read()
+        from tpu_task.storage.http_util import authorized_send
+
+        return authorized_send(
+            self._token, method, url, data=data, headers=headers,
+            ok_statuses=ok_statuses, urlopen=self._urlopen,
+            sleep=self._sleep or time.sleep)
 
     def _key(self, key: str) -> str:
         return posixpath.join(self.prefix, key) if self.prefix else key
@@ -318,10 +330,44 @@ class GCSBackend(Backend):
     def write(self, key: str, data: bytes) -> None:
         import urllib.parse
 
+        if len(data) > self.RESUMABLE_THRESHOLD:
+            self._write_resumable(key, data)
+            return
         url = (f"https://storage.googleapis.com/upload/storage/v1/b/{self.container}/o"
                f"?uploadType=media&name={urllib.parse.quote(self._key(key), safe='')}")
         self._request("POST", url, data=data,
                       headers={"Content-Type": "application/octet-stream"})
+
+    def _write_resumable(self, key: str, data: bytes) -> None:
+        """Chunked resumable upload: initiate a session, PUT fixed-size
+        chunks with Content-Range (intermediate chunks answer 308)."""
+        import time
+        import urllib.parse
+
+        from tpu_task.storage.http_util import authorized_send, send
+
+        initiate_url = (
+            f"https://storage.googleapis.com/upload/storage/v1/b/{self.container}/o"
+            f"?uploadType=resumable&name={urllib.parse.quote(self._key(key), safe='')}")
+        _, response_headers = authorized_send(
+            self._token, "POST", initiate_url, data=b"",
+            headers={"X-Upload-Content-Type": "application/octet-stream"},
+            with_headers=True, urlopen=self._urlopen,
+            sleep=self._sleep or time.sleep)
+        session_url = {k.lower(): v for k, v in response_headers.items()}.get("location")
+        if not session_url:
+            raise RuntimeError("resumable upload: no session URI returned")
+
+        total = len(data)
+        for start in range(0, total, self.UPLOAD_CHUNK):
+            chunk = data[start:start + self.UPLOAD_CHUNK]
+            end = start + len(chunk) - 1
+            send(  # the session URL is itself the credential: no Bearer auth
+                "PUT", session_url, data=chunk,
+                headers={"Content-Range": f"bytes {start}-{end}/{total}",
+                         "Content-Type": "application/octet-stream"},
+                ok_statuses=(308,),  # intermediate chunk accepted
+                urlopen=self._urlopen, sleep=self._sleep or time.sleep)
 
     def delete(self, key: str) -> None:
         import urllib.error
@@ -348,8 +394,9 @@ class GCSBackend(Backend):
             raise
 
 
-def _gcs_token_from_service_account(credentials_json: str) -> str:
-    """Exchange service-account credentials for an OAuth2 access token (RS256 JWT)."""
+def _gcs_token_from_service_account(credentials_json: str) -> Tuple[str, float]:
+    """Exchange service-account credentials for ``(access_token, expires_in)``
+    via an RS256 JWT assertion."""
     import base64
     import time
     import urllib.parse
@@ -380,11 +427,12 @@ def _gcs_token_from_service_account(credentials_json: str) -> str:
         "assertion": assertion.decode(),
     }).encode()
     with urllib.request.urlopen("https://oauth2.googleapis.com/token", body, timeout=30) as response:
-        return json.loads(response.read())["access_token"]
+        payload = json.loads(response.read())
+    return payload["access_token"], float(payload.get("expires_in", 3600))
 
 
-def _gcs_token_from_metadata() -> str:
-    """Fetch an access token from the GCE/TPU-VM metadata server."""
+def _gcs_token_from_metadata() -> Tuple[str, float]:
+    """Fetch ``(access_token, expires_in)`` from the GCE/TPU-VM metadata server."""
     import urllib.request
 
     request = urllib.request.Request(
@@ -393,7 +441,8 @@ def _gcs_token_from_metadata() -> str:
         headers={"Metadata-Flavor": "Google"},
     )
     with urllib.request.urlopen(request, timeout=10) as response:
-        return json.loads(response.read())["access_token"]
+        payload = json.loads(response.read())
+    return payload["access_token"], float(payload.get("expires_in", 3600))
 
 
 class _UnavailableBackend(Backend):

@@ -38,10 +38,14 @@ def _amz_now() -> str:
     return time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
 
 
-def _http(request: urllib.request.Request) -> bytes:
+def _http(request: urllib.request.Request, urlopen=None, sleep=None) -> bytes:
+    from tpu_task.storage.http_util import send
+
     try:
-        with urllib.request.urlopen(request, timeout=60) as response:
-            return response.read()
+        return send(
+            request.get_method(), request.full_url,
+            data=request.data, headers=dict(request.header_items()),
+            urlopen=urlopen, sleep=sleep or time.sleep)
     except urllib.error.HTTPError as error:
         if error.code == 404:
             raise ResourceNotFoundError(request.full_url) from error
@@ -62,6 +66,8 @@ class S3Backend(Backend):
         self.session_token = config.get("session_token", "")
         self.host = config.get(
             "endpoint", f"{container}.s3.{self.region}.amazonaws.com")
+        self._urlopen = None  # test hook: injectable transport
+        self._sleep = None    # test hook: injectable backoff sleep
 
     def _key(self, key: str) -> str:
         full = f"{self.prefix}/{key}" if self.prefix else key
@@ -80,7 +86,7 @@ class S3Backend(Backend):
         request = urllib.request.Request(url, data=body or None, method=method)
         for name, value in headers.items():
             request.add_header(name, value)
-        return _http(request)
+        return _http(request, urlopen=self._urlopen, sleep=self._sleep)
 
     def list(self, prefix: str = "") -> List[str]:
         full_prefix = self._key(prefix).lstrip("/")
@@ -164,6 +170,8 @@ class AzureBlobBackend(Backend):
         self.prefix = (path or "").strip("/")
         self.host = config.get("endpoint",
                                f"{self.account}.blob.core.windows.net")
+        self._urlopen = None  # test hook: injectable transport
+        self._sleep = None    # test hook: injectable backoff sleep
 
     def _blob_path(self, key: str) -> str:
         full = f"{self.prefix}/{key}" if self.prefix else key
@@ -187,7 +195,7 @@ class AzureBlobBackend(Backend):
         for name, value in headers.items():
             request.add_header(name, value)
         request.add_header("Authorization", auth)
-        return _http(request)
+        return _http(request, urlopen=self._urlopen, sleep=self._sleep)
 
     def list(self, prefix: str = "") -> List[str]:
         full_prefix = (self.prefix + "/" + prefix.lstrip("/")) if self.prefix else prefix

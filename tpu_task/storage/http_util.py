@@ -1,0 +1,161 @@
+"""HTTP resilience shared by every REST client (GCS, Cloud TPU, GCE).
+
+The reference gets retry/backoff, token refresh, and request pacing for free
+from the cloud SDKs (aws-sdk-go-v2, google.golang.org/api — SURVEY.md §2.2-2.3
+clients); this build speaks raw REST, so the resilience layer lives here:
+
+* :func:`send` — one request with bounded exponential backoff on 429/5xx and
+  transient transport errors, honoring ``Retry-After``.
+* :class:`OAuthToken` — cached bearer token with expiry-aware refresh.
+* :func:`authorized_send` — :func:`send` + Bearer auth, retrying exactly once
+  on 401 with a force-refreshed token (expired/revoked server-side).
+
+Everything is injectable (``urlopen``, ``sleep``, ``now``) so fault-injection
+tests can script 500s, 429s, and expired tokens hermetically.
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _time
+from typing import Callable, Dict, Optional, Tuple
+
+RETRY_STATUSES = (408, 429, 500, 502, 503, 504)
+MAX_RETRIES = 5
+BACKOFF_BASE = 0.5
+BACKOFF_CAP = 8.0
+RETRY_AFTER_CAP = 60.0
+
+
+def _default_urlopen(request, timeout):
+    import urllib.request
+
+    return urllib.request.urlopen(request, timeout=timeout)
+
+
+def send(
+    method: str,
+    url: str,
+    *,
+    data: Optional[bytes] = None,
+    headers: Optional[Dict[str, str]] = None,
+    timeout: float = 60.0,
+    retries: int = MAX_RETRIES,
+    ok_statuses: Tuple[int, ...] = (),
+    with_headers: bool = False,
+    urlopen=None,
+    sleep=_time.sleep,
+):
+    """One HTTP request with retry/backoff on transient failures.
+
+    Retries 408/429/5xx and transport-level errors with exponential backoff
+    (0.5 s → 8 s), honoring ``Retry-After`` when the server sends one.
+    ``ok_statuses`` treats additional HTTP error codes as success and returns
+    their body (GCS resumable uploads answer 308 for intermediate chunks).
+    Non-retryable errors (4xx) raise immediately. With ``with_headers`` the
+    return value is ``(body, headers_dict)`` instead of just the body.
+    """
+    import urllib.error
+    import urllib.request
+
+    urlopen = urlopen or _default_urlopen
+    delay = BACKOFF_BASE
+    last_error: Optional[Exception] = None
+    for attempt in range(retries + 1):
+        request = urllib.request.Request(url, data=data, method=method)
+        for key, value in (headers or {}).items():
+            request.add_header(key, value)
+        try:
+            with urlopen(request, timeout=timeout) as response:
+                body = response.read()
+                if with_headers:
+                    return body, dict(response.headers or {})
+                return body
+        except urllib.error.HTTPError as error:
+            if error.code in ok_statuses:
+                body = error.read() or b""
+                if with_headers:
+                    return body, dict(error.headers or {})
+                return body
+            if error.code not in RETRY_STATUSES or attempt == retries:
+                raise
+            last_error = error
+            retry_after = error.headers.get("Retry-After") if error.headers else None
+            wait = delay
+            if retry_after:
+                try:
+                    wait = min(float(retry_after), RETRY_AFTER_CAP)
+                except ValueError:
+                    pass
+            sleep(wait)
+        except urllib.error.URLError as error:
+            if attempt == retries:
+                raise
+            last_error = error
+            sleep(delay)
+        delay = min(delay * 2, BACKOFF_CAP)
+    raise RuntimeError(f"unreachable retry loop exit: {last_error}")
+
+
+class OAuthToken:
+    """Thread-safe cached bearer token with expiry-aware refresh.
+
+    ``fetch`` returns ``(token, expires_in_seconds)``. The cached token is
+    refreshed when within ``early`` seconds of expiry — long-lived processes
+    (a >1 h lifecycle poll loop) keep working across token rotations.
+    """
+
+    def __init__(self, fetch: Callable[[], Tuple[str, float]],
+                 early: float = 60.0, now=_time.time):
+        self._fetch = fetch
+        self._early = early
+        self._now = now
+        self._lock = threading.Lock()
+        self._token: Optional[str] = None
+        self._expires_at = 0.0
+
+    def get(self) -> str:
+        with self._lock:
+            if self._token is None or self._now() >= self._expires_at - self._early:
+                token, expires_in = self._fetch()
+                self._token = token
+                self._expires_at = self._now() + float(expires_in)
+            return self._token
+
+    def invalidate(self) -> None:
+        with self._lock:
+            self._token = None
+            self._expires_at = 0.0
+
+
+def authorized_send(
+    token: OAuthToken,
+    method: str,
+    url: str,
+    *,
+    data: Optional[bytes] = None,
+    headers: Optional[Dict[str, str]] = None,
+    timeout: float = 60.0,
+    retries: int = MAX_RETRIES,
+    ok_statuses: Tuple[int, ...] = (),
+    with_headers: bool = False,
+    urlopen=None,
+    sleep=_time.sleep,
+):
+    """:func:`send` with Bearer auth; one forced token refresh on 401."""
+    import urllib.error
+
+    request_headers = dict(headers or {})
+    request_headers["Authorization"] = "Bearer " + token.get()
+    try:
+        return send(method, url, data=data, headers=request_headers,
+                    timeout=timeout, retries=retries, ok_statuses=ok_statuses,
+                    with_headers=with_headers, urlopen=urlopen, sleep=sleep)
+    except urllib.error.HTTPError as error:
+        if error.code != 401:
+            raise
+        token.invalidate()
+        request_headers["Authorization"] = "Bearer " + token.get()
+        return send(method, url, data=data, headers=request_headers,
+                    timeout=timeout, retries=retries, ok_statuses=ok_statuses,
+                    with_headers=with_headers, urlopen=urlopen, sleep=sleep)

@@ -33,6 +33,29 @@ __all__ = [
 ]
 
 
+# Concurrent object-store streams (rclone's --transfers knob defaults to 4;
+# checkpoint-class objects benefit from more on fat NICs).
+CLOUD_COPY_WORKERS = int(os.environ.get("TPU_TASK_TRANSFERS", "16"))
+
+
+def _for_each(fn, keys: Sequence[str], parallel: bool) -> None:
+    """Apply ``fn`` to every key, on a thread pool for network-bound work.
+
+    The pool drain re-raises the first worker exception, mirroring rclone's
+    multiplexed transfers (SURVEY.md §2.9 item 1)."""
+    if parallel and len(keys) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(
+            max_workers=min(CLOUD_COPY_WORKERS, len(keys))
+        ) as pool:
+            for _result in pool.map(fn, keys):
+                pass
+    else:
+        for key in keys:
+            fn(key)
+
+
 def _copy_files(source: Backend, destination: Backend, keys: Sequence[str],
                 src_meta=None) -> None:
     src_root, dst_root = source.local_root(), destination.local_root()
@@ -43,11 +66,16 @@ def _copy_files(source: Backend, destination: Backend, keys: Sequence[str],
                 return
         except OSError as error:
             logger.warning("native copy failed (%s); falling back to python copy", error)
-    for key in keys:
+
+    def copy_one(key: str) -> None:
         destination.write(key, source.read(key))
         # Preserve modtimes so the incremental diff (size+modtime) converges.
         if src_meta and key in src_meta and hasattr(destination, "set_mtime"):
             destination.set_mtime(key, src_meta[key][1])
+
+    # Cloud transfers are network-bound → thread pool; local↔local stays
+    # serial here (the C++ fast path above covers it).
+    _for_each(copy_one, keys, parallel=src_root is None or dst_root is None)
 
 
 def _changed_keys(keys: Sequence[str], src_meta, dst_meta,
@@ -167,8 +195,8 @@ def delete_storage(remote: str) -> None:
     backend, _ = open_backend(remote)
     if not backend.exists():
         raise ResourceNotFoundError(remote)
-    for key in backend.list():
-        backend.delete(key)
+    keys = backend.list()
+    _for_each(backend.delete, keys, parallel=backend.local_root() is None)
     if isinstance(backend, LocalBackend):
         backend.remove_empty_dirs()
 
