@@ -1,0 +1,325 @@
+"""K8s real-mode unit suite against a faked kubectl.
+
+Drives create → read → push → pull → delete with every cluster interaction
+faked at the single ``kubectl`` seam, mirroring the reference semantics:
+Job counters → Status (resource_job.go:337-344), Job events → Events
+(resource_job.go:320-335), transfer-mode Job + kubectl cp for the data
+plane (task.go:146-166, 262-296). Asserts real-mode observation never
+touches the hermetic local control plane.
+"""
+
+import shutil
+from pathlib import Path
+
+import pytest
+
+from tpu_task.backends.k8s import task as k8s_task
+from tpu_task.backends.k8s.manifests import render_transfer_job
+from tpu_task.backends.k8s.task import K8STask, list_k8s_tasks
+from tpu_task.backends.local.control_plane import MachineGroup
+from tpu_task.common.cloud import Cloud, Provider
+from tpu_task.common.errors import ResourceNotFoundError
+from tpu_task.common.identifier import Identifier
+from tpu_task.common.values import (
+    Environment,
+    Size,
+    StatusCode,
+    Task as TaskSpec,
+)
+
+IDENTIFIER = Identifier.deterministic("k8s-real")
+
+
+class FakeCluster:
+    """In-memory cluster behind the kubectl seam; PVCs are temp dirs."""
+
+    def __init__(self, root: Path):
+        self.root = root
+        self.objects = {}      # (kind, name) -> manifest
+        self.pods = {}         # name -> {labels, ip, phase, claim}
+        self.job_status = {}   # job name -> counters dict
+        self.event_items = []  # raw event objects
+        self.calls = []
+
+    # -- helpers --------------------------------------------------------------
+    def pvc_dir(self, claim: str) -> Path:
+        directory = self.root / "pvc" / claim
+        directory.mkdir(parents=True, exist_ok=True)
+        return directory
+
+    def _match(self, labels: dict, selector: str) -> bool:
+        key, _, value = selector.partition("=")
+        if value:
+            return labels.get(key) == value
+        return key in labels
+
+    # -- the kubectl seam -----------------------------------------------------
+    def __call__(self, *argv, manifest=None, timeout=300.0):
+        self.calls.append(argv)
+        verb = argv[0]
+        if verb == "apply":
+            for obj in manifest or []:
+                self._apply(obj)
+            return ""
+        if verb == "get":
+            return self._get(argv[1:])
+        if verb == "delete":
+            return self._delete(argv[1:])
+        if verb == "cp":
+            return self._cp(argv[1], argv[2])
+        if verb == "logs":
+            return "pod/x: hello from the cluster\n"
+        raise AssertionError(f"unexpected kubectl verb: {argv}")
+
+    def _apply(self, obj):
+        kind, name = obj["kind"], obj["metadata"]["name"]
+        self.objects[(kind, name)] = obj
+        if kind == "Job":
+            template = obj["spec"]["template"]
+            claim = ""
+            for volume in template["spec"].get("volumes", []):
+                pvc = volume.get("persistentVolumeClaim")
+                if pvc:
+                    claim = pvc["claimName"]
+            self.pods[f"{name}-pod-0"] = {
+                "labels": dict(template["metadata"].get("labels", {})),
+                "ip": f"10.1.0.{len(self.pods) + 2}",
+                "phase": "Running",
+                "claim": claim,
+                "job": name,
+            }
+
+    def _get(self, argv):
+        import json
+
+        kind = argv[0]
+        if argv[1] == "-l":
+            selector = argv[2]
+            if kind == "pods":
+                items = [
+                    {"metadata": {"name": name, "labels": pod["labels"]},
+                     "status": {"phase": pod["phase"], "podIP": pod["ip"]}}
+                    for name, pod in self.pods.items()
+                    if self._match(pod["labels"], selector)
+                ]
+            else:
+                items = [obj for (obj_kind, _), obj in self.objects.items()
+                         if obj_kind.lower() == kind.rstrip("s")
+                         or obj_kind == "ConfigMap" and kind == "configmap"
+                         if self._match(obj["metadata"].get("labels", {}),
+                                        selector)]
+            return json.dumps({"items": items})
+        if kind == "events":
+            return json.dumps({"items": self.event_items})
+        if kind == "job":
+            name = argv[1]
+            if ("Job", name) not in self.objects:
+                raise ResourceNotFoundError(f"job {name} not found")
+            return json.dumps({"status": self.job_status.get(name, {})})
+        raise AssertionError(f"unexpected kubectl get: {argv}")
+
+    def _delete(self, argv):
+        kinds = argv[0].split(",")
+        kind_map = {"job": "Job", "configmap": "ConfigMap",
+                    "pvc": "PersistentVolumeClaim"}
+        if argv[1] == "-l":
+            selector = argv[2]
+            doomed = [key for key, obj in self.objects.items()
+                      if key[0] in {kind_map[k] for k in kinds}
+                      and self._match(obj["metadata"].get("labels", {}),
+                                      selector)]
+        else:
+            doomed = [(kind_map[kinds[0]], argv[1])]
+        for key in doomed:
+            self.objects.pop(key, None)
+            if key[0] == "Job":
+                for pod in [n for n, p in self.pods.items()
+                            if p["job"] == key[1]]:
+                    del self.pods[pod]
+        return ""
+
+    def _cp(self, source, destination):
+        if ":" in source:  # pod → local
+            pod_name, remote = source.split(":", 1)
+            local = Path(destination)
+            src = self._resolve(pod_name, remote)
+        else:  # local → pod
+            pod_name, remote = destination.split(":", 1)
+            local = Path(source)
+            src = None
+        if src is None:
+            target = self._resolve(pod_name, remote)
+            shutil.copytree(local, target, dirs_exist_ok=True)
+        else:
+            shutil.copytree(src, local, dirs_exist_ok=True)
+        return ""
+
+    def _resolve(self, pod_name: str, remote: str) -> Path:
+        pod = self.pods[pod_name]
+        assert remote.startswith("/workdir"), remote
+        return self.pvc_dir(pod["claim"])
+
+
+@pytest.fixture
+def cluster(tmp_path, monkeypatch):
+    fake = FakeCluster(tmp_path / "cluster")
+    monkeypatch.setattr(k8s_task, "kubectl", fake)
+    monkeypatch.setattr(k8s_task, "real_mode", lambda: True)
+    monkeypatch.setenv("TPU_TASK_LOCAL_ROOT", str(tmp_path / "local-plane"))
+    monkeypatch.setenv("TPU_TASK_K8S_POLL_PERIOD", "0.01")
+
+    def _no_local_plane(self):
+        raise AssertionError("real-mode observation touched the local plane")
+
+    monkeypatch.setattr(MachineGroup, "reconcile", _no_local_plane)
+    monkeypatch.setattr(MachineGroup, "scale", _no_local_plane)
+    return fake
+
+
+def make_task(tmp_path, directory=None, directory_out="", parallelism=1):
+    spec = TaskSpec(
+        size=Size(machine="m"),
+        environment=Environment(script="#!/bin/sh\necho hi\n",
+                                directory=directory or "",
+                                directory_out=directory_out),
+        parallelism=parallelism,
+    )
+    return K8STask(Cloud(provider=Provider.K8S), IDENTIFIER, spec)
+
+
+def test_create_read_delete_cycle(cluster, tmp_path):
+    task = make_task(tmp_path)
+    task.create()
+    assert ("ConfigMap", f"{IDENTIFIER.long()}-script") in cluster.objects
+    assert ("PersistentVolumeClaim",
+            f"{IDENTIFIER.long()}-workdir") in cluster.objects
+    assert ("Job", IDENTIFIER.long()) in cluster.objects
+
+    cluster.job_status[IDENTIFIER.long()] = {"active": 2, "succeeded": 1}
+    cluster.event_items.append({
+        "firstTimestamp": "2026-07-29T12:00:00Z",
+        "message": "Created pod", "reason": "SuccessfulCreate",
+        "action": "create",
+    })
+    task.read()
+    assert task.spec.status == {StatusCode.ACTIVE: 2,
+                                StatusCode.SUCCEEDED: 1,
+                                StatusCode.FAILED: 0}
+    assert task.spec.events[0].code == "Created pod"
+    assert task.spec.events[0].description == ["SuccessfulCreate", "create"]
+    assert task.spec.addresses  # pod IPs surfaced
+
+    task.delete()
+    assert not any(kind == "Job" for kind, _ in cluster.objects)
+    task.delete()  # idempotent
+
+
+def test_read_missing_job_raises_not_found(cluster, tmp_path):
+    task = make_task(tmp_path)
+    with pytest.raises(ResourceNotFoundError):
+        task.read()
+
+
+def test_push_pull_through_transfer_pod(cluster, tmp_path):
+    workdir = tmp_path / "work"
+    (workdir / "cache").mkdir(parents=True)
+    (workdir / "cache" / "junk.bin").write_text("excluded")
+    (workdir / "input.txt").write_text("payload")
+
+    task = make_task(tmp_path, directory=str(workdir), directory_out="output")
+    task.spec.environment.exclude_list = ["cache/**"]
+    task.create()
+
+    # Push landed the workdir on the PVC via the transfer pod, with the
+    # exclude rules applied before kubectl cp.
+    pvc = cluster.pvc_dir(f"{IDENTIFIER.long()}-workdir")
+    assert (pvc / "input.txt").read_text() == "payload"
+    assert not (pvc / "cache" / "junk.bin").exists()
+    # The ephemeral transfer job was cleaned up; the real Job remains.
+    assert ("Job", f"{IDENTIFIER.long()}-transfer") not in cluster.objects
+    assert ("Job", IDENTIFIER.long()) in cluster.objects
+
+    # Simulate the task writing results, then pull-on-delete.
+    (pvc / "output").mkdir()
+    (pvc / "output" / "result.txt").write_text("done")
+    task.delete()
+    assert (workdir / "output" / "result.txt").read_text() == "done"
+    # directory_out limiting: the pushed input is not re-downloaded over
+    # itself as new content, and nothing outside output/ is required.
+    assert not cluster.objects  # full teardown
+
+
+def test_logs_real_mode(cluster, tmp_path):
+    task = make_task(tmp_path)
+    task.create()
+    assert task.logs() == ["pod/x: hello from the cluster\n"]
+
+
+def test_list_tasks_without_instance_hack(cluster, tmp_path):
+    task = make_task(tmp_path)
+    task.create()
+    listed = list_k8s_tasks(Cloud(provider=Provider.K8S))
+    assert [identifier.long() for identifier in listed] == [IDENTIFIER.long()]
+
+
+def test_start_stop_not_implemented(cluster, tmp_path):
+    from tpu_task.common.errors import ResourceNotImplementedError
+
+    task = make_task(tmp_path)
+    with pytest.raises(ResourceNotImplementedError):
+        task.start()
+    with pytest.raises(ResourceNotImplementedError):
+        task.stop()
+
+
+def test_transfer_job_manifest_shape(tmp_path):
+    spec = TaskSpec(environment=Environment(script="x"))
+    job = render_transfer_job("tpi-a-b-c", spec)
+    assert job["metadata"]["name"] == "tpi-a-b-c-transfer"
+    pod = job["spec"]["template"]["spec"]
+    assert pod["containers"][0]["command"][-1] == "sleep infinity"
+    assert pod["volumes"][0]["persistentVolumeClaim"]["claimName"] == \
+        "tpi-a-b-c-workdir"
+
+
+def test_hermetic_job_completion_index_filled(tmp_path, monkeypatch):
+    """The hermetic plane exports the real rank, not an empty placeholder."""
+    import time
+
+    monkeypatch.setenv("TPU_TASK_LOCAL_ROOT", str(tmp_path / "plane"))
+    monkeypatch.setenv("TPU_TASK_LOCAL_LOG_PERIOD", "0.1")
+    monkeypatch.setenv("TPU_TASK_LOCAL_DATA_PERIOD", "0.1")
+    monkeypatch.delenv("KUBECONFIG", raising=False)
+    monkeypatch.delenv("KUBECONFIG_DATA", raising=False)
+
+    spec = TaskSpec(
+        environment=Environment(
+            script="#!/bin/sh\necho rank=$JOB_COMPLETION_INDEX\n"),
+        parallelism=2,
+    )
+    task = K8STask(Cloud(provider=Provider.K8S),
+                   Identifier.deterministic("k8s-rank"), spec)
+    task.create()
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            task.read()
+            if task.status().get(StatusCode.SUCCEEDED, 0) >= 2:
+                break
+            time.sleep(0.2)
+        logs = "\n".join(task.logs())
+        assert "rank=0" in logs and "rank=1" in logs
+    finally:
+        task.delete()
+
+
+def test_kubeconfig_tempfile_cached(tmp_path, monkeypatch):
+    monkeypatch.setenv("KUBECONFIG_DATA", "apiVersion: v1\nkind: Config\n")
+    k8s_task._kubeconfig_cache.clear()
+    first = k8s_task._kubeconfig_path()
+    second = k8s_task._kubeconfig_path()
+    assert first == second
+    assert len(k8s_task._kubeconfig_cache) == 1
+    k8s_task._cleanup_kubeconfigs()
+    import os
+    assert not os.path.exists(first)

@@ -110,3 +110,55 @@ def render_manifests(identifier: str, spec: TaskSpec, namespace: str = "default"
         "spec": job_spec,
     }
     return [config_map, pvc, job]
+
+
+def render_transfer_job(identifier: str, spec: TaskSpec,
+                        namespace: str = "default",
+                        region: str = "") -> Dict[str, Any]:
+    """Ephemeral sleep Job mounting the workdir PVC for ``kubectl cp``.
+
+    The reference switches the same Job into "transfer mode" via
+    TPI_TRANSFER_MODE, where the entrypoint sleeps instead of running the
+    script (resource_job.go:203-213, task.go:146-166). Rendering a distinct
+    single-pod Job is equivalent and avoids mutating process env. The main
+    Job's *region* node selectors are carried over so a WaitForFirstConsumer
+    RWO volume binds in a zone/pool the real Job can also schedule into; the
+    accelerator selector is deliberately not — zone, not GPU model, decides
+    where the volume binds, and the busybox pod requests no GPU so it would
+    sit Pending behind accelerator taints.
+    """
+    selectors = parse_node_selectors(region)
+    return {
+        "apiVersion": "batch/v1",
+        "kind": "Job",
+        "metadata": {
+            "name": f"{identifier}-transfer",
+            "namespace": namespace,
+            "labels": {"tpu-task-transfer": identifier},
+        },
+        "spec": {
+            "parallelism": 1,
+            "completions": 1,
+            "backoffLimit": 0,
+            "template": {
+                "metadata": {"labels": {"tpu-task-transfer": identifier}},
+                "spec": {
+                    "restartPolicy": "Never",
+                    **({"nodeSelector": selectors} if selectors else {}),
+                    "containers": [{
+                        "name": "transfer",
+                        "image": "busybox",
+                        "command": ["/bin/sh", "-c", "sleep infinity"],
+                        "workingDir": "/workdir",
+                        "volumeMounts": [
+                            {"name": "workdir", "mountPath": "/workdir"},
+                        ],
+                    }],
+                    "volumes": [
+                        {"name": "workdir", "persistentVolumeClaim": {
+                            "claimName": f"{identifier}-workdir"}},
+                    ],
+                },
+            },
+        },
+    }

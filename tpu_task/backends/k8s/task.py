@@ -7,34 +7,74 @@ clusters (task.go:316-324 NotImplementedError). Real mode shells out to
 kubeconfig being present (KUBECONFIG / KUBECONFIG_DATA — client/client.go);
 without one, the hermetic scaling-group plane runs the job locally with
 JOB_COMPLETION_INDEX ranks so indexed-completion semantics stay testable.
+
+Real-mode observation and data plane (round-3 additions):
+
+- ``read``/``status``/``events`` come from the cluster — Job counters map
+  ``job.status.{active,succeeded,failed}`` exactly as the reference folds
+  them (resource_job.go:337-344), events are the Job's event stream
+  (resource_job.go:320-335), addresses are pod IPs.
+- ``push``/``pull`` use an ephemeral transfer-mode Job sharing the workdir
+  PVC plus ``kubectl cp`` (task.go:146-166 create-side, 207-230 +
+  262-296 delete-side pull through a temp dir with output filtering).
 """
 
 from __future__ import annotations
 
+import atexit
+import hashlib
 import json
 import os
 import shutil
 import subprocess
 import tempfile
-from typing import Dict, List, Optional
+import time
+from contextlib import contextmanager
+from datetime import datetime, timezone
+from typing import Any, Dict, Iterator, List, Optional
 
 from tpu_task.backends.group_task import GroupBackedTask
 from tpu_task.backends.k8s.machines import parse_k8s_machine
-from tpu_task.backends.k8s.manifests import render_manifests
+from tpu_task.backends.k8s.manifests import render_manifests, render_transfer_job
 from tpu_task.common.cloud import Cloud
-from tpu_task.common.errors import ResourceNotImplementedError
+from tpu_task.common.errors import (
+    ResourceNotFoundError,
+    ResourceNotImplementedError,
+)
 from tpu_task.common.identifier import Identifier, WrongIdentifierError
 from tpu_task.common.ssh import DeterministicSSHKeyPair
-from tpu_task.common.values import Task as TaskSpec
+from tpu_task.common.values import Event, Status, StatusCode
+from tpu_task.storage import limit_transfer, transfer
+
+# KUBECONFIG_DATA is materialized to one temp file per distinct credential
+# value, reused across calls and removed at exit (round-2 advisor: the old
+# code leaked a new temp file per kubectl invocation).
+_kubeconfig_cache: Dict[str, str] = {}
+
+
+def _cleanup_kubeconfigs() -> None:
+    for path in _kubeconfig_cache.values():
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+    _kubeconfig_cache.clear()
+
+
+atexit.register(_cleanup_kubeconfigs)
 
 
 def _kubeconfig_path() -> Optional[str]:
-    """KUBECONFIG_DATA env (written to a temp file) or KUBECONFIG."""
+    """KUBECONFIG_DATA env (written to a cached temp file) or KUBECONFIG."""
     data = os.environ.get("KUBECONFIG_DATA", "")
     if data:
-        fd, path = tempfile.mkstemp(prefix="tpu-task-kubeconfig-")
-        with os.fdopen(fd, "w") as handle:
-            handle.write(data)
+        key = hashlib.sha256(data.encode()).hexdigest()
+        path = _kubeconfig_cache.get(key)
+        if path is None or not os.path.exists(path):
+            fd, path = tempfile.mkstemp(prefix="tpu-task-kubeconfig-")
+            with os.fdopen(fd, "w") as handle:
+                handle.write(data)
+            _kubeconfig_cache[key] = path
         return path
     path = os.environ.get("KUBECONFIG", "")
     return path if path and os.path.exists(path) else None
@@ -44,49 +84,94 @@ def real_mode() -> bool:
     return bool(shutil.which("kubectl")) and _kubeconfig_path() is not None
 
 
+def namespace() -> str:
+    """Target namespace; pinned so apply (manifest metadata) and every get/
+    delete/cp agree even when the kubeconfig context names another one."""
+    return os.environ.get("TPU_TASK_K8S_NAMESPACE", "default")
+
+
+def kubectl(*argv: str, manifest: Optional[list] = None,
+            timeout: Optional[float] = 300.0) -> str:
+    """Run kubectl against the configured cluster; raise on failure.
+
+    Module-level (not a method) so ``list_k8s_tasks`` needs no half-built
+    task instance, and so tests fake exactly one seam. ``timeout=None``
+    disables the cap (data-plane cp of large workdirs).
+    """
+    config = _kubeconfig_path()
+    command = ["kubectl", f"--kubeconfig={config}",
+               f"--namespace={namespace()}", *argv]
+    result = subprocess.run(
+        command, capture_output=True, text=True, timeout=timeout,
+        input=json.dumps({"apiVersion": "v1", "kind": "List",
+                          "items": manifest}) if manifest else None,
+    )
+    if result.returncode != 0:
+        stderr = result.stderr.strip()
+        # Only the API server's NotFound counts — a bare "not found" substring
+        # also appears in unrelated failures (e.g. "tar: executable file not
+        # found") that must not be treated as a missing resource.
+        if "(NotFound)" in stderr:
+            raise ResourceNotFoundError(stderr)
+        raise RuntimeError(f"kubectl failed: {stderr}")
+    return result.stdout
+
+
+def _kubectl_json(*argv: str) -> Dict[str, Any]:
+    return json.loads(kubectl(*argv, "-o", "json") or "{}")
+
+
+def _parse_k8s_time(value: str) -> datetime:
+    try:
+        return datetime.fromisoformat(value.replace("Z", "+00:00"))
+    except (ValueError, AttributeError):
+        return datetime.fromtimestamp(0, tz=timezone.utc)
+
+
 class K8STask(GroupBackedTask):
     provider_name = "k8s"
 
     def validate(self) -> None:
         parse_k8s_machine(self.spec.size.machine or "m")
 
-    def extra_environment(self) -> Dict[str, str]:
-        # Indexed-completion rank for the hermetic plane: the local agent
-        # exports TPU_TASK_WORKER_ID; mirror it under the k8s-native name so
-        # user scripts porting from real clusters keep working.
-        return {"JOB_COMPLETION_INDEX": ""}
-
     def get_key_pair(self) -> Optional[DeterministicSSHKeyPair]:
         return None  # no SSH on k8s (task/k8s/task.go:330)
 
-    # -- real-cluster mode ----------------------------------------------------
-    def _kubectl(self, *argv: str, manifest: Optional[list] = None) -> str:
-        config = _kubeconfig_path()
-        command = ["kubectl", f"--kubeconfig={config}", *argv]
-        result = subprocess.run(
-            command, capture_output=True, text=True, timeout=300,
-            input=json.dumps({"apiVersion": "v1", "kind": "List",
-                              "items": manifest}) if manifest else None,
-        )
-        if result.returncode != 0:
-            raise RuntimeError(f"kubectl failed: {result.stderr.strip()}")
-        return result.stdout
-
+    # -- real-cluster lifecycle -----------------------------------------------
     def create(self) -> None:
         if not real_mode():
             super().create()
             return
         manifests = render_manifests(self.identifier.long(), self.spec,
+                                     namespace=namespace(),
                                      region=str(self.cloud.region))
-        self._kubectl("apply", "-f", "-", manifest=manifests)
+        config_map, pvc, job = manifests
+        # ConfigMap + PVC first, then data upload through a transfer pod
+        # while the PVC is unclaimed, then the real Job (task.go:129-176;
+        # ordering matters for ReadWriteOnce claims).
+        kubectl("apply", "-f", "-", manifest=[config_map, pvc])
+        if self.spec.environment.directory:
+            self.push()
+        kubectl("apply", "-f", "-", manifest=[job])
 
     def delete(self) -> None:
         if not real_mode():
             super().delete()
             return
-        self._kubectl("delete", "job,configmap,pvc",
-                      "-l", f"tpu-task={self.identifier.long()}",
-                      "--ignore-not-found=true")
+        if self.spec.environment.directory and self._alive():
+            try:
+                # Free the PVC from the main Job before mounting it in the
+                # transfer pod (task.go:207-230 deletes the Job first; the
+                # pull is gated on Read succeeding, task.go:210, so an
+                # idempotent delete of a gone task skips straight to cleanup).
+                kubectl("delete", "job", self.identifier.long(),
+                        "--ignore-not-found=true", "--wait=true")
+                self.pull()
+            except (ResourceNotFoundError, TimeoutError):
+                pass
+        kubectl("delete", "job,configmap,pvc",
+                "-l", f"tpu-task={self.identifier.long()}",
+                "--ignore-not-found=true")
 
     def start(self) -> None:
         if not real_mode():
@@ -102,23 +187,161 @@ class K8STask(GroupBackedTask):
         raise ResourceNotImplementedError(
             "k8s jobs cannot be stopped (task/k8s/task.go:316-324)")
 
+    def _alive(self) -> bool:
+        """True when the task's cluster objects still exist (delete gate)."""
+        try:
+            _kubectl_json("get", "job", self.identifier.long())
+            return True
+        except ResourceNotFoundError:
+            return False
+
+    # -- real-cluster observation ----------------------------------------------
+    def read(self) -> None:
+        if not real_mode():
+            super().read()
+            return
+        job = _kubectl_json("get", "job", self.identifier.long())
+        counters = job.get("status", {}) or {}
+        self.spec.status = {
+            StatusCode.ACTIVE: int(counters.get("active") or 0),
+            StatusCode.SUCCEEDED: int(counters.get("succeeded") or 0),
+            StatusCode.FAILED: int(counters.get("failed") or 0),
+        }
+        self.spec.events = self._cluster_events()
+        self.spec.addresses = self._pod_addresses()
+
+    def status(self) -> Status:
+        if not real_mode():
+            return super().status()
+        if not self.spec.status:
+            self.read()
+        return self.spec.status
+
+    def events(self) -> List[Event]:
+        if not real_mode():
+            return super().events()
+        return self._cluster_events()
+
+    def _cluster_events(self) -> List[Event]:
+        """Job event stream → Event records (resource_job.go:320-335)."""
+        listing = _kubectl_json(
+            "get", "events", "--field-selector",
+            f"involvedObject.name={self.identifier.long()}")
+        events = []
+        for item in listing.get("items", []):
+            stamp = (item.get("firstTimestamp")
+                     or item.get("eventTime") or "")
+            events.append(Event(
+                time=_parse_k8s_time(stamp),
+                code=item.get("message", ""),
+                description=[item.get("reason", ""),
+                             item.get("action", "")],
+            ))
+        return events
+
+    def _pod_addresses(self) -> List[str]:
+        listing = _kubectl_json(
+            "get", "pods", "-l", f"tpu-task={self.identifier.long()}")
+        return [item["status"]["podIP"]
+                for item in listing.get("items", [])
+                if item.get("status", {}).get("podIP")]
+
     def logs(self) -> List[str]:
         if not real_mode():
             return super().logs()
-        out = self._kubectl("logs", f"job/{self.identifier.long()}",
-                            "--all-containers=true", "--prefix=true")
-        return [out] if out else []
+        # One entry per pod — `kubectl logs job/x` picks a single pod, which
+        # drops every other worker's output for indexed parallelism > 1
+        # (the reference streams each pod, resource_job.go:345-370).
+        listing = _kubectl_json(
+            "get", "pods", "-l", f"tpu-task={self.identifier.long()}")
+        logs = []
+        for item in listing.get("items", []):
+            name = item["metadata"]["name"]
+            try:
+                out = kubectl("logs", name, "--all-containers=true",
+                              "--timestamps=true")
+            except RuntimeError:
+                # Containers not started yet (ContainerCreating/Pending);
+                # skip that pod, keep the others (resource_job.go:352-356).
+                continue
+            if out:
+                logs.append(out)
+        return logs
+
+    # -- real-cluster data plane ----------------------------------------------
+    @contextmanager
+    def _transfer_pod(self) -> Iterator[str]:
+        """Ephemeral sleep Job mounting the workdir PVC (task.go:146-166)."""
+        name = f"{self.identifier.long()}-transfer"
+        job = render_transfer_job(self.identifier.long(), self.spec,
+                                  namespace=namespace(),
+                                  region=str(self.cloud.region))
+        kubectl("delete", "job", name, "--ignore-not-found=true",
+                "--wait=true")
+        kubectl("apply", "-f", "-", manifest=[job])
+        try:
+            yield self._wait_for_pod(f"tpu-task-transfer={self.identifier.long()}")
+        finally:
+            kubectl("delete", "job", name, "--ignore-not-found=true",
+                    "--wait=true")
+
+    def _wait_for_pod(self, selector: str, timeout: float = 300.0) -> str:
+        """Poll until a pod matching ``selector`` is Running; return its name
+        (reference WaitForPods, resources/common.go:17)."""
+        interval = float(os.environ.get("TPU_TASK_K8S_POLL_PERIOD", "1"))
+        deadline = time.monotonic() + timeout
+        while True:
+            listing = _kubectl_json("get", "pods", "-l", selector)
+            for item in listing.get("items", []):
+                if item.get("status", {}).get("phase") == "Running":
+                    return item["metadata"]["name"]
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"no running pod matched {selector!r} in {timeout}s")
+            time.sleep(interval)
+
+    def push(self) -> None:
+        if not real_mode():
+            super().push()
+            return
+        directory = self.spec.environment.directory
+        if not directory:
+            return
+        # Apply the exclude rules locally before cp — kubectl cp has no
+        # filter support, and the hermetic plane's push filters too.
+        staging = tempfile.mkdtemp(prefix="tpu-task-push-")
+        try:
+            transfer(directory, staging,
+                     list(self.spec.environment.exclude_list))
+            with self._transfer_pod() as pod:
+                kubectl("cp", staging, f"{pod}:/workdir", timeout=None)
+        finally:
+            shutil.rmtree(staging, ignore_errors=True)
+
+    def pull(self) -> None:
+        if not real_mode():
+            super().pull()
+            return
+        directory = self.spec.environment.directory
+        if not directory:
+            return
+        with self._transfer_pod() as pod:
+            staging = tempfile.mkdtemp(prefix="tpu-task-pull-")
+            try:
+                kubectl("cp", f"{pod}:/workdir", staging, timeout=None)
+                rules = limit_transfer(
+                    self.spec.environment.directory_out,
+                    list(self.spec.environment.exclude_list))
+                transfer(staging, directory, rules)
+            finally:
+                shutil.rmtree(staging, ignore_errors=True)
 
 
 def list_k8s_tasks(cloud: Cloud) -> List[Identifier]:
     if real_mode():
-        import json as json_module
-
-        task = K8STask.__new__(K8STask)
-        out = task._kubectl("get", "configmap", "-l", "tpu-task",
-                            "-o", "json")
+        listing = _kubectl_json("get", "configmap", "-l", "tpu-task")
         identifiers = []
-        for item in json_module.loads(out).get("items", []):
+        for item in listing.get("items", []):
             name = item["metadata"]["labels"].get("tpu-task", "")
             try:
                 identifiers.append(Identifier.parse(name))

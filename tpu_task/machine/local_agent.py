@@ -122,6 +122,11 @@ class Agent:
         restore_accelerator_env(env)
         env["TPU_WORKER_ID"] = str(self.worker_id)
         env["TPU_TASK_MACHINE_IDENTITY"] = self.machine_id
+        if env.get("TPU_TASK_CLOUD_PROVIDER") == "k8s":
+            # Mirror the rank under the k8s-native name so scripts written
+            # for real indexed Jobs (resource_job.go:135-140) run unchanged
+            # on the hermetic plane.
+            env["JOB_COMPLETION_INDEX"] = str(self.worker_id)
 
         remaining = None
         if self.timeout_epoch > 0:
