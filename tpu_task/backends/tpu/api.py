@@ -516,11 +516,24 @@ class RestTpuClient:
         if spec_payload:
             node_id = spec_payload[0].get("nodeId", "")
             node = spec_payload[0].get("node", {})
+            # Parse the FULL node spec back — the API echoes startup-script,
+            # metadata, labels, network and scheduling in this GET, and the
+            # recovery reconciler re-queues from exactly this spec so a bare
+            # `read` (fresh process, empty local TaskSpec) recovers a
+            # preempted slice with its original bootstrap intact.
+            metadata = dict(node.get("metadata", {}))
+            startup_script = metadata.pop("startup-script", "")
+            scheduling = node.get("schedulingConfig", {})
             spec = QueuedResourceSpec(
                 node_id=node_id,
                 accelerator_type=node.get("acceleratorType", ""),
                 runtime_version=node.get("runtimeVersion", ""),
-                spot=bool(node.get("schedulingConfig", {}).get("spot")),
+                startup_script=startup_script,
+                metadata=metadata,
+                labels=dict(node.get("labels", {})),
+                spot=bool(scheduling.get("spot") or scheduling.get("preemptible")),
+                service_account=node.get("serviceAccount", {}).get("email", ""),
+                network=node.get("networkConfig", {}).get("network", "default"),
             )
         return QueuedResourceInfo(name=name, state=state, spec=spec, node_name=node_id)
 

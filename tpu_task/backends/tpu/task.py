@@ -233,9 +233,16 @@ class TPUTask(Task):
             self.client.create_queued_resource(self._qr_name(index), qr_spec)
 
     def stop(self) -> None:
-        for index in range(max(self.spec.parallelism, len(self._existing_qrs()))):
+        # Iterate actual surviving QR names, unioned with the spec's index
+        # range — an index scan alone misses stragglers when the surviving
+        # set is sparse (e.g. only `-3` left after partial deletes) and the
+        # local spec says parallelism=1.
+        names = set(self._existing_qrs())
+        names.update(self._qr_name(index)
+                     for index in range(self.spec.parallelism))
+        for name in sorted(names):
             try:
-                self.client.delete_queued_resource(self._qr_name(index), force=True)
+                self.client.delete_queued_resource(name, force=True)
             except ResourceNotFoundError:
                 pass
 
@@ -267,7 +274,14 @@ class TPUTask(Task):
                 self._events.append(Event(
                     time=datetime.fromisoformat(event["time"]),
                     code=event["code"], description=[event["description"]]))
-            if info.state == QR_SUSPENDED and self.spec.spot >= 0:
+            # Recovery is gated on the *queued resource's own* spot bit, not
+            # the in-memory spec: a bare `tpu-task read --follow` constructs
+            # the task with an empty TaskSpec (spot = disabled), and the
+            # primary real-world monitor loop must still recover preempted
+            # spot slices. self.spec.spot remains as a fallback for specs
+            # created before the API echoed schedulingConfig.
+            if info.state == QR_SUSPENDED and (info.spec.spot
+                                               or self.spec.spot >= 0):
                 self._recover(info)
                 continue
             if info.state == QR_ACTIVE and info.node_name:
