@@ -302,6 +302,41 @@ def test_tpu_client_token_refresh_on_401():
     assert transport.requests[1].get_header("Authorization") == "Bearer fresh"
 
 
+def test_tpu_get_parses_full_node_spec():
+    """The QR GET echoes the complete node spec; the client must parse it all
+    back so recovery can re-queue from the API record alone (a bare `read`
+    holds no local spec). Regression for the r2 sparse-parse bug."""
+    payload = {
+        "state": {"state": "SUSPENDED"},
+        "tpu": {"nodeSpec": [{
+            "nodeId": "qr-1",
+            "node": {
+                "acceleratorType": "v5litepod-16",
+                "runtimeVersion": "v2-alpha-tpuv5-lite",
+                "metadata": {"startup-script": "#!/bin/bash\necho hi",
+                             "tpu-task-env-FOO": "bar"},
+                "labels": {"team": "ml"},
+                "schedulingConfig": {"preemptible": True},
+                "serviceAccount": {"email": "sa@proj.iam.gserviceaccount.com"},
+                "networkConfig": {"network": "projects/p/global/networks/custom"},
+            },
+        }]},
+    }
+    transport = FakeTransport([("ok", json.dumps(payload).encode())])
+    info = _tpu(transport).get_queued_resource("qr-1")
+    assert info.state == "SUSPENDED"
+    spec = info.spec
+    assert spec.accelerator_type == "v5litepod-16"
+    assert spec.runtime_version == "v2-alpha-tpuv5-lite"
+    assert spec.startup_script == "#!/bin/bash\necho hi"
+    assert "startup-script" not in spec.metadata
+    assert spec.metadata["tpu-task-env-FOO"] == "bar"
+    assert spec.labels == {"team": "ml"}
+    assert spec.spot is True
+    assert spec.service_account == "sa@proj.iam.gserviceaccount.com"
+    assert spec.network == "projects/p/global/networks/custom"
+
+
 # -- parallel cloud copy ------------------------------------------------------
 
 

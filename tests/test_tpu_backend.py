@@ -330,6 +330,40 @@ def test_tpu_preemption_recovery_mttr(tpu_cloud, tmp_path):
         task.delete()
 
 
+def test_recovery_through_fresh_task_with_empty_spec(tpu_cloud):
+    """Flagship regression: a bare `tpu-task read` — fresh process, empty
+    TaskSpec, spot disabled by default — must still recover a preempted spot
+    slice, re-queueing it with the ORIGINAL startup script taken from the
+    control plane's own QR record, not a re-render of the empty local spec
+    (reference analog: MIG auto-healing needs no client state,
+    resource_instance_group_manager.go:103-131)."""
+    script = "#!/bin/bash\necho original-workload\nsleep 300\n"
+    spec = TaskSpec(size=Size(machine="v4-8"),
+                    environment=Environment(script=script),
+                    spot=SPOT_ENABLED)
+    identifier = Identifier.deterministic("tpu-bare-read")
+    task = task_factory.new(tpu_cloud, identifier, spec)
+    task.create()
+    try:
+        poll(task, lambda t: t.client.get_queued_resource(
+            t._qr_name(0)).state == tpu_api.QR_ACTIVE, timeout=15)
+        original = task.client.get_queued_resource(task._qr_name(0)).spec
+        assert original.metadata.get("tpu-task-script-b64")
+        task.client.preempt_node(task._qr_name(0))
+
+        fresh = task_factory.new(tpu_cloud, identifier, TaskSpec())
+        assert fresh.spec.spot < 0  # the CLI default: spot disabled
+        fresh.read()
+        assert "recover" in [event.code for event in fresh.events()]
+        requeued = fresh.client.get_queued_resource(fresh._qr_name(0))
+        assert requeued.spec.startup_script == original.startup_script
+        assert requeued.spec.metadata.get("tpu-task-script-b64") == \
+            original.metadata.get("tpu-task-script-b64")
+        assert requeued.spec.spot  # the re-queued slice stays a spot slice
+    finally:
+        task.delete()
+
+
 def test_tpu_cli_end_to_end(tpu_cloud, tmp_path, monkeypatch):
     """The CLI drives the TPU backend hermetically (cloud=tpu + fake plane)."""
     import subprocess
