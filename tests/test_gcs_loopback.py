@@ -1,0 +1,82 @@
+"""End-to-end GCS data-plane integration over a real HTTP socket.
+
+Drives the actual GCSBackend — resumable chunked uploads, parallel ranged
+downloads, list/delete — against the in-process loopback emulator, so the
+full protocol path (urllib, thread pools, Content-Range bookkeeping) is
+exercised without scripted fakes. Role in the reference: the rclone `local`
+backend integration tests (storage_test.go:54-107), upgraded to keep HTTP in
+the loop.
+"""
+
+import os
+
+import pytest
+
+from tpu_task.storage.backends import GCSBackend
+from tpu_task.storage.gcs_emulator import LoopbackGCS
+
+
+@pytest.fixture()
+def loopback():
+    with LoopbackGCS() as server:
+        yield server
+
+
+def _backend(server, prefix=""):
+    backend = GCSBackend("bkt", prefix)
+    server.attach(backend)
+    return backend
+
+
+def test_small_object_roundtrip(loopback):
+    backend = _backend(loopback)
+    backend.write("reports/status-1", b'{"code": "0"}')
+    assert backend.read("reports/status-1") == b'{"code": "0"}'
+    assert backend.list("reports") == ["reports/status-1"]
+    backend.delete("reports/status-1")
+    assert backend.list() == []
+
+
+def test_prefix_is_scoped(loopback):
+    backend = _backend(loopback, prefix="task-1")
+    backend.write("data/file.txt", b"x")
+    assert loopback.objects == {"task-1/data/file.txt": b"x"}
+    assert backend.list() == ["data/file.txt"]
+
+
+def test_large_object_streams_both_ways(loopback, tmp_path):
+    """A multi-chunk checkpoint goes up via the resumable protocol and comes
+    back via parallel ranged GETs, byte-identical."""
+    backend = _backend(loopback)
+    backend.UPLOAD_CHUNK = 256 * 1024
+    backend.RESUMABLE_THRESHOLD = 256 * 1024
+    backend.DOWNLOAD_CHUNK = 192 * 1024  # misaligned with upload chunk on purpose
+
+    content = os.urandom(1024 * 1024 + 12345)
+    source = tmp_path / "ckpt.bin"
+    source.write_bytes(content)
+
+    backend.write_from_file("checkpoints/step-100.bin", str(source))
+    assert loopback.objects["checkpoints/step-100.bin"] == content
+
+    restored = tmp_path / "restored.bin"
+    backend.read_to_file("checkpoints/step-100.bin", str(restored))
+    assert restored.read_bytes() == content
+
+
+def test_large_bytes_write_uses_resumable(loopback):
+    backend = _backend(loopback)
+    backend.UPLOAD_CHUNK = 128 * 1024
+    backend.RESUMABLE_THRESHOLD = 128 * 1024
+    content = os.urandom(500 * 1024)
+    backend.write("big.bin", content)
+    assert loopback.objects["big.bin"] == content
+
+
+def test_list_meta_sizes(loopback):
+    backend = _backend(loopback)
+    backend.write("a.txt", b"aaa")
+    backend.write("b/c.txt", b"ccccc")
+    meta = backend.list_meta()
+    assert meta["a.txt"][0] == 3
+    assert meta["b/c.txt"][0] == 5

@@ -184,7 +184,7 @@ def test_gcs_large_write_resumable_chunks():
     size = GCSBackend.RESUMABLE_THRESHOLD + GCSBackend.UPLOAD_CHUNK // 2
     transport = FakeTransport([
         ("ok", b"", {"Location": "https://gcs/session-123"}),  # initiate
-        ("http", 308),                                         # chunk 1
+        ("http", 308, {"Range": f"bytes=0-{GCSBackend.UPLOAD_CHUNK - 1}"}),
         ("ok", b"{}"),                                         # final chunk
     ])
     _gcs(transport).write("ckpt.bin", b"z" * size)
@@ -203,12 +203,165 @@ def test_gcs_resumable_chunk_retries_on_503():
     size = GCSBackend.RESUMABLE_THRESHOLD + 1
     transport = FakeTransport([
         ("ok", b"", {"Location": "https://gcs/session-9"}),
-        ("http", 308),        # chunk 1 accepted
+        ("http", 308, {"Range": f"bytes=0-{GCSBackend.UPLOAD_CHUNK - 1}"}),
         ("http", 503),        # final chunk fails once
         ("ok", b"{}"),        # retried fine
     ])
     _gcs(transport).write("ckpt.bin", b"z" * size)
     assert len(transport.requests) == 4
+
+
+def test_gcs_final_chunk_308_no_progress_is_an_error():
+    """A 308 on the FINAL chunk that never advances means the object never
+    finalized — it must raise, not silently succeed (ADVICE r2 medium)."""
+    from tpu_task.storage.backends import GCSBackend
+
+    chunk = GCSBackend.UPLOAD_CHUNK
+    size = GCSBackend.RESUMABLE_THRESHOLD + 1
+    transport = FakeTransport([
+        ("ok", b"", {"Location": "https://gcs/session-1"}),
+        ("http", 308, {"Range": f"bytes=0-{chunk - 1}"}),  # chunk 1 committed
+        ("http", 308, {"Range": f"bytes=0-{chunk - 1}"}),  # final: no progress
+        ("http", 308, {"Range": f"bytes=0-{chunk - 1}"}),  # resent: still none
+    ])
+    with pytest.raises(RuntimeError, match="stalled"):
+        _gcs(transport).write("ckpt.bin", b"z" * size)
+
+
+def test_gcs_final_chunk_308_with_progress_resends_gap():
+    """A 308 on the final chunk whose Range shows the server behind resends
+    from the committed offset instead of aborting the whole session."""
+    backend = _gcs(FakeTransport([]))
+    backend.UPLOAD_CHUNK = 4
+    backend.RESUMABLE_THRESHOLD = 4
+    transport = FakeTransport([
+        ("ok", b"", {"Location": "https://gcs/session-5"}),
+        ("http", 308, {"Range": "bytes=0-3"}),   # chunk 1 fully committed
+        ("http", 308, {"Range": "bytes=0-7"}),   # chunk 2 fully committed
+        ("http", 308, {"Range": "bytes=0-8"}),   # final PUT only half landed
+        ("ok", b"{}"),                            # gap resent → finalized
+    ])
+    backend._urlopen = transport
+    backend.write("ckpt.bin", b"abcdefghij")
+    ranges = [r.get_header("Content-range") for r in transport.requests[1:]]
+    assert ranges == ["bytes 0-3/10", "bytes 4-7/10", "bytes 8-9/10",
+                      "bytes 9-9/10"]
+    assert transport.requests[4].data == b"j"
+
+
+def test_gcs_intermediate_308_range_behind_resends_gap():
+    """When a retried chunk leaves the server's persisted offset behind, the
+    Range header governs: the next PUT resends from the committed offset."""
+    from tpu_task.storage.backends import GCSBackend
+
+    backend = _gcs(FakeTransport([]))
+    backend.UPLOAD_CHUNK = 4
+    backend.RESUMABLE_THRESHOLD = 4
+    data = b"abcdefghij"  # 10 bytes → chunks of 4
+    transport = FakeTransport([
+        ("ok", b"", {"Location": "https://gcs/session-2"}),
+        # chunk bytes 0-3 sent, but server only committed 0-1:
+        ("http", 308, {"Range": "bytes=0-1"}),
+        # resent from offset 2 (bytes 2-5), all committed:
+        ("http", 308, {"Range": "bytes=0-5"}),
+        # bytes 6-9 = final chunk, 2xx finalizes:
+        ("ok", b"{}"),
+    ])
+    backend._urlopen = transport
+    backend.write("ckpt.bin", data)
+    ranges = [r.get_header("Content-range") for r in transport.requests[1:]]
+    assert ranges == ["bytes 0-3/10", "bytes 2-5/10", "bytes 6-9/10"]
+    assert transport.requests[2].data == b"cdef"
+
+
+def test_gcs_resumable_stall_raises():
+    """308s whose Range stops advancing get one resend, then a hard error —
+    never an infinite loop."""
+    backend = _gcs(FakeTransport([]))
+    backend.UPLOAD_CHUNK = 4
+    backend.RESUMABLE_THRESHOLD = 4
+    transport = FakeTransport([
+        ("ok", b"", {"Location": "https://gcs/session-3"}),
+        ("http", 308, {"Range": "bytes=0-1"}),   # committed offset 2
+        ("http", 308, {"Range": "bytes=0-1"}),   # no progress → resend once
+        ("http", 308, {"Range": "bytes=0-1"}),   # still none → stalled
+    ])
+    backend._urlopen = transport
+    with pytest.raises(RuntimeError, match="stalled"):
+        backend.write("ckpt.bin", b"abcdefghij")
+
+
+def test_gcs_308_without_range_means_nothing_persisted():
+    """Per the resumable protocol a 308 with NO Range header means zero bytes
+    persisted — the client must resend the chunk, not advance past it."""
+    backend = _gcs(FakeTransport([]))
+    backend.UPLOAD_CHUNK = 4
+    backend.RESUMABLE_THRESHOLD = 4
+    transport = FakeTransport([
+        ("ok", b"", {"Location": "https://gcs/session-6"}),
+        ("http", 308),                            # nothing persisted
+        ("http", 308, {"Range": "bytes=0-3"}),    # resend landed
+        ("http", 308, {"Range": "bytes=0-7"}),
+        ("ok", b"{}"),
+    ])
+    backend._urlopen = transport
+    backend.write("ckpt.bin", b"abcdefghij")
+    ranges = [r.get_header("Content-range") for r in transport.requests[1:]]
+    assert ranges == ["bytes 0-3/10", "bytes 0-3/10", "bytes 4-7/10",
+                      "bytes 8-9/10"]
+
+
+def test_gcs_write_from_file_streams_chunks(tmp_path):
+    """write_from_file drives the resumable protocol straight off disk —
+    correct Content-Range sequence, bodies read per-chunk."""
+    backend = _gcs(FakeTransport([]))
+    backend.UPLOAD_CHUNK = 4
+    backend.RESUMABLE_THRESHOLD = 4
+    path = tmp_path / "ckpt.bin"
+    path.write_bytes(b"abcdefghij")
+    transport = FakeTransport([
+        ("ok", b"", {"Location": "https://gcs/session-4"}),
+        ("http", 308, {"Range": "bytes=0-3"}),
+        ("http", 308, {"Range": "bytes=0-7"}),
+        ("ok", b"{}"),
+    ])
+    backend._urlopen = transport
+    backend.write_from_file("ckpt.bin", str(path))
+    bodies = [r.data for r in transport.requests[1:]]
+    assert bodies == [b"abcd", b"efgh", b"ij"]
+
+
+def test_gcs_read_to_file_parallel_ranged_download(tmp_path):
+    """Large downloads fetch parallel ranged chunks and assemble in place."""
+    backend = _gcs(FakeTransport([]))
+    backend.DOWNLOAD_CHUNK = 4
+    backend.DOWNLOAD_WORKERS = 1  # deterministic order for the scripted fake
+    content = b"abcdefghij"
+    transport = FakeTransport([
+        ("ok", json.dumps({"size": str(len(content))}).encode()),  # size probe
+        ("ok", content[0:4]),
+        ("ok", content[4:8]),
+        ("ok", content[8:10]),
+    ])
+    backend._urlopen = transport
+    out = tmp_path / "restored.bin"
+    backend.read_to_file("ckpt.bin", str(out))
+    assert out.read_bytes() == content
+    range_headers = [r.get_header("Range") for r in transport.requests[1:]]
+    assert range_headers == ["bytes=0-3", "bytes=4-7", "bytes=8-9"]
+
+
+def test_gcs_read_to_file_small_object_single_get(tmp_path):
+    backend = _gcs(FakeTransport([]))
+    content = b"tiny"
+    transport = FakeTransport([
+        ("ok", json.dumps({"size": str(len(content))}).encode()),
+        ("ok", content),
+    ])
+    backend._urlopen = transport
+    out = tmp_path / "small.bin"
+    backend.read_to_file("k", str(out))
+    assert out.read_bytes() == content
 
 
 def test_gcs_expired_token_mid_lifecycle():

@@ -100,6 +100,21 @@ class Backend:
     def write(self, key: str, data: bytes) -> None:
         raise NotImplementedError
 
+    def read_to_file(self, key: str, path: str) -> None:
+        """Download an object to a local file.
+
+        Backends override this with a streaming implementation so multi-GB
+        checkpoints never fully materialize in RAM; the default buffers."""
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        data = self.read(key)
+        with open(path, "wb") as handle:
+            handle.write(data)
+
+    def write_from_file(self, key: str, path: str) -> None:
+        """Upload a local file as an object (streaming where supported)."""
+        with open(path, "rb") as handle:
+            self.write(key, handle.read())
+
     def delete(self, key: str) -> None:
         raise NotImplementedError
 
@@ -166,6 +181,18 @@ class LocalBackend(Backend):
         with open(path, "wb") as handle:
             handle.write(data)
 
+    def read_to_file(self, key: str, path: str) -> None:
+        source = self._abs(key)
+        if not os.path.isfile(source):
+            raise ResourceNotFoundError(key)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        shutil.copyfile(source, path)
+
+    def write_from_file(self, key: str, path: str) -> None:
+        destination = self._abs(key)
+        os.makedirs(os.path.dirname(destination), exist_ok=True)
+        shutil.copyfile(path, destination)
+
     def set_mtime(self, key: str, mtime: float) -> None:
         try:
             os.utime(self._abs(key), (mtime, mtime))
@@ -229,7 +256,9 @@ class GCSBackend(Backend):
     """
 
     RESUMABLE_THRESHOLD = 8 * 1024 * 1024
-    UPLOAD_CHUNK = 8 * 1024 * 1024  # multiple of 256 KiB per GCS spec
+    UPLOAD_CHUNK = 8 * 1024 * 1024    # multiple of 256 KiB per GCS spec
+    DOWNLOAD_CHUNK = 16 * 1024 * 1024
+    DOWNLOAD_WORKERS = 8              # parallel ranged GETs per object
 
     def __init__(self, container: str, path: str = "", config: Optional[Dict[str, str]] = None):
         from tpu_task.storage.http_util import OAuthToken
@@ -328,20 +357,37 @@ class GCSBackend(Backend):
             raise
 
     def write(self, key: str, data: bytes) -> None:
+        import io
         import urllib.parse
 
         if len(data) > self.RESUMABLE_THRESHOLD:
-            self._write_resumable(key, data)
+            self._write_resumable_stream(key, io.BytesIO(data), len(data))
             return
         url = (f"https://storage.googleapis.com/upload/storage/v1/b/{self.container}/o"
                f"?uploadType=media&name={urllib.parse.quote(self._key(key), safe='')}")
         self._request("POST", url, data=data,
                       headers={"Content-Type": "application/octet-stream"})
 
-    def _write_resumable(self, key: str, data: bytes) -> None:
-        """Chunked resumable upload: initiate a session, PUT fixed-size
-        chunks with Content-Range (intermediate chunks answer 308)."""
+    def write_from_file(self, key: str, path: str) -> None:
+        """Streaming upload: the file is read one UPLOAD_CHUNK at a time, so
+        resident memory stays O(chunk) regardless of object size."""
+        size = os.path.getsize(path)
+        if size <= self.RESUMABLE_THRESHOLD:
+            with open(path, "rb") as handle:
+                self.write(key, handle.read())
+            return
+        with open(path, "rb") as handle:
+            self._write_resumable_stream(key, handle, size)
+
+    def _write_resumable_stream(self, key: str, handle, total: int) -> None:
+        """Chunked resumable upload: initiate a session, PUT fixed-size chunks
+        with Content-Range. Intermediate chunks must answer 308; the committed
+        offset is taken from the Range header so a retried chunk that left the
+        server behind is resent from where the server actually is. The final
+        chunk requires a 2xx — a 308 there means the upload never finalized
+        and is an error, not success."""
         import time
+        import urllib.error
         import urllib.parse
 
         from tpu_task.storage.http_util import authorized_send, send
@@ -358,16 +404,109 @@ class GCSBackend(Backend):
         if not session_url:
             raise RuntimeError("resumable upload: no session URI returned")
 
-        total = len(data)
-        for start in range(0, total, self.UPLOAD_CHUNK):
-            chunk = data[start:start + self.UPLOAD_CHUNK]
-            end = start + len(chunk) - 1
-            send(  # the session URL is itself the credential: no Bearer auth
-                "PUT", session_url, data=chunk,
-                headers={"Content-Range": f"bytes {start}-{end}/{total}",
-                         "Content-Type": "application/octet-stream"},
-                ok_statuses=(308,),  # intermediate chunk accepted
-                urlopen=self._urlopen, sleep=self._sleep or time.sleep)
+        offset = 0
+        stalls = 0
+        while offset < total:
+            handle.seek(offset)
+            chunk = handle.read(self.UPLOAD_CHUNK)
+            if not chunk:
+                raise RuntimeError(
+                    f"resumable upload: source truncated at {offset}/{total}")
+            end = offset + len(chunk) - 1
+            headers = {"Content-Range": f"bytes {offset}-{end}/{total}",
+                       "Content-Type": "application/octet-stream"}
+            if end == total - 1:
+                # Final chunk: only 2xx finalizes the object. A 308 here means
+                # the server is still behind (e.g. a retried chunk left its
+                # persisted offset short) — fall through to the committed-
+                # offset bookkeeping and resend the gap rather than abort.
+                try:
+                    send("PUT", session_url, data=chunk, headers=headers,
+                         urlopen=self._urlopen, sleep=self._sleep or time.sleep)
+                    return
+                except urllib.error.HTTPError as error:
+                    if error.code != 308:
+                        raise
+                    chunk_headers = error.headers
+            else:
+                # The session URL is itself the credential: no Bearer auth.
+                _, chunk_headers = send(
+                    "PUT", session_url, data=chunk, headers=headers,
+                    ok_statuses=(308,), with_headers=True,
+                    urlopen=self._urlopen, sleep=self._sleep or time.sleep)
+            # Per the resumable protocol, the Range header on a 308 carries
+            # the committed offset; NO Range header means nothing persisted.
+            committed = _resumable_committed_offset(chunk_headers) or 0
+            if committed > offset:
+                offset = committed  # may be < end+1: resend the gap
+                stalls = 0
+            else:
+                stalls += 1  # no progress: resend once, then give up
+                if stalls >= 2:
+                    raise RuntimeError(
+                        f"resumable upload stalled at offset {offset}"
+                        f" of {total} for {key!r}")
+
+    def read_to_file(self, key: str, path: str) -> None:
+        """Streaming download: large objects arrive as parallel ranged GETs,
+        so resident memory stays O(chunk × workers). Writes land in a temp
+        file renamed into place on success — an interrupted download never
+        publishes a full-size, hole-filled file under the final name."""
+        size = self._object_size(key)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        if size <= self.DOWNLOAD_CHUNK:
+            with open(path, "wb") as handle:
+                handle.write(self.read(key))
+            return
+
+        import urllib.parse
+        from concurrent.futures import ThreadPoolExecutor
+
+        url = (f"https://storage.googleapis.com/storage/v1/b/{self.container}/o/"
+               f"{urllib.parse.quote(self._key(key), safe='')}?alt=media")
+        partial = f"{path}.partial-{os.getpid()}"
+        fd = os.open(partial, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+        try:
+            os.truncate(fd, size)
+
+            def fetch(start: int) -> None:
+                end = min(start + self.DOWNLOAD_CHUNK, size) - 1
+                data = self._request("GET", url,
+                                     headers={"Range": f"bytes={start}-{end}"})
+                os.pwrite(fd, data, start)
+
+            starts = list(range(0, size, self.DOWNLOAD_CHUNK))
+            workers = min(self.DOWNLOAD_WORKERS, len(starts))
+            if workers > 1:
+                with ThreadPoolExecutor(max_workers=workers) as pool:
+                    for _done in pool.map(fetch, starts):
+                        pass
+            else:
+                for start in starts:
+                    fetch(start)
+        except BaseException:
+            os.close(fd)
+            try:
+                os.remove(partial)
+            except OSError:
+                pass
+            raise
+        os.close(fd)
+        os.replace(partial, path)
+
+    def _object_size(self, key: str) -> int:
+        import urllib.error
+        import urllib.parse
+
+        url = (f"https://storage.googleapis.com/storage/v1/b/{self.container}/o/"
+               f"{urllib.parse.quote(self._key(key), safe='')}?fields=size")
+        try:
+            payload = json.loads(self._request("GET", url))
+        except urllib.error.HTTPError as error:
+            if error.code == 404:
+                raise ResourceNotFoundError(key) from error
+            raise
+        return int(payload.get("size", 0))
 
     def delete(self, key: str) -> None:
         import urllib.error
@@ -392,6 +531,22 @@ class GCSBackend(Backend):
             if error.code == 404:
                 return False
             raise
+
+
+def _resumable_committed_offset(headers) -> Optional[int]:
+    """Next write offset from a 308 response's ``Range: bytes=0-N`` header
+    (N = last persisted byte, so the next offset is N+1); None when absent —
+    which per the resumable protocol means nothing persisted."""
+    if not headers:
+        return None
+    value = headers.get("Range") or headers.get("range") or ""
+    if not value.startswith("bytes="):
+        return None
+    _, _, end = value[len("bytes="):].partition("-")
+    try:
+        return int(end) + 1
+    except ValueError:
+        return None
 
 
 def _gcs_token_from_service_account(credentials_json: str) -> Tuple[str, float]:

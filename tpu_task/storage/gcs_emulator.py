@@ -1,0 +1,213 @@
+"""In-process loopback GCS emulator (JSON API subset).
+
+Serves the exact surface :class:`tpu_task.storage.backends.GCSBackend` speaks —
+media/resumable uploads, ranged downloads, list with prefix, delete — over a
+real HTTP socket, so the full data path (chunked resumable protocol, parallel
+ranged GETs, thread pools, urllib) can be integration-tested and benchmarked
+hermetically. Role in the reference: the rclone `local` backend that lets
+storage_test.go exercise the real sync engine without a cloud
+(/root/reference/task/common/machine/storage_test.go:54-107) — except this one
+keeps the HTTP/protocol layers in the loop too.
+
+Not a faithful GCS: no auth checks, no generations, no CRC. It implements the
+happy path plus the resumable-offset bookkeeping (308 + Range header) needed
+to validate the client's committed-offset handling.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "LoopbackGCS/1"
+
+    # -- helpers -------------------------------------------------------------
+    def _store(self) -> "LoopbackGCS":
+        return self.server.emulator  # type: ignore[attr-defined]
+
+    def _reply(self, code: int, body: bytes = b"",
+               headers: Optional[Dict[str, str]] = None) -> None:
+        self.send_response(code)
+        for key, value in (headers or {}).items():
+            self.send_header(key, value)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_body(self) -> bytes:
+        length = int(self.headers.get("Content-Length", "0"))
+        return self.rfile.read(length) if length else b""
+
+    def log_message(self, *args) -> None:  # quiet
+        pass
+
+    # -- upload --------------------------------------------------------------
+    def do_POST(self) -> None:
+        parsed = urllib.parse.urlparse(self.path)
+        query = urllib.parse.parse_qs(parsed.query)
+        name = urllib.parse.unquote(query.get("name", [""])[0])
+        upload_type = query.get("uploadType", [""])[0]
+        if upload_type == "media":
+            self._store().objects[name] = self._read_body()
+            self._reply(200, b"{}")
+        elif upload_type == "resumable":
+            self._read_body()
+            session = self._store().new_session(name)
+            host = self.headers.get("Host", "127.0.0.1")
+            self._reply(200, b"", {
+                "Location": f"http://{host}/upload-session/{session}"})
+        else:
+            self._reply(400, b"unknown uploadType")
+
+    def do_PUT(self) -> None:
+        match = re.match(r"^/upload-session/(\d+)$", self.path)
+        if not match:
+            self._reply(404, b"no such session")
+            return
+        store = self._store()
+        session_id = int(match.group(1))
+        body = self._read_body()
+        content_range = self.headers.get("Content-Range", "")
+        range_match = re.match(r"bytes (\d+)-(\d+)/(\d+)", content_range)
+        if not range_match:
+            self._reply(400, b"bad Content-Range")
+            return
+        start, end, total = (int(g) for g in range_match.groups())
+        committed = store.session_put(session_id, start, body, total)
+        if committed >= total:
+            name = store.finish_session(session_id)
+            self._reply(200, json.dumps({"name": name}).encode())
+        else:
+            self._reply(308, b"", {"Range": f"bytes=0-{committed - 1}"})
+
+    # -- download / metadata / list ------------------------------------------
+    def do_GET(self) -> None:
+        parsed = urllib.parse.urlparse(self.path)
+        query = urllib.parse.parse_qs(parsed.query)
+        store = self._store()
+        object_match = re.match(r"^/storage/v1/b/([^/]+)/o/(.+)$", parsed.path)
+        if object_match:
+            key = urllib.parse.unquote(object_match.group(2))
+            data = store.objects.get(key)
+            if data is None:
+                self._reply(404, b"not found")
+                return
+            if query.get("alt", [""])[0] == "media":
+                range_header = self.headers.get("Range", "")
+                range_match = re.match(r"bytes=(\d+)-(\d+)", range_header)
+                if range_match:
+                    start, end = int(range_match.group(1)), int(range_match.group(2))
+                    self._reply(206, data[start:end + 1], {
+                        "Content-Range": f"bytes {start}-{end}/{len(data)}"})
+                else:
+                    self._reply(200, data)
+            else:  # metadata probe (?fields=size)
+                self._reply(200, json.dumps({
+                    "name": key, "size": str(len(data))}).encode())
+            return
+        if re.match(r"^/storage/v1/b/[^/]+/o$", parsed.path):  # list
+            prefix = urllib.parse.unquote(query.get("prefix", [""])[0])
+            items = [{"name": key, "size": str(len(value)), "updated":
+                      "2026-01-01T00:00:00Z"}
+                     for key, value in sorted(store.objects.items())
+                     if key.startswith(prefix)]
+            self._reply(200, json.dumps({"items": items}).encode())
+            return
+        if re.match(r"^/storage/v1/b/[^/]+$", parsed.path):  # bucket probe
+            self._reply(200, b"{}")
+            return
+        self._reply(404, b"not found")
+
+    def do_DELETE(self) -> None:
+        parsed = urllib.parse.urlparse(self.path)
+        object_match = re.match(r"^/storage/v1/b/([^/]+)/o/(.+)$", parsed.path)
+        if not object_match:
+            self._reply(404, b"not found")
+            return
+        key = urllib.parse.unquote(object_match.group(2))
+        if self._store().objects.pop(key, None) is None:
+            self._reply(404, b"not found")
+        else:
+            self._reply(204)
+
+
+class LoopbackGCS:
+    """A loopback GCS server plus the transport hook that points a
+    :class:`GCSBackend` at it (rewrites storage.googleapis.com → 127.0.0.1)."""
+
+    def __init__(self):
+        self.objects: Dict[str, bytes] = {}
+        self._sessions: Dict[int, Tuple[str, bytearray, int]] = {}
+        self._next_session = 1
+        self._lock = threading.Lock()
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+        self._server.emulator = self  # type: ignore[attr-defined]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+
+    # -- lifecycle -----------------------------------------------------------
+    def __enter__(self) -> "LoopbackGCS":
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    # -- resumable-session bookkeeping ----------------------------------------
+    def new_session(self, name: str) -> int:
+        with self._lock:
+            session = self._next_session
+            self._next_session += 1
+            self._sessions[session] = (name, bytearray(), 0)
+            return session
+
+    def session_put(self, session: int, start: int, body: bytes, total: int) -> int:
+        with self._lock:
+            name, buffer, committed = self._sessions[session]
+            if start > committed:  # gap: refuse, keep committed offset
+                return committed
+            if len(buffer) < total:  # preallocate once from the declared total
+                buffer.extend(b"\0" * (total - len(buffer)))
+            needed = start + len(body)
+            buffer[start:needed] = body
+            committed = max(committed, needed)
+            self._sessions[session] = (name, buffer, committed)
+            return committed
+
+    def finish_session(self, session: int) -> str:
+        with self._lock:
+            name, buffer, _ = self._sessions.pop(session)
+            self.objects[name] = bytes(buffer)
+            return name
+
+    # -- client wiring ---------------------------------------------------------
+    def attach(self, backend) -> None:
+        """Point a GCSBackend at this server (token stubbed, URLs rewritten)."""
+        port = self.port
+
+        def loopback_urlopen(request, timeout=None):
+            import urllib.request
+
+            url = request.full_url.replace(
+                "https://storage.googleapis.com", f"http://127.0.0.1:{port}")
+            patched = urllib.request.Request(
+                url, data=request.data, method=request.get_method())
+            for key, value in request.header_items():
+                patched.add_header(key, value)
+            return urllib.request.urlopen(patched, timeout=timeout)
+
+        backend._token._fetch = lambda: ("loopback-token", 3600.0)
+        backend._urlopen = loopback_urlopen

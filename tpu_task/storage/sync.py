@@ -56,6 +56,17 @@ def _for_each(fn, keys: Sequence[str], parallel: bool) -> None:
             fn(key)
 
 
+def _contained(root: str, key: str) -> str:
+    """Resolve ``key`` under ``root``, refusing escapes — an object store may
+    legally hold a key like ``../../etc/x`` and must not write outside the
+    transfer directory (same guard as LocalBackend._abs)."""
+    root = os.path.abspath(root)
+    path = os.path.normpath(os.path.join(root, key))
+    if not path.startswith(root + os.sep):
+        raise ValueError(f"key escapes transfer root: {key!r}")
+    return path
+
+
 def _copy_files(source: Backend, destination: Backend, keys: Sequence[str],
                 src_meta=None) -> None:
     src_root, dst_root = source.local_root(), destination.local_root()
@@ -68,7 +79,15 @@ def _copy_files(source: Backend, destination: Backend, keys: Sequence[str],
             logger.warning("native copy failed (%s); falling back to python copy", error)
 
     def copy_one(key: str) -> None:
-        destination.write(key, source.read(key))
+        # Stream through the filesystem when one side is local so multi-GB
+        # checkpoints never fully materialize in RAM (chunked resumable
+        # uploads / parallel ranged downloads on the cloud side).
+        if src_root is not None:
+            destination.write_from_file(key, _contained(src_root, key))
+        elif dst_root is not None:
+            source.read_to_file(key, _contained(dst_root, key))
+        else:
+            destination.write(key, source.read(key))
         # Preserve modtimes so the incremental diff (size+modtime) converges.
         if src_meta and key in src_meta and hasattr(destination, "set_mtime"):
             destination.set_mtime(key, src_meta[key][1])
