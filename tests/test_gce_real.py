@@ -1,0 +1,330 @@
+"""Real-mode GCE backend against a scripted compute REST transport.
+
+Covers VERDICT r2 ask #3: InstanceTemplate + MIG via compute.googleapis.com,
+the 6-rule firewall scheme, the ``{user}@{project}/{image-or-family}``
+grammar with family fallback, and Size.storage honored as boot-disk size.
+Reference: /root/reference/task/gcp/task.go,
+task/gcp/resources/resource_instance_template.go,
+resource_instance_group_manager.go, resource_firewall_rule.go,
+data_source_image.go.
+"""
+
+import json
+
+import pytest
+
+from test_http_resilience import FakeSleep, FakeTransport
+
+from tpu_task.backends.gcp.api import RestComputeClient, parse_permission_set
+from tpu_task.backends.gcp.machines import parse_gcp_machine
+from tpu_task.common.cloud import Cloud, Credentials, GCPCredentials, Provider
+from tpu_task.common.identifier import Identifier
+from tpu_task.common.values import (
+    Environment, Firewall, FirewallRule as FirewallRuleSpec, Size, Spot,
+    Task as TaskSpec,
+)
+
+CREDS = json.dumps({"project_id": "proj", "client_email": "sa@proj",
+                    "private_key": "unused-in-tests"})
+
+
+def _client(transport):
+    client = RestComputeClient("proj", "us-west1-b")
+    client._token._fetch = lambda: ("tok", 3600.0)
+    client._urlopen = transport
+    client._sleep = FakeSleep()
+    return client
+
+
+def _cloud():
+    return Cloud(provider=Provider.GCP, region="us-west1-b",
+                 credentials=Credentials(gcp=GCPCredentials(
+                     application_credentials=CREDS)))
+
+
+def _real_task(spec=None, transport=None):
+    from tpu_task.backends.gcp.task import GCERealTask
+
+    task = GCERealTask(_cloud(), Identifier.deterministic("gce"), spec or TaskSpec())
+    task.client._token._fetch = lambda: ("tok", 3600.0)
+    task.client._urlopen = transport
+    task.client._sleep = FakeSleep()
+    return task
+
+
+# -- factory routing ----------------------------------------------------------
+
+
+def test_factory_routes_to_real_gce_with_credentials(monkeypatch):
+    from tpu_task.backends.gcp.task import GCERealTask, new_gcp_task
+
+    monkeypatch.delenv("TPU_TASK_FAKE_TPU_ROOT", raising=False)
+    task = new_gcp_task(_cloud(), Identifier.deterministic("t"), TaskSpec())
+    assert isinstance(task, GCERealTask)
+
+
+def test_factory_stays_hermetic_without_credentials(monkeypatch):
+    from tpu_task.backends.gcp.task import GCPTask, new_gcp_task
+
+    monkeypatch.delenv("TPU_TASK_FAKE_TPU_ROOT", raising=False)
+    task = new_gcp_task(Cloud(provider=Provider.GCP, region="us-west1-b"),
+                        Identifier.deterministic("t"), TaskSpec())
+    assert isinstance(task, GCPTask)
+
+
+def test_factory_fake_root_forces_hermetic(monkeypatch):
+    from tpu_task.backends.gcp.task import GCPTask, new_gcp_task
+
+    monkeypatch.setenv("TPU_TASK_FAKE_TPU_ROOT", "/tmp/fake")
+    task = new_gcp_task(_cloud(), Identifier.deterministic("t"), TaskSpec())
+    assert isinstance(task, GCPTask)
+
+
+# -- image grammar (data_source_image.go) -------------------------------------
+
+
+def test_image_alias_and_direct_hit():
+    from tpu_task.backends.gcp.resources import Image
+
+    transport = FakeTransport([("ok", json.dumps({"selfLink": "lnk"}).encode())])
+    image = Image(_client(transport), "")  # default → ubuntu alias
+    image.read()
+    assert image.ssh_user == "ubuntu"
+    assert image.resource["selfLink"] == "lnk"
+    assert "/projects/ubuntu-os-cloud/global/images/ubuntu-2004-lts" in \
+        transport.requests[0].full_url
+
+
+def test_image_family_fallback_on_404():
+    from tpu_task.backends.gcp.resources import Image
+
+    transport = FakeTransport([
+        ("http", 404),  # direct image miss
+        ("ok", json.dumps({"selfLink": "family-lnk"}).encode()),
+    ])
+    image = Image(_client(transport), "me@my-proj/my-family")
+    image.read()
+    assert image.ssh_user == "me"
+    assert image.resource["selfLink"] == "family-lnk"
+    assert "/projects/my-proj/global/images/family/my-family" in \
+        transport.requests[1].full_url
+
+
+def test_image_bad_grammar_raises():
+    from tpu_task.backends.gcp.resources import Image
+
+    with pytest.raises(ValueError, match="image"):
+        Image(_client(FakeTransport([])), "no-at-sign/whatever").read()
+
+
+# -- firewall scheme (gcp/task.go:72-128) -------------------------------------
+
+
+def test_standard_firewall_rules_scheme():
+    from tpu_task.backends.gcp.resources import standard_firewall_rules
+
+    firewall = Firewall(ingress=FirewallRuleSpec(ports=[22, 80]))
+    rules = standard_firewall_rules(_client(FakeTransport([])), "tpi-x",
+                                    firewall, "net-link")
+    names = [rule.name for rule in rules]
+    assert names == ["tpi-x-e1", "tpi-x-i1", "tpi-x-e2", "tpi-x-i2",
+                     "tpi-x-e3", "tpi-x-i3"]
+    internal_egress = rules[0].body()
+    assert internal_egress["destinationRanges"] == ["10.128.0.0/9"]
+    assert internal_egress["priority"] == 1
+    assert internal_egress["allowed"][0] == {"IPProtocol": "tcp"}  # every port
+    user_ingress = rules[3].body()
+    assert user_ingress["allowed"] == [
+        {"IPProtocol": "tcp", "ports": ["22", "80"]},
+        {"IPProtocol": "udp", "ports": ["22", "80"]}]
+    assert "sourceRanges" not in user_ingress  # None nets → any (omitted)
+    deny_ingress = rules[5].body()
+    assert deny_ingress["denied"][0] == {"IPProtocol": "tcp"}
+    assert deny_ingress["priority"] == 3
+    assert deny_ingress["targetTags"] == ["tpi-x-i3"]
+
+
+# -- instance template (resource_instance_template.go) ------------------------
+
+
+def test_template_body_honors_disk_and_accelerator():
+    from tpu_task.backends.gcp.resources import InstanceTemplate
+
+    template = InstanceTemplate(
+        _client(FakeTransport([])), "tpi-x", parse_gcp_machine("m+v100"),
+        startup_script="#!/bin/sh\ntrue", ssh_public_key="ssh-rsa AAA",
+        ssh_user="ubuntu", image_self_link="img", network_self_link="net",
+        firewall_tags=["tpi-x-i2"], service_accounts=[{"email": "default"}],
+        spot=0.0, disk_size_gb=200)
+    body = template.body()
+    props = body["properties"]
+    assert props["machineType"] == "custom-8-65536-ext"
+    assert props["guestAccelerators"] == [
+        {"acceleratorType": "nvidia-tesla-v100", "acceleratorCount": 1}]
+    assert props["disks"][0]["initializeParams"]["diskSizeGb"] == 200
+    assert props["scheduling"] == {"onHostMaintenance": "TERMINATE",
+                                   "preemptible": True}
+    metadata = {item["key"]: item["value"] for item in props["metadata"]["items"]}
+    assert metadata["startup-script"].startswith("#!/bin/sh")
+    assert metadata["ssh-keys"] == "ubuntu:ssh-rsa AAA host\n"
+    assert props["tags"]["items"] == ["tpi-x-i2"]
+
+
+def test_template_spot_bid_rejected():
+    from tpu_task.backends.gcp.resources import InstanceTemplate
+
+    template = InstanceTemplate(
+        _client(FakeTransport([])), "tpi-x", parse_gcp_machine("m"),
+        startup_script="", ssh_public_key="", ssh_user="u",
+        image_self_link="img", network_self_link="net", firewall_tags=[],
+        service_accounts=[], spot=0.5)
+    with pytest.raises(ValueError, match="bidding"):
+        template.body()
+
+
+def test_template_on_demand_migrates():
+    from tpu_task.backends.gcp.resources import InstanceTemplate
+
+    template = InstanceTemplate(
+        _client(FakeTransport([])), "tpi-x", parse_gcp_machine("m"),
+        startup_script="", ssh_public_key="k", ssh_user="u",
+        image_self_link="img", network_self_link="net", firewall_tags=[],
+        service_accounts=[], spot=-1.0)
+    scheduling = template.body()["properties"]["scheduling"]
+    assert scheduling == {"onHostMaintenance": "MIGRATE", "preemptible": False}
+
+
+# -- permission set -----------------------------------------------------------
+
+
+def test_permission_set_parsing():
+    assert parse_permission_set("")[0]["email"] == "default"
+    parsed = parse_permission_set(
+        "sa@proj.iam.gserviceaccount.com,scopes=storage-rw,compute")
+    assert parsed == [{"email": "sa@proj.iam.gserviceaccount.com",
+                       "scopes": ["https://www.googleapis.com/auth/storage-rw",
+                                  "https://www.googleapis.com/auth/compute"]}]
+    with pytest.raises(ValueError):
+        parse_permission_set("sa@x,bogus=1")
+
+
+# -- lifecycle against scripted REST ------------------------------------------
+
+
+def _done():
+    return ("ok", json.dumps({"status": "DONE"}).encode())
+
+
+def test_create_issues_full_resource_plan(monkeypatch, tmp_path):
+    spec = TaskSpec(size=Size(machine="m", storage=111),
+                    environment=Environment(script="#!/bin/sh\ntrue"),
+                    spot=Spot(-1))
+    transport = FakeTransport([
+        ("ok", json.dumps({"selfLink": "net-link"}).encode()),   # network
+        ("ok", json.dumps({"selfLink": "img-link"}).encode()),   # image
+        _done(), _done(), _done(), _done(), _done(), _done(),    # 6 firewalls
+        _done(),                                                  # template ins
+        ("ok", json.dumps({"selfLink": "tpl-link"}).encode()),   # template get
+        _done(),                                                  # MIG insert
+        _done(),                                                  # resize
+    ])
+    task = _real_task(spec, transport)
+    task.bucket.create = lambda: None  # GCS exercised in loopback tests
+    task.create()
+
+    urls = [r.full_url for r in transport.requests]
+    assert "/global/networks/default" in urls[0]
+    assert sum("/global/firewalls" in u for u in urls) == 6
+    template_insert = json.loads(transport.requests[8].data)
+    assert template_insert["properties"]["disks"][0]["initializeParams"][
+        "diskSizeGb"] == 111
+    assert template_insert["properties"]["metadata"]["items"][1][
+        "key"] == "startup-script"
+    mig_insert = json.loads(transport.requests[10].data)
+    assert mig_insert["instanceTemplate"] == "tpl-link"
+    assert mig_insert["targetSize"] == 0
+    assert urls[11].endswith("/resize?size=1")
+
+
+def test_read_aggregates_addresses_status_events(monkeypatch):
+    task = _real_task(TaskSpec())
+    transport = FakeTransport([
+        ("ok", json.dumps({"name": "mig"}).encode()),            # MIG get
+        ("ok", json.dumps({"items": [{
+            "timestamp": "2026-07-29T00:00:00Z",
+            "error": {"code": "QUOTA", "message": "boom"},
+            "instanceActionDetails": {"action": "CREATING"},
+        }]}).encode()),                                          # listErrors
+        ("ok", json.dumps({"items": [
+            {"status": "RUNNING", "instance": "https://x/instances/vm-0"},
+            {"status": "PROVISIONING", "instance": "https://x/instances/vm-1"},
+        ]}).encode()),                                           # listInstances
+        ("ok", json.dumps({"networkInterfaces": [{
+            "accessConfigs": [{"natIP": "34.1.2.3"}]}]}).encode()),  # instance
+    ])
+    task.client._urlopen = transport
+    monkeypatch.setattr("tpu_task.backends.gcs_remote.storage_status",
+                        lambda remote, initial=None: initial)
+    task.read()
+    assert task.get_addresses() == ["34.1.2.3"]
+    from tpu_task.common.values import StatusCode
+
+    assert task.spec.status == {StatusCode.ACTIVE: 1}
+    assert task.spec.events[0].code == "QUOTA"
+    assert task.spec.events[0].description == ["boom", "CREATING"]
+
+
+def test_delete_tolerates_missing_resources(monkeypatch):
+    task = _real_task(TaskSpec())
+    transport = FakeTransport([
+        ("http", 404),  # MIG delete
+        ("http", 404),  # template delete
+        ("http", 404), ("http", 404), ("http", 404),
+        ("http", 404), ("http", 404), ("http", 404),  # 6 firewalls
+    ])
+    task.client._urlopen = transport
+    task.bucket.delete = lambda: None
+    task.delete()  # idempotent: no raise
+    assert len(transport.requests) == 8
+
+
+def test_stop_resizes_to_zero():
+    task = _real_task(TaskSpec())
+    transport = FakeTransport([_done()])
+    task.client._urlopen = transport
+    task.stop()
+    assert transport.requests[0].full_url.endswith("/resize?size=0")
+
+
+# -- TPU networkConfig / disk-size enforcement --------------------------------
+
+
+def test_tpu_rejects_disk_size(monkeypatch, tmp_path):
+    from tpu_task.backends.tpu.task import TPUTask
+
+    monkeypatch.setenv("TPU_TASK_FAKE_TPU_ROOT", str(tmp_path))
+    spec = TaskSpec(size=Size(machine="v4-8", storage=200))
+    task = TPUTask(Cloud(provider=Provider.TPU, region="us-central2-b"),
+                   Identifier.deterministic("t"), spec)
+    with pytest.raises(ValueError, match="disk_size"):
+        task.create()
+    # Constructing (and tearing down) an existing task must keep working —
+    # validation lives in create(), not __init__.
+    task.stop()
+    task.delete()
+
+
+def test_tpu_external_ips_follow_firewall(monkeypatch, tmp_path):
+    from tpu_task.backends.tpu.task import TPUTask
+
+    monkeypatch.setenv("TPU_TASK_FAKE_TPU_ROOT", str(tmp_path))
+    cloud = Cloud(provider=Provider.TPU, region="us-central2-b")
+
+    open_spec = TaskSpec(size=Size(machine="v4-8"))
+    task = TPUTask(cloud, Identifier.deterministic("t"), open_spec)
+    assert task._qr_spec().enable_external_ips is True
+
+    closed = TaskSpec(size=Size(machine="v4-8"),
+                      firewall=Firewall(ingress=FirewallRuleSpec(ports=[])))
+    task = TPUTask(cloud, Identifier.deterministic("t"), closed)
+    assert task._qr_spec().enable_external_ips is False

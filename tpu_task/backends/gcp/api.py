@@ -1,0 +1,179 @@
+"""GCE control-plane client: compute.googleapis.com REST (no SDK).
+
+The reference drives GCE through google.golang.org/api/compute/v1
+(/root/reference/task/gcp/resources/*.go); this client speaks the same REST
+surface over the shared retry/refresh layer (:mod:`tpu_task.storage.http_util`)
+— the plumbing the Cloud TPU and GCS clients already use. Error mapping
+follows the reference: 404 → NotFound, 409/alreadyExists → AlreadyExists
+(idempotent create), everything transient retried with backoff.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, List, Optional
+
+from tpu_task.common.errors import ResourceAlreadyExistsError, ResourceNotFoundError
+
+COMPUTE = "https://compute.googleapis.com/compute/v1"
+
+
+class RestComputeClient:
+    """Minimal compute/v1 REST client for the resources the task DAG needs:
+    images, firewalls, networks, instance templates, instance group managers,
+    instances, and their global/zonal operations."""
+
+    def __init__(self, project: str, zone: str, credentials_json: str = ""):
+        from tpu_task.storage.http_util import OAuthToken
+
+        self.project = project
+        self.zone = zone
+        self.region = zone.rsplit("-", 1)[0]
+        self.credentials_json = credentials_json
+        self._token = OAuthToken(self._fetch_token)
+        self._urlopen = None  # test hook: injectable transport
+        self._sleep = None    # test hook: injectable backoff sleep
+
+    # -- plumbing -------------------------------------------------------------
+    def _fetch_token(self):
+        from tpu_task.storage.backends import (
+            _gcs_token_from_metadata,
+            _gcs_token_from_service_account,
+        )
+
+        if self.credentials_json:
+            return _gcs_token_from_service_account(self.credentials_json)
+        return _gcs_token_from_metadata()
+
+    def _request(self, method: str, url: str,
+                 payload: Optional[dict] = None) -> dict:
+        import urllib.error
+
+        from tpu_task.storage.http_util import authorized_send
+
+        data = json.dumps(payload).encode() if payload is not None else None
+        try:
+            body = authorized_send(
+                self._token, method, url, data=data,
+                headers={"Content-Type": "application/json"},
+                urlopen=self._urlopen, sleep=self._sleep or time.sleep)
+            return json.loads(body or b"{}")
+        except urllib.error.HTTPError as error:
+            if error.code == 404:
+                raise ResourceNotFoundError(url) from error
+            if error.code == 409:
+                raise ResourceAlreadyExistsError(url) from error
+            raise
+
+    def _global(self, path: str) -> str:
+        return f"{COMPUTE}/projects/{self.project}/global/{path}"
+
+    def _zonal(self, path: str) -> str:
+        return f"{COMPUTE}/projects/{self.project}/zones/{self.zone}/{path}"
+
+    def wait_operation(self, operation: dict, timeout: float = 900.0) -> dict:
+        """Exponential-backoff operation poller, 2 s → 32 s (the reference's
+        waitForOperation — task/gcp/resources/common.go:15-35). Compute
+        operations carry a selfLink; poll it until status DONE."""
+        delay = 2.0
+        deadline = time.time() + timeout
+        sleep = self._sleep or time.sleep
+        while operation.get("status") != "DONE":
+            if time.time() > deadline:
+                raise TimeoutError(f"operation timed out: {operation.get('name')}")
+            sleep(delay)
+            delay = min(delay * 2, 32.0)
+            operation = self._request("GET", operation["selfLink"])
+        if operation.get("error"):
+            raise RuntimeError(f"operation failed: {operation['error']}")
+        return operation
+
+    # -- images (data_source_image.go) ----------------------------------------
+    def get_image(self, project: str, name: str) -> dict:
+        return self._request(
+            "GET", f"{COMPUTE}/projects/{project}/global/images/{name}")
+
+    def get_image_from_family(self, project: str, family: str) -> dict:
+        return self._request(
+            "GET", f"{COMPUTE}/projects/{project}/global/images/family/{family}")
+
+    # -- networks (data_source_default_network.go) ----------------------------
+    def get_network(self, name: str = "default") -> dict:
+        return self._request("GET", self._global(f"networks/{name}"))
+
+    # -- firewalls (resource_firewall_rule.go) --------------------------------
+    def insert_firewall(self, body: dict) -> dict:
+        return self._request("POST", self._global("firewalls"), body)
+
+    def get_firewall(self, name: str) -> dict:
+        return self._request("GET", self._global(f"firewalls/{name}"))
+
+    def delete_firewall(self, name: str) -> dict:
+        return self._request("DELETE", self._global(f"firewalls/{name}"))
+
+    # -- instance templates (resource_instance_template.go) -------------------
+    def insert_instance_template(self, body: dict) -> dict:
+        return self._request("POST", self._global("instanceTemplates"), body)
+
+    def get_instance_template(self, name: str) -> dict:
+        return self._request("GET", self._global(f"instanceTemplates/{name}"))
+
+    def delete_instance_template(self, name: str) -> dict:
+        return self._request("DELETE", self._global(f"instanceTemplates/{name}"))
+
+    # -- instance group managers (resource_instance_group_manager.go) ---------
+    def insert_instance_group_manager(self, body: dict) -> dict:
+        return self._request("POST", self._zonal("instanceGroupManagers"), body)
+
+    def get_instance_group_manager(self, name: str) -> dict:
+        return self._request("GET", self._zonal(f"instanceGroupManagers/{name}"))
+
+    def resize_instance_group_manager(self, name: str, size: int) -> dict:
+        return self._request(
+            "POST", self._zonal(f"instanceGroupManagers/{name}/resize?size={size}"))
+
+    def delete_instance_group_manager(self, name: str) -> dict:
+        return self._request("DELETE", self._zonal(f"instanceGroupManagers/{name}"))
+
+    def list_instance_group_managers(self) -> List[str]:
+        payload = self._request("GET", self._zonal("instanceGroupManagers"))
+        return sorted(item.get("name", "") for item in payload.get("items", []))
+
+    def list_manager_errors(self, name: str) -> List[dict]:
+        payload = self._request(
+            "GET", self._zonal(f"instanceGroupManagers/{name}/listErrors"))
+        return payload.get("items", [])
+
+    def list_group_instances(self, name: str) -> List[dict]:
+        payload = self._request(
+            "POST", self._zonal(f"instanceGroups/{name}/listInstances"), {})
+        return payload.get("items", [])
+
+    # -- instances ------------------------------------------------------------
+    def get_instance(self, name: str) -> dict:
+        return self._request("GET", self._zonal(f"instances/{name}"))
+
+
+def parse_permission_set(permission_set: str) -> List[Dict]:
+    """``sa@proj.iam.gserviceaccount.com[,scopes=alias1,alias2]`` →
+    compute serviceAccounts list (data_source_permission_set.go:14-41).
+    Empty input → default compute SA with cloud-platform scope."""
+    if not permission_set:
+        return [{"email": "default",
+                 "scopes": ["https://www.googleapis.com/auth/cloud-platform"]}]
+    email, _, scope_part = permission_set.partition(",")
+    scopes = []
+    if scope_part:
+        if not scope_part.startswith("scopes="):
+            raise ValueError(
+                f"invalid permission set {permission_set!r}: expected "
+                "'email[,scopes=alias,...]'")
+        for alias in scope_part[len("scopes="):].split(","):
+            if alias.startswith("https://"):
+                scopes.append(alias)
+            else:
+                scopes.append(f"https://www.googleapis.com/auth/{alias}")
+    else:
+        scopes = ["https://www.googleapis.com/auth/cloud-platform"]
+    return [{"email": email, "scopes": scopes}]

@@ -1,0 +1,404 @@
+"""GCE resource primitives: Image, FirewallRule, InstanceTemplate, MIG.
+
+Each implements the Resource CRUD contract (common/resource.py) against the
+compute REST client, mirroring the reference's L2 objects:
+
+* Image           — /root/reference/task/gcp/resources/data_source_image.go
+* FirewallRule    — resource_firewall_rule.go (priority/direction/action,
+                    target-tag scoped, TCP+UDP per port)
+* InstanceTemplate— resource_instance_template.go (machine script → metadata,
+                    size grammar, disk size, accelerators, preemptible)
+* InstanceGroupManager — resource_instance_group_manager.go (TargetSize 0,
+                    Read → Status/Addresses/Events, Update = Resize)
+
+Idempotency discipline carried over verbatim: Create tolerates AlreadyExists
+→ Read; Delete tolerates NotFound (SURVEY.md §7 hard part #5).
+"""
+
+from __future__ import annotations
+
+import re
+from datetime import datetime, timezone
+from typing import Dict, List, Optional
+
+from tpu_task.backends.gcp.api import RestComputeClient
+from tpu_task.backends.gcp.machines import GceMachine
+from tpu_task.common.errors import ResourceAlreadyExistsError, ResourceNotFoundError
+from tpu_task.common.values import Event, FirewallRule as FirewallRuleSpec
+
+IMAGE_ALIASES = {
+    "ubuntu": "ubuntu@ubuntu-os-cloud/ubuntu-2004-lts",
+    "nvidia": "ubuntu@deeplearning-platform-release/common-cu113-ubuntu-2004",
+}
+_IMAGE_RE = re.compile(r"^([^@]+)@([^/]+)/([^/]+)$")
+
+
+class Image:
+    """``{user}@{project}/{image-or-family}`` with family fallback
+    (data_source_image.go:31-75). Empty identifier defaults to ubuntu."""
+
+    def __init__(self, client: RestComputeClient, identifier: str):
+        self.client = client
+        self.identifier = identifier or "ubuntu"
+        self.ssh_user = ""
+        self.resource: Optional[dict] = None
+
+    def read(self) -> None:
+        image = IMAGE_ALIASES.get(self.identifier, self.identifier)
+        match = _IMAGE_RE.match(image)
+        if not match:
+            raise ValueError(f"wrong image name: {self.identifier!r} "
+                             "(expected '{user}@{project}/{image-or-family}')")
+        self.ssh_user, project, image_or_family = match.groups()
+        try:
+            self.resource = self.client.get_image(project, image_or_family)
+        except ResourceNotFoundError:
+            self.resource = self.client.get_image_from_family(
+                project, image_or_family)
+
+    def create(self) -> None:  # data source
+        self.read()
+
+    def delete(self) -> None:  # data source
+        pass
+
+
+class Bucket:
+    """Per-task GCS bucket + rclone-style connection string
+    (resource_bucket.go: create/wait/empty-on-delete; connstring with inline
+    SA JSON at :117-127). Region = zone minus suffix (:51)."""
+
+    def __init__(self, identifier: str, zone: str, project: str,
+                 credentials_json: str = ""):
+        from tpu_task.storage.backends import GCSBackend
+
+        self.name = identifier
+        self.location = zone.rsplit("-", 1)[0]
+        self.project = project
+        self.credentials_json = credentials_json
+        config = ({"service_account_credentials": credentials_json}
+                  if credentials_json else {})
+        self.backend = GCSBackend(self.name, config=config)
+
+    def create(self) -> None:
+        import urllib.error
+
+        url = ("https://storage.googleapis.com/storage/v1/b"
+               f"?project={self.project}")
+        body = {"name": self.name, "location": self.location}
+        import json as _json
+
+        try:
+            self.backend._request("POST", url, data=_json.dumps(body).encode(),
+                                  headers={"Content-Type": "application/json"})
+        except urllib.error.HTTPError as error:
+            if error.code != 409:  # AlreadyExists → idempotent no-op
+                raise
+
+    def read(self) -> None:
+        if not self.backend.exists():
+            raise ResourceNotFoundError(self.name)
+
+    def delete(self) -> None:
+        """Empty the bucket, then delete the bucket itself (NotFound ok)."""
+        import urllib.error
+
+        from tpu_task.storage import delete_storage
+
+        try:
+            delete_storage(self.connection_string())
+        except ResourceNotFoundError:
+            return
+        url = f"https://storage.googleapis.com/storage/v1/b/{self.name}"
+        try:
+            self.backend._request("DELETE", url)
+        except urllib.error.HTTPError as error:
+            if error.code != 404:
+                raise
+
+    def connection_string(self) -> str:
+        from tpu_task.storage import Connection
+
+        config = ({"service_account_credentials": self.credentials_json}
+                  if self.credentials_json else {})
+        return str(Connection(backend="googlecloudstorage",
+                              container=self.name, config=config))
+
+
+DIRECTION_INGRESS = "INGRESS"
+DIRECTION_EGRESS = "EGRESS"
+ACTION_ALLOW = "ALLOW"
+ACTION_DENY = "DENY"
+
+
+class FirewallRule:
+    """One priority/direction/action firewall rule scoped to a target tag
+    equal to its own name (resource_firewall_rule.go:33-120)."""
+
+    def __init__(self, client: RestComputeClient, identifier: str,
+                 rule: FirewallRuleSpec, direction: str, action: str,
+                 priority: int, network_self_link: str = ""):
+        self.client = client
+        # "{id}-{direction initial}{priority}": e.g. tpi-...-i2
+        self.name = f"{identifier}-{direction[0].lower()}{priority}"
+        self.rule = rule
+        self.direction = direction
+        self.action = action
+        self.priority = priority
+        self.network_self_link = network_self_link
+
+    def body(self) -> dict:
+        nets = [str(net) for net in (self.rule.nets or [])]
+        ports = [str(port) for port in (self.rule.ports or [])]
+        definition: dict = {
+            "name": self.name,
+            "network": self.network_self_link,
+            "priority": self.priority,
+            "targetTags": [self.name],
+            "direction": self.direction,
+        }
+        # Omit empty ranges like the Go client's nil-slice marshalling does:
+        # the API then defaults to 0.0.0.0/0 (resource_firewall_rule.go:63-90).
+        if nets:
+            key = ("sourceRanges" if self.direction == DIRECTION_INGRESS
+                   else "destinationRanges")
+            definition[key] = nets
+        protocol = {"ports": ports} if ports else {}  # no ports → every port
+        protocols = [{"IPProtocol": "tcp", **protocol},
+                     {"IPProtocol": "udp", **protocol}]
+        if self.action == ACTION_ALLOW:
+            definition["allowed"] = protocols
+        else:
+            definition["denied"] = protocols
+        return definition
+
+    def create(self) -> None:
+        try:
+            operation = self.client.insert_firewall(self.body())
+            self.client.wait_operation(operation)
+        except ResourceAlreadyExistsError:
+            self.read()
+
+    def read(self) -> None:
+        self.client.get_firewall(self.name)
+
+    def delete(self) -> None:
+        try:
+            operation = self.client.delete_firewall(self.name)
+            self.client.wait_operation(operation)
+        except ResourceNotFoundError:
+            pass
+
+
+def standard_firewall_rules(client: RestComputeClient, identifier: str,
+                            firewall, network_self_link: str) -> List[FirewallRule]:
+    """The reference's 6-rule priority scheme (task/gcp/task.go:72-126):
+    internal 10.128.0.0/9 allow in/out at priority 1, the user's external
+    ingress/egress allows at priority 2, default-deny in/out at priority 3.
+    Tag-scoped, so rules only bind to instances carrying the rule names."""
+    import ipaddress
+
+    internal = FirewallRuleSpec(
+        nets=[ipaddress.IPv4Network("10.128.0.0/9")])
+    deny_all = FirewallRuleSpec()
+    return [
+        FirewallRule(client, identifier, internal, DIRECTION_EGRESS,
+                     ACTION_ALLOW, 1, network_self_link),
+        FirewallRule(client, identifier, internal, DIRECTION_INGRESS,
+                     ACTION_ALLOW, 1, network_self_link),
+        FirewallRule(client, identifier, firewall.egress, DIRECTION_EGRESS,
+                     ACTION_ALLOW, 2, network_self_link),
+        FirewallRule(client, identifier, firewall.ingress, DIRECTION_INGRESS,
+                     ACTION_ALLOW, 2, network_self_link),
+        FirewallRule(client, identifier, deny_all, DIRECTION_EGRESS,
+                     ACTION_DENY, 3, network_self_link),
+        FirewallRule(client, identifier, deny_all, DIRECTION_INGRESS,
+                     ACTION_DENY, 3, network_self_link),
+    ]
+
+
+class InstanceTemplate:
+    """Instance template carrying the rendered bootstrap as startup-script
+    metadata (resource_instance_template.go:48-196)."""
+
+    def __init__(self, client: RestComputeClient, identifier: str,
+                 machine: GceMachine, *, startup_script: str,
+                 ssh_public_key: str, ssh_user: str, image_self_link: str,
+                 network_self_link: str, firewall_tags: List[str],
+                 service_accounts: List[Dict], spot: float,
+                 disk_size_gb: int = -1, labels: Optional[Dict[str, str]] = None):
+        self.client = client
+        self.name = identifier
+        self.machine = machine
+        self.startup_script = startup_script
+        self.ssh_public_key = ssh_public_key
+        self.ssh_user = ssh_user
+        self.image_self_link = image_self_link
+        self.network_self_link = network_self_link
+        self.firewall_tags = firewall_tags
+        self.service_accounts = service_accounts
+        self.spot = spot
+        self.disk_size_gb = disk_size_gb
+        self.labels = labels or {}
+        self.resource: Optional[dict] = None
+
+    def body(self) -> dict:
+        if self.spot > 0:
+            # GCP preemptible instances have no bid price
+            # (resource_instance_template.go:110-113).
+            raise ValueError("preemptible instances don't have bidding price")
+        preemptible = self.spot == 0
+        accelerators = []
+        if self.machine.accelerator_type:
+            accelerators.append({
+                "acceleratorType": self.machine.accelerator_type,
+                "acceleratorCount": self.machine.accelerator_count,
+            })
+        # MIGRATE keeps long jobs alive through host events, but preemptible
+        # capacity and GPU attachments both require TERMINATE
+        # (resource_instance_template.go:115-118).
+        maintenance = "TERMINATE" if preemptible or accelerators else "MIGRATE"
+        disk: dict = {
+            "boot": True,
+            "autoDelete": True,
+            "type": "PERSISTENT",
+            "mode": "READ_WRITE",
+            "initializeParams": {
+                "sourceImage": self.image_self_link,
+                "diskType": "pd-balanced",
+            },
+        }
+        if self.disk_size_gb > 0:  # Size.storage honored (template.go:177-179)
+            disk["initializeParams"]["diskSizeGb"] = self.disk_size_gb
+        ssh_keys = f"{self.ssh_user}:{self.ssh_public_key.strip()} host\n"
+        return {
+            "name": self.name,
+            "properties": {
+                "machineType": self.machine.machine_type,
+                "disks": [disk],
+                "networkInterfaces": [{
+                    "network": self.network_self_link,
+                    "accessConfigs": [{"type": "ONE_TO_ONE_NAT",
+                                       "networkTier": "STANDARD"}],
+                }],
+                "serviceAccounts": self.service_accounts,
+                "tags": {"items": list(self.firewall_tags)},
+                "scheduling": {
+                    "onHostMaintenance": maintenance,
+                    "preemptible": preemptible,
+                },
+                "labels": self.labels,
+                "metadata": {"items": [
+                    {"key": "ssh-keys", "value": ssh_keys},
+                    {"key": "startup-script", "value": self.startup_script},
+                ]},
+                "guestAccelerators": accelerators,
+            },
+        }
+
+    def create(self) -> None:
+        try:
+            operation = self.client.insert_instance_template(self.body())
+            self.client.wait_operation(operation)
+        except ResourceAlreadyExistsError:
+            pass
+        self.read()
+
+    def read(self) -> None:
+        self.resource = self.client.get_instance_template(self.name)
+
+    def delete(self) -> None:
+        try:
+            operation = self.client.delete_instance_template(self.name)
+            self.client.wait_operation(operation)
+        except ResourceNotFoundError:
+            pass
+
+
+class InstanceGroupManager:
+    """Zonal MIG over the instance template; created at TargetSize 0 and
+    resized to parallelism on Start — preemption recovery is the MIG's own
+    recreation loop (resource_instance_group_manager.go:99-131)."""
+
+    def __init__(self, client: RestComputeClient, identifier: str,
+                 template_self_link: str = "", parallelism: int = 1):
+        self.client = client
+        self.name = identifier
+        self.template_self_link = template_self_link
+        self.parallelism = parallelism
+        self.addresses: List[str] = []
+        self.events: List[Event] = []
+        self.running = 0
+        self.resource: Optional[dict] = None
+
+    def create(self) -> None:
+        body = {
+            "name": self.name,
+            "baseInstanceName": self.name,
+            "instanceTemplate": self.template_self_link,
+            "targetSize": 0,
+            "updatePolicy": {
+                "maxSurge": {"fixed": 0},
+                "maxUnavailable": {"fixed": self.parallelism},
+            },
+        }
+        try:
+            operation = self.client.insert_instance_group_manager(body)
+            self.client.wait_operation(operation)
+        except ResourceAlreadyExistsError:
+            self.read()
+
+    def read(self) -> None:
+        self.resource = self.client.get_instance_group_manager(self.name)
+        self.events = []
+        for item in self.client.list_manager_errors(self.name):
+            error = item.get("error", {})
+            try:
+                stamp = datetime.fromisoformat(
+                    item.get("timestamp", "").replace("Z", "+00:00"))
+            except ValueError:
+                stamp = datetime.fromtimestamp(0, tz=timezone.utc)
+            self.events.append(Event(
+                time=stamp, code=error.get("code", ""),
+                description=[error.get("message", ""),
+                             item.get("instanceActionDetails", {}).get("action", "")]))
+        running_names = [
+            item.get("instance", "").rsplit("/", 1)[-1]
+            for item in self.client.list_group_instances(self.name)
+            if item.get("status") == "RUNNING"]
+        self.running = len(running_names)
+        self.addresses = []
+        if not running_names:
+            return
+
+        def nat_ip(instance_name: str) -> str:
+            instance = self.client.get_instance(instance_name)
+            interfaces = instance.get("networkInterfaces", [])
+            for config in (interfaces[0].get("accessConfigs", [])
+                           if interfaces else []):
+                if config.get("natIP"):
+                    return config["natIP"]
+            return ""
+
+        # Per-instance GETs are independent (same N+1 the reference does at
+        # resource_instance_group_manager.go:79-96, but fanned out so a
+        # parallelism-32 status poll is one round-trip deep, not 32).
+        if len(running_names) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=min(16, len(running_names))) as pool:
+                ips = list(pool.map(nat_ip, running_names))
+        else:
+            ips = [nat_ip(running_names[0])]
+        self.addresses = [ip for ip in ips if ip]
+
+    def resize(self, size: int) -> None:
+        operation = self.client.resize_instance_group_manager(self.name, size)
+        self.client.wait_operation(operation)
+
+    def delete(self) -> None:
+        try:
+            operation = self.client.delete_instance_group_manager(self.name)
+            self.client.wait_operation(operation)
+        except ResourceNotFoundError:
+            pass

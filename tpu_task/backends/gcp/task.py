@@ -1,21 +1,26 @@
 """GCP backend: TPU accelerator types route to the Cloud TPU control plane;
-GCE machine types run hermetically.
+GCE machine types run against the real compute API (with credentials) or
+hermetically (without).
 
 The reference's GCP path (task/gcp/task.go: InstanceTemplate + MIG) is
 exactly what this framework re-targets at Cloud TPU (SURVEY.md north star):
 ``cloud=gcp machine=v4-8`` provisions a QueuedResource-backed TPU slice —
 the real control plane — while GPU/CPU GCE machine types (``m``,
-``m+v100*1``…) validate against the reference's size/zone grammar and run on
-the hermetic scaling-group plane. Spot semantics follow the reference:
-``spot > 0`` is rejected because GCP preemptible capacity has no bid price
-(resource_instance_template.go:110-113).
+``m+v100*1``…) provision an InstanceTemplate + managed instance group via
+``compute.googleapis.com`` REST (GCERealTask), falling back to the hermetic
+scaling-group plane when no credentials are configured. Spot semantics follow
+the reference: ``spot > 0`` is rejected because GCP preemptible capacity has
+no bid price (resource_instance_template.go:110-113).
 """
 
 from __future__ import annotations
 
-from typing import Dict, List
+import json
+import os
+from typing import Dict, List, Optional
 
 from tpu_task.backends.gcp.machines import parse_gcp_machine, resolve_gcp_zone
+from tpu_task.backends.gcs_remote import GcsRemoteMixin
 from tpu_task.backends.group_task import GroupBackedTask
 from tpu_task.backends.tpu.accelerators import InvalidAcceleratorError
 from tpu_task.common.cloud import Cloud
@@ -39,12 +44,23 @@ def _is_tpu_machine(machine: str) -> bool:
         return False
 
 
+def _gce_real_mode(cloud: Cloud) -> bool:
+    """Real compute API when credentials are configured and the hermetic
+    plane isn't forced (mirrors the TPU backend's fake_mode gate)."""
+    if os.environ.get("TPU_TASK_FAKE_TPU_ROOT"):
+        return False
+    return bool(cloud.credentials.gcp
+                and cloud.credentials.gcp.application_credentials)
+
+
 def new_gcp_task(cloud: Cloud, identifier: Identifier, spec: TaskSpec) -> Task:
     """cloud=gcp factory: TPU accelerators → TPU backend, else GCE semantics."""
     if spec.size.machine and _is_tpu_machine(spec.size.machine):
         from tpu_task.backends.tpu import TPUTask
 
         return TPUTask(cloud, identifier, spec)
+    if _gce_real_mode(cloud):
+        return GCERealTask(cloud, identifier, spec)
     return GCPTask(cloud, identifier, spec)
 
 
@@ -70,27 +86,209 @@ class GCPTask(GroupBackedTask):
         return env
 
 
+class GCERealTask(GcsRemoteMixin, Task):
+    """GCE task over the real compute control plane.
+
+    Composition parity with /root/reference/task/gcp/task.go: ordered step
+    plan — image read, bucket, credentials env, the 6-rule firewall scheme,
+    InstanceTemplate (startup-script metadata, disk size, accelerators,
+    preemptible scheduling), zonal MIG at TargetSize 0 — then Push and Start
+    (Resize to parallelism). Read aggregates MIG errors → Events, RUNNING
+    instances → Status/Addresses (resource_instance_group_manager.go:44-100).
+    """
+
+    def __init__(self, cloud: Cloud, identifier: Identifier, spec: TaskSpec):
+        from tpu_task.backends.gcp.api import RestComputeClient
+        from tpu_task.backends.gcp.resources import Bucket, InstanceGroupManager
+
+        self.cloud = cloud
+        self.identifier = identifier
+        self.spec = spec
+        self.machine = parse_gcp_machine(spec.size.machine or "m")
+        self.zone = resolve_gcp_zone(str(cloud.region))
+        if spec.spot > 0:
+            raise ValueError(
+                "GCP preemptible instances don't support bidding "
+                "(set spot = 0 for auto pricing)")
+        self.credentials_json = cloud.credentials.gcp.application_credentials
+        self.project = json.loads(self.credentials_json).get("project_id", "")
+        self.client = RestComputeClient(self.project, self.zone,
+                                        self.credentials_json)
+        self.bucket = Bucket(identifier.long(), self.zone, self.project,
+                             self.credentials_json)
+        self.manager = InstanceGroupManager(self.client, identifier.long(),
+                                            parallelism=spec.parallelism)
+
+    # -- plumbing -------------------------------------------------------------
+    def _remote(self) -> str:
+        if self.spec.remote_storage is not None:
+            from tpu_task.storage import Connection
+
+            return str(Connection(
+                backend="googlecloudstorage",
+                container=self.spec.remote_storage.container,
+                path=self.spec.remote_storage.path,
+                config=dict(self.spec.remote_storage.config)))
+        return self.bucket.connection_string()
+
+    def _credentials_env(self) -> Dict[str, str]:
+        """Env map injected into the VM (data_source_credentials.go:30-49)."""
+        return {
+            "GOOGLE_APPLICATION_CREDENTIALS_DATA": self.credentials_json,
+            "TPU_TASK_REMOTE": self._remote(),
+            "TPU_TASK_CLOUD_PROVIDER": "gcp",
+            "TPU_TASK_CLOUD_REGION": str(self.cloud.region),
+            "TPU_TASK_IDENTIFIER": self.identifier.long(),
+        }
+
+    def _startup_script(self) -> str:
+        import time as _time
+        from datetime import datetime, timezone
+
+        from tpu_task.machine import render_script
+
+        timeout = self.spec.environment.timeout
+        epoch = (None if timeout is None else datetime.fromtimestamp(
+            _time.time() + timeout.total_seconds(), tz=timezone.utc))
+        return render_script(self.spec.environment.script,
+                             self._credentials_env(),
+                             self.spec.environment.variables, epoch)
+
+    def get_key_pair(self):
+        from tpu_task.common.ssh import DeterministicSSHKeyPair
+
+        return DeterministicSSHKeyPair(self.credentials_json,
+                                       self.identifier.long())
+
+    def _resources(self):
+        """Build the resource DAG (deferred: needs network + image reads)."""
+        from tpu_task.backends.gcp.api import parse_permission_set
+        from tpu_task.backends.gcp.resources import (
+            Image, InstanceTemplate, standard_firewall_rules,
+        )
+
+        network = self.client.get_network("default")
+        image = Image(self.client, self.spec.environment.image)
+        image.read()
+        rules = standard_firewall_rules(self.client, self.identifier.long(),
+                                        self.spec.firewall, network["selfLink"])
+        template = InstanceTemplate(
+            self.client, self.identifier.long(), self.machine,
+            startup_script=self._startup_script(),
+            ssh_public_key=self.get_key_pair().public_string(),
+            ssh_user=image.ssh_user,
+            image_self_link=image.resource["selfLink"],
+            network_self_link=network["selfLink"],
+            firewall_tags=[rule.name for rule in rules],
+            service_accounts=parse_permission_set(self.spec.permission_set),
+            spot=float(self.spec.spot),
+            disk_size_gb=self.spec.size.storage,
+            labels=dict(self.cloud.tags),
+        )
+        return rules, template
+
+    # -- lifecycle ------------------------------------------------------------
+    def create(self) -> None:
+        from tpu_task.common.steps import Step, run_steps
+
+        rules, template = self._resources()
+        steps = [Step("Creating bucket...", self.bucket.create)]
+        steps += [Step(f"Creating firewall rule {rule.name}...", rule.create)
+                  for rule in rules]
+
+        def create_template():
+            template.create()
+            self.manager.template_self_link = template.resource["selfLink"]
+
+        steps += [
+            Step("Creating instance template...", create_template),
+            Step("Creating instance group manager...", self.manager.create),
+            Step("Uploading directory...", self.push),
+            Step("Starting task...", self.start),
+        ]
+        run_steps(steps)
+
+    def start(self) -> None:
+        self.manager.resize(self.spec.parallelism)
+
+    def stop(self) -> None:
+        self.manager.resize(0)
+
+    def read(self) -> None:
+        self.manager.read()
+        self.spec.addresses = list(self.manager.addresses)
+        self.spec.status = self.status(running=self.manager.running)
+        self.spec.events = self.events()
+
+    def delete(self) -> None:
+        from tpu_task.backends.gcp.resources import (
+            InstanceTemplate, standard_firewall_rules,
+        )
+        from tpu_task.common.errors import ResourceNotFoundError
+
+        if self.spec.environment.directory:
+            try:
+                self.pull()
+            except ResourceNotFoundError:
+                pass
+        self.manager.delete()
+        InstanceTemplate(
+            self.client, self.identifier.long(), self.machine,
+            startup_script="", ssh_public_key="", ssh_user="",
+            image_self_link="", network_self_link="", firewall_tags=[],
+            service_accounts=[], spot=-1.0).delete()
+        # Firewall rule names are deterministic; delete without reads.
+        for rule in standard_firewall_rules(self.client,
+                                            self.identifier.long(),
+                                            self.spec.firewall, ""):
+            rule.delete()
+        self.bucket.delete()
+
+    # -- observation (data plane inherited from GcsRemoteMixin) ---------------
+    def status(self, running: Optional[int] = None):
+        if running is None:
+            self.manager.read()
+            running = self.manager.running
+        return self._folded_status(running)
+
+    def events(self):
+        return list(self.manager.events)
+
+
 def list_gcp_tasks(cloud: Cloud) -> List[Identifier]:
-    """Union of TPU-provisioned and hermetic-group task identifiers."""
+    """Union of TPU-provisioned, real-GCE (MIG), and hermetic-group tasks —
+    real-mode GCE tasks are billed resources, so ``list`` must surface them
+    for discovery and bulk cleanup (the reference's `leo list` contract)."""
     from tpu_task.backends.local.control_plane import list_groups
     from tpu_task.backends.tpu.task import fake_mode, list_tpu_tasks
     from tpu_task.common.identifier import WrongIdentifierError
 
     identifiers: List[Identifier] = []
     seen = set()
-    import os
 
-    if fake_mode() or os.environ.get("GOOGLE_APPLICATION_CREDENTIALS_DATA"):
-        for identifier in list_tpu_tasks(cloud):
-            if identifier.long() not in seen:
-                seen.add(identifier.long())
-                identifiers.append(identifier)
-    for name in list_groups():
-        try:
-            identifier = Identifier.parse(name)
-        except WrongIdentifierError:
-            continue
+    def add(identifier: Identifier) -> None:
         if identifier.long() not in seen:
             seen.add(identifier.long())
             identifiers.append(identifier)
+
+    if fake_mode() or os.environ.get("GOOGLE_APPLICATION_CREDENTIALS_DATA"):
+        for identifier in list_tpu_tasks(cloud):
+            add(identifier)
+    if _gce_real_mode(cloud):
+        from tpu_task.backends.gcp.api import RestComputeClient
+
+        credentials_json = cloud.credentials.gcp.application_credentials
+        client = RestComputeClient(
+            json.loads(credentials_json).get("project_id", ""),
+            resolve_gcp_zone(str(cloud.region)), credentials_json)
+        for name in client.list_instance_group_managers():
+            try:
+                add(Identifier.parse(name))
+            except WrongIdentifierError:
+                continue
+    for name in list_groups():
+        try:
+            add(Identifier.parse(name))
+        except WrongIdentifierError:
+            continue
     return identifiers

@@ -1,0 +1,76 @@
+"""Shared bucket data-plane/observation plumbing for real-cloud backends.
+
+TPU slices and GCE instance groups both speak the same bucket protocol —
+``data/`` for the workdir, ``reports/task-*``/``reports/status-*`` for the
+mailbox (/root/reference/task/common/machine/storage.go) — so the push/pull/
+logs/status plumbing lives here once, parameterized on ``_remote()``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+from tpu_task.common.errors import ResourceNotFoundError
+from tpu_task.common.identifier import Identifier
+from tpu_task.common.values import Status, StatusCode
+from tpu_task.storage import (
+    limit_transfer,
+    logs as storage_logs,
+    status as storage_status,
+    transfer,
+)
+
+
+class GcsRemoteMixin:
+    """Requires ``self.spec`` (TaskSpec), ``self.identifier`` and
+    ``_remote() -> str`` (connection string or local path)."""
+
+    def _remote(self) -> str:
+        raise NotImplementedError
+
+    def _data_remote(self) -> str:
+        remote = self._remote()
+        if remote.startswith(":"):
+            from tpu_task.storage import Connection
+
+            conn = Connection.parse(remote)
+            conn.path = (conn.path or "") + "/data"
+            return str(conn)
+        return os.path.join(remote, "data")
+
+    # -- data plane -----------------------------------------------------------
+    def push(self) -> None:
+        if not self.spec.environment.directory:
+            return
+        transfer(self.spec.environment.directory, self._data_remote(),
+                 self.spec.environment.exclude_list)
+
+    def pull(self) -> None:
+        if not self.spec.environment.directory:
+            return
+        rules = limit_transfer(self.spec.environment.directory_out,
+                               list(self.spec.environment.exclude_list))
+        transfer(self._data_remote(), self.spec.environment.directory, rules)
+
+    # -- observation ----------------------------------------------------------
+    def _folded_status(self, running: int) -> Status:
+        """ACTIVE=running folded with the bucket's status reports; a missing
+        bucket (pre-create, post-delete) is just the initial counters."""
+        initial: Status = {StatusCode.ACTIVE: running}
+        try:
+            return storage_status(self._remote(), initial)
+        except ResourceNotFoundError:
+            return initial
+
+    def logs(self) -> List[str]:
+        try:
+            return storage_logs(self._remote())
+        except ResourceNotFoundError:
+            return []
+
+    def get_identifier(self) -> Identifier:
+        return self.identifier
+
+    def get_addresses(self) -> List[str]:
+        return list(self.spec.addresses)

@@ -62,6 +62,12 @@ class QueuedResourceSpec:
     service_account: str = ""
     network: str = "default"
     zone: str = ""
+    # networkConfig from the task's Firewall model: a spec whose ingress
+    # allows nothing gets no external IP (gcp/task.go:72-128 equivalent for
+    # a slice). tags carries the task identifier so tag-scoped firewall
+    # rules can bind to the node's workers.
+    enable_external_ips: bool = True
+    tags: List[str] = field(default_factory=list)
 
 
 @dataclass
@@ -482,8 +488,11 @@ class RestTpuClient:
                     "node": {
                         "acceleratorType": spec.accelerator_type,
                         "runtimeVersion": spec.runtime_version,
-                        "networkConfig": {"network": spec.network,
-                                          "enableExternalIps": True},
+                        "networkConfig": {
+                            "network": spec.network,
+                            "enableExternalIps": spec.enable_external_ips,
+                        },
+                        **({"tags": spec.tags} if spec.tags else {}),
                         "metadata": {
                             "startup-script": spec.startup_script,
                             **spec.metadata,
@@ -534,6 +543,9 @@ class RestTpuClient:
                 spot=bool(scheduling.get("spot") or scheduling.get("preemptible")),
                 service_account=node.get("serviceAccount", {}).get("email", ""),
                 network=node.get("networkConfig", {}).get("network", "default"),
+                enable_external_ips=bool(node.get("networkConfig", {})
+                                         .get("enableExternalIps", True)),
+                tags=list(node.get("tags", [])),
             )
         return QueuedResourceInfo(name=name, state=state, spec=spec, node_name=node_id)
 

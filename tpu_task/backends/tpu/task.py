@@ -37,21 +37,16 @@ from tpu_task.backends.tpu.api import (
     QueuedResourceSpec,
     RestTpuClient,
 )
+from tpu_task.backends.gcs_remote import GcsRemoteMixin
 from tpu_task.common.cloud import Cloud
 from tpu_task.common.errors import ResourceNotFoundError
 from tpu_task.common.identifier import Identifier, WrongIdentifierError
 from tpu_task.common.ssh import DeterministicSSHKeyPair
 from tpu_task.common.steps import Step, run_steps
-from tpu_task.common.values import Event, Status, StatusCode
+from tpu_task.common.values import Event, Status
 from tpu_task.common.values import Task as TaskSpec
 from tpu_task.machine import render_script
-from tpu_task.storage import (
-    delete_storage,
-    limit_transfer,
-    logs as storage_logs,
-    status as storage_status,
-    transfer,
-)
+from tpu_task.storage import delete_storage
 from tpu_task.task import Task
 
 # Generic region → TPU zone map (the reference's region maps, client.go:47-52).
@@ -79,7 +74,7 @@ def fake_mode() -> bool:
     return bool(os.environ.get("TPU_TASK_FAKE_TPU_ROOT"))
 
 
-class TPUTask(Task):
+class TPUTask(GcsRemoteMixin, Task):
     def __init__(self, cloud: Cloud, identifier: Identifier, spec: TaskSpec):
         self.cloud = cloud
         self.identifier = identifier
@@ -169,6 +164,11 @@ class TPUTask(Task):
         for name, value in {**self._credentials_env(),
                             **variables.enrich()}.items():
             metadata[f"tpu-task-env-{name}"] = value
+        # networkConfig from the Firewall model: an ingress rule that allows
+        # nothing (explicit empty ports or nets — values.py semantics) means
+        # the slice needs no external IP; SSH then rides internal addressing.
+        ingress = self.spec.firewall.ingress
+        external = not (ingress.ports == [] or ingress.nets == [])
         return QueuedResourceSpec(
             node_id="",  # set per queued resource
             accelerator_type=self.accelerator.type,
@@ -180,10 +180,24 @@ class TPUTask(Task):
             labels=dict(self.cloud.tags),
             spot=self.spec.spot >= 0,
             service_account=self.spec.permission_set,
+            enable_external_ips=external,
+            # Slices carry the task identifier as a network tag so
+            # tag-scoped firewall rules (user-managed or the GCE backend's
+            # 6-rule scheme) can bind to exactly this task's workers.
+            tags=[self.identifier.long()],
         )
 
     # -- lifecycle ------------------------------------------------------------
     def create(self) -> None:
+        if self.spec.size.storage > 0:
+            # TPU-VM boot disks are fixed-size and the QueuedResource API
+            # attaches only pre-created data disks; rejecting loudly beats
+            # silently provisioning nothing (honored on cloud=gcp GCE).
+            # Validated here, not in __init__, so read/stop/delete on an
+            # existing task never trip over it.
+            raise ValueError(
+                f"disk_size={self.spec.size.storage} is not supported for "
+                "TPU slices; attach data via storage{} or use a GCE machine")
         run_steps([
             Step(f"Parsing accelerator {self.accelerator.type} "
                  f"({self.accelerator.chips} chips, {self.accelerator.workers} workers)...",
@@ -344,32 +358,7 @@ class TPUTask(Task):
 
             shutil.rmtree(self._bucket_dir, ignore_errors=True)
 
-    # -- data plane -----------------------------------------------------------
-    def push(self) -> None:
-        if not self.spec.environment.directory:
-            return
-        transfer(self.spec.environment.directory,
-                 self._data_remote(),
-                 self.spec.environment.exclude_list)
-
-    def pull(self) -> None:
-        if not self.spec.environment.directory:
-            return
-        rules = limit_transfer(self.spec.environment.directory_out,
-                               list(self.spec.environment.exclude_list))
-        transfer(self._data_remote(), self.spec.environment.directory, rules)
-
-    def _data_remote(self) -> str:
-        remote = self._remote()
-        if remote.startswith(":"):
-            from tpu_task.storage import Connection
-
-            conn = Connection.parse(remote)
-            conn.path = (conn.path or "") + "/data"
-            return str(conn)
-        return os.path.join(remote, "data")
-
-    # -- observation ----------------------------------------------------------
+    # -- observation (data plane inherited from GcsRemoteMixin) ---------------
     def status(self, running: Optional[int] = None) -> Status:
         if running is None:
             running = 0
@@ -382,26 +371,10 @@ class TPUTask(Task):
                             running += 1
                 except ResourceNotFoundError:
                     continue
-        initial: Status = {StatusCode.ACTIVE: running}
-        try:
-            return storage_status(self._remote(), initial)
-        except ResourceNotFoundError:
-            return initial
+        return self._folded_status(running)
 
     def events(self) -> List[Event]:
         return list(self._events) + list(self._recovery_events)
-
-    def logs(self) -> List[str]:
-        try:
-            return storage_logs(self._remote())
-        except ResourceNotFoundError:
-            return []
-
-    def get_identifier(self) -> Identifier:
-        return self.identifier
-
-    def get_addresses(self) -> List[str]:
-        return list(self.spec.addresses)
 
     # -- multi-host fan-out ---------------------------------------------------
     def worker_addresses(self) -> List[str]:

@@ -139,15 +139,23 @@ class Backend:
         return None
 
 
+def contained_path(root: str, key: str) -> str:
+    """Resolve ``key`` under ``root``, refusing escapes. Strict containment:
+    the separator is required, so a sibling directory sharing the root as a
+    string prefix ("/x/data" vs "/x/data2") cannot be reached via "../"."""
+    root = os.path.abspath(root)
+    path = os.path.normpath(os.path.join(root, key))
+    if path != root and not path.startswith(root + os.sep):
+        raise ValueError(f"key escapes backend root: {key!r}")
+    return path
+
+
 class LocalBackend(Backend):
     def __init__(self, root: str):
         self.root = os.path.abspath(root)
 
     def _abs(self, key: str) -> str:
-        path = os.path.normpath(os.path.join(self.root, key))
-        if not path.startswith(self.root):
-            raise ValueError(f"key escapes backend root: {key!r}")
-        return path
+        return contained_path(self.root, key)
 
     def list(self, prefix: str = "") -> List[str]:
         base = self._abs(prefix) if prefix else self.root

@@ -22,7 +22,9 @@ from typing import Dict, List, Optional, Sequence
 from tpu_task.common.errors import ResourceNotFoundError
 from tpu_task.common.values import Status, StatusCode
 from tpu_task.storage import native
-from tpu_task.storage.backends import Backend, Connection, LocalBackend, open_backend
+from tpu_task.storage.backends import (
+    Backend, Connection, LocalBackend, contained_path, open_backend,
+)
 from tpu_task.storage.filters import FilterSet, compile_exclude_list, limit_transfer
 
 logger = logging.getLogger("tpu_task")
@@ -56,17 +58,6 @@ def _for_each(fn, keys: Sequence[str], parallel: bool) -> None:
             fn(key)
 
 
-def _contained(root: str, key: str) -> str:
-    """Resolve ``key`` under ``root``, refusing escapes — an object store may
-    legally hold a key like ``../../etc/x`` and must not write outside the
-    transfer directory (same guard as LocalBackend._abs)."""
-    root = os.path.abspath(root)
-    path = os.path.normpath(os.path.join(root, key))
-    if not path.startswith(root + os.sep):
-        raise ValueError(f"key escapes transfer root: {key!r}")
-    return path
-
-
 def _copy_files(source: Backend, destination: Backend, keys: Sequence[str],
                 src_meta=None) -> None:
     src_root, dst_root = source.local_root(), destination.local_root()
@@ -82,10 +73,12 @@ def _copy_files(source: Backend, destination: Backend, keys: Sequence[str],
         # Stream through the filesystem when one side is local so multi-GB
         # checkpoints never fully materialize in RAM (chunked resumable
         # uploads / parallel ranged downloads on the cloud side).
+        # contained_path: an object store may legally hold a key like
+        # "../../etc/x" and must not write outside the transfer directory.
         if src_root is not None:
-            destination.write_from_file(key, _contained(src_root, key))
+            destination.write_from_file(key, contained_path(src_root, key))
         elif dst_root is not None:
-            source.read_to_file(key, _contained(dst_root, key))
+            source.read_to_file(key, contained_path(dst_root, key))
         else:
             destination.write(key, source.read(key))
         # Preserve modtimes so the incremental diff (size+modtime) converges.
