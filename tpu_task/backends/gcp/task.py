@@ -24,6 +24,7 @@ from tpu_task.backends.gcs_remote import GcsRemoteMixin
 from tpu_task.backends.group_task import GroupBackedTask
 from tpu_task.backends.tpu.accelerators import InvalidAcceleratorError
 from tpu_task.common.cloud import Cloud
+from tpu_task.common.errors import ResourceNotFoundError
 from tpu_task.common.identifier import Identifier
 from tpu_task.common.values import Task as TaskSpec
 from tpu_task.task import Task
@@ -122,13 +123,7 @@ class GCERealTask(GcsRemoteMixin, Task):
     # -- plumbing -------------------------------------------------------------
     def _remote(self) -> str:
         if self.spec.remote_storage is not None:
-            from tpu_task.storage import Connection
-
-            return str(Connection(
-                backend="googlecloudstorage",
-                container=self.spec.remote_storage.container,
-                path=self.spec.remote_storage.path,
-                config=dict(self.spec.remote_storage.config)))
+            return self._remote_storage_connection()
         return self.bucket.connection_string()
 
     def _credentials_env(self) -> Dict[str, str]:
@@ -190,9 +185,16 @@ class GCERealTask(GcsRemoteMixin, Task):
     # -- lifecycle ------------------------------------------------------------
     def create(self) -> None:
         from tpu_task.common.steps import Step, run_steps
+        from tpu_task.storage import check_storage
 
         rules, template = self._resources()
-        steps = [Step("Creating bucket...", self.bucket.create)]
+        if self.spec.remote_storage is not None:
+            # Pre-allocated container: verify access, create nothing
+            # (data_source_bucket.go role).
+            steps = [Step("Verifying bucket...",
+                          lambda: check_storage(self._remote()))]
+        else:
+            steps = [Step("Creating bucket...", self.bucket.create)]
         steps += [Step(f"Creating firewall rule {rule.name}...", rule.create)
                   for rule in rules]
 
@@ -242,14 +244,37 @@ class GCERealTask(GcsRemoteMixin, Task):
                                             self.identifier.long(),
                                             self.spec.firewall, ""):
             rule.delete()
-        self.bucket.delete()
+        if self.spec.remote_storage is not None:
+            # Pre-allocated container: empty only this task's subdirectory,
+            # never delete the user's bucket.
+            from tpu_task.storage import delete_storage
+
+            try:
+                delete_storage(self._remote())
+            except ResourceNotFoundError:
+                pass
+        else:
+            self.bucket.delete()
 
     # -- observation (data plane inherited from GcsRemoteMixin) ---------------
     def status(self, running: Optional[int] = None):
         if running is None:
+            # read() just folded the full MIG fan-out into spec.status; a
+            # poll loop calling read()+status() must not redo ~N requests.
+            if self.spec.status:
+                return self.spec.status
             self.manager.read()
             running = self.manager.running
         return self._folded_status(running)
+
+    def observed_parallelism(self) -> Optional[int]:
+        """targetSize from the MIG's own record (read populates it)."""
+        if self.manager.resource is None:
+            try:
+                self.manager.read()
+            except ResourceNotFoundError:
+                return None
+        return int(self.manager.resource.get("targetSize") or 0) or None
 
     def events(self):
         return list(self.manager.events)

@@ -29,6 +29,18 @@ class GcsRemoteMixin:
     def _remote(self) -> str:
         raise NotImplementedError
 
+    def _remote_storage_connection(self, backend: str = "googlecloudstorage") -> str:
+        """Connection string for a pre-allocated container; an empty path
+        defaults to the task identifier's short form so tasks sharing one
+        container don't interleave mailboxes (gcp/task.go:48-50)."""
+        storage = self.spec.remote_storage
+        if not storage.path:
+            storage.path = self.identifier.short()
+        from tpu_task.storage import Connection
+
+        return str(Connection(backend=backend, container=storage.container,
+                              path=storage.path, config=dict(storage.config)))
+
     def _data_remote(self) -> str:
         remote = self._remote()
         if remote.startswith(":"):

@@ -108,6 +108,13 @@ class GroupBackedTask(Task):
     def stop(self) -> None:
         self.group.scale(0)
 
+    def observed_parallelism(self):
+        """Parallelism from the group's own persisted state (not the spec a
+        bare `read` was constructed with)."""
+        if not self.group.exists():
+            return None
+        return self.group.reconcile().parallelism or None
+
     # -- data plane ------------------------------------------------------------
     def push(self) -> None:
         if not self.spec.environment.directory:

@@ -217,6 +217,17 @@ class K8STask(GroupBackedTask):
             self.read()
         return self.spec.status
 
+    def observed_parallelism(self):
+        """Parallelism from the Job's own spec in real mode (a bare `read`
+        holds only a default TaskSpec)."""
+        if not real_mode():
+            return super().observed_parallelism()
+        try:
+            job = _kubectl_json("get", "job", self.identifier.long())
+        except ResourceNotFoundError:
+            return None
+        return int(job.get("spec", {}).get("parallelism") or 0) or None
+
     def events(self) -> List[Event]:
         if not real_mode():
             return super().events()

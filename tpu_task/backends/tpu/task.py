@@ -106,13 +106,7 @@ class TPUTask(GcsRemoteMixin, Task):
     def _remote(self) -> str:
         """Bucket connection string (StorageCredentials.ConnectionString parity)."""
         if self.spec.remote_storage is not None:
-            config = dict(self.spec.remote_storage.config)
-            from tpu_task.storage import Connection
-
-            return str(Connection(backend="googlecloudstorage",
-                                  container=self.spec.remote_storage.container,
-                                  path=self.spec.remote_storage.path,
-                                  config=config))
+            return self._remote_storage_connection()
         if fake_mode():
             return self._bucket_dir
         config = {}
@@ -211,27 +205,22 @@ class TPUTask(GcsRemoteMixin, Task):
         if fake_mode():
             os.makedirs(self._bucket_dir, exist_ok=True)
             return
-        # Real mode: create the GCS bucket via the JSON API (idempotent).
-        from tpu_task.storage.backends import GCSBackend
+        if self.spec.remote_storage is not None:
+            # Pre-allocated container: verify access, create nothing
+            # (data_source_bucket.go role).
+            from tpu_task.storage import check_storage
 
-        backend = GCSBackend(self.identifier.long(),
-                             config=self._storage_config())
-        if backend.exists():
+            check_storage(self._remote())
             return
-        project = self.client.project  # type: ignore[union-attr]
-        url = f"https://storage.googleapis.com/storage/v1/b?project={project}"
-        body = json.dumps({"name": self.identifier.long(),
-                           "location": self.zone.rsplit("-", 1)[0]}).encode()
-        # Routed through the backend's authorized retry layer (token refresh,
-        # 429/5xx backoff); 409 = bucket already exists, the idempotent path.
-        import urllib.error
+        self._bucket_resource().create()
 
-        try:
-            backend._request("POST", url, data=body,
-                             headers={"Content-Type": "application/json"})
-        except urllib.error.HTTPError as error:
-            if error.code != 409:
-                raise
+    def _bucket_resource(self):
+        from tpu_task.backends.gcp.resources import Bucket
+
+        return Bucket(self.identifier.long(), self.zone,
+                      self.client.project,  # type: ignore[union-attr]
+                      self._storage_config().get(
+                          "service_account_credentials", ""))
 
     def _storage_config(self) -> Dict[str, str]:
         if self.cloud.credentials.gcp and self.cloud.credentials.gcp.application_credentials:
@@ -264,6 +253,11 @@ class TPUTask(GcsRemoteMixin, Task):
         prefix = self.identifier.long() + "-"
         return [name for name in self.client.list_queued_resources()
                 if name.startswith(prefix)]
+
+    def observed_parallelism(self) -> Optional[int]:
+        """Worker-count from the control plane's own record (surviving queued
+        resources), so a bare `read` doesn't trust a defaulted flag."""
+        return len(self._existing_qrs()) or None
 
     def read(self) -> None:
         # Self-destruct: worker 0 leaves a shutdown marker in the bucket at
@@ -349,7 +343,12 @@ class TPUTask(GcsRemoteMixin, Task):
             except ResourceNotFoundError:
                 pass
         self.stop()
+        if not fake_mode() and self.spec.remote_storage is None:
+            # Per-task bucket: empty it AND delete the bucket itself.
+            self._bucket_resource().delete()
+            return
         try:
+            # Pre-allocated container: empty only this task's subdirectory.
             delete_storage(self._remote())
         except ResourceNotFoundError:
             pass

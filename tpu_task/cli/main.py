@@ -30,6 +30,7 @@ from tpu_task.common.values import (
     Environment,
     Firewall,
     FirewallRule,
+    RemoteStorage,
     Size,
     StatusCode,
     Task as TaskSpec,
@@ -40,7 +41,11 @@ logger = logging.getLogger("tpu_task")
 
 
 def build_cloud(args) -> Cloud:
-    return Cloud(provider=Provider(args.cloud), region=args.region)
+    tags = {}
+    for item in getattr(args, "tags", None) or []:
+        name, _, value = item.partition("=")
+        tags[name] = value
+    return Cloud(provider=Provider(args.cloud), region=args.region, tags=tags)
 
 
 def build_spec(args, trailing) -> TaskSpec:
@@ -54,6 +59,18 @@ def build_spec(args, trailing) -> TaskSpec:
         script = "#!/bin/sh\n" + script
     if trailing:
         script += "\n" + " ".join(shlex.quote(part) for part in trailing)
+
+    remote_storage = None
+    if args.storage_container:
+        # Pre-allocated container (the schema's storage{} block —
+        # resource_task.go:120-140): path defaults to the identifier's short
+        # form at the backend when left empty.
+        config = {}
+        for item in args.storage_container_opts or []:
+            name, _, value = item.partition("=")
+            config[name] = value
+        remote_storage = RemoteStorage(container=args.storage_container,
+                                       path=args.storage_path, config=config)
 
     spec = TaskSpec(
         size=Size(machine=args.machine, storage=args.disk_size),
@@ -70,6 +87,7 @@ def build_spec(args, trailing) -> TaskSpec:
         parallelism=args.parallelism,
         permission_set=args.permission_set,
         spot=SPOT_ENABLED if args.spot else SPOT_DISABLED,
+        remote_storage=remote_storage,
     )
     return spec
 
@@ -119,6 +137,7 @@ def cmd_read(args) -> int:
     last = 0
     first_run = True
     waiting = False
+    seen_events = set()
     while True:
         tsk.read()
 
@@ -137,8 +156,26 @@ def cmd_read(args) -> int:
             print(".", end="", file=sys.stderr, flush=True)
 
         for event in tsk.events():
-            logger.debug("%s: %s", event.code, " ".join(event.description))
-        status = _derive_status(tsk.status(), args.parallelism)
+            key = (event.time.isoformat(), event.code, tuple(event.description))
+            if key in seen_events:
+                continue
+            seen_events.add(key)
+            # Recovery/self-destruct events are the preemption-MTTR record —
+            # surface them in the follow loop, not just at debug level.
+            if event.code in ("recover", "REQUEUE", "SUSPEND", "self-destruct"):
+                if waiting:
+                    print(file=sys.stderr)
+                    waiting = False
+                logger.info("%s: %s", event.code, " ".join(event.description))
+            else:
+                logger.debug("%s: %s", event.code, " ".join(event.description))
+
+        # The task's own state knows the real worker count (e.g. surviving
+        # queued resources, group size); a defaulted --parallelism flag must
+        # not make a parallelism-4 task read "succeeded" after one worker.
+        observed = getattr(tsk, "observed_parallelism", lambda: None)()
+        parallelism = max(args.parallelism, observed or 0)
+        status = _derive_status(tsk.status(), parallelism)
 
         delta = "\n".join(lines[last:])
         if delta:
@@ -270,6 +307,18 @@ def make_parser() -> argparse.ArgumentParser:
     create.add_argument("--spot", action="store_true", help="use spot/preemptible capacity")
     create.add_argument("--disk-size", type=int, default=-1, dest="disk_size",
                         help="disk size in gigabytes")
+    create.add_argument("--tags", action="append", metavar="NAME=VALUE",
+                        help="resource tags/labels applied to cloud resources")
+    create.add_argument("--storage-container", default="",
+                        dest="storage_container",
+                        help="pre-allocated storage container (bucket/PVC) "
+                             "instead of a per-task one")
+    create.add_argument("--storage-path", default="", dest="storage_path",
+                        help="subdirectory inside --storage-container "
+                             "(default: the task identifier)")
+    create.add_argument("--storage-container-opts", action="append",
+                        metavar="NAME=VALUE", dest="storage_container_opts",
+                        help="container options (e.g. account=..., key=...)")
     create.add_argument("--timeout", type=int, default=24 * 60 * 60,
                         help="timeout in seconds")
     create.add_argument("--workdir", default=".", help="working directory to upload")
