@@ -1,0 +1,45 @@
+# tpu-task build/test entry points.
+# Role of /root/reference/Makefile:13-47 (build/install/test/smoke/sweep),
+# re-shaped for a Python package: the "binary" is the wheel the worker
+# bootstrap installs (machine/wheel.py stages it into the task bucket).
+
+PYTHON ?= python3
+
+.PHONY: test smoke sweep bench wheel multichip kernels-tpu clean
+
+# Hermetic suite (the reference's `make test`, 30 s budget there; ours spans
+# the fake control planes, sharded-compute CPU checks, and the loopback GCS
+# integration, so the budget is minutes, not seconds).
+test:
+	$(PYTHON) -m pytest tests/ -q
+
+# Real-cloud smoke: full lifecycle with double-invoke idempotency, gated per
+# provider (`make smoke` equivalent; 30 min budget — Makefile:42-44).
+# Usage: SMOKE_TEST_ENABLE_TPU=1 GOOGLE_APPLICATION_CREDENTIALS_DATA=... make smoke
+smoke:
+	$(PYTHON) -m pytest tests/test_smoke_real.py -m smoke -q
+
+# Delete stray smoke-test resources (the always-run sweep job, smoke.yml:96-101).
+sweep:
+	SMOKE_TEST_SWEEP=1 $(PYTHON) -m pytest tests/test_smoke_real.py -m smoke -q
+
+# Headline benchmark: one JSON line (driver contract).
+bench:
+	$(PYTHON) bench.py
+
+# Build the agent wheel the worker bootstrap installs.
+wheel:
+	$(PYTHON) -m pip wheel --no-deps --no-build-isolation -w dist .
+
+# Compile-check the multi-chip sharded train step on a virtual 8-device mesh.
+multichip:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		$(PYTHON) __graft_entry__.py
+
+# Compiled-path kernel correctness on an attached real TPU (not interpret
+# mode): flash fwd+bwd vs the XLA reference at bf16 tolerance.
+kernels-tpu:
+	TPU_TASK_TEST_REAL_TPU=1 $(PYTHON) -m pytest tests/test_ops_attention.py -q
+
+clean:
+	rm -rf dist build *.egg-info ~/.tpu-task/wheels

@@ -220,6 +220,7 @@ def test_create_issues_full_resource_plan(monkeypatch, tmp_path):
                     environment=Environment(script="#!/bin/sh\ntrue"),
                     spot=Spot(-1))
     transport = FakeTransport([
+        ("http", 404),  # recorded-remote probe: template doesn't exist yet
         ("ok", json.dumps({"selfLink": "net-link"}).encode()),   # network
         ("ok", json.dumps({"selfLink": "img-link"}).encode()),   # image
         _done(), _done(), _done(), _done(), _done(), _done(),    # 6 firewalls
@@ -230,20 +231,24 @@ def test_create_issues_full_resource_plan(monkeypatch, tmp_path):
     ])
     task = _real_task(spec, transport)
     task.bucket.create = lambda: None  # GCS exercised in loopback tests
+    monkeypatch.setattr("tpu_task.machine.wheel.stage_wheel", lambda remote: "")
     task.create()
 
     urls = [r.full_url for r in transport.requests]
-    assert "/global/networks/default" in urls[0]
+    assert "/global/networks/default" in urls[1]
     assert sum("/global/firewalls" in u for u in urls) == 6
-    template_insert = json.loads(transport.requests[8].data)
+    template_insert = json.loads(transport.requests[9].data)
     assert template_insert["properties"]["disks"][0]["initializeParams"][
         "diskSizeGb"] == 111
-    assert template_insert["properties"]["metadata"]["items"][1][
-        "key"] == "startup-script"
-    mig_insert = json.loads(transport.requests[10].data)
+    metadata_items = template_insert["properties"]["metadata"]["items"]
+    assert metadata_items[1]["key"] == "startup-script"
+    # The remote is recorded so bare read/delete target the right bucket.
+    assert metadata_items[2]["key"] == "tpu-task-remote"
+    assert task.identifier.long() in metadata_items[2]["value"]
+    mig_insert = json.loads(transport.requests[11].data)
     assert mig_insert["instanceTemplate"] == "tpl-link"
     assert mig_insert["targetSize"] == 0
-    assert urls[11].endswith("/resize?size=1")
+    assert urls[12].endswith("/resize?size=1")
 
 
 def test_read_aggregates_addresses_status_events(monkeypatch):
@@ -261,6 +266,7 @@ def test_read_aggregates_addresses_status_events(monkeypatch):
         ]}).encode()),                                           # listInstances
         ("ok", json.dumps({"networkInterfaces": [{
             "accessConfigs": [{"natIP": "34.1.2.3"}]}]}).encode()),  # instance
+        ("http", 404),  # recorded-remote probe (template gone → default)
     ])
     task.client._urlopen = transport
     monkeypatch.setattr("tpu_task.backends.gcs_remote.storage_status",
@@ -277,6 +283,7 @@ def test_read_aggregates_addresses_status_events(monkeypatch):
 def test_delete_tolerates_missing_resources(monkeypatch):
     task = _real_task(TaskSpec())
     transport = FakeTransport([
+        ("http", 404),  # recorded-remote probe
         ("http", 404),  # MIG delete
         ("http", 404),  # template delete
         ("http", 404), ("http", 404), ("http", 404),
@@ -285,7 +292,7 @@ def test_delete_tolerates_missing_resources(monkeypatch):
     task.client._urlopen = transport
     task.bucket.delete = lambda: None
     task.delete()  # idempotent: no raise
-    assert len(transport.requests) == 8
+    assert len(transport.requests) == 9
 
 
 def test_stop_resizes_to_zero():
@@ -344,3 +351,23 @@ def test_remote_storage_path_defaults_to_identifier():
     spec2 = TaskSpec(remote_storage=RemoteStorage(container="shared",
                                                   path="runs/7"))
     assert _real_task(spec2)._remote() == ":googlecloudstorage:shared/runs/7"
+
+
+def test_bare_read_recovers_recorded_remote(monkeypatch, tmp_path):
+    """A fresh task object with an empty TaskSpec (bare CLI read/delete) must
+    target the storage the task was CREATED with — recovered from the queued
+    resource's own metadata, not guessed from the default per-task bucket."""
+    from tpu_task.backends.tpu.task import TPUTask
+    from tpu_task.common.values import RemoteStorage
+
+    monkeypatch.setenv("TPU_TASK_FAKE_TPU_ROOT", str(tmp_path))
+    cloud = Cloud(provider=Provider.TPU, region="us-central2-b")
+    identifier = Identifier.deterministic("bare-remote")
+    created = TPUTask(cloud, identifier, TaskSpec(
+        size=Size(machine="v4-8"),
+        remote_storage=RemoteStorage(container="shared", path="runs/1")))
+    created.start()  # submits queued resources whose metadata records the remote
+
+    fresh = TPUTask(cloud, identifier, TaskSpec())
+    assert fresh._remote() == ":googlecloudstorage:shared/runs/1"
+    created.stop()

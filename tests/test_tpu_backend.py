@@ -279,8 +279,11 @@ def test_tpu_multihost_workers_all_run(tpu_cloud, tmp_path):
     task.create()
     try:
         # While the slice is alive: all 4 worker endpoints exported.
-        poll(task, lambda t: len(t.get_addresses()) == 4, timeout=15)
-        poll(task, lambda t: t.status().get(StatusCode.SUCCEEDED, 0) >= 4)
+        # Generous timeouts: 4 agent subprocesses + sync loops under full-
+        # suite load can take tens of seconds on a busy machine.
+        poll(task, lambda t: len(t.get_addresses()) == 4, timeout=60)
+        poll(task, lambda t: t.status().get(StatusCode.SUCCEEDED, 0) >= 4,
+             timeout=90)
         logs = "".join(task.logs())
         for rank in range(4):
             assert f"rank={rank}" in logs

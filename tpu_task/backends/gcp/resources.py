@@ -226,7 +226,8 @@ class InstanceTemplate:
                  ssh_public_key: str, ssh_user: str, image_self_link: str,
                  network_self_link: str, firewall_tags: List[str],
                  service_accounts: List[Dict], spot: float,
-                 disk_size_gb: int = -1, labels: Optional[Dict[str, str]] = None):
+                 disk_size_gb: int = -1, labels: Optional[Dict[str, str]] = None,
+                 remote: str = ""):
         self.client = client
         self.name = identifier
         self.machine = machine
@@ -240,6 +241,7 @@ class InstanceTemplate:
         self.spot = spot
         self.disk_size_gb = disk_size_gb
         self.labels = labels or {}
+        self.remote = remote
         self.resource: Optional[dict] = None
 
     def body(self) -> dict:
@@ -291,6 +293,10 @@ class InstanceTemplate:
                 "metadata": {"items": [
                     {"key": "ssh-keys", "value": ssh_keys},
                     {"key": "startup-script", "value": self.startup_script},
+                    # Records the task's storage so a bare read/delete (fresh
+                    # process, empty spec) targets the right bucket.
+                    *([{"key": "tpu-task-remote", "value": self.remote}]
+                      if self.remote else []),
                 ]},
                 "guestAccelerators": accelerators,
             },

@@ -119,12 +119,33 @@ class GCERealTask(GcsRemoteMixin, Task):
                              self.credentials_json)
         self.manager = InstanceGroupManager(self.client, identifier.long(),
                                             parallelism=spec.parallelism)
+        self._remote_record: Optional[str] = None  # lazy template lookup
 
     # -- plumbing -------------------------------------------------------------
     def _remote(self) -> str:
         if self.spec.remote_storage is not None:
             return self._remote_storage_connection()
+        recorded = self._recorded_remote()
+        if recorded:
+            return recorded
         return self.bucket.connection_string()
+
+    def _recorded_remote(self) -> str:
+        """The remote recorded in the instance template's metadata, so a
+        bare read/delete targets the storage the task was created with
+        ('' when the template doesn't exist or records none)."""
+        if self._remote_record is not None:
+            return self._remote_record
+        try:
+            template = self.client.get_instance_template(self.identifier.long())
+        except ResourceNotFoundError:
+            self._remote_record = ""
+            return ""
+        items = template.get("properties", {}).get("metadata", {}).get("items", [])
+        remote = next((item.get("value", "") for item in items
+                       if item.get("key") == "tpu-task-remote"), "")
+        self._remote_record = remote
+        return remote
 
     def _credentials_env(self) -> Dict[str, str]:
         """Env map injected into the VM (data_source_credentials.go:30-49)."""
@@ -147,7 +168,9 @@ class GCERealTask(GcsRemoteMixin, Task):
             _time.time() + timeout.total_seconds(), tz=timezone.utc))
         return render_script(self.spec.environment.script,
                              self._credentials_env(),
-                             self.spec.environment.variables, epoch)
+                             self.spec.environment.variables, epoch,
+                             agent_wheel_url=getattr(
+                                 self, "_agent_wheel_url", ""))
 
     def get_key_pair(self):
         from tpu_task.common.ssh import DeterministicSSHKeyPair
@@ -179,6 +202,7 @@ class GCERealTask(GcsRemoteMixin, Task):
             spot=float(self.spec.spot),
             disk_size_gb=self.spec.size.storage,
             labels=dict(self.cloud.tags),
+            remote=self._remote(),
         )
         return rules, template
 
@@ -187,7 +211,6 @@ class GCERealTask(GcsRemoteMixin, Task):
         from tpu_task.common.steps import Step, run_steps
         from tpu_task.storage import check_storage
 
-        rules, template = self._resources()
         if self.spec.remote_storage is not None:
             # Pre-allocated container: verify access, create nothing
             # (data_source_bucket.go role).
@@ -195,8 +218,16 @@ class GCERealTask(GcsRemoteMixin, Task):
                           lambda: check_storage(self._remote()))]
         else:
             steps = [Step("Creating bucket...", self.bucket.create)]
-        steps += [Step(f"Creating firewall rule {rule.name}...", rule.create)
-                  for rule in rules]
+        run_steps(steps)
+
+        # Stage the agent wheel before rendering the startup script, so the
+        # bootstrap's wheel URL lands in the instance template metadata.
+        from tpu_task.machine.wheel import stage_wheel
+
+        self._agent_wheel_url = stage_wheel(self._remote())
+        rules, template = self._resources()
+        steps = [Step(f"Creating firewall rule {rule.name}...", rule.create)
+                 for rule in rules]
 
         def create_template():
             template.create()
@@ -226,8 +257,10 @@ class GCERealTask(GcsRemoteMixin, Task):
         from tpu_task.backends.gcp.resources import (
             InstanceTemplate, standard_firewall_rules,
         )
-        from tpu_task.common.errors import ResourceNotFoundError
 
+        # Resolve (and cache) the remote BEFORE deleting the template whose
+        # metadata records it.
+        remote = self._remote()
         if self.spec.environment.directory:
             try:
                 self.pull()
@@ -244,17 +277,17 @@ class GCERealTask(GcsRemoteMixin, Task):
                                             self.identifier.long(),
                                             self.spec.firewall, ""):
             rule.delete()
-        if self.spec.remote_storage is not None:
+        if self._is_per_task_bucket(remote):
+            self.bucket.delete()
+        else:
             # Pre-allocated container: empty only this task's subdirectory,
             # never delete the user's bucket.
             from tpu_task.storage import delete_storage
 
             try:
-                delete_storage(self._remote())
+                delete_storage(remote)
             except ResourceNotFoundError:
                 pass
-        else:
-            self.bucket.delete()
 
     # -- observation (data plane inherited from GcsRemoteMixin) ---------------
     def status(self, running: Optional[int] = None):

@@ -34,12 +34,13 @@ class GcsRemoteMixin:
         defaults to the task identifier's short form so tasks sharing one
         container don't interleave mailboxes (gcp/task.go:48-50)."""
         storage = self.spec.remote_storage
-        if not storage.path:
-            storage.path = self.identifier.short()
+        # Computed locally, NOT assigned back: a TaskSpec reused for a second
+        # task must not inherit the first task's defaulted path.
+        path = storage.path or self.identifier.short()
         from tpu_task.storage import Connection
 
         return str(Connection(backend=backend, container=storage.container,
-                              path=storage.path, config=dict(storage.config)))
+                              path=path, config=dict(storage.config)))
 
     def _data_remote(self) -> str:
         remote = self._remote()
@@ -50,6 +51,19 @@ class GcsRemoteMixin:
             conn.path = (conn.path or "") + "/data"
             return str(conn)
         return os.path.join(remote, "data")
+
+    def _is_per_task_bucket(self, remote: str) -> bool:
+        """True when the remote is this task's own bucket (safe to delete
+        outright); False for pre-allocated containers, which only ever get
+        their task subdirectory emptied."""
+        from tpu_task.storage import Connection
+
+        try:
+            conn = Connection.parse(remote)
+        except ValueError:
+            return False
+        return (conn.container == self.identifier.long()
+                and not conn.path.strip("/"))
 
     # -- data plane -----------------------------------------------------------
     def push(self) -> None:

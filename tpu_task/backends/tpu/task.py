@@ -84,6 +84,7 @@ class TPUTask(GcsRemoteMixin, Task):
         self._events: List[Event] = []
         # Recovery events survive across reads — they are the MTTR record.
         self._recovery_events: List[Event] = []
+        self._remote_record: Optional[str] = None  # lazy QR-metadata lookup
 
         if fake_mode():
             self.client = FakeTpuControlPlane()
@@ -104,9 +105,19 @@ class TPUTask(GcsRemoteMixin, Task):
         return f"{self.identifier.long()}-{index}"
 
     def _remote(self) -> str:
-        """Bucket connection string (StorageCredentials.ConnectionString parity)."""
+        """Bucket connection string (StorageCredentials.ConnectionString parity).
+
+        A bare `read`/`delete` (fresh process, empty TaskSpec) must target the
+        storage the task was CREATED with: the queued resource's metadata
+        records the remote, so recover it from the control plane before
+        assuming the default per-task bucket — a task created with a
+        pre-allocated container must not be observed/emptied at the wrong
+        bucket."""
         if self.spec.remote_storage is not None:
             return self._remote_storage_connection()
+        recorded = self._recorded_remote()
+        if recorded:
+            return recorded
         if fake_mode():
             return self._bucket_dir
         config = {}
@@ -117,6 +128,23 @@ class TPUTask(GcsRemoteMixin, Task):
 
         return str(Connection(backend="googlecloudstorage",
                               container=self.identifier.long(), config=config))
+
+    def _recorded_remote(self) -> str:
+        """The remote recorded in a surviving queued resource's metadata
+        ('' when no queued resource holds one — e.g. during create)."""
+        if self._remote_record is not None:
+            return self._remote_record
+        for name in self._existing_qrs():
+            try:
+                info = self.client.get_queued_resource(name)
+            except ResourceNotFoundError:
+                continue
+            remote = info.spec.metadata.get("tpu-task-remote", "")
+            if remote:
+                self._remote_record = remote
+                return remote
+        self._remote_record = ""
+        return ""
 
     def _credentials_env(self) -> Dict[str, str]:
         """Env map injected into workers (data_source_credentials.go:30-49)."""
@@ -143,6 +171,7 @@ class TPUTask(GcsRemoteMixin, Task):
         startup = render_script(
             self.spec.environment.script, self._credentials_env(), variables,
             self._timeout_epoch(),
+            agent_wheel_url=getattr(self, "_agent_wheel_url", ""),
         )
         metadata = {
             # Contract consumed by the fake control plane's worker executor;
@@ -197,9 +226,19 @@ class TPUTask(GcsRemoteMixin, Task):
                  f"({self.accelerator.chips} chips, {self.accelerator.workers} workers)...",
                  lambda: None),
             Step("Creating storage bucket...", self._create_bucket),
+            Step("Staging agent wheel...", self._stage_agent),
             Step("Uploading directory...", self.push),
             Step("Submitting queued resources...", self.start),
         ])
+
+    def _stage_agent(self) -> None:
+        """Upload the tpu-task wheel the worker bootstrap installs
+        (tpu-worker-script.sh.tpl fetches it with a metadata token)."""
+        if fake_mode():
+            return  # hermetic workers run the local agent directly
+        from tpu_task.machine.wheel import stage_wheel
+
+        self._agent_wheel_url = stage_wheel(self._remote())
 
     def _create_bucket(self) -> None:
         if fake_mode():
@@ -337,25 +376,29 @@ class TPUTask(GcsRemoteMixin, Task):
         self.client.create_queued_resource(info.name, spec)
 
     def delete(self) -> None:
+        # Resolve (and cache) the remote BEFORE stop() deletes the queued
+        # resources whose metadata records it.
+        remote = self._remote()
         if self.spec.environment.directory:
             try:
                 self.pull()
             except ResourceNotFoundError:
                 pass
         self.stop()
-        if not fake_mode() and self.spec.remote_storage is None:
+        if not fake_mode() and self._is_per_task_bucket(remote):
             # Per-task bucket: empty it AND delete the bucket itself.
             self._bucket_resource().delete()
             return
         try:
             # Pre-allocated container: empty only this task's subdirectory.
-            delete_storage(self._remote())
+            delete_storage(remote)
         except ResourceNotFoundError:
             pass
         if fake_mode() and os.path.isdir(self._bucket_dir):
             import shutil
 
             shutil.rmtree(self._bucket_dir, ignore_errors=True)
+
 
     # -- observation (data plane inherited from GcsRemoteMixin) ---------------
     def status(self, running: Optional[int] = None) -> Status:

@@ -42,9 +42,13 @@ logger = logging.getLogger("tpu_task")
 
 def build_cloud(args) -> Cloud:
     tags = {}
+    # Both repeated flags and comma-separated pairs, like pflag's
+    # StringToStringVar (create.go:57): --tags a=b,c=d --tags e=f
     for item in getattr(args, "tags", None) or []:
-        name, _, value = item.partition("=")
-        tags[name] = value
+        for pair in item.split(","):
+            name, _, value = pair.partition("=")
+            if name:
+                tags[name] = value
     return Cloud(provider=Provider(args.cloud), region=args.region, tags=tags)
 
 
@@ -138,6 +142,7 @@ def cmd_read(args) -> int:
     first_run = True
     waiting = False
     seen_events = set()
+    observed = None
     while True:
         tsk.read()
 
@@ -173,8 +178,11 @@ def cmd_read(args) -> int:
         # The task's own state knows the real worker count (e.g. surviving
         # queued resources, group size); a defaulted --parallelism flag must
         # not make a parallelism-4 task read "succeeded" after one worker.
-        observed = getattr(tsk, "observed_parallelism", lambda: None)()
-        parallelism = max(args.parallelism, observed or 0)
+        # Resolved once — it's a create-time constant, not worth a control-
+        # plane request per poll tick.
+        if observed is None:
+            observed = getattr(tsk, "observed_parallelism", lambda: None)() or 0
+        parallelism = max(args.parallelism, observed)
         status = _derive_status(tsk.status(), parallelism)
 
         delta = "\n".join(lines[last:])

@@ -27,8 +27,12 @@ def render_script(
     credentials: Dict[str, str],
     variables: Variables,
     timeout: Optional[datetime],
+    agent_wheel_url: str = "",
 ) -> str:
-    """Render the worker bootstrap script (machine.Script equivalent)."""
+    """Render the worker bootstrap script (machine.Script equivalent).
+
+    ``agent_wheel_url`` is the staged agent wheel's authenticated media URL
+    (empty: the bootstrap falls back to the package index)."""
     timeout_string = "infinity" if timeout is None else str(int(timeout.timestamp()))
 
     environment = ""
@@ -49,4 +53,5 @@ def render_script(
         .replace("@VARIABLES@", base64.b64encode(environment.encode()).decode())
         .replace("@CREDENTIALS@", base64.b64encode(export_credentials.encode()).decode())
         .replace("@TIMEOUT@", timeout_string)
+        .replace("@AGENT_WHEEL_URL@", agent_wheel_url)
     )

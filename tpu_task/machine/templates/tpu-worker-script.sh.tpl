@@ -87,6 +87,15 @@ sudo tee /etc/systemd/system/tpu-task.service > /dev/null <<END
 END
 
 # Install the tpu-task agent (data plane + self-destruct CLI) and JAX for TPU.
+# The orchestrator stages the wheel in the task bucket at create time; fetch
+# it with a metadata-server token (no package index required), falling back
+# to the index only when no wheel was staged.
+TPU_TASK_AGENT_WHEEL_URL="@AGENT_WHEEL_URL@"
+if ! command -v tpu-task 2>&1 > /dev/null && test -n "$TPU_TASK_AGENT_WHEEL_URL"; then
+  TPU_TASK_GCS_TOKEN="$(curl -s -H 'Metadata-Flavor: Google' 'http://metadata.google.internal/computeMetadata/v1/instance/service-accounts/default/token' | python3 -c 'import sys, json; print(json.load(sys.stdin)["access_token"])')"
+  curl -sf -H "Authorization: Bearer $TPU_TASK_GCS_TOKEN" -o /tmp/tpu-task-agent.whl "$TPU_TASK_AGENT_WHEEL_URL" \
+    && python3 -m pip install --quiet /tmp/tpu-task-agent.whl
+fi
 if ! command -v tpu-task 2>&1 > /dev/null; then
   python3 -m pip install --quiet tpu-task || pip install --quiet tpu-task
 fi

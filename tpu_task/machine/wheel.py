@@ -1,0 +1,103 @@
+"""Agent wheel: build once, stage into the task bucket, install on workers.
+
+The reference ships `leo` as a static Go binary the bootstrap downloads
+(machine-script.sh.tpl:59-87); the tpu-task equivalent is a pure-Python wheel
+built from this checkout, staged under ``agent/`` in the task's bucket, and
+installed by the worker bootstrap with a metadata-server token — so a real
+TPU-VM bootstrap never depends on the package existing on a package index.
+"""
+
+from __future__ import annotations
+
+import glob
+import logging
+import os
+import subprocess
+import sys
+from typing import Optional
+
+logger = logging.getLogger("tpu_task")
+
+AGENT_PREFIX = "agent"  # bucket subdirectory for the staged wheel
+
+
+def _repo_root() -> Optional[str]:
+    """The checkout containing pyproject.toml, if we're running from one."""
+    package_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    root = os.path.dirname(package_dir)
+    if os.path.exists(os.path.join(root, "pyproject.toml")):
+        return root
+    return None
+
+
+def _cache_dir() -> str:
+    return os.path.join(os.path.expanduser("~/.tpu-task"), "wheels")
+
+
+def _newest_source_mtime(root: str) -> float:
+    newest = os.path.getmtime(os.path.join(root, "pyproject.toml"))
+    for dirpath, dirnames, filenames in os.walk(os.path.join(root, "tpu_task")):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in filenames:
+            if name.endswith((".py", ".tpl", ".cpp")):
+                try:
+                    newest = max(newest,
+                                 os.path.getmtime(os.path.join(dirpath, name)))
+                except OSError:
+                    pass
+    return newest
+
+
+def ensure_wheel() -> Optional[str]:
+    """Build (or reuse) the tpu-task wheel; None when not buildable here
+    (e.g. running from an installed package — the bootstrap then falls back
+    to the package index). The cache is invalidated against source mtimes so
+    agent fixes actually reach workers instead of staging a stale build."""
+    root = _repo_root()
+    cached = sorted(glob.glob(os.path.join(_cache_dir(), "tpu_task-*.whl")))
+    if cached and (root is None
+                   or os.path.getmtime(cached[-1]) >= _newest_source_mtime(root)):
+        return cached[-1]
+    if root is None:
+        return None
+    for stale in cached:
+        try:
+            os.remove(stale)
+        except OSError:
+            pass
+    os.makedirs(_cache_dir(), exist_ok=True)
+    try:
+        subprocess.run(
+            [sys.executable, "-m", "pip", "wheel", "--no-deps",
+             "--no-build-isolation", "--quiet", "-w", _cache_dir(), root],
+            check=True, capture_output=True, text=True, timeout=300)
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired) as error:
+        output = getattr(error, "stderr", "") or str(error)
+        logger.warning("agent wheel build failed (%s); workers will fall "
+                       "back to the package index", output.strip()[-200:])
+        return None
+    built = sorted(glob.glob(os.path.join(_cache_dir(), "tpu_task-*.whl")))
+    return built[-1] if built else None
+
+
+def stage_wheel(remote: str) -> str:
+    """Upload the agent wheel to ``{remote}/agent/``; returns the staged
+    object's authenticated media URL ('' if unavailable)."""
+    import posixpath
+    import urllib.parse
+
+    from tpu_task.storage.backends import BACKEND_GCS, open_backend
+
+    wheel = ensure_wheel()
+    if wheel is None:
+        return ""
+    basename = os.path.basename(wheel)
+    backend, conn = open_backend(remote)
+    key = posixpath.join(AGENT_PREFIX, basename)
+    backend.write_from_file(key, wheel)
+    if conn.backend != BACKEND_GCS:
+        return ""  # local/fake remotes don't run the real bootstrap
+    object_name = posixpath.join(conn.path.strip("/"), key) \
+        if conn.path.strip("/") else key
+    return (f"https://storage.googleapis.com/storage/v1/b/{conn.container}/o/"
+            f"{urllib.parse.quote(object_name, safe='')}?alt=media")
