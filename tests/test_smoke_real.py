@@ -25,7 +25,11 @@ from tpu_task.common.values import Environment, Size, StatusCode, Task as TaskSp
 pytestmark = pytest.mark.smoke
 
 ENABLED = bool(os.environ.get("SMOKE_TEST_ENABLE_TPU"))
-HAS_CREDS = bool(os.environ.get("GOOGLE_APPLICATION_CREDENTIALS_DATA"))
+# Inline JSON or a GOOGLE_APPLICATION_CREDENTIALS file path (what CI's OIDC
+# auth step provides) both count — from_env handles either.
+from tpu_task.common.cloud import GCPCredentials  # noqa: E402
+
+HAS_CREDS = bool(GCPCredentials.from_env().application_credentials)
 
 
 @pytest.mark.skipif(not (ENABLED and HAS_CREDS),

@@ -397,3 +397,30 @@ def test_tpu_cli_end_to_end(tpu_cloud, tmp_path, monkeypatch):
         [sys.executable, "-m", "tpu_task.cli", "--cloud", "tpu",
          "delete", identifier],
         capture_output=True, text=True, timeout=60, env=env).returncode == 0
+
+
+def test_recovery_restores_agent_wheel_url(tpu_cloud, tmp_path, monkeypatch):
+    """A bare-read recovery must re-render the bootstrap WITH the staged
+    agent-wheel URL recorded in the queued resource's metadata — otherwise
+    the respawned worker falls back to a package index that may not have
+    the agent at all."""
+    spec = TaskSpec(size=Size(machine="v4-8"),
+                    environment=Environment(script="#!/bin/bash\nsleep 60\n"),
+                    spot=SPOT_ENABLED)
+    task = task_factory.new(tpu_cloud, Identifier.deterministic("wheel-rec"), spec)
+    task._agent_wheel_url = "https://gcs/b/o/agent.whl?alt=media"
+    task.start()
+    try:
+        qr = task.client.get_queued_resource(task._qr_name(0))
+        assert qr.spec.metadata["tpu-task-agent-wheel"] == \
+            "https://gcs/b/o/agent.whl?alt=media"
+
+        # Fresh process, empty spec: _recover must carry the URL through.
+        bare = task_factory.new(tpu_cloud,
+                                Identifier.deterministic("wheel-rec"),
+                                TaskSpec())
+        info = bare.client.get_queued_resource(task._qr_name(0))
+        bare._recover(info)
+        assert bare._agent_wheel_url == "https://gcs/b/o/agent.whl?alt=media"
+    finally:
+        task.stop()

@@ -175,7 +175,10 @@ class TPUTask(GcsRemoteMixin, Task):
         )
         metadata = {
             # Contract consumed by the fake control plane's worker executor;
-            # harmless extra metadata on real nodes.
+            # harmless extra metadata on real nodes. tpu-task-remote and
+            # tpu-task-agent-wheel also serve as the control-plane record a
+            # bare read/recovery resolves storage and the staged wheel from.
+            "tpu-task-agent-wheel": getattr(self, "_agent_wheel_url", ""),
             "tpu-task-remote": self._remote(),
             "tpu-task-script-b64": base64.b64encode(
                 self.spec.environment.script.encode()).decode(),
@@ -363,6 +366,12 @@ class TPUTask(GcsRemoteMixin, Task):
         self._recovery_events.append(Event(
             time=datetime.now(timezone.utc), code="recover",
             description=[f"re-queueing preempted {info.name}"]))
+        # Recover the staged agent-wheel URL from the QR's own metadata —
+        # a bare-read process never staged one itself, and a re-rendered
+        # bootstrap without it would fall back to the package index.
+        recorded_wheel = info.spec.metadata.get("tpu-task-agent-wheel", "")
+        if recorded_wheel and not getattr(self, "_agent_wheel_url", ""):
+            self._agent_wheel_url = recorded_wheel
         spec = info.spec
         if not spec.accelerator_type or not spec.startup_script:
             # REST reads return a sparse spec (no bootstrap/metadata);

@@ -142,7 +142,7 @@ def cmd_read(args) -> int:
     first_run = True
     waiting = False
     seen_events = set()
-    observed = None
+    observed = 0
     while True:
         tsk.read()
 
@@ -178,9 +178,9 @@ def cmd_read(args) -> int:
         # The task's own state knows the real worker count (e.g. surviving
         # queued resources, group size); a defaulted --parallelism flag must
         # not make a parallelism-4 task read "succeeded" after one worker.
-        # Resolved once — it's a create-time constant, not worth a control-
-        # plane request per poll tick.
-        if observed is None:
+        # Cache only a POSITIVE answer — resources may not exist yet on the
+        # first ticks, and caching that 0 would disable the guard for good.
+        if not observed:
             observed = getattr(tsk, "observed_parallelism", lambda: None)() or 0
         parallelism = max(args.parallelism, observed)
         status = _derive_status(tsk.status(), parallelism)

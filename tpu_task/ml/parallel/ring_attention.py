@@ -166,6 +166,230 @@ def _default_impl() -> str:
     return "pallas" if jax.default_backend() == "tpu" else "xla"
 
 
+# -- zigzag (balanced causal) schedule ----------------------------------------
+#
+# The uniform schedule above computes-and-discards future chunks to keep the
+# collective pattern static, wasting ~2× attention FLOPs for causal masks.
+# The zigzag schedule (ring-flash-attention's balancing trick) removes the
+# waste: the sequence is split into 2P stripes and device i holds the PAIR
+# [stripe i, stripe 2P-1-i]. For any remote source s exactly half the
+# (2 q-stripes × 2 k-stripes) rectangle is causally live —
+#   s < i: both local q stripes attend k's FIRST stripe only;
+#   s > i: only the local SECOND q stripe attends, but to both k stripes —
+# so every device does the same 2c² block work each step (c = seq/(2P)),
+# nothing is discarded, and the diagonal costs 2c² via the block kernel's
+# own causal skipping. Total: ~half the uniform schedule's attention FLOPs.
+
+
+def zigzag_permute(x, devices: int, axis: int = 1):
+    """Global → zigzag layout: stripe order [0, 2P-1, 1, 2P-2, ...] so a
+    contiguous 1/P shard holds stripes (i, 2P-1-i)."""
+    stripes = 2 * devices
+    length = x.shape[axis]
+    if length % stripes:
+        raise ValueError(f"sequence {length} not divisible by 2P={stripes}")
+    order = []
+    for index in range(devices):
+        order += [index, stripes - 1 - index]
+    parts = jnp.split(x, stripes, axis=axis)
+    return jnp.concatenate([parts[j] for j in order], axis=axis)
+
+
+def zigzag_unpermute(x, devices: int, axis: int = 1):
+    """Inverse of :func:`zigzag_permute`."""
+    stripes = 2 * devices
+    order = []
+    for index in range(devices):
+        order += [index, stripes - 1 - index]
+    inverse = [0] * stripes
+    for position, stripe in enumerate(order):
+        inverse[stripe] = position
+    parts = jnp.split(x, stripes, axis=axis)
+    return jnp.concatenate([parts[j] for j in inverse], axis=axis)
+
+
+def _pad_rows(o_half, lse_half, c):
+    """Extend an (o, lse) pair covering the SECOND stripe to all 2c rows
+    (first stripe: zero output, NEG_INF lse — a no-op under folding)."""
+    b, _, h, d = o_half.shape
+    o_full = jnp.concatenate(
+        [jnp.zeros((b, c, h, d), o_half.dtype), o_half], axis=1)
+    lse_full = jnp.concatenate(
+        [jnp.full((b, h, c), NEG_INF, lse_half.dtype), lse_half], axis=2)
+    return o_full, lse_full
+
+
+def _zigzag_fwd_impl(q, k, v, axis_name, impl, interpret):
+    axis_size = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    c = q.shape[1] // 2
+
+    block = functools.partial(
+        block_attention_fwd, impl=impl, interpret=interpret)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    k_cur = lax.ppermute(k, axis_name, perm)
+    v_cur = lax.ppermute(v, axis_name, perm)
+    # Diagonal, two causally-tight blocks:
+    #   rows [0,2c) vs k stripe 1 with q_offset=0 → stripe-1 causal for the
+    #   first c rows, full for the second stripe's rows (col ≤ row);
+    #   second stripe vs k stripe 2, plain causal (stripe-aligned positions).
+    o, lse = block(q, k[:, :c], v[:, :c], True, q_offset=0)
+    o = o.astype(jnp.float32)
+    o_d2, lse_d2 = block(q[:, c:], k[:, c:], v[:, c:], True, q_offset=0)
+    o, lse = _fold(o, lse, *_pad_rows(o_d2, lse_d2, c))
+
+    def body(step, carry):
+        k_cur, v_cur, o, lse = carry
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        src_idx = (my_idx - step) % axis_size
+
+        def from_past(operands):
+            k_c, v_c = operands  # s < i: all q rows × k's first stripe
+            return block(q, k_c[:, :c], v_c[:, :c], False, q_offset=0)
+
+        def from_future(operands):
+            k_c, v_c = operands  # s > i: second q stripe × both k stripes
+            o_half, lse_half = block(q[:, c:], k_c, v_c, False, q_offset=0)
+            return _pad_rows(o_half, lse_half, c)
+
+        o_b, lse_b = lax.cond(src_idx < my_idx, from_past, from_future,
+                              (k_cur, v_cur))
+        o, lse = _fold(o, lse, o_b, lse_b)
+        return k_nxt, v_nxt, o, lse
+
+    _, _, o, lse = lax.fori_loop(1, axis_size, body, (k_cur, v_cur, o, lse))
+    return o.astype(q.dtype), lse
+
+
+def _zigzag_bwd_impl(q, k, v, o, lse, do, axis_name, impl, interpret):
+    axis_size = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    c = q.shape[1] // 2
+
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    ).transpose(0, 2, 1)  # (b, h, 2c)
+
+    block_bwd = functools.partial(
+        block_attention_bwd, impl=impl, interpret=interpret)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    q2, do2 = q[:, c:], do[:, c:]
+    lse2, delta2 = lse[:, :, c:], delta[:, :, c:]
+
+    def pad_q(dq_half):
+        return jnp.concatenate(
+            [jnp.zeros((dq_half.shape[0], c) + dq_half.shape[2:],
+                       jnp.float32), dq_half.astype(jnp.float32)], axis=1)
+
+    def pad_k2(d_half):
+        return jnp.concatenate(
+            [d_half.astype(jnp.float32),
+             jnp.zeros((d_half.shape[0], c) + d_half.shape[2:],
+                       jnp.float32)], axis=1)
+
+    k_cur = lax.ppermute(k, axis_name, perm)
+    v_cur = lax.ppermute(v, axis_name, perm)
+    # Diagonal: mirrors the forward's two causally-tight blocks.
+    dq_a, dk1, dv1 = block_bwd(q, k[:, :c], v[:, :c], do, lse, delta,
+                               True, q_offset=0)
+    dq = dq_a.astype(jnp.float32)
+    dq2_d, dk2, dv2 = block_bwd(q2, k[:, c:], v[:, c:], do2, lse2, delta2,
+                                True, q_offset=0)
+    dq = dq + pad_q(dq2_d)
+    dk_acc = jnp.concatenate(
+        [dk1.astype(jnp.float32), dk2.astype(jnp.float32)], axis=1)
+    dv_acc = jnp.concatenate(
+        [dv1.astype(jnp.float32), dv2.astype(jnp.float32)], axis=1)
+
+    def body(step, carry):
+        k_cur, v_cur, dk_acc, dv_acc, dq = carry
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        dk_in = lax.ppermute(dk_acc, axis_name, perm)
+        dv_in = lax.ppermute(dv_acc, axis_name, perm)
+        src_idx = (my_idx - step) % axis_size
+
+        def from_past(operands):
+            k_c, v_c = operands
+            dq_b, dk_half, dv_half = block_bwd(
+                q, k_c[:, :c], v_c[:, :c], do, lse, delta, False, q_offset=0)
+            return (dq_b.astype(jnp.float32), pad_k2(dk_half),
+                    pad_k2(dv_half))
+
+        def from_future(operands):
+            k_c, v_c = operands
+            dq_half, dk_b, dv_b = block_bwd(
+                q2, k_c, v_c, do2, lse2, delta2, False, q_offset=0)
+            return (pad_q(dq_half), dk_b.astype(jnp.float32),
+                    dv_b.astype(jnp.float32))
+
+        dq_b, dk_b, dv_b = lax.cond(src_idx < my_idx, from_past, from_future,
+                                    (k_cur, v_cur))
+        return (k_nxt, v_nxt, dk_in + dk_b, dv_in + dv_b, dq + dq_b)
+
+    _, _, dk_acc, dv_acc, dq = lax.fori_loop(
+        1, axis_size, body, (k_cur, v_cur, dk_acc, dv_acc, dq))
+    dk = lax.ppermute(dk_acc, axis_name, perm)
+    dv = lax.ppermute(dv_acc, axis_name, perm)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _zigzag_shard(q, k, v, axis_name, impl, interpret):
+    o, _ = _zigzag_fwd_impl(q, k, v, axis_name, impl, interpret)
+    return o
+
+
+def _zigzag_shard_fwd(q, k, v, axis_name, impl, interpret):
+    o, lse = _zigzag_fwd_impl(q, k, v, axis_name, impl, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _zigzag_shard_bwd(axis_name, impl, interpret, res, do):
+    q, k, v, o, lse = res
+    return _zigzag_bwd_impl(q, k, v, o, lse, do, axis_name, impl, interpret)
+
+
+_zigzag_shard.defvjp(_zigzag_shard_fwd, _zigzag_shard_bwd)
+
+
+def zigzag_ring_attention_shard(q, k, v, axis_name: str = "sp",
+                                impl: str | None = None,
+                                interpret: bool = False):
+    """Per-shard zigzag body: local arrays must be in zigzag layout — the
+    device's shard is [stripe i ; stripe 2P-1-i] (use zigzag_permute)."""
+    if impl is None:
+        impl = _default_impl()
+    return _zigzag_shard(q, k, v, axis_name, impl, interpret)
+
+
+def zigzag_ring_attention(q, k, v, mesh, axis_name: str = "sp",
+                          impl: str | None = None, interpret: bool = False):
+    """Global-view balanced causal ring attention (always causal).
+
+    Permutes the sequence into zigzag stripe order, runs the balanced ring
+    under shard_map, and un-permutes the output — exact causal attention at
+    ~half the uniform ring's attention FLOPs.
+    """
+    devices = mesh.shape[axis_name]
+    spec = PartitionSpec(None, axis_name, None, None)
+    fn = jax.shard_map(
+        functools.partial(zigzag_ring_attention_shard, axis_name=axis_name,
+                          impl=impl, interpret=interpret),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=not interpret,
+    )
+    qz = zigzag_permute(q, devices)
+    kz = zigzag_permute(k, devices)
+    vz = zigzag_permute(v, devices)
+    return zigzag_unpermute(fn(qz, kz, vz), devices)
+
+
 def ring_attention_shard(q, k, v, axis_name: str = "sp", causal: bool = True,
                          impl: str | None = None, interpret: bool = False):
     """Per-shard body: call inside ``shard_map`` with seq sharded on axis_name.
