@@ -37,9 +37,12 @@ multichip:
 		$(PYTHON) __graft_entry__.py
 
 # Compiled-path kernel correctness on an attached real TPU (not interpret
-# mode): flash fwd+bwd vs the XLA reference at bf16 tolerance.
+# mode): flash fwd+bwd vs the XLA reference at bf16 tolerance. Selects the
+# test_compiled_* set — the interpret-mode math tests are f32-exact and run
+# in the hermetic suite on CPU.
 kernels-tpu:
-	TPU_TASK_TEST_REAL_TPU=1 $(PYTHON) -m pytest tests/test_ops_attention.py -q
+	TPU_TASK_TEST_REAL_TPU=1 $(PYTHON) -m pytest tests/test_ops_attention.py \
+		-k compiled -q
 
 clean:
 	rm -rf dist build *.egg-info ~/.tpu-task/wheels
