@@ -1,11 +1,13 @@
 """Mixture-of-Experts FFN with expert parallelism over the ``ep`` mesh axis.
 
-Experts are sharded one-per-group across ``ep``; tokens are routed top-1
-(switch-style) with a capacity factor, exchanged via all_to_all inside
-``shard_map``, processed by the local experts, and returned. Router
-jitter/aux-loss keep the load balanced. The dense path
-(``tpu_task.ml.models.transformer``) stays untouched — MoE is an opt-in
-block with the same (batch, seq, d_model) contract.
+Experts are sharded one-per-group across ``ep``; tokens are routed top-k
+(top-1 = switch-style) with a capacity factor, exchanged via all_to_all
+inside ``shard_map``, processed by the local experts, and returned. Router
+jitter/aux-loss keep the load balanced. Slots dropped by the capacity limit
+contribute a gate-weighted identity pass-through instead of zero, so
+over-capacity tokens keep their representation rather than losing signal.
+The dense path (``tpu_task.ml.models.transformer``) stays untouched — MoE is
+an opt-in block with the same (batch, seq, d_model) contract.
 """
 
 from __future__ import annotations
@@ -26,6 +28,10 @@ class MoEConfig:
     n_experts: int = 4
     capacity_factor: float = 1.25
     router_noise: float = 0.0
+    # Experts consulted per token. top_k=1 keeps switch semantics (gate =
+    # winning probability); top_k>1 renormalizes the chosen gates to sum 1
+    # (GShard-style).
+    top_k: int = 1
 
 
 def init(rng, cfg: MoEConfig) -> Dict[str, Any]:
@@ -51,32 +57,41 @@ def param_logical_axes() -> Dict[str, Tuple]:
 
 
 def _route(x, router, cfg: MoEConfig, rng=None):
-    """Top-1 routing: returns (expert_index, gate, aux_loss) per token."""
+    """Top-k routing: (expert_index, gate) of shape (tokens, k) + aux loss."""
     logits = x @ router  # (tokens, n_experts)
     if cfg.router_noise > 0 and rng is not None:
         logits = logits + cfg.router_noise * jax.random.normal(
             rng, logits.shape, logits.dtype)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    expert_index = jnp.argmax(probs, axis=-1)
-    gate = jnp.take_along_axis(probs, expert_index[:, None], axis=-1)[:, 0]
-    # Switch-transformer load-balancing aux loss.
-    density = jnp.mean(jax.nn.one_hot(expert_index, cfg.n_experts), axis=0)
+    gate, expert_index = lax.top_k(probs, cfg.top_k)  # (tokens, k) each
+    if cfg.top_k > 1:
+        gate = gate / jnp.sum(gate, axis=-1, keepdims=True)
+    # Load-balancing aux loss over all k assignments (switch/GShard).
+    assigned = jnp.mean(
+        jax.nn.one_hot(expert_index, cfg.n_experts).sum(axis=1), axis=0)
     density_proxy = jnp.mean(probs, axis=0)
-    aux_loss = cfg.n_experts * jnp.sum(density * density_proxy)
+    aux_loss = cfg.n_experts * jnp.sum(assigned * density_proxy) / cfg.top_k
     return expert_index, gate, aux_loss
 
 
 def apply_dense(params, cfg: MoEConfig, x, rng=None):
-    """Single-device reference: dispatch via one-hot matmuls (no a2a)."""
+    """Single-device reference: dispatch via one-hot matmuls (no a2a, no
+    capacity limit — the exact result the sharded path approaches as
+    capacity grows)."""
     b, s, d = x.shape
     tokens = x.reshape(b * s, d)
     expert_index, gate, aux_loss = _route(tokens, params["router"], cfg, rng)
-    one_hot = jax.nn.one_hot(expert_index, cfg.n_experts, dtype=x.dtype)
-    # (experts, tokens, d): every expert sees its tokens, zeros elsewhere.
-    dispatched = jnp.einsum("te,td->etd", one_hot, tokens)
-    hidden = jax.nn.silu(jnp.einsum("etd,edf->etf", dispatched, params["w_in"]))
-    out = jnp.einsum("etf,efd->etd", hidden, params["w_out"])
-    combined = jnp.einsum("etd,te->td", out, one_hot) * gate[:, None].astype(x.dtype)
+    combined = jnp.zeros_like(tokens)
+    for slot in range(cfg.top_k):
+        one_hot = jax.nn.one_hot(expert_index[:, slot], cfg.n_experts,
+                                 dtype=x.dtype)
+        # (experts, tokens, d): every expert sees its tokens, zeros elsewhere.
+        dispatched = jnp.einsum("te,td->etd", one_hot, tokens)
+        hidden = jax.nn.silu(
+            jnp.einsum("etd,edf->etf", dispatched, params["w_in"]))
+        out = jnp.einsum("etf,efd->etd", hidden, params["w_out"])
+        combined = combined + jnp.einsum("etd,te->td", out, one_hot) * \
+            gate[:, slot, None].astype(x.dtype)
     return combined.reshape(b, s, d), aux_loss
 
 
@@ -99,19 +114,26 @@ def apply_sharded(params, cfg: MoEConfig, x, mesh, axis_name: str = "ep",
         shard_rng = None if rng is None else jax.random.fold_in(
             rng, lax.axis_index(axis_name))
         expert_index, gate, aux_loss = _route(tokens, router, cfg, shard_rng)
-        capacity = max(1, int(cfg.capacity_factor * n_tokens / cfg.n_experts))
+        capacity = max(1, int(cfg.capacity_factor * n_tokens * cfg.top_k
+                              / cfg.n_experts))
 
-        # Position of each token within its expert's capacity buffer:
-        # 0-based arrival order among tokens routed to the same expert.
-        one_hot = jax.nn.one_hot(expert_index, cfg.n_experts, dtype=jnp.int32)
+        # Flatten the (tokens, k) assignments slot-major so primary-slot
+        # assignments win capacity over secondary ones.
+        flat_expert = expert_index.T.reshape(-1)   # (k * n_tokens,)
+        flat_gate = gate.T.reshape(-1)
+        flat_tokens = jnp.tile(tokens, (cfg.top_k, 1))
+
+        # Position of each assignment within its expert's capacity buffer:
+        # 0-based arrival order among assignments routed to the same expert.
+        one_hot = jax.nn.one_hot(flat_expert, cfg.n_experts, dtype=jnp.int32)
         position = jnp.sum(jnp.cumsum(one_hot, axis=0) * one_hot, axis=-1) - 1
         keep = position < capacity
 
         # Dispatch buffer: (n_experts, capacity, d).
         buffer = jnp.zeros((cfg.n_experts, capacity, d), x_local.dtype)
         safe_pos = jnp.where(keep, position, 0)
-        buffer = buffer.at[expert_index, safe_pos].add(
-            tokens * keep[:, None].astype(tokens.dtype))
+        buffer = buffer.at[flat_expert, safe_pos].add(
+            flat_tokens * keep[:, None].astype(tokens.dtype))
 
         # all_to_all: (n_experts, cap, d) → exchange expert groups so each
         # shard holds its experts' tokens from EVERY shard:
@@ -128,9 +150,14 @@ def apply_sharded(params, cfg: MoEConfig, x, mesh, axis_name: str = "ep",
                                   concat_axis=0, tiled=False)
         returned = returned.reshape(cfg.n_experts, capacity, d)
 
-        combined = returned[expert_index, safe_pos] * \
-            keep[:, None].astype(tokens.dtype) * \
-            gate[:, None].astype(tokens.dtype)
+        delivered = returned[flat_expert, safe_pos]
+        # Dropped slots pass the token through unchanged (gate-weighted
+        # identity) instead of zeroing its contribution.
+        slot_out = jnp.where(keep[:, None], delivered, flat_tokens)
+        combined = jnp.sum(
+            (slot_out * flat_gate[:, None].astype(tokens.dtype)).reshape(
+                cfg.top_k, n_tokens, d),
+            axis=0)
         aux = lax.pmean(aux_loss, axis_name)
         return combined.reshape(b, s, d), aux
 

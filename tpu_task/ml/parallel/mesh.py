@@ -80,17 +80,24 @@ def make_mesh(
 def pvary(x, axis_names):
     """Mark ``x`` as device-varying over ``axis_names`` inside shard_map.
 
-    Wraps ``lax.pcast(..., to='varying')`` (new name) with a fallback to the
-    deprecated ``lax.pvary`` on older jax.
+    Idempotent: an input already varying over the axes passes through (the
+    raw primitive rejects varying→varying). Wraps ``lax.pcast(...,
+    to='varying')`` (new name) with a fallback to the deprecated
+    ``lax.pvary`` on older jax.
     """
+    import jax
     from jax import lax
 
+    vma = getattr(jax.typeof(x), "vma", frozenset())
+    missing = tuple(axis for axis in axis_names if axis not in vma)
+    if not missing:
+        return x
     if hasattr(lax, "pcast"):
         try:
-            return lax.pcast(x, axis_names, to="varying")
+            return lax.pcast(x, missing, to="varying")
         except TypeError:
             pass
-    return lax.pvary(x, axis_names)
+    return lax.pvary(x, missing)
 
 
 def worker_env(worker_id: int, num_workers: int, coordinator: str) -> dict:

@@ -1,11 +1,19 @@
-"""GPipe-style pipeline parallelism over a ``pp`` mesh axis.
+"""Pipeline parallelism over a ``pp`` mesh axis: GPipe and 1F1B schedules.
 
 Layers are split into P contiguous stages, one per device along ``pp``;
 the batch is split into M microbatches that stream through the stages with
-``lax.ppermute`` hand-offs. The schedule runs M + P - 1 ticks (fill + drain);
-bubble fraction (P-1)/(M+P-1) shrinks as M grows. Activations and outputs
-stay static-shaped (a single rolling buffer per stage) so XLA compiles one
-program per stage — no data-dependent Python control flow.
+``lax.ppermute`` hand-offs.
+
+* :func:`pipeline_apply` — GPipe forward: M + P - 1 ticks (fill + drain);
+  bubble fraction (P-1)/(M+P-1) shrinks as M grows.
+* :func:`pipeline_train` — 1F1B training schedule: forward and backward
+  interleave per microbatch, so a stage holds at most ~2P in-flight
+  activations instead of all M (the reason 1F1B exists); the backward
+  recomputes each stage's forward from its saved INPUT via ``jax.vjp``
+  (activation recomputation), and gradients accumulate per stage.
+
+Activations and outputs stay static-shaped (rolling buffers per stage) so
+XLA compiles one program per stage — no data-dependent Python control flow.
 """
 
 from __future__ import annotations
@@ -85,3 +93,119 @@ def pipeline_apply(
         out_specs=PartitionSpec(),      # outputs replicated
     )
     return fn(stage_params, micro).reshape(batch, *x.shape[1:])
+
+
+def pipeline_train(
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    stage_params: Any,
+    x: jnp.ndarray,
+    targets: jnp.ndarray,
+    loss_fn: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray],
+    mesh,
+    n_microbatches: int,
+    axis_name: str = "pp",
+):
+    """1F1B pipelined training step: returns ``(mean_loss, grads)``.
+
+    Schedule: stage s runs the forward of microbatch m at tick ``m + s`` and
+    its backward at tick ``m + 2(P-1) - s`` — the last stage's backward for
+    m starts right after its forward (one-forward-one-backward steady
+    state). A stage therefore keeps at most ``2(P-1-s)+1 ≤ 2P-1`` saved
+    INPUTS in a ring buffer; the backward recomputes the stage forward from
+    the saved input with ``jax.vjp`` and accumulates parameter gradients.
+    Total ticks: M + 2P - 2.
+
+    ``loss_fn(out_mb, target_mb) -> scalar`` is evaluated by the LAST stage
+    only; the returned loss is the mean over microbatches. ``grads`` has the
+    same stage-stacked structure (leading axis P, sharded over ``pp``) as
+    ``stage_params``.
+    """
+    n_stages = mesh.shape[axis_name]
+    batch = x.shape[0]
+    if batch % n_microbatches:
+        raise ValueError(f"batch {batch} not divisible by microbatches "
+                         f"{n_microbatches}")
+    mb = batch // n_microbatches
+    micro = x.reshape(n_microbatches, mb, *x.shape[1:])
+    micro_targets = targets.reshape(n_microbatches, mb, *targets.shape[1:])
+    buffer_slots = 2 * n_stages  # ≥ max in-flight (2P-1), power-of-2-ish
+
+    def shard_fn(params_slice, micro_local, targets_local):
+        params_stage = jax.tree.map(lambda p: p[0], params_slice)
+        stage = lax.axis_index(axis_name)
+        ticks = n_microbatches + 2 * (n_stages - 1)
+        fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
+        bwd_perm = [(i + 1, i) for i in range(n_stages - 1)]
+
+        from tpu_task.ml.parallel.mesh import pvary
+
+        zero_mb = pvary(jnp.zeros_like(micro_local[0]), (axis_name,))
+        state = (
+            zero_mb,                                      # forward carry
+            zero_mb,                                      # backward carry (dx)
+            pvary(jnp.zeros((buffer_slots,) + micro_local.shape[1:],
+                            micro_local.dtype), (axis_name,)),  # input ring
+            jax.tree.map(lambda p: pvary(jnp.zeros_like(p), (axis_name,)),
+                         params_stage),                   # grad accumulators
+            pvary(jnp.zeros((), jnp.float32), (axis_name,)),  # loss sum
+        )
+
+        def tick(t, state):
+            fwd_carry, bwd_carry, ring, grads, loss_sum = state
+
+            # ---- forward half: microbatch f = t - stage ----
+            f = t - stage
+            do_fwd = (f >= 0) & (f < n_microbatches)
+            f_index = jnp.clip(f, 0, n_microbatches - 1)
+            inject = micro_local[f_index]
+            inp = jnp.where(stage == 0, inject, fwd_carry)
+            slot_f = jnp.mod(f_index, buffer_slots)
+            ring = jnp.where(do_fwd, ring.at[slot_f].set(inp), ring)
+            out = stage_fn(params_stage, inp)
+
+            # ---- backward half: microbatch b = t - 2(P-1) + stage ----
+            b = t - 2 * (n_stages - 1) + stage
+            do_bwd = (b >= 0) & (b < n_microbatches)
+            b_index = jnp.clip(b, 0, n_microbatches - 1)
+            saved_inp = ring[jnp.mod(b_index, buffer_slots)]
+            out_b, vjp_fn = jax.vjp(stage_fn, params_stage, saved_inp)
+            # Last stage: cotangent from the loss on its own (recomputed)
+            # output; other stages: cotangent arriving from stage s+1.
+            loss_b, dloss = jax.value_and_grad(loss_fn)(
+                out_b, targets_local[b_index])
+            cot = jnp.where(stage == n_stages - 1,
+                            dloss.astype(out_b.dtype), bwd_carry)
+            dparams, dx = vjp_fn(cot)
+            # jnp.where, not a 0/1 multiplier: bubble ticks run the backward
+            # on ring zeros, and 0 * NaN (e.g. a stage whose VJP is singular
+            # at 0) would poison the accumulator.
+            grads = jax.tree.map(
+                lambda g, d: g + jnp.where(do_bwd, d, jnp.zeros_like(d)),
+                grads, dparams)
+            loss_sum = loss_sum + jnp.where(
+                do_bwd & (stage == n_stages - 1), loss_b, 0.0)
+
+            # ---- hand-offs (issued together so transfers overlap) ----
+            fwd_carry = lax.ppermute(out, axis_name, fwd_perm)
+            bwd_carry = lax.ppermute(dx, axis_name, bwd_perm)
+            return fwd_carry, bwd_carry, ring, grads, loss_sum
+
+        _, _, _, grads, loss_sum = lax.fori_loop(0, ticks, tick, state)
+        # Loss lives on the last stage only; replicate. Grads stay per-stage,
+        # scaled to match the MEAN loss (each tick accumulated one
+        # microbatch's unscaled gradient).
+        loss = lax.psum(loss_sum, axis_name) / n_microbatches
+        grads = jax.tree.map(lambda g: g / n_microbatches, grads)
+        return loss, jax.tree.map(lambda g: g[None], grads)
+
+    fn = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(
+            PartitionSpec(axis_name),   # stage-sharded params
+            PartitionSpec(),            # microbatches replicated
+            PartitionSpec(),            # targets replicated
+        ),
+        out_specs=(PartitionSpec(), PartitionSpec(axis_name)),
+    )
+    return fn(stage_params, micro, micro_targets)
