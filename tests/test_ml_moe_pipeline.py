@@ -37,10 +37,12 @@ def test_moe_sharded_matches_dense():
 
 
 def test_moe_capacity_dropped_tokens_pass_through():
-    """Tiny capacity: overflow assignments contribute a gate-weighted
-    IDENTITY instead of zero — over-capacity tokens keep their signal
-    (VERDICT r2 weak #9)."""
-    cfg = moe.MoEConfig(d_model=16, d_ff=32, n_experts=4, capacity_factor=0.5)
+    """Tiny capacity with dropped_identity=True: overflow assignments
+    contribute a gate-weighted IDENTITY instead of zero — for residual-free
+    wirings where zero would erase the token (VERDICT r2 weak #9). The
+    default policy stays zero (the external residual is the pass-through)."""
+    cfg = moe.MoEConfig(d_model=16, d_ff=32, n_experts=4, capacity_factor=0.5,
+                        dropped_identity=True)
     params = moe.init(jax.random.PRNGKey(0), cfg)
     mesh = meshlib.make_mesh(4, axis_names=("ep",), axis_sizes=(4,))
     x = jax.random.normal(jax.random.PRNGKey(1), (4, 8, 16))
@@ -219,3 +221,17 @@ def test_1f1b_rejects_ragged_microbatches():
     with pytest.raises(ValueError, match="divisible"):
         pipeline_train(_stage_mlp, params, x, x,
                        lambda o, t: jnp.mean((o - t) ** 2), mesh, 3)
+
+
+def test_moe_default_drop_policy_is_zero():
+    """Default (external-residual wiring): dropped slots contribute exact
+    zeros — switch semantics, no double-count under x + moe(x)."""
+    cfg = moe.MoEConfig(d_model=16, d_ff=32, n_experts=4, capacity_factor=0.5)
+    params = moe.init(jax.random.PRNGKey(0), cfg)
+    mesh = meshlib.make_mesh(4, axis_names=("ep",), axis_sizes=(4,))
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 8, 16))
+    out, _ = moe.apply_sharded(params, cfg, x, mesh)
+    # With capacity 1 per expert per shard, some tokens must be dropped and
+    # come back as exact zeros.
+    per_token = np.abs(np.asarray(out)).sum(-1)
+    assert (per_token == 0).any()

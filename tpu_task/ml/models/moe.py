@@ -32,6 +32,13 @@ class MoEConfig:
     # winning probability); top_k>1 renormalizes the chosen gates to sum 1
     # (GShard-style).
     top_k: int = 1
+    # Dropped-slot policy. False (default): dropped slots contribute ZERO —
+    # the switch convention, correct when the block is wired with the
+    # standard external residual (x + moe(x)): the residual IS the
+    # pass-through, and adding gate*x here would double-count. True:
+    # dropped slots contribute a gate-weighted identity — for residual-free
+    # wirings where a zero would erase the token's representation.
+    dropped_identity: bool = False
 
 
 def init(rng, cfg: MoEConfig) -> Dict[str, Any]:
@@ -81,17 +88,20 @@ def apply_dense(params, cfg: MoEConfig, x, rng=None):
     b, s, d = x.shape
     tokens = x.reshape(b * s, d)
     expert_index, gate, aux_loss = _route(tokens, params["router"], cfg, rng)
-    combined = jnp.zeros_like(tokens)
-    for slot in range(cfg.top_k):
-        one_hot = jax.nn.one_hot(expert_index[:, slot], cfg.n_experts,
-                                 dtype=x.dtype)
-        # (experts, tokens, d): every expert sees its tokens, zeros elsewhere.
-        dispatched = jnp.einsum("te,td->etd", one_hot, tokens)
-        hidden = jax.nn.silu(
-            jnp.einsum("etd,edf->etf", dispatched, params["w_in"]))
-        out = jnp.einsum("etf,efd->etd", hidden, params["w_out"])
-        combined = combined + jnp.einsum("etd,te->td", out, one_hot) * \
-            gate[:, slot, None].astype(x.dtype)
+    # top_k experts per token are DISTINCT, so the k one-hots are disjoint:
+    # one summed dispatch matrix feeds a single expert pass, and the
+    # gate-weighted combine separates the slots again.
+    one_hot = jax.nn.one_hot(expert_index, cfg.n_experts,
+                             dtype=x.dtype)                 # (t, k, e)
+    dispatch = one_hot.sum(axis=1)                          # (t, e) ∈ {0,1}
+    weights = jnp.einsum("tke,tk->te", one_hot,
+                         gate.astype(x.dtype))              # gate per expert
+    # (experts, tokens, d): every expert sees its tokens, zeros elsewhere.
+    dispatched = jnp.einsum("te,td->etd", dispatch, tokens)
+    hidden = jax.nn.silu(
+        jnp.einsum("etd,edf->etf", dispatched, params["w_in"]))
+    out = jnp.einsum("etf,efd->etd", hidden, params["w_out"])
+    combined = jnp.einsum("etd,te->td", out, weights)
     return combined.reshape(b, s, d), aux_loss
 
 
@@ -151,9 +161,10 @@ def apply_sharded(params, cfg: MoEConfig, x, mesh, axis_name: str = "ep",
         returned = returned.reshape(cfg.n_experts, capacity, d)
 
         delivered = returned[flat_expert, safe_pos]
-        # Dropped slots pass the token through unchanged (gate-weighted
-        # identity) instead of zeroing its contribution.
-        slot_out = jnp.where(keep[:, None], delivered, flat_tokens)
+        if cfg.dropped_identity:
+            slot_out = jnp.where(keep[:, None], delivered, flat_tokens)
+        else:  # switch convention: the external residual is the pass-through
+            slot_out = delivered * keep[:, None].astype(tokens.dtype)
         combined = jnp.sum(
             (slot_out * flat_gate[:, None].astype(tokens.dtype)).reshape(
                 cfg.top_k, n_tokens, d),

@@ -12,6 +12,7 @@ library its user scripts would have to bring themselves.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import Any, Dict, Optional
 
@@ -120,17 +121,55 @@ def _embed_fwd(table, tokens):
     return table[tokens], (tokens, table)
 
 
+# GLOBAL one-hot bytes above which the table gradient accumulates over token
+# chunks (multi-GB at long sequences if XLA declines to fuse it). The count
+# is computed pre-SPMD, so it overestimates per-device bytes by the dp×tp
+# shard factor — the default stays high so the single SPMD-friendly einsum
+# path is kept whenever memory plausibly allows; tune per deployment via
+# TPU_TASK_EMBED_ONEHOT_LIMIT_MB.
+_EMBED_ONEHOT_BYTES_LIMIT = int(os.environ.get(
+    "TPU_TASK_EMBED_ONEHOT_LIMIT_MB", "2048")) * 1024 * 1024
+
+
 def _embed_bwd(res, g):
     tokens, table = res
-    onehot = jax.nn.one_hot(tokens, table.shape[0], dtype=g.dtype)
-    # Accumulate in float32 at full precision — the scatter-add this
-    # replaces was exact, so the matmul must not truncate to bf16.
-    d_table = jnp.einsum(
-        "...v,...d->vd", onehot, g,
-        precision=jax.lax.Precision.HIGHEST,
-        preferred_element_type=jnp.float32,
-    ).astype(table.dtype)
-    return d_table, None
+    vocab = table.shape[0]
+    flat_tokens = tokens.reshape(-1)
+    flat_g = g.reshape(-1, g.shape[-1])
+    n_tokens = flat_tokens.shape[0]
+
+    def onehot_grad(toks, gs):
+        onehot = jax.nn.one_hot(toks, vocab, dtype=gs.dtype)
+        # Accumulate in float32 at full precision — the scatter-add this
+        # replaces was exact, so the matmul must not truncate to bf16.
+        return jnp.einsum(
+            "tv,td->vd", onehot, gs,
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        )
+
+    onehot_bytes = n_tokens * vocab * jnp.dtype(g.dtype).itemsize
+    if onehot_bytes <= _EMBED_ONEHOT_BYTES_LIMIT:
+        d_table = onehot_grad(flat_tokens, flat_g)
+    else:
+        # Chunked accumulation: bounds the materialized one-hot to
+        # chunk × vocab while keeping the SPMD-friendly contraction form
+        # (a scatter-add would force the sharded table to rematerialize).
+        chunk = max(256, _EMBED_ONEHOT_BYTES_LIMIT //
+                    (vocab * jnp.dtype(g.dtype).itemsize))
+        pad = (-n_tokens) % chunk
+        toks = jnp.pad(flat_tokens, (0, pad), constant_values=0)
+        gs = jnp.pad(flat_g, ((0, pad), (0, 0)))  # zero cotangent: no-op rows
+        toks = toks.reshape(-1, chunk)
+        gs = gs.reshape(-1, chunk, gs.shape[-1])
+
+        def body(acc, args):
+            return acc + onehot_grad(*args), None
+
+        d_table, _ = jax.lax.scan(
+            body, jnp.zeros((vocab, flat_g.shape[-1]), jnp.float32),
+            (toks, gs))
+    return d_table.astype(table.dtype), None
 
 
 embed_lookup.defvjp(_embed_fwd, _embed_bwd)
