@@ -1,0 +1,211 @@
+"""Loopback S3 and Azure Blob emulators (REST subsets over real HTTP).
+
+Role: drive the SigV4 S3 backend and SharedKey Azure backend through the
+full urllib/HTTP path hermetically — the rclone-local integration idea
+(storage_test.go:54-107) applied to the cloud backends. Happy-path only:
+auth headers are checked for presence/format, not cryptographically
+verified (the signing math has its own vector tests in test_signing.py).
+Pagination is deliberately tiny (PAGE_SIZE) so the continuation loops run.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict
+from xml.sax.saxutils import escape
+
+PAGE_SIZE = 2  # force pagination in list operations
+
+
+class _BaseHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def _store(self):
+        return self.server.emulator  # type: ignore[attr-defined]
+
+    def _reply(self, code: int, body: bytes = b"",
+               content_type: str = "application/xml") -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_body(self) -> bytes:
+        length = int(self.headers.get("Content-Length", "0"))
+        return self.rfile.read(length) if length else b""
+
+    def log_message(self, *args) -> None:
+        pass
+
+
+class _LoopbackStore:
+    def __init__(self, handler):
+        self.objects: Dict[str, bytes] = {}
+        self.auth_headers: list = []  # recorded for assertions
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        self._server.emulator = self  # type: ignore[attr-defined]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._server.shutdown()
+        self._server.server_close()
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def attach(self, backend) -> None:
+        """Point a backend at this server (host rewritten to loopback)."""
+        port = self.port
+        host = backend.host
+
+        def loopback_urlopen(request, timeout=None):
+            import urllib.request
+
+            url = request.full_url.replace(
+                f"https://{host}", f"http://127.0.0.1:{port}")
+            patched = urllib.request.Request(
+                url, data=request.data, method=request.get_method())
+            for key, value in request.header_items():
+                patched.add_header(key, value)
+            return urllib.request.urlopen(patched, timeout=timeout)
+
+        backend._urlopen = loopback_urlopen
+
+
+class _S3Handler(_BaseHandler):
+    """ListObjectsV2 + object GET/PUT/DELETE (virtual-hosted style: the
+    bucket is in the Host header, the path is the key)."""
+
+    def _authorized(self) -> bool:
+        auth = self.headers.get("Authorization", "")
+        self._store().auth_headers.append(auth)
+        return auth.startswith("AWS4-HMAC-SHA256 Credential=")
+
+    def do_GET(self) -> None:
+        if not self._authorized():
+            self._reply(403, b"<Error>bad auth</Error>")
+            return
+        parsed = urllib.parse.urlparse(self.path)
+        query = urllib.parse.parse_qs(parsed.query)
+        store = self._store()
+        if query.get("list-type", [""])[0] == "2":
+            prefix = query.get("prefix", [""])[0]
+            start = int(query.get("continuation-token", ["0"])[0] or 0)
+            matching = sorted(k for k in store.objects if k.startswith(prefix))
+            page = matching[start:start + PAGE_SIZE]
+            items = "".join(
+                f"<Contents><Key>{escape(key)}</Key>"
+                f"<LastModified>2026-01-01T00:00:00.000Z</LastModified>"
+                f"<Size>{len(store.objects[key])}</Size></Contents>"
+                for key in page)
+            token = ""
+            if start + PAGE_SIZE < len(matching):
+                token = (f"<NextContinuationToken>{start + PAGE_SIZE}"
+                         "</NextContinuationToken>")
+            self._reply(200, (f"<ListBucketResult>{items}{token}"
+                              "</ListBucketResult>").encode())
+            return
+        key = urllib.parse.unquote(parsed.path.lstrip("/"))
+        data = store.objects.get(key)
+        if data is None:
+            self._reply(404, b"<Error><Code>NoSuchKey</Code></Error>")
+        else:
+            self._reply(200, data, "application/octet-stream")
+
+    def do_PUT(self) -> None:
+        if not self._authorized():
+            self._reply(403, b"<Error>bad auth</Error>")
+            return
+        key = urllib.parse.unquote(
+            urllib.parse.urlparse(self.path).path.lstrip("/"))
+        self._store().objects[key] = self._read_body()
+        self._reply(200)
+
+    def do_DELETE(self) -> None:
+        if not self._authorized():
+            self._reply(403, b"<Error>bad auth</Error>")
+            return
+        key = urllib.parse.unquote(
+            urllib.parse.urlparse(self.path).path.lstrip("/"))
+        self._store().objects.pop(key, None)
+        self._reply(204)
+
+
+class _AzureHandler(_BaseHandler):
+    """Container list + blob GET/PUT/DELETE (path: /container/blob)."""
+
+    def _authorized(self) -> bool:
+        auth = self.headers.get("Authorization", "")
+        self._store().auth_headers.append(auth)
+        return auth.startswith("SharedKey ")
+
+    def _split(self, path: str):
+        parts = urllib.parse.unquote(path.lstrip("/")).split("/", 1)
+        return parts[0], (parts[1] if len(parts) > 1 else "")
+
+    def do_GET(self) -> None:
+        if not self._authorized():
+            self._reply(403, b"<Error>bad auth</Error>")
+            return
+        parsed = urllib.parse.urlparse(self.path)
+        query = urllib.parse.parse_qs(parsed.query)
+        store = self._store()
+        if query.get("comp", [""])[0] == "list":
+            prefix = query.get("prefix", [""])[0]
+            start = int(query.get("marker", ["0"])[0] or 0)
+            matching = sorted(k for k in store.objects if k.startswith(prefix))
+            page = matching[start:start + PAGE_SIZE]
+            items = "".join(
+                f"<Blob><Name>{escape(name)}</Name><Properties>"
+                f"<Last-Modified>Thu, 01 Jan 2026 00:00:00 GMT</Last-Modified>"
+                f"<Content-Length>{len(store.objects[name])}</Content-Length>"
+                f"</Properties></Blob>"
+                for name in page)
+            marker = ""
+            if start + PAGE_SIZE < len(matching):
+                marker = f"<NextMarker>{start + PAGE_SIZE}</NextMarker>"
+            self._reply(200, (f"<EnumerationResults><Blobs>{items}</Blobs>"
+                              f"{marker}</EnumerationResults>").encode())
+            return
+        _, blob = self._split(parsed.path)
+        data = store.objects.get(blob)
+        if data is None:
+            self._reply(404, b"<Error>BlobNotFound</Error>")
+        else:
+            self._reply(200, data, "application/octet-stream")
+
+    def do_PUT(self) -> None:
+        if not self._authorized():
+            self._reply(403, b"<Error>bad auth</Error>")
+            return
+        _, blob = self._split(urllib.parse.urlparse(self.path).path)
+        self._store().objects[blob] = self._read_body()
+        self._reply(201)
+
+    def do_DELETE(self) -> None:
+        if not self._authorized():
+            self._reply(403, b"<Error>bad auth</Error>")
+            return
+        _, blob = self._split(urllib.parse.urlparse(self.path).path)
+        self._store().objects.pop(blob, None)
+        self._reply(202)
+
+
+class LoopbackS3(_LoopbackStore):
+    def __init__(self):
+        super().__init__(_S3Handler)
+
+
+class LoopbackAzureBlob(_LoopbackStore):
+    def __init__(self):
+        super().__init__(_AzureHandler)

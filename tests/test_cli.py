@@ -214,3 +214,83 @@ def test_read_surfaces_recovery_events(monkeypatch, caplog):
     recover_logs = [r for r in caplog.records if "re-queueing" in r.message]
     assert len(recover_logs) == 1
     assert recover_logs[0].levelno == logging.INFO
+
+
+def test_main_tf_seeds_flag_defaults(tmp_path, monkeypatch):
+    """main.tf in cwd bridges into flag defaults — the reference's shared
+    HCL-config-to-flag layer (root.go:79-137); explicit flags still win and
+    TASK_* env sits between file and flags."""
+    import importlib
+
+    cli_main = importlib.import_module("tpu_task.cli.main")
+    (tmp_path / "main.tf").write_text('''
+resource "iterative_task" "from-config" {
+  cloud       = "gcp"
+  region      = "us-west1-b"
+  machine     = "m+t4"
+  image       = "nvidia"
+  spot        = 0
+  parallelism = 3
+  disk_size   = 77
+  environment = { FOO = "bar" }
+  tags        = { team = "ml" }
+  storage {
+    workdir   = "src"
+    output    = "results"
+    container = "shared-bkt"
+  }
+}
+''')
+    monkeypatch.chdir(tmp_path)
+
+    args = cli_main.parse_cli_args(["create"])
+    assert args.cloud == "gcp" and args.region == "us-west1-b"
+    assert args.machine == "m+t4" and args.image == "nvidia"
+    assert args.spot is True and args.parallelism == 3
+    assert args.disk_size == 77
+    assert args.environment == ["FOO=bar"] and args.tags == ["team=ml"]
+    assert args.workdir == "src" and args.output == "results"
+    assert args.storage_container == "shared-bkt"
+    assert args.name == "from-config"
+
+    # Explicit flags beat the file; append-action flags REPLACE the
+    # config list, never merge with it.
+    args = cli_main.parse_cli_args(
+        ["create", "--machine", "xl", "--environment", "BAZ=1"])
+    assert args.machine == "xl"
+    assert args.environment == ["BAZ=1"]
+
+    # TASK_* env beats the file (but not flags).
+    monkeypatch.setenv("TASK_MACHINE", "l")
+    assert cli_main.parse_cli_args(["create"]).machine == "l"
+    assert cli_main.parse_cli_args(["create", "--machine", "s"]).machine == "s"
+
+
+def test_config_bridge_survives_malformed_values(tmp_path, monkeypatch):
+    """Typos in main.tf/TASK_* degrade to warnings — `list` on a worker must
+    never crash because of them."""
+    import importlib
+
+    cli_main = importlib.import_module("tpu_task.cli.main")
+    (tmp_path / "main.tf").write_text(
+        'resource "iterative_task" "x" { cloud = "not-a-cloud" }\n')
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("TASK_SPOT", "true")        # boolean string: accepted
+    monkeypatch.setenv("TASK_PARALLELISM", "two")  # garbage: dropped
+    args = cli_main.parse_cli_args(["create"])
+    assert args.cloud == "tpu"        # invalid config cloud dropped
+    assert args.spot is True
+    assert args.parallelism == 1      # unparsable env dropped
+
+    monkeypatch.setenv("TASK_SPOT", "maybe")
+    args = cli_main.parse_cli_args(["create"])
+    assert args.spot is False         # unparsable spot dropped
+
+
+def test_no_main_tf_keeps_builtin_defaults(tmp_path, monkeypatch):
+    import importlib
+
+    cli_main = importlib.import_module("tpu_task.cli.main")
+    monkeypatch.chdir(tmp_path)
+    args = cli_main.parse_cli_args(["create"])
+    assert args.machine == "m" and args.cloud == "tpu"

@@ -1,0 +1,127 @@
+"""S3/Azure backends end-to-end over real HTTP (loopback emulators).
+
+Converts VERDICT r2 rows 14/23 from 'signed but never driven' to
+integration-tested: the full urllib path runs — SigV4/SharedKey headers
+attached, XML listings parsed, pagination loops exercised (PAGE_SIZE=2),
+and the sync engine's transfer drives each backend like a task bucket.
+"""
+
+import os
+
+import pytest
+
+from object_store_emulators import LoopbackAzureBlob, LoopbackS3
+
+from tpu_task.storage.cloud_backends import AzureBlobBackend, S3Backend
+
+
+@pytest.fixture()
+def s3():
+    with LoopbackS3() as server:
+        backend = S3Backend("bkt", "task-1", config={
+            "access_key_id": "AKIDEXAMPLE",
+            "secret_access_key": "secret",
+            "region": "us-east-1",
+        })
+        server.attach(backend)
+        yield server, backend
+
+
+@pytest.fixture()
+def azure():
+    with LoopbackAzureBlob() as server:
+        backend = AzureBlobBackend("ctr", "task-1", config={
+            "account": "acct", "key": "a2V5c2VjcmV0"})
+        server.attach(backend)
+        yield server, backend
+
+
+def test_s3_roundtrip_and_auth(s3):
+    server, backend = s3
+    backend.write("reports/status-1", b'{"code": "0"}')
+    assert backend.read("reports/status-1") == b'{"code": "0"}'
+    assert server.objects == {"task-1/reports/status-1": b'{"code": "0"}'}
+    backend.delete("reports/status-1")
+    assert backend.list() == []
+    assert all(a.startswith("AWS4-HMAC-SHA256 Credential=AKIDEXAMPLE/")
+               for a in server.auth_headers)
+
+
+def test_s3_list_paginates(s3):
+    server, backend = s3
+    for index in range(5):  # PAGE_SIZE=2 → 3 pages
+        backend.write(f"data/f{index}.txt", b"x" * index)
+    assert backend.list() == [f"data/f{i}.txt" for i in range(5)]
+    meta = backend.list_meta()
+    assert meta["data/f3.txt"][0] == 3
+
+
+def test_s3_missing_key_maps_not_found(s3):
+    from tpu_task.common.errors import ResourceNotFoundError
+
+    _, backend = s3
+    with pytest.raises(ResourceNotFoundError):
+        backend.read("nope")
+
+
+def test_s3_sync_transfer_roundtrip(s3, tmp_path):
+    """The sync engine drives S3 like a task bucket: push, then pull."""
+    import importlib
+
+    from tpu_task.storage.filters import compile_exclude_list
+
+    sync_mod = importlib.import_module("tpu_task.storage.sync")
+    server, backend = s3
+    src = tmp_path / "work"
+    (src / "sub").mkdir(parents=True)
+    (src / "a.txt").write_text("alpha")
+    (src / "sub" / "b.bin").write_bytes(os.urandom(128))
+
+    # Route open_backend to the attached loopback backend for this remote.
+    real_open = sync_mod.open_backend
+
+    def fake_open(remote):
+        if remote == "s3://loop":
+            return backend, None
+        return real_open(remote)
+
+    sync_mod.open_backend, saved = fake_open, real_open
+    try:
+        sync_mod._transfer(str(src), "s3://loop",
+                           compile_exclude_list([]), False)
+        out = tmp_path / "restored"
+        sync_mod._transfer("s3://loop", str(out),
+                           compile_exclude_list([]), False)
+    finally:
+        sync_mod.open_backend = saved
+    assert (out / "a.txt").read_text() == "alpha"
+    assert (out / "sub" / "b.bin").read_bytes() == \
+        (src / "sub" / "b.bin").read_bytes()
+
+
+def test_azure_roundtrip_and_auth(azure):
+    server, backend = azure
+    backend.write("data/model.bin", b"weights")
+    assert backend.read("data/model.bin") == b"weights"
+    assert server.objects == {"task-1/data/model.bin": b"weights"}
+    backend.delete("data/model.bin")
+    assert backend.list() == []
+    assert all(a.startswith("SharedKey acct:")
+               for a in server.auth_headers)
+
+
+def test_azure_list_paginates(azure):
+    server, backend = azure
+    for index in range(5):
+        backend.write(f"logs/l{index}.txt", b"y" * (index + 1))
+    assert backend.list() == [f"logs/l{i}.txt" for i in range(5)]
+    meta = backend.list_meta()
+    assert meta["logs/l4.txt"][0] == 5
+
+
+def test_azure_missing_blob_maps_not_found(azure):
+    from tpu_task.common.errors import ResourceNotFoundError
+
+    _, backend = azure
+    with pytest.raises(ResourceNotFoundError):
+        backend.read("missing")

@@ -19,6 +19,7 @@ import shlex
 import sys
 import time
 from datetime import timedelta
+from typing import Optional
 
 from tpu_task import task as task_factory
 from tpu_task.common.cloud import Cloud, Provider
@@ -285,7 +286,125 @@ def cmd_storage(args) -> int:
     return 0
 
 
-def make_parser() -> argparse.ArgumentParser:
+# Flags seedable from main.tf / TASK_* env (cmd/leo/root.go:96-137's list).
+_GLOBAL_CONFIG_FLAGS = ("cloud", "region")
+_CREATE_CONFIG_FLAGS = ("image", "machine", "name", "parallelism",
+                        "permission_set", "script", "spot", "disk_size",
+                        "timeout")
+# append-action flags: seeded AFTER parsing (parser-level defaults would
+# MERGE with explicit flags instead of being replaced by them).
+_APPEND_CONFIG_FLAGS = ("environment", "tags", "exclude",
+                        "storage_container_opts")
+
+
+def config_defaults(directory: str = ".") -> dict:
+    """Flag defaults bridged from ``main.tf`` and ``TASK_*`` env vars.
+
+    The reference's CLI and Terraform front-end share one config format via
+    viper's HCL-file→flag bridge (root.go:79-137); same here: a main.tf in
+    the working directory seeds defaults for every flag it names (explicit
+    command-line flags still win), then ``TASK_<FLAG>`` environment
+    variables override the file. Multiple task resources: last one wins
+    (viper.Set semantics).
+    """
+    import os as _os
+
+    defaults: dict = {}
+    path = _os.path.join(directory, "main.tf")
+    if _os.path.exists(path):
+        from tpu_task.frontend.declarative import TASK_RESOURCE_TYPES
+        from tpu_task.frontend.hcl import HclError, parse_hcl
+
+        try:
+            root = parse_hcl(open(path).read())
+        except (HclError, OSError, UnicodeDecodeError) as error:
+            # Config seeding must never take the CLI down — warn and run
+            # with builtin defaults.
+            logger.warning("ignoring unreadable main.tf: %s", error)
+            root = None
+        if root is not None:
+            for block in root.find("resource"):
+                if len(block.labels) != 2 or \
+                        block.labels[0] not in TASK_RESOURCE_TYPES:
+                    continue
+                body = dict(block.body)
+                for nested in block.blocks:  # nested blocks → body entries
+                    body.setdefault(nested.type, nested.body)
+                defaults["name"] = block.labels[1]
+                for option in _GLOBAL_CONFIG_FLAGS + _CREATE_CONFIG_FLAGS:
+                    if option in body:
+                        defaults[option] = body[option]
+                for mapping, flag in (("environment", "environment"),
+                                      ("tags", "tags")):
+                    if isinstance(body.get(mapping), dict):
+                        defaults[flag] = [
+                            f"{key}={value if value is not None else ''}"
+                            for key, value in body[mapping].items()]
+                storage = body.get("storage")
+                if isinstance(storage, dict):
+                    for key, flag in (("workdir", "workdir"),
+                                      ("output", "output"),
+                                      ("container", "storage_container"),
+                                      ("container_path", "storage_path")):
+                        if key in storage:
+                            defaults[flag] = storage[key]
+                    if "exclude" in storage:
+                        defaults["exclude"] = list(storage["exclude"])
+                    if isinstance(storage.get("container_opts"), dict):
+                        defaults["storage_container_opts"] = [
+                            f"{key}={value}" for key, value
+                            in storage["container_opts"].items()]
+    # TASK_* env overrides the file (viper.SetEnvPrefix("task")).
+    for option in _GLOBAL_CONFIG_FLAGS + _CREATE_CONFIG_FLAGS:
+        value = _os.environ.get(f"TASK_{option.upper()}")
+        if value is not None:
+            defaults[option] = value
+
+    # Normalize/validate values the file/env deliver as strings — a typo in
+    # main.tf or a TASK_* var must degrade to a warning, never crash `list`
+    # on a worker.
+    def drop(option, reason):
+        logger.warning("ignoring configured %s: %s", option, reason)
+        defaults.pop(option, None)
+
+    for option in ("parallelism", "disk_size", "timeout"):
+        if option in defaults:
+            try:
+                defaults[option] = int(defaults[option])
+            except (TypeError, ValueError):
+                drop(option, f"not an integer: {defaults[option]!r}")
+    if "spot" in defaults and not isinstance(defaults["spot"], bool):
+        raw = defaults["spot"]
+        if isinstance(raw, str) and raw.strip().lower() in (
+                "true", "false", "yes", "no"):
+            defaults["spot"] = raw.strip().lower() in ("true", "yes")
+        else:
+            try:
+                # The schema's spot is a price (float, -1 disabled); the CLI
+                # flag is boolean — any value >= 0 enables spot capacity.
+                defaults["spot"] = float(raw) >= 0
+            except (TypeError, ValueError):
+                drop("spot", f"not a boolean or price: {raw!r}")
+    if "cloud" in defaults:
+        valid = [provider.value for provider in Provider]
+        if defaults["cloud"] not in valid:
+            drop("cloud", f"{defaults['cloud']!r} not one of {valid}")
+    return defaults
+
+
+def parse_cli_args(argv=None):
+    """Parse argv with main.tf/TASK_* seeding; append-action flags are
+    filled from config only when not given explicitly (flags REPLACE
+    config lists — viper semantics — rather than appending to them)."""
+    defaults = config_defaults()
+    args = make_parser(defaults).parse_args(argv)
+    for flag in _APPEND_CONFIG_FLAGS:
+        if flag in defaults and getattr(args, flag, None) is None:
+            setattr(args, flag, list(defaults[flag]))
+    return args
+
+
+def make_parser(defaults: Optional[dict] = None) -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="tpu-task",
         description="Run ephemeral ML tasks on Cloud TPU (and other backends) "
@@ -384,6 +503,17 @@ def make_parser() -> argparse.ArgumentParser:
         verb_parser.add_argument("--exclude", action="append")
         verb_parser.set_defaults(func=cmd_storage)
 
+    if defaults:
+        # Parser-level defaults beat argument-level defaults but lose to
+        # explicit flags — exactly the config < env < flag precedence the
+        # reference's viper bridge implements. Append-action flags are
+        # excluded (argparse would APPEND explicit flags to the default
+        # list); parse_cli_args fills those post-parse instead.
+        parser.set_defaults(**{key: value for key, value in defaults.items()
+                               if key in _GLOBAL_CONFIG_FLAGS})
+        create.set_defaults(**{
+            key: value for key, value in defaults.items()
+            if key not in _GLOBAL_CONFIG_FLAGS + _APPEND_CONFIG_FLAGS})
     return parser
 
 
@@ -391,7 +521,7 @@ def main(argv=None) -> int:
     from tpu_task.utils.logger import configure_logging
     from tpu_task.utils.telemetry import send_event, wait_for_telemetry
 
-    args = make_parser().parse_args(argv)
+    args = parse_cli_args(argv)
     configure_logging(verbose=args.verbose)
     action = f"cli_{args.subcommand}"
     try:

@@ -4,10 +4,11 @@ Experts are sharded one-per-group across ``ep``; tokens are routed top-k
 (top-1 = switch-style) with a capacity factor, exchanged via all_to_all
 inside ``shard_map``, processed by the local experts, and returned. Router
 jitter/aux-loss keep the load balanced. Slots dropped by the capacity limit
-contribute a gate-weighted identity pass-through instead of zero, so
-over-capacity tokens keep their representation rather than losing signal.
-The dense path (``tpu_task.ml.models.transformer``) stays untouched — MoE is
-an opt-in block with the same (batch, seq, d_model) contract.
+contribute ZERO by default (the switch convention — the block's external
+residual is the pass-through); set ``dropped_identity=True`` for a
+gate-weighted identity in residual-free wirings. The dense path
+(``tpu_task.ml.models.transformer``) stays untouched — MoE is an opt-in
+block with the same (batch, seq, d_model) contract.
 """
 
 from __future__ import annotations
