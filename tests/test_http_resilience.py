@@ -34,8 +34,8 @@ class FakeResponse:
 class FakeTransport:
     """Plays a script of responses; records every request it sees.
 
-    Script entries: ("ok", body[, headers]) | ("http", code[, headers]) |
-    ("conn",).
+    Script entries: ("ok", body[, headers]) | ("http", code[, headers[,
+    body]]) | ("conn",).
     """
 
     def __init__(self, script):
@@ -55,13 +55,14 @@ class FakeTransport:
         if kind == "http":
             code = entry[1]
             headers = entry[2] if len(entry) > 2 else {}
+            error_body = entry[3] if len(entry) > 3 else b""
             import email.message
 
             message = email.message.Message()
             for key, value in headers.items():
                 message[key] = value
             raise urllib.error.HTTPError(
-                request.full_url, code, "err", message, io.BytesIO(b""))
+                request.full_url, code, "err", message, io.BytesIO(error_body))
         if kind == "conn":
             raise urllib.error.URLError("connection reset")
         raise AssertionError(f"unknown script entry {entry!r}")

@@ -1,8 +1,10 @@
 from tpu_task.backends.aws.task import (
     AWS_REGIONS,
     AWS_SIZES,
+    AWSRealTask,
     AWSTask,
     list_aws_tasks,
+    new_aws_task,
     resolve_aws_machine,
     resolve_aws_region,
     validate_instance_profile_arn,
@@ -11,8 +13,10 @@ from tpu_task.backends.aws.task import (
 __all__ = [
     "AWS_REGIONS",
     "AWS_SIZES",
+    "AWSRealTask",
     "AWSTask",
     "list_aws_tasks",
+    "new_aws_task",
     "resolve_aws_machine",
     "resolve_aws_region",
     "validate_instance_profile_arn",

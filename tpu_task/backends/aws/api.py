@@ -1,0 +1,125 @@
+"""AWS control-plane client: EC2 + Auto Scaling Query APIs over SigV4.
+
+The reference drives AWS through aws-sdk-go-v2 (/root/reference/task/aws/
+client/client.go); this client speaks the raw Query protocol — form-encoded
+``Action`` POSTs signed with the same SigV4 layer the S3 data plane uses
+(:mod:`tpu_task.storage.signing`), XML responses parsed with the stdlib.
+Transient failures ride the shared retry/backoff layer; AWS error codes map
+to the common NotFound/AlreadyExists semantics so every resource keeps the
+reference's idempotency discipline.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+import time
+import urllib.error
+import urllib.parse
+from typing import Dict, List, Optional
+from xml.etree import ElementTree
+
+from tpu_task.common.errors import ResourceAlreadyExistsError, ResourceNotFoundError
+from tpu_task.storage.signing import sigv4_sign
+
+# Error codes that mean "already there" / "not there" across EC2 and
+# autoscaling (smithy APIError codes the reference matches by string).
+_ALREADY_EXISTS = ("AlreadyExists", "Duplicate", "InvalidKeyPair.Duplicate",
+                   "InvalidGroup.Duplicate", "InvalidLaunchTemplateName."
+                   "AlreadyExistsException")
+_NOT_FOUND = ("NotFound", "NotFoundException", "InvalidGroup.NotFound",
+              "InvalidLaunchTemplateName.NotFoundException",
+              "InvalidKeyPair.NotFound")
+
+
+def _strip_namespaces(xml_text: bytes) -> ElementTree.Element:
+    """Parse XML with namespaces removed — AWS responses carry per-service
+    default namespaces that would otherwise infect every find()."""
+    text = re.sub(rb'xmlns="[^"]+"', b"", xml_text, count=1)
+    return ElementTree.fromstring(text)
+
+
+class AwsQueryError(RuntimeError):
+    def __init__(self, code: str, message: str):
+        super().__init__(f"{code}: {message}")
+        self.code = code
+
+
+class QueryClient:
+    """One AWS Query-protocol service endpoint (ec2 / autoscaling)."""
+
+    def __init__(self, service: str, version: str, region: str,
+                 access_key: str, secret_key: str, session_token: str = "",
+                 host: str = ""):
+        self.service = service
+        self.version = version
+        self.region = region
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.session_token = session_token
+        self.host = host or f"{service}.{region}.amazonaws.com"
+        self._urlopen = None  # test hook: injectable transport
+        self._sleep = None    # test hook: injectable backoff sleep
+
+    def call(self, action: str, params: Optional[Dict[str, str]] = None
+             ) -> ElementTree.Element:
+        from tpu_task.storage.http_util import send
+
+        form = {"Action": action, "Version": self.version, **(params or {})}
+        body = urllib.parse.urlencode(sorted(form.items())).encode()
+        headers = sigv4_sign(
+            "POST", self.host, "/", {},
+            {"content-type": "application/x-www-form-urlencoded"},
+            hashlib.sha256(body).hexdigest(),
+            self.access_key, self.secret_key, self.region, self.service,
+            time.strftime("%Y%m%dT%H%M%SZ", time.gmtime()),
+            self.session_token)
+        headers["Content-Type"] = "application/x-www-form-urlencoded"
+        try:
+            response = send("POST", f"https://{self.host}/", data=body,
+                            headers=headers, urlopen=self._urlopen,
+                            sleep=self._sleep or time.sleep)
+        except urllib.error.HTTPError as error:
+            raise self._map_error(error) from error
+        return _strip_namespaces(response)
+
+    def _map_error(self, error: urllib.error.HTTPError) -> Exception:
+        body = b""
+        try:
+            body = error.read() or b""
+        except Exception:
+            pass
+        code_match = re.search(rb"<Code>([^<]+)</Code>", body)
+        message_match = re.search(rb"<Message>([^<]*)</Message>", body)
+        code = code_match.group(1).decode() if code_match else str(error.code)
+        message = message_match.group(1).decode() if message_match else ""
+        if any(code.endswith(marker) or marker in code
+               for marker in _ALREADY_EXISTS):
+            return ResourceAlreadyExistsError(f"{code}: {message}")
+        if any(code.endswith(marker) or marker in code
+               for marker in _NOT_FOUND):
+            return ResourceNotFoundError(f"{code}: {message}")
+        # The Auto Scaling API answers ValidationError for nearly every bad
+        # request; only the "name not found" variant is a NotFound —
+        # anything else must surface, not be swallowed by idempotent deletes.
+        if code == "ValidationError" and "not found" in message.lower():
+            return ResourceNotFoundError(f"{code}: {message}")
+        return AwsQueryError(code, message)
+
+
+def member_list(prefix: str, values: List[str],
+                member: bool = False) -> Dict[str, str]:
+    """AWS Query list encoding: ``Prefix.N`` (EC2) or ``Prefix.member.N``
+    (autoscaling)."""
+    infix = ".member." if member else "."
+    return {f"{prefix}{infix}{index + 1}": value
+            for index, value in enumerate(values)}
+
+
+def texts(root: ElementTree.Element, path: str) -> List[str]:
+    return [element.text or "" for element in root.iterfind(path)]
+
+
+def text(root: ElementTree.Element, path: str, default: str = "") -> str:
+    element = root.find(path)
+    return element.text if element is not None and element.text else default

@@ -1,24 +1,30 @@
-"""AWS backend: reference-parity semantics on the hermetic control plane.
+"""AWS backend: real EC2/ASG control plane (with credentials) or hermetic.
 
 Size map and region map mirror /root/reference/task/aws/resources/
 resource_launch_template.go:61-73 and task/aws/client/client.go:22-27; the
 instance-profile ARN validator mirrors data_source_permission_set.go:15-40.
 Spot semantics (ASG MixedInstancesPolicy, resource_auto_scaling_group.go:
 64-90): any spot >= 0 is accepted — >0 is the max bid, 0 means 100% spot at
-on-demand cap. The real EC2/S3 control plane is not wired in this round
-(the framework's north star is Cloud TPU — SURVEY.md §7 stage 7); lifecycle
-semantics run end-to-end on the hermetic scaling-group plane so a future
-REST client drops into a tested seam.
+on-demand cap. With AWS credentials configured, AWSRealTask provisions the
+reference's resource DAG (VPC/subnets/image data sources; S3 bucket,
+security group, key pair, launch template, auto-scaling group) over the
+Query APIs; without credentials the hermetic scaling-group plane keeps the
+semantics testable.
 """
 
 from __future__ import annotations
 
+import os
 import re
-from typing import Dict, List
+from typing import Dict, List, Optional
 
+from tpu_task.backends.gcs_remote import GcsRemoteMixin
 from tpu_task.backends.group_task import GroupBackedTask
 from tpu_task.common.cloud import Cloud
+from tpu_task.common.errors import ResourceNotFoundError
 from tpu_task.common.identifier import Identifier, WrongIdentifierError
+from tpu_task.common.values import Task as TaskSpec
+from tpu_task.task import Task
 
 AWS_SIZES: Dict[str, str] = {
     "s": "t2.micro",
@@ -68,6 +74,20 @@ def validate_instance_profile_arn(arn: str) -> str:
     return arn
 
 
+def _aws_real_mode(cloud: Cloud) -> bool:
+    """Real Query APIs when credentials are configured and the hermetic
+    plane isn't forced (mirrors the GCE backend's gate)."""
+    if os.environ.get("TPU_TASK_FAKE_TPU_ROOT"):
+        return False
+    return bool(cloud.credentials.aws and cloud.credentials.aws.access_key_id)
+
+
+def new_aws_task(cloud: Cloud, identifier: Identifier, spec: TaskSpec):
+    if _aws_real_mode(cloud):
+        return AWSRealTask(cloud, identifier, spec)
+    return AWSTask(cloud, identifier, spec)
+
+
 class AWSTask(GroupBackedTask):
     provider_name = "aws"
 
@@ -87,13 +107,284 @@ class AWSTask(GroupBackedTask):
         return env
 
 
+class AWSRealTask(GcsRemoteMixin, Task):
+    """AWS task over the real EC2 + Auto Scaling control plane.
+
+    Composition parity with /root/reference/task/aws/task.go:28-196: ordered
+    step plan — VPC/subnets/image reads, S3 bucket, security group,
+    deterministic key pair, launch template with the rendered bootstrap as
+    UserData, ASG at desired 0 — then Push and Start (DesiredCapacity =
+    parallelism). Read aggregates running instances → Status/Addresses and
+    scaling activities → Events (resource_auto_scaling_group.go:108-186).
+    """
+
+    def __init__(self, cloud: Cloud, identifier: Identifier, spec: TaskSpec):
+        from tpu_task.backends.aws.api import QueryClient
+        from tpu_task.backends.aws.resources import (
+            ASG_VERSION, EC2_VERSION, AutoScalingGroup, S3Bucket,
+        )
+
+        self.cloud = cloud
+        self.identifier = identifier
+        self.spec = spec
+        self.instance_type = resolve_aws_machine(spec.size.machine or "m")
+        self.region = resolve_aws_region(str(cloud.region))
+        validate_instance_profile_arn(spec.permission_set)
+        creds = cloud.credentials.aws
+        self.ec2 = QueryClient("ec2", EC2_VERSION, self.region,
+                               creds.access_key_id, creds.secret_access_key,
+                               creds.session_token)
+        self.asg_client = QueryClient(
+            "autoscaling", ASG_VERSION, self.region, creds.access_key_id,
+            creds.secret_access_key, creds.session_token)
+        self.bucket = S3Bucket(identifier.long(), self.region,
+                               creds.access_key_id, creds.secret_access_key,
+                               creds.session_token)
+        self.group = AutoScalingGroup(
+            self.asg_client, self.ec2, identifier.long(),
+            parallelism=spec.parallelism, spot=float(spec.spot))
+        self._remote_record: Optional[str] = None  # lazy tag lookup
+
+    # -- plumbing -------------------------------------------------------------
+    def _remote(self) -> str:
+        if self.spec.remote_storage is not None:
+            return self._remote_storage_connection(backend="s3")
+        recorded = self._recorded_remote()
+        if recorded:
+            return recorded
+        return self.bucket.connection_string()
+
+    def _recorded_remote(self) -> str:
+        """The remote recorded as a launch-template instance tag (sanitized
+        — no credentials), so a bare read/delete targets the storage the
+        task was created with; this process's credentials are re-injected."""
+        if self._remote_record is not None:
+            return self._remote_record
+        from tpu_task.backends.aws.resources import LaunchTemplate
+
+        template = LaunchTemplate(
+            self.ec2, self.identifier.long(), instance_type="", image_id="",
+            key_name="", security_group_id="", user_data_b64="")
+        try:
+            recorded = template.read_tags().get("tpu-task-remote", "")
+        except ResourceNotFoundError:
+            recorded = ""
+        self._remote_record = self._with_local_credentials(recorded)
+        return self._remote_record
+
+    def _with_local_credentials(self, remote: str) -> str:
+        if not remote.startswith(":s3"):
+            return remote
+        from tpu_task.storage import Connection
+
+        conn = Connection.parse(remote)
+        creds = self.cloud.credentials.aws
+        conn.config.setdefault("region", self.region)
+        conn.config["access_key_id"] = creds.access_key_id
+        conn.config["secret_access_key"] = creds.secret_access_key
+        if creds.session_token:
+            conn.config["session_token"] = creds.session_token
+        return str(conn)
+
+    def _credentials_env(self) -> Dict[str, str]:
+        """Env map injected into the VM (data_source_credentials.go:41-49)."""
+        creds = self.cloud.credentials.aws
+        env = {
+            "AWS_ACCESS_KEY_ID": creds.access_key_id,
+            "AWS_SECRET_ACCESS_KEY": creds.secret_access_key,
+            "TPU_TASK_REMOTE": self._remote(),
+            "TPU_TASK_CLOUD_PROVIDER": "aws",
+            "TPU_TASK_CLOUD_REGION": str(self.cloud.region),
+            "TPU_TASK_IDENTIFIER": self.identifier.long(),
+        }
+        if creds.session_token:
+            env["AWS_SESSION_TOKEN"] = creds.session_token
+        return env
+
+    def get_key_pair(self):
+        from tpu_task.common.ssh import DeterministicSSHKeyPair
+
+        # Keypair derived from the secret key (client.go:88 parity).
+        return DeterministicSSHKeyPair(
+            self.cloud.credentials.aws.secret_access_key,
+            self.identifier.long())
+
+    def _user_data(self) -> str:
+        import base64
+        import time as _time
+        from datetime import datetime, timezone
+
+        from tpu_task.machine import render_script
+
+        timeout = self.spec.environment.timeout
+        epoch = (None if timeout is None else datetime.fromtimestamp(
+            _time.time() + timeout.total_seconds(), tz=timezone.utc))
+        script = render_script(self.spec.environment.script,
+                               self._credentials_env(),
+                               self.spec.environment.variables, epoch,
+                               agent_wheel_url=getattr(
+                                   self, "_agent_wheel_url", ""))
+        return base64.b64encode(script.encode()).decode()
+
+    # -- lifecycle ------------------------------------------------------------
+    def create(self) -> None:
+        from tpu_task.backends.aws.resources import (
+            DefaultVpc, Image, KeyPair, LaunchTemplate, SecurityGroup, Subnets,
+        )
+        from tpu_task.common.steps import Step, run_steps
+        from tpu_task.storage import check_storage
+
+        vpc = DefaultVpc(self.ec2)
+        subnets = Subnets(self.ec2, vpc)
+        image = Image(self.ec2, self.spec.environment.image)
+        security_group = SecurityGroup(self.ec2, self.identifier.long(), vpc,
+                                       self.spec.firewall)
+        key_pair = KeyPair(self.ec2, self.identifier.long(),
+                           self.get_key_pair().public_string())
+
+        steps = [
+            Step("Importing DefaultVPC...", vpc.read),
+            Step("Importing DefaultVPCSubnets...", subnets.read),
+            Step("Reading Image...", image.read),
+        ]
+        if self.spec.remote_storage is not None:
+            steps.append(Step("Verifying bucket...",
+                              lambda: check_storage(self._remote())))
+        else:
+            steps.append(Step("Creating Bucket...", self.bucket.create))
+        steps += [
+            Step("Creating SecurityGroup...", security_group.create),
+            Step("Creating KeyPair...", key_pair.create),
+        ]
+        run_steps(steps)
+
+        from tpu_task.machine.wheel import stage_wheel
+
+        self._agent_wheel_url = stage_wheel(self._remote())
+        template = LaunchTemplate(
+            self.ec2, self.identifier.long(),
+            instance_type=self.instance_type,
+            image_id=image.image_id, key_name=self.identifier.long(),
+            security_group_id=security_group.group_id,
+            user_data_b64=self._user_data(),
+            instance_profile_arn=self.spec.permission_set,
+            disk_size_gb=self.spec.size.storage,
+            # Sanitized: tags are readable by any DescribeTags principal and
+            # capped at 256 chars — no credentials in the record.
+            tags={"tpu-task-remote": self._sanitized_remote(),
+                  **self.cloud.tags})
+        self.group.launch_template = self.identifier.long()
+        self.group.subnet_ids = subnets.subnet_ids
+        run_steps([
+            Step("Creating LaunchTemplate...", template.create),
+            Step("Creating AutoScalingGroup...", self.group.create),
+            Step("Uploading Directory...", self.push),
+            Step("Starting task...", self.start),
+        ])
+
+    def start(self) -> None:
+        self.group.resize(self.spec.parallelism)
+
+    def stop(self) -> None:
+        self.group.resize(0)
+
+    def read(self) -> None:
+        self.group.read()
+        self.spec.addresses = list(self.group.addresses)
+        self.spec.status = self.status(running=self.group.running)
+        self.spec.events = self.events()
+
+    def delete(self) -> None:
+        from tpu_task.backends.aws.resources import (
+            DefaultVpc, KeyPair, LaunchTemplate, SecurityGroup,
+        )
+
+        # Resolve (and cache) the remote BEFORE deleting the template whose
+        # tags record it.
+        remote = self._remote()
+        if self.spec.environment.directory:
+            try:
+                self.pull()
+            except ResourceNotFoundError:
+                pass
+        self.group.delete()
+        LaunchTemplate(self.ec2, self.identifier.long(), instance_type="",
+                       image_id="", key_name="", security_group_id="",
+                       user_data_b64="").delete()
+        KeyPair(self.ec2, self.identifier.long(), "").delete()
+        SecurityGroup(self.ec2, self.identifier.long(), DefaultVpc(self.ec2),
+                      self.spec.firewall).delete()
+        if self._is_per_task_bucket(remote):
+            self.bucket.delete()
+        else:
+            from tpu_task.storage import delete_storage
+
+            try:
+                delete_storage(remote)
+            except ResourceNotFoundError:
+                pass
+
+    # -- observation (data plane inherited from GcsRemoteMixin) ---------------
+    def status(self, running: Optional[int] = None):
+        if running is None:
+            if self.spec.status:
+                return self.spec.status
+            self.group.read()
+            running = self.group.running
+        return self._folded_status(running)
+
+    def events(self):
+        return list(self.group.events)
+
+    def observed_parallelism(self) -> Optional[int]:
+        """DesiredCapacity from the ASG's own record."""
+        if not self.group.exists:
+            try:
+                self.group.read()
+            except ResourceNotFoundError:
+                return None
+        return self.group.desired or None
+
+
 def list_aws_tasks(cloud: Cloud) -> List[Identifier]:
+    identifiers = []
+    seen = set()
+
+    def add(identifier: Identifier) -> None:
+        if identifier.long() not in seen:
+            seen.add(identifier.long())
+            identifiers.append(identifier)
+
+    if _aws_real_mode(cloud):
+        from tpu_task.backends.aws.api import QueryClient
+        from tpu_task.backends.aws.resources import ASG_VERSION
+        from tpu_task.backends.aws.api import texts
+
+        creds = cloud.credentials.aws
+        client = QueryClient("autoscaling", ASG_VERSION,
+                             resolve_aws_region(str(cloud.region)),
+                             creds.access_key_id, creds.secret_access_key,
+                             creds.session_token)
+        token = ""
+        while True:  # paginate: silent truncation would hide billed tasks
+            params = {"NextToken": token} if token else {}
+            from tpu_task.backends.aws.api import text as xml_text
+
+            root = client.call("DescribeAutoScalingGroups", params)
+            for name in texts(root, ".//AutoScalingGroups/member/"
+                                    "AutoScalingGroupName"):
+                try:
+                    add(Identifier.parse(name))
+                except WrongIdentifierError:
+                    continue
+            token = xml_text(root, ".//NextToken")
+            if not token:
+                break
     from tpu_task.backends.local.control_plane import list_groups
 
-    identifiers = []
     for name in list_groups():
         try:
-            identifiers.append(Identifier.parse(name))
+            add(Identifier.parse(name))
         except WrongIdentifierError:
             continue
     return identifiers

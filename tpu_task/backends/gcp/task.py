@@ -144,8 +144,17 @@ class GCERealTask(GcsRemoteMixin, Task):
         items = template.get("properties", {}).get("metadata", {}).get("items", [])
         remote = next((item.get("value", "") for item in items
                        if item.get("key") == "tpu-task-remote"), "")
-        self._remote_record = remote
-        return remote
+        self._remote_record = self._with_local_credentials(remote)
+        return self._remote_record
+
+    def _with_local_credentials(self, remote: str) -> str:
+        if not remote.startswith(":googlecloudstorage"):
+            return remote
+        from tpu_task.storage import Connection
+
+        conn = Connection.parse(remote)
+        conn.config["service_account_credentials"] = self.credentials_json
+        return str(conn)
 
     def _credentials_env(self) -> Dict[str, str]:
         """Env map injected into the VM (data_source_credentials.go:30-49)."""
@@ -202,7 +211,9 @@ class GCERealTask(GcsRemoteMixin, Task):
             spot=float(self.spec.spot),
             disk_size_gb=self.spec.size.storage,
             labels=dict(self.cloud.tags),
-            remote=self._remote(),
+            # Sanitized: the record only locates the bucket; readers
+            # re-inject their own credentials (_with_local_credentials).
+            remote=self._sanitized_remote(),
         )
         return rules, template
 

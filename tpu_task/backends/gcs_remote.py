@@ -52,6 +52,31 @@ class GcsRemoteMixin:
             return str(conn)
         return os.path.join(remote, "data")
 
+    # Config keys that must NEVER be written into control-plane records
+    # (instance tags, template metadata) — the record only needs to locate
+    # the storage; the reader re-injects its own credentials.
+    SECRET_CONFIG_KEYS = ("secret_access_key", "session_token",
+                          "access_key_id", "service_account_credentials",
+                          "key")
+
+    def _sanitized_remote(self) -> str:
+        """The remote with credentials stripped — safe to record in tags or
+        metadata readable by other principals."""
+        remote = self._remote()
+        if not remote.startswith(":"):
+            return remote
+        from tpu_task.storage import Connection
+
+        conn = Connection.parse(remote)
+        conn.config = {key: value for key, value in conn.config.items()
+                      if key not in self.SECRET_CONFIG_KEYS}
+        return str(conn)
+
+    def _with_local_credentials(self, remote: str) -> str:
+        """Re-inject this process's credentials into a sanitized recorded
+        remote; backends override with their credential source."""
+        return remote
+
     def _is_per_task_bucket(self, remote: str) -> bool:
         """True when the remote is this task's own bucket (safe to delete
         outright); False for pre-allocated containers, which only ever get

@@ -141,10 +141,23 @@ class TPUTask(GcsRemoteMixin, Task):
                 continue
             remote = info.spec.metadata.get("tpu-task-remote", "")
             if remote:
-                self._remote_record = remote
-                return remote
+                self._remote_record = self._with_local_credentials(remote)
+                return self._remote_record
         self._remote_record = ""
         return ""
+
+    def _with_local_credentials(self, remote: str) -> str:
+        if not remote.startswith(":googlecloudstorage"):
+            return remote
+        from tpu_task.storage import Connection
+
+        conn = Connection.parse(remote)
+        creds = ""
+        if self.cloud.credentials.gcp:
+            creds = self.cloud.credentials.gcp.application_credentials
+        if creds:
+            conn.config["service_account_credentials"] = creds
+        return str(conn)
 
     def _credentials_env(self) -> Dict[str, str]:
         """Env map injected into workers (data_source_credentials.go:30-49)."""
@@ -177,9 +190,11 @@ class TPUTask(GcsRemoteMixin, Task):
             # Contract consumed by the fake control plane's worker executor;
             # harmless extra metadata on real nodes. tpu-task-remote and
             # tpu-task-agent-wheel also serve as the control-plane record a
-            # bare read/recovery resolves storage and the staged wheel from.
+            # bare read/recovery resolves storage and the staged wheel from;
+            # the remote is SANITIZED (no credentials) — readers re-inject
+            # their own, and workers get theirs via the bootstrap env.
             "tpu-task-agent-wheel": getattr(self, "_agent_wheel_url", ""),
-            "tpu-task-remote": self._remote(),
+            "tpu-task-remote": self._sanitized_remote(),
             "tpu-task-script-b64": base64.b64encode(
                 self.spec.environment.script.encode()).decode(),
             "tpu-task-timeout": str(int(self._timeout_epoch().timestamp())

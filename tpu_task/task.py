@@ -83,9 +83,9 @@ def new(cloud: Cloud, identifier: Identifier, spec: TaskSpec) -> Task:
 
         return K8STask(cloud, identifier, spec)
     if cloud.provider == Provider.AWS:
-        from tpu_task.backends.aws import AWSTask
+        from tpu_task.backends.aws import new_aws_task
 
-        return AWSTask(cloud, identifier, spec)
+        return new_aws_task(cloud, identifier, spec)
     if cloud.provider == Provider.AZ:
         from tpu_task.backends.az import AZTask
 
