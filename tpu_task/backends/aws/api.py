@@ -61,27 +61,43 @@ class QueryClient:
         self._urlopen = None  # test hook: injectable transport
         self._sleep = None    # test hook: injectable backoff sleep
 
+    # AWS signals throttling as HTTP 400 + one of these codes — the shared
+    # retry layer (408/429/5xx only) never sees them, so the Query client
+    # backs off itself, like the reference SDK's retryer.
+    THROTTLE_CODES = ("Throttling", "ThrottlingException",
+                      "RequestLimitExceeded", "RequestThrottled")
+
     def call(self, action: str, params: Optional[Dict[str, str]] = None
              ) -> ElementTree.Element:
         from tpu_task.storage.http_util import send
 
         form = {"Action": action, "Version": self.version, **(params or {})}
         body = urllib.parse.urlencode(sorted(form.items())).encode()
-        headers = sigv4_sign(
-            "POST", self.host, "/", {},
-            {"content-type": "application/x-www-form-urlencoded"},
-            hashlib.sha256(body).hexdigest(),
-            self.access_key, self.secret_key, self.region, self.service,
-            time.strftime("%Y%m%dT%H%M%SZ", time.gmtime()),
-            self.session_token)
-        headers["Content-Type"] = "application/x-www-form-urlencoded"
-        try:
-            response = send("POST", f"https://{self.host}/", data=body,
-                            headers=headers, urlopen=self._urlopen,
-                            sleep=self._sleep or time.sleep)
-        except urllib.error.HTTPError as error:
-            raise self._map_error(error) from error
-        return _strip_namespaces(response)
+        sleep = self._sleep or time.sleep
+        delay = 1.0
+        for attempt in range(6):
+            headers = sigv4_sign(
+                "POST", self.host, "/", {},
+                {"content-type": "application/x-www-form-urlencoded"},
+                hashlib.sha256(body).hexdigest(),
+                self.access_key, self.secret_key, self.region, self.service,
+                time.strftime("%Y%m%dT%H%M%SZ", time.gmtime()),
+                self.session_token)
+            headers["Content-Type"] = "application/x-www-form-urlencoded"
+            try:
+                response = send("POST", f"https://{self.host}/", data=body,
+                                headers=headers, urlopen=self._urlopen,
+                                sleep=sleep)
+                return _strip_namespaces(response)
+            except urllib.error.HTTPError as error:
+                mapped = self._map_error(error)
+                if isinstance(mapped, AwsQueryError) and \
+                        mapped.code in self.THROTTLE_CODES and attempt < 5:
+                    sleep(delay)
+                    delay = min(delay * 2, 16.0)
+                    continue
+                raise mapped from error
+        raise RuntimeError("unreachable retry loop exit")
 
     def _map_error(self, error: urllib.error.HTTPError) -> Exception:
         body = b""

@@ -170,7 +170,11 @@ class SecurityGroup:
         self._authorize_rules("Egress", self.firewall.egress)
 
     def _authorize_rules(self, direction: str, rule) -> None:
+        if rule.nets is not None and not rule.nets:
+            return  # specified-but-empty = allow NONE (values.py semantics)
         nets = [str(net) for net in (rule.nets or [])] or ["0.0.0.0/0"]
+        if rule.ports is not None and not rule.ports:
+            return  # allow none
         if rule.ports is None:
             params = {"IpPermissions.1.IpProtocol": "-1"}
             for index, net in enumerate(nets):
@@ -202,13 +206,35 @@ class SecurityGroup:
         if not self.group_id:
             raise ResourceNotFoundError(self.name)
 
-    def delete(self) -> None:
+    def delete(self, timeout: float = 600.0) -> None:
+        import time as _time
+
+        from tpu_task.backends.aws.api import AwsQueryError
+
         try:
             if not self.group_id:
                 self.read()
-            self.ec2.call("DeleteSecurityGroup", {"GroupId": self.group_id})
         except ResourceNotFoundError:
-            pass
+            return
+        # Instances from the just-force-deleted ASG keep ENIs referencing
+        # this group for minutes; retry DependencyViolation until they drain
+        # (the reference gets this from the SDK waiter it runs first).
+        sleep = self.ec2._sleep or _time.sleep
+        delay = 2.0
+        deadline = _time.time() + timeout
+        while True:
+            try:
+                self.ec2.call("DeleteSecurityGroup",
+                              {"GroupId": self.group_id})
+                return
+            except ResourceNotFoundError:
+                return
+            except AwsQueryError as error:
+                if error.code != "DependencyViolation" or \
+                        _time.time() > deadline:
+                    raise
+                sleep(delay)
+                delay = min(delay * 2, 32.0)
 
 
 class LaunchTemplate:
@@ -379,12 +405,27 @@ class AutoScalingGroup:
             "DesiredCapacity": str(capacity),
             "HonorCooldown": "false"})
 
-    def delete(self) -> None:
+    def delete(self, timeout: float = 600.0) -> None:
+        import time as _time
+
         try:
             self.asg.call("DeleteAutoScalingGroup", {
                 "AutoScalingGroupName": self.name, "ForceDelete": "true"})
         except ResourceNotFoundError:
-            pass
+            return
+        # ForceDelete is async; wait for the group to disappear so the
+        # security group behind it can actually be deleted next
+        # (the reference's GroupNotExistsWaiter role).
+        sleep = self.asg._sleep or _time.sleep
+        delay = 2.0
+        deadline = _time.time() + timeout
+        while _time.time() < deadline:
+            try:
+                self.read()
+            except ResourceNotFoundError:
+                return
+            sleep(delay)
+            delay = min(delay * 2, 32.0)
 
 
 class S3Bucket:

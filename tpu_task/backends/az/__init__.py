@@ -1,8 +1,10 @@
 from tpu_task.backends.az.task import (
     AZ_REGIONS,
     AZ_SIZES,
+    AZRealTask,
     AZTask,
     list_az_tasks,
+    new_az_task,
     resolve_az_machine,
     resolve_az_region,
     validate_arm_id,
@@ -11,8 +13,10 @@ from tpu_task.backends.az.task import (
 __all__ = [
     "AZ_REGIONS",
     "AZ_SIZES",
+    "AZRealTask",
     "AZTask",
     "list_az_tasks",
+    "new_az_task",
     "resolve_az_machine",
     "resolve_az_region",
     "validate_arm_id",

@@ -1,0 +1,109 @@
+"""Azure control-plane client: ARM REST over AAD client-credentials OAuth.
+
+The reference drives Azure through 12 typed SDK clients under one authorizer
+(/root/reference/task/az/client/client.go:20-53); this client speaks ARM's
+JSON REST directly — one bearer token from login.microsoftonline.com, every
+management call through the shared retry/refresh layer, 404/409 mapped to
+the common NotFound/AlreadyExists semantics, and long-running operations
+polled via provisioningState (the SDK futures' WaitForCompletionRef role).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, Optional
+
+from tpu_task.common.errors import ResourceAlreadyExistsError, ResourceNotFoundError
+
+MANAGEMENT = "https://management.azure.com"
+
+# api-versions per resource provider (matching the SDK versions the
+# reference pins in its imports).
+API_VERSIONS = {
+    "resourcegroups": "2021-04-01",
+    "Microsoft.Network": "2021-05-01",
+    "Microsoft.Storage": "2021-08-01",
+    "Microsoft.Compute": "2021-11-01",
+}
+
+
+class ArmClient:
+    def __init__(self, subscription_id: str, tenant_id: str, client_id: str,
+                 client_secret: str):
+        from tpu_task.storage.http_util import OAuthToken
+
+        self.subscription_id = subscription_id
+        self.tenant_id = tenant_id
+        self.client_id = client_id
+        self.client_secret = client_secret
+        self._token = OAuthToken(self._fetch_token)
+        self._urlopen = None  # test hook: injectable transport
+        self._sleep = None    # test hook: injectable backoff sleep
+
+    def _fetch_token(self):
+        import urllib.parse
+        import urllib.request
+
+        body = urllib.parse.urlencode({
+            "grant_type": "client_credentials",
+            "client_id": self.client_id,
+            "client_secret": self.client_secret,
+            "scope": "https://management.azure.com/.default",
+        }).encode()
+        url = (f"https://login.microsoftonline.com/{self.tenant_id}"
+               "/oauth2/v2.0/token")
+        opener = self._urlopen or urllib.request.urlopen
+        request = urllib.request.Request(url, data=body, method="POST")
+        with opener(request, timeout=30) as response:
+            payload = json.loads(response.read())
+        return payload["access_token"], float(payload.get("expires_in", 3600))
+
+    def request(self, method: str, path: str, api_version: str,
+                payload: Optional[dict] = None) -> dict:
+        import urllib.error
+
+        from tpu_task.storage.http_util import authorized_send
+
+        url = f"{MANAGEMENT}{path}?api-version={api_version}"
+        data = json.dumps(payload).encode() if payload is not None else None
+        try:
+            body = authorized_send(
+                self._token, method, url, data=data,
+                headers={"Content-Type": "application/json"},
+                urlopen=self._urlopen, sleep=self._sleep or time.sleep)
+            return json.loads(body or b"{}")
+        except urllib.error.HTTPError as error:
+            if error.code == 404:
+                raise ResourceNotFoundError(path) from error
+            if error.code == 409:
+                raise ResourceAlreadyExistsError(path) from error
+            raise
+
+    def _rg_path(self, resource_group: str) -> str:
+        return (f"/subscriptions/{self.subscription_id}/resourcegroups/"
+                f"{resource_group}")
+
+    def provider_path(self, resource_group: str, provider: str,
+                      resource: str) -> str:
+        return (f"{self._rg_path(resource_group)}/providers/{provider}/"
+                f"{resource}")
+
+    def wait_provisioned(self, path: str, api_version: str,
+                         timeout: float = 900.0) -> dict:
+        """Poll a resource until provisioningState Succeeded (2 s → 32 s
+        backoff, the ARM analog of the reference's operation waiters)."""
+        delay = 2.0
+        deadline = time.time() + timeout
+        sleep = self._sleep or time.sleep
+        while True:
+            resource = self.request("GET", path, api_version)
+            state = resource.get("properties", {}).get("provisioningState", "")
+            if state == "Succeeded":
+                return resource
+            if state in ("Failed", "Canceled"):
+                raise RuntimeError(f"provisioning {state}: {path}")
+            if time.time() > deadline:
+                raise TimeoutError(f"provisioning timed out: {path}")
+            sleep(delay)
+            delay = min(delay * 2, 32.0)

@@ -1,0 +1,413 @@
+"""Azure resource primitives over the ARM client.
+
+Mirrors the reference's L2 objects (/root/reference/task/az/resources/):
+ResourceGroup (root container — resource_group.go), VirtualNetwork with an
+NSG-bound subnet (resource_virtual_network.go, resource_subnet.go,
+resource_security_group.go), StorageAccount + BlobContainer
+(resource_storage_account.go, resource_blob_container.go), and the
+VirtualMachineScaleSet (resource_virtual_machine_scale_set.go: capacity 0,
+CustomData bootstrap, {user}@{publisher}:{offer}:{sku}:{version} image
+grammar, spot eviction-policy Delete + BillingProfile, Read folding
+instance-view summaries into Status and per-VM public IPs into Addresses).
+
+Deleting the resource group tears everything down — ARM's containment is
+the teardown mechanism the reference leans on (task/az/task.go).
+"""
+
+from __future__ import annotations
+
+import re
+from datetime import datetime, timezone
+from typing import Dict, List, Optional
+
+from tpu_task.backends.az.api import API_VERSIONS, ArmClient
+from tpu_task.common.errors import ResourceNotFoundError
+from tpu_task.common.values import Event, Firewall
+
+IMAGE_ALIASES = {
+    "ubuntu": "ubuntu@Canonical:0001-com-ubuntu-server-focal:20_04-lts:latest",
+    "nvidia": "ubuntu@microsoft-dsvm:ubuntu-2004:2004-gen2:latest",
+}
+_IMAGE_RE = re.compile(r"^([^@]+)@([^:]+):([^:]+):([^:]+):([^:]+)(#plan)?$")
+
+
+def parse_image(identifier: str):
+    """``{user}@{publisher}:{offer}:{sku}:{version}[#plan]`` →
+    (ssh_user, image_reference_dict, plan?) — scale_set.go:265-285."""
+    image = IMAGE_ALIASES.get(identifier or "ubuntu", identifier or "ubuntu")
+    image = IMAGE_ALIASES.get("ubuntu") if image == "" else image
+    match = _IMAGE_RE.match(image)
+    if not match:
+        raise ValueError(f"invalid machine image format: {identifier!r} "
+                         "(use {user}@{publisher}:{offer}:{sku}:{version})")
+    user, publisher, offer, sku, version, plan = match.groups()
+    reference = {"publisher": publisher, "offer": offer, "sku": sku,
+                 "version": version}
+    return user, reference, bool(plan)
+
+
+class ResourceGroup:
+    def __init__(self, client: ArmClient, name: str, location: str):
+        self.client = client
+        self.name = name
+        self.location = location
+        self.path = client._rg_path(name)
+
+    def create(self) -> None:
+        self.client.request("PUT", self.path, API_VERSIONS["resourcegroups"],
+                            {"location": self.location})
+
+    def read(self) -> None:
+        self.client.request("GET", self.path, API_VERSIONS["resourcegroups"])
+
+    def delete(self) -> None:
+        try:
+            self.client.request("DELETE", self.path,
+                                API_VERSIONS["resourcegroups"])
+        except ResourceNotFoundError:
+            pass
+
+
+class SecurityGroup:
+    """NSG with allow rules from the task Firewall (priority 100+i inbound,
+    intra-VNet traffic rides Azure's default rules)."""
+
+    def __init__(self, client: ArmClient, resource_group: str, name: str,
+                 location: str, firewall: Firewall):
+        self.client = client
+        self.name = name
+        self.location = location
+        self.firewall = firewall
+        self.path = client.provider_path(
+            resource_group, "Microsoft.Network",
+            f"networkSecurityGroups/{name}")
+        self.resource_id = ""
+
+    def _rule(self, name: str, priority: int, direction: str, access: str,
+              port: str, nets: List[str]) -> dict:
+        return {
+            "name": name,
+            "properties": {
+                "priority": priority,
+                "direction": direction,
+                "access": access,
+                "protocol": "*",
+                "sourcePortRange": "*",
+                "destinationPortRange": port,
+                "sourceAddressPrefix": nets[0] if len(nets) == 1 else "*",
+                **({"sourceAddressPrefixes": nets} if len(nets) > 1 else {}),
+                "destinationAddressPrefix": "*",
+            },
+        }
+
+    def body(self) -> dict:
+        """FirewallRule semantics (values.py): ports/nets None = allow any;
+        specified-but-empty = allow none. Azure defaults: inbound internet
+        denied, outbound allowed — so 'allow any' ingress needs an explicit
+        rule and restricted egress needs an explicit deny."""
+        rules = []
+        ingress = self.firewall.ingress
+        ingress_nets = (None if ingress.nets is None
+                        else [str(net) for net in ingress.nets])
+        if ingress_nets == []:
+            pass  # allow none: Azure's default inbound deny covers it
+        elif ingress.ports is None:
+            rules.append(self._rule(f"{self.name}-in-any", 100, "Inbound",
+                                    "Allow", "*", ingress_nets or []))
+        else:
+            for index, port in enumerate(ingress.ports):
+                rules.append(self._rule(f"{self.name}-in-{port}", 100 + index,
+                                        "Inbound", "Allow", str(port),
+                                        ingress_nets or []))
+        egress = self.firewall.egress
+        egress_nets = (None if egress.nets is None
+                       else [str(net) for net in egress.nets])
+        if egress.ports is None and egress_nets is None:
+            pass  # allow any: Azure's default outbound allow covers it
+        else:
+            for index, port in enumerate(egress.ports or []):
+                if egress_nets == []:
+                    break  # allow none: just the deny below
+                rules.append(self._rule(f"{self.name}-out-{port}",
+                                        100 + index, "Outbound", "Allow",
+                                        str(port), egress_nets or []))
+            rules.append(self._rule(f"{self.name}-out-deny", 4000,
+                                    "Outbound", "Deny", "*", []))
+        return {"location": self.location,
+                "properties": {"securityRules": rules}}
+
+    def create(self) -> None:
+        resource = self.client.request(
+            "PUT", self.path, API_VERSIONS["Microsoft.Network"], self.body())
+        self.resource_id = resource.get("id", self.path)
+
+    def delete(self) -> None:
+        try:
+            self.client.request("DELETE", self.path,
+                                API_VERSIONS["Microsoft.Network"])
+        except ResourceNotFoundError:
+            pass
+
+
+class VirtualNetwork:
+    """10.0.0.0/16 VNet with one NSG-bound subnet
+    (resource_virtual_network.go, resource_subnet.go)."""
+
+    def __init__(self, client: ArmClient, resource_group: str, name: str,
+                 location: str, security_group: SecurityGroup):
+        self.client = client
+        self.name = name
+        self.location = location
+        self.security_group = security_group
+        self.path = client.provider_path(
+            resource_group, "Microsoft.Network", f"virtualNetworks/{name}")
+        self.subnet_id = ""
+
+    def create(self) -> None:
+        resource = self.client.request(
+            "PUT", self.path, API_VERSIONS["Microsoft.Network"], {
+                "location": self.location,
+                "properties": {
+                    "addressSpace": {"addressPrefixes": ["10.0.0.0/16"]},
+                    "subnets": [{
+                        "name": self.name,
+                        "properties": {
+                            "addressPrefix": "10.0.0.0/16",
+                            "networkSecurityGroup": {
+                                "id": self.security_group.resource_id},
+                        },
+                    }],
+                },
+            })
+        subnets = resource.get("properties", {}).get("subnets", [])
+        self.subnet_id = (subnets[0].get("id", "") if subnets
+                          else f"{self.path}/subnets/{self.name}")
+
+    def delete(self) -> None:
+        try:
+            self.client.request("DELETE", self.path,
+                                API_VERSIONS["Microsoft.Network"])
+        except ResourceNotFoundError:
+            pass
+
+
+class StorageAccount:
+    """Per-task storage account named identifier.short() (24-char limit —
+    resource_storage_account.go:16-23), Standard_LRS."""
+
+    def __init__(self, client: ArmClient, resource_group: str, name: str,
+                 location: str):
+        self.client = client
+        self.name = name
+        self.location = location
+        self.path = client.provider_path(
+            resource_group, "Microsoft.Storage", f"storageAccounts/{name}")
+
+    def create(self) -> None:
+        self.client.request("PUT", self.path, API_VERSIONS["Microsoft.Storage"], {
+            "location": self.location,
+            "kind": "StorageV2",
+            "sku": {"name": "Standard_LRS"},
+        })
+        self.client.wait_provisioned(self.path,
+                                     API_VERSIONS["Microsoft.Storage"])
+
+    def key(self) -> str:
+        payload = self.client.request(
+            "POST", f"{self.path}/listKeys", API_VERSIONS["Microsoft.Storage"])
+        keys = payload.get("keys", [])
+        if not keys:
+            raise ResourceNotFoundError(f"no keys for {self.name}")
+        return keys[0].get("value", "")
+
+    def delete(self) -> None:
+        try:
+            self.client.request("DELETE", self.path,
+                                API_VERSIONS["Microsoft.Storage"])
+        except ResourceNotFoundError:
+            pass
+
+
+class BlobContainer:
+    """Blob container via the data plane (SharedKey PUT restype=container —
+    resource_blob_container.go)."""
+
+    def __init__(self, account: str, key: str, name: str):
+        from tpu_task.storage.cloud_backends import AzureBlobBackend
+
+        self.account = account
+        self.account_key = key
+        self.name = name
+        self.backend = AzureBlobBackend(name, config={"account": account,
+                                                      "key": key})
+
+    def create(self) -> None:
+        import urllib.error
+
+        try:
+            self.backend._request("PUT", f"/{self.name}",
+                                  {"restype": "container"})
+        except urllib.error.HTTPError as error:
+            if error.code != 409:  # ContainerAlreadyExists → idempotent
+                raise
+
+    def connection_string(self) -> str:
+        from tpu_task.storage import Connection
+
+        return str(Connection(backend="azureblob", container=self.name,
+                              config={"account": self.account,
+                                      "key": self.account_key}))
+
+
+class VirtualMachineScaleSet:
+    """VMSS at capacity 0 (resource_virtual_machine_scale_set.go:64-235):
+    CustomData bootstrap, spot eviction Delete + BillingProfile max price
+    (>0 cap, 0 → -1 no cap), per-instance public IPs."""
+
+    def __init__(self, client: ArmClient, resource_group: str, name: str,
+                 location: str, *, vm_size: str = "", subnet_id: str = "",
+                 image_reference: Optional[dict] = None, ssh_user: str = "",
+                 ssh_public_key: str = "", custom_data_b64: str = "",
+                 spot: float = -1.0, disk_size_gb: int = -1,
+                 identity_ids: Optional[List[str]] = None,
+                 tags: Optional[Dict[str, str]] = None):
+        self.client = client
+        self.resource_group = resource_group
+        self.name = name
+        self.location = location
+        self.vm_size = vm_size
+        self.subnet_id = subnet_id
+        self.image_reference = image_reference or {}
+        self.ssh_user = ssh_user
+        self.ssh_public_key = ssh_public_key
+        self.custom_data_b64 = custom_data_b64
+        self.spot = spot
+        self.disk_size_gb = disk_size_gb
+        self.identity_ids = identity_ids or []
+        self.tags = tags or {}
+        self.path = client.provider_path(
+            resource_group, "Microsoft.Compute",
+            f"virtualMachineScaleSets/{name}")
+        self.addresses: List[str] = []
+        self.events: List[Event] = []
+        self.running = 0
+        self.capacity = 0
+        self.read_tags: Dict[str, str] = {}
+
+    def body(self) -> dict:
+        os_profile = {
+            "computerNamePrefix": "tpi",
+            "adminUsername": self.ssh_user,
+            "customData": self.custom_data_b64,
+            "linuxConfiguration": {
+                "disablePasswordAuthentication": True,
+                "ssh": {"publicKeys": [{
+                    "path": f"/home/{self.ssh_user}/.ssh/authorized_keys",
+                    "keyData": self.ssh_public_key,
+                }]},
+            },
+        }
+        storage_profile: dict = {"imageReference": self.image_reference}
+        if self.disk_size_gb > 0:  # Size.storage honored
+            storage_profile["osDisk"] = {
+                "createOption": "FromImage",
+                "diskSizeGB": self.disk_size_gb,
+            }
+        profile: dict = {
+            "osProfile": os_profile,
+            "storageProfile": storage_profile,
+            "networkProfile": {"networkInterfaceConfigurations": [{
+                "name": self.name,
+                "properties": {
+                    "primary": True,
+                    "ipConfigurations": [{
+                        "name": self.name,
+                        "properties": {
+                            "subnet": {"id": self.subnet_id},
+                            "publicIPAddressConfiguration": {
+                                "name": self.name,
+                                "properties": {
+                                    "idleTimeoutInMinutes": 15}},
+                        },
+                    }],
+                },
+            }]},
+        }
+        if self.spot >= 0:
+            # Spot with eviction Delete; 0 → maxPrice -1 = on-demand cap
+            # (scale_set.go:219-229).
+            profile["priority"] = "Spot"
+            profile["evictionPolicy"] = "Delete"
+            profile["billingProfile"] = {
+                "maxPrice": self.spot if self.spot > 0 else -1}
+        body: dict = {
+            "location": self.location,
+            "sku": {"name": self.vm_size, "tier": "Standard", "capacity": 0},
+            "tags": self.tags,
+            "properties": {
+                "overprovision": False,
+                "upgradePolicy": {"mode": "Manual"},
+                "virtualMachineProfile": profile,
+            },
+        }
+        if self.identity_ids:
+            body["identity"] = {
+                "type": "UserAssigned",
+                "userAssignedIdentities": {
+                    arm_id: {} for arm_id in self.identity_ids},
+            }
+        return body
+
+    def create(self) -> None:
+        self.client.request("PUT", self.path, API_VERSIONS["Microsoft.Compute"],
+                            self.body())
+        self.client.wait_provisioned(self.path,
+                                     API_VERSIONS["Microsoft.Compute"])
+
+    def read(self) -> None:
+        resource = self.client.request("GET", self.path,
+                                       API_VERSIONS["Microsoft.Compute"])
+        self.capacity = int(resource.get("sku", {}).get("capacity", 0))
+        self.read_tags = dict(resource.get("tags", {}))
+
+        view = self.client.request("GET", f"{self.path}/instanceView",
+                                   API_VERSIONS["Microsoft.Compute"])
+        self.running = 0
+        for summary in view.get("virtualMachine", {}).get(
+                "statusesSummary", []):
+            if summary.get("code") == "ProvisioningState/succeeded":
+                self.running = int(summary.get("count", 0))
+        self.events = []
+        for status in view.get("statuses", []):
+            stamp = datetime.fromtimestamp(0, tz=timezone.utc)
+            try:
+                stamp = datetime.fromisoformat(
+                    status.get("time", "").replace("Z", "+00:00"))
+            except ValueError:
+                pass
+            self.events.append(Event(
+                time=stamp, code=status.get("code", ""),
+                description=[status.get("level", ""),
+                             status.get("displayStatus", ""),
+                             status.get("message", "")]))
+
+        self.addresses = []
+        ips = self.client.request(
+            "GET", f"{self.path}/publicipaddresses",
+            API_VERSIONS["Microsoft.Network"])
+        for item in ips.get("value", []):
+            address = item.get("properties", {}).get("ipAddress", "")
+            if address:
+                self.addresses.append(address)
+
+    def scale(self, capacity: int) -> None:
+        self.client.request("PATCH", self.path,
+                            API_VERSIONS["Microsoft.Compute"],
+                            {"sku": {"capacity": capacity}})
+
+    def delete(self) -> None:
+        try:
+            self.client.request("DELETE", self.path,
+                                API_VERSIONS["Microsoft.Compute"])
+        except ResourceNotFoundError:
+            pass

@@ -1,23 +1,29 @@
-"""Azure backend: reference-parity semantics on the hermetic control plane.
+"""Azure backend: real ARM control plane (with credentials) or hermetic.
 
 Size and region maps mirror /root/reference/task/az/resources/
 resource_virtual_machine_scale_set.go:111-124 and task/az/client/client.go:
 65-70; the user-assigned-identity ARM-ID validator mirrors
 data_source_permission_set.go:18-44 (comma-separated list). Spot semantics
 (VMSS eviction-policy Delete + BillingProfile, resource_virtual_machine_
-scale_set.go:219-229): >0 is the max price, 0 maps to -1 (no cap). The real
-ARM control plane is not wired this round (north star is Cloud TPU);
-lifecycle semantics run on the hermetic scaling-group plane.
+scale_set.go:219-229): >0 is the max price, 0 maps to -1 (no cap). With
+Azure credentials configured, AZRealTask provisions the reference's
+resource-group-rooted DAG over ARM REST; without credentials the hermetic
+scaling-group plane keeps the semantics testable.
 """
 
 from __future__ import annotations
 
+import os
 import re
-from typing import Dict, List
+from typing import Dict, List, Optional
 
+from tpu_task.backends.gcs_remote import GcsRemoteMixin
 from tpu_task.backends.group_task import GroupBackedTask
 from tpu_task.common.cloud import Cloud
+from tpu_task.common.errors import ResourceNotFoundError
 from tpu_task.common.identifier import Identifier, WrongIdentifierError
+from tpu_task.common.values import Task as TaskSpec
+from tpu_task.task import Task
 
 AZ_SIZES: Dict[str, str] = {
     "s": "Standard_B1s",
@@ -75,6 +81,21 @@ def validate_arm_id(permission_set: str) -> List[str]:
     return ids
 
 
+def _az_real_mode(cloud: Cloud) -> bool:
+    """Real ARM when the 4-tuple is configured and the hermetic plane isn't
+    forced (mirrors the AWS/GCE gates)."""
+    if os.environ.get("TPU_TASK_FAKE_TPU_ROOT"):
+        return False
+    creds = cloud.credentials.az
+    return bool(creds and creds.client_id and creds.subscription_id)
+
+
+def new_az_task(cloud: Cloud, identifier: Identifier, spec: TaskSpec):
+    if _az_real_mode(cloud):
+        return AZRealTask(cloud, identifier, spec)
+    return AZTask(cloud, identifier, spec)
+
+
 class AZTask(GroupBackedTask):
     provider_name = "az"
 
@@ -94,13 +115,283 @@ class AZTask(GroupBackedTask):
         return env
 
 
+class AZRealTask(GcsRemoteMixin, Task):
+    """Azure task over the real ARM control plane.
+
+    Composition parity with /root/reference/task/az/task.go: a resource
+    group roots the DAG — storage account + blob container, NSG + VNet +
+    subnet, VMSS at capacity 0 — then Push and Start (sku.capacity =
+    parallelism). Read folds instance-view summaries into Status, statuses
+    into Events, and per-VM public IPs into Addresses
+    (resource_virtual_machine_scale_set.go:240-301). Deleting the resource
+    group is the teardown.
+    """
+
+    def __init__(self, cloud: Cloud, identifier: Identifier, spec: TaskSpec):
+        from tpu_task.backends.az.api import ArmClient
+        from tpu_task.backends.az.resources import (
+            ResourceGroup, VirtualMachineScaleSet,
+        )
+
+        self.cloud = cloud
+        self.identifier = identifier
+        self.spec = spec
+        self.vm_size = resolve_az_machine(spec.size.machine or "m")
+        self.region = resolve_az_region(str(cloud.region))
+        self.identity_ids = validate_arm_id(spec.permission_set)
+        creds = cloud.credentials.az
+        self.client = ArmClient(creds.subscription_id, creds.tenant_id,
+                                creds.client_id, creds.client_secret)
+        self.resource_group = ResourceGroup(self.client, identifier.long(),
+                                            self.region)
+        self.scale_set = VirtualMachineScaleSet(
+            self.client, identifier.long(), identifier.long(), self.region)
+        self._remote_record: Optional[str] = None  # lazy tag lookup
+        self._account_key: Optional[str] = None
+
+    # -- plumbing -------------------------------------------------------------
+    def _storage_account(self):
+        from tpu_task.backends.az.resources import StorageAccount
+
+        return StorageAccount(self.client, self.identifier.long(),
+                              self.identifier.short(), self.region)
+
+    def _container(self):
+        from tpu_task.backends.az.resources import BlobContainer
+
+        if self._account_key is None:
+            self._account_key = self._storage_account().key()
+        return BlobContainer(self.identifier.short(), self._account_key,
+                             self.identifier.long())
+
+    def _remote(self) -> str:
+        if self.spec.remote_storage is not None:
+            return self._remote_storage_connection(backend="azureblob")
+        recorded = self._recorded_remote()
+        if recorded:
+            return recorded
+        return self._container().connection_string()
+
+    def _recorded_remote(self) -> str:
+        """The remote recorded (sanitized) as a VMSS tag; the account key is
+        re-fetched via listKeys rather than stored anywhere. Reuses tags a
+        prior scale_set.read() already fetched — no extra ARM round-trips
+        per poll tick."""
+        if self._remote_record is not None:
+            return self._remote_record
+        if self.scale_set.read_tags:
+            recorded = self.scale_set.read_tags.get("tpu-task-remote", "")
+        else:
+            try:
+                self.scale_set.read()
+                recorded = self.scale_set.read_tags.get("tpu-task-remote", "")
+            except ResourceNotFoundError:
+                recorded = ""
+        self._remote_record = self._with_local_credentials(recorded)
+        return self._remote_record
+
+    def _with_local_credentials(self, remote: str) -> str:
+        if not remote.startswith(":azureblob"):
+            return remote
+        from tpu_task.storage import Connection
+
+        conn = Connection.parse(remote)
+        if conn.config.get("account") == self.identifier.short():
+            conn.config["key"] = self._container().account_key
+        elif "key" not in conn.config:
+            import logging
+
+            logging.getLogger("tpu_task").warning(
+                "recorded remote uses external account %r; supply its key "
+                "via --storage-container-opts key=... for data access",
+                conn.config.get("account", ""))
+        return str(conn)
+
+    def _credentials_env(self) -> Dict[str, str]:
+        """Env map injected into the VM (data_source_credentials.go)."""
+        creds = self.cloud.credentials.az
+        return {
+            "AZURE_CLIENT_ID": creds.client_id,
+            "AZURE_CLIENT_SECRET": creds.client_secret,
+            "AZURE_SUBSCRIPTION_ID": creds.subscription_id,
+            "AZURE_TENANT_ID": creds.tenant_id,
+            "TPU_TASK_REMOTE": self._remote(),
+            "TPU_TASK_CLOUD_PROVIDER": "az",
+            "TPU_TASK_CLOUD_REGION": str(self.cloud.region),
+            "TPU_TASK_IDENTIFIER": self.identifier.long(),
+        }
+
+    def get_key_pair(self):
+        from tpu_task.common.ssh import DeterministicSSHKeyPair
+
+        return DeterministicSSHKeyPair(
+            self.cloud.credentials.az.client_secret, self.identifier.long())
+
+    def _custom_data(self) -> str:
+        import base64
+        import time as _time
+        from datetime import datetime, timezone
+
+        from tpu_task.machine import render_script
+
+        timeout = self.spec.environment.timeout
+        epoch = (None if timeout is None else datetime.fromtimestamp(
+            _time.time() + timeout.total_seconds(), tz=timezone.utc))
+        script = render_script(self.spec.environment.script,
+                               self._credentials_env(),
+                               self.spec.environment.variables, epoch,
+                               agent_wheel_url=getattr(
+                                   self, "_agent_wheel_url", ""))
+        return base64.b64encode(script.encode()).decode()
+
+    # -- lifecycle ------------------------------------------------------------
+    def create(self) -> None:
+        from tpu_task.backends.az.resources import (
+            SecurityGroup, VirtualNetwork, parse_image,
+        )
+        from tpu_task.common.steps import Step, run_steps
+        from tpu_task.storage import check_storage
+
+        security_group = SecurityGroup(
+            self.client, self.identifier.long(), self.identifier.long(),
+            self.region, self.spec.firewall)
+        network = VirtualNetwork(self.client, self.identifier.long(),
+                                 self.identifier.long(), self.region,
+                                 security_group)
+        steps = [Step("Creating ResourceGroup...", self.resource_group.create)]
+        if self.spec.remote_storage is not None:
+            steps.append(Step("Verifying container...",
+                              lambda: check_storage(self._remote())))
+        else:
+            steps += [
+                Step("Creating StorageAccount...",
+                     lambda: self._storage_account().create()),
+                Step("Creating BlobContainer...",
+                     lambda: self._container().create()),
+            ]
+        steps += [
+            Step("Creating SecurityGroup...", security_group.create),
+            Step("Creating VirtualNetwork...", network.create),
+        ]
+        run_steps(steps)
+
+        from tpu_task.machine.wheel import stage_wheel
+
+        self._agent_wheel_url = stage_wheel(self._remote())
+        ssh_user, image_reference, _plan = parse_image(
+            self.spec.environment.image)
+        self.scale_set.vm_size = self.vm_size
+        self.scale_set.subnet_id = network.subnet_id
+        self.scale_set.image_reference = image_reference
+        self.scale_set.ssh_user = ssh_user
+        self.scale_set.ssh_public_key = self.get_key_pair().public_string()
+        self.scale_set.custom_data_b64 = self._custom_data()
+        self.scale_set.spot = float(self.spec.spot)
+        self.scale_set.disk_size_gb = self.spec.size.storage
+        self.scale_set.identity_ids = self.identity_ids
+        self.scale_set.tags = {"tpu-task-remote": self._sanitized_remote(),
+                               **self.cloud.tags}
+        run_steps([
+            Step("Creating VirtualMachineScaleSet...", self.scale_set.create),
+            Step("Uploading Directory...", self.push),
+            Step("Starting task...", self.start),
+        ])
+
+    def start(self) -> None:
+        self.scale_set.scale(self.spec.parallelism)
+
+    def stop(self) -> None:
+        self.scale_set.scale(0)
+
+    def read(self) -> None:
+        self.scale_set.read()
+        self.spec.addresses = list(self.scale_set.addresses)
+        self.spec.status = self.status(running=self.scale_set.running)
+        self.spec.events = self.events()
+
+    def delete(self) -> None:
+        import logging
+
+        # Resolve the remote BEFORE the teardown removes the tag record;
+        # a second delete (account already gone → listKeys 404) must stay
+        # idempotent, and storage hiccups must never block the resource-
+        # group teardown that actually stops the billing.
+        try:
+            remote = self._remote()
+        except ResourceNotFoundError:
+            remote = ""
+        if remote and self.spec.environment.directory:
+            try:
+                self.pull()
+            except ResourceNotFoundError:
+                pass
+        if remote and not self._is_per_task_bucket(remote):
+            # Pre-allocated container: empty only this task's subdirectory.
+            from tpu_task.storage import delete_storage
+
+            try:
+                delete_storage(remote)
+            except ResourceNotFoundError:
+                pass
+            except Exception as error:
+                logging.getLogger("tpu_task").warning(
+                    "could not empty %s (%s); continuing with teardown",
+                    remote, error)
+        # The resource group contains everything (incl. the per-task
+        # storage account): one delete is the full teardown (task/az/task.go).
+        self.resource_group.delete()
+
+    # -- observation (data plane inherited from GcsRemoteMixin) ---------------
+    def status(self, running: Optional[int] = None):
+        if running is None:
+            if self.spec.status:
+                return self.spec.status
+            self.scale_set.read()
+            running = self.scale_set.running
+        return self._folded_status(running)
+
+    def events(self):
+        return list(self.scale_set.events)
+
+    def observed_parallelism(self) -> Optional[int]:
+        """sku.capacity from the VMSS's own record."""
+        if not self.scale_set.capacity:
+            try:
+                self.scale_set.read()
+            except ResourceNotFoundError:
+                return None
+        return self.scale_set.capacity or None
+
+
 def list_az_tasks(cloud: Cloud) -> List[Identifier]:
+    identifiers = []
+    seen = set()
+
+    def add(identifier: Identifier) -> None:
+        if identifier.long() not in seen:
+            seen.add(identifier.long())
+            identifiers.append(identifier)
+
+    if _az_real_mode(cloud):
+        # ListResourceGroups backs `leo list` (resource_group.go:14).
+        from tpu_task.backends.az.api import API_VERSIONS, ArmClient
+
+        creds = cloud.credentials.az
+        client = ArmClient(creds.subscription_id, creds.tenant_id,
+                           creds.client_id, creds.client_secret)
+        payload = client.request(
+            "GET", f"/subscriptions/{client.subscription_id}/resourcegroups",
+            API_VERSIONS["resourcegroups"])
+        for item in payload.get("value", []):
+            try:
+                add(Identifier.parse(item.get("name", "")))
+            except WrongIdentifierError:
+                continue
     from tpu_task.backends.local.control_plane import list_groups
 
-    identifiers = []
     for name in list_groups():
         try:
-            identifiers.append(Identifier.parse(name))
+            add(Identifier.parse(name))
         except WrongIdentifierError:
             continue
     return identifiers

@@ -87,9 +87,9 @@ def new(cloud: Cloud, identifier: Identifier, spec: TaskSpec) -> Task:
 
         return new_aws_task(cloud, identifier, spec)
     if cloud.provider == Provider.AZ:
-        from tpu_task.backends.az import AZTask
+        from tpu_task.backends.az import new_az_task
 
-        return AZTask(cloud, identifier, spec)
+        return new_az_task(cloud, identifier, spec)
     raise ValueError(f"unknown provider: {cloud.provider!r}")
 
 
