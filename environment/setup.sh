@@ -1,0 +1,38 @@
+#!/bin/bash
+# Baked-image provisioning for TPU-VM (and GCE) workers.
+#
+# Role of /root/reference/environment/setup.sh (docker + nvidia + terraform
+# for the iterative-cml AMI), re-targeted: pre-install everything the
+# tpu-task worker bootstrap would otherwise fetch at boot, so instances from
+# the baked image skip the install stanzas entirely (the bootstrap's
+# `command -v tpu-task` / `python3 -c 'import jax'` guards short-circuit)
+# and cold-start in seconds.
+#
+# Usage (image pipeline — see environment/README.md):
+#   1. boot a builder VM from the base image (TPU-VM: tpu-ubuntu2204-base)
+#   2. copy the tpu-task wheel next to this script and run it
+#   3. gcloud compute images create ... --source-disk=<builder-disk>
+set -euo pipefail
+
+export DEBIAN_FRONTEND=noninteractive
+
+sudo apt-get update -qq
+sudo apt-get install -y -qq python3-pip curl
+
+# The tpu-task agent (data plane + self-destruct CLI). A wheel shipped next
+# to this script wins; the package index is the fallback.
+WHEEL="$(ls "$(dirname "$0")"/tpu_task-*.whl 2> /dev/null | head -1 || true)"
+if test -n "$WHEEL"; then
+  sudo python3 -m pip install --quiet "$WHEEL"
+else
+  sudo python3 -m pip install --quiet tpu-task
+fi
+
+# JAX for TPU (the libtpu wheel rides the jax[tpu] extra).
+sudo python3 -m pip install --quiet 'jax[tpu]' \
+  --find-links https://storage.googleapis.com/jax-releases/libtpu_releases.html
+
+# Boot-time noise the bootstrap otherwise disables per-instance.
+sudo systemctl disable --now apt-daily.timer apt-daily-upgrade.timer 2> /dev/null || true
+
+echo "baked: $(tpu-task --help > /dev/null 2>&1 && echo tpu-task-ok) $(python3 -c 'import jax; print("jax", jax.__version__)')"

@@ -44,16 +44,31 @@ def test_tpu_real_lifecycle(tmp_path):
         credentials=Credentials(gcp=GCPCredentials.from_env()),
     )
 
+    _sweep(cloud)
+    _lifecycle(cloud, os.environ.get("SMOKE_TEST_TPU_MACHINE", "v2-8"),
+               tmp_path)
+
+
+# -- per-provider matrix (reference smoke.yml: SMOKE_TEST_ENABLE_{AWS,AZ,GCP}) --
+
+
+def _sweep(cloud) -> None:
+    """Always-run straggler cleanup (smoke.yml:96-101 role)."""
     if os.environ.get("SMOKE_TEST_SWEEP"):
         for identifier in task_factory.list_tasks(cloud):
             task_factory.new(cloud, identifier, TaskSpec()).delete()
 
+
+def _lifecycle(cloud, machine: str, tmp_path, budget_s: int = 25 * 60):
+    """The reference's smoke shape (task_smoke_test.go:162-233): delete →
+    create → create (idempotent) → poll logs for a sentinel → delete →
+    delete, asserting the output round-trip."""
     sentinel = str(uuid.uuid4())
     workdir = tmp_path / "work"
     workdir.mkdir()
     (workdir / "input.txt").write_text("smoke-payload")
     spec = TaskSpec(
-        size=Size(machine=os.environ.get("SMOKE_TEST_TPU_MACHINE", "v2-8")),
+        size=Size(machine=machine),
         environment=Environment(
             script=f"#!/bin/bash\ncat input.txt\necho {sentinel}\n"
                    "mkdir -p output && echo ok > output/r.txt\n",
@@ -62,11 +77,11 @@ def test_tpu_real_lifecycle(tmp_path):
     )
     identifier = Identifier.random("smoke")
     task = task_factory.new(cloud, identifier, spec)
-    task.delete()            # NotFound tolerated
+    task.delete()
     task.create()
-    task.create()            # double-invoke idempotency (smoke_test.go:180)
+    task.create()
     try:
-        deadline = time.time() + 25 * 60
+        deadline = time.time() + budget_s
         while time.time() < deadline:
             task.read()
             status = task.status()
@@ -80,5 +95,46 @@ def test_tpu_real_lifecycle(tmp_path):
         assert sentinel in logs and "smoke-payload" in logs
     finally:
         task.delete()
-        task.delete()        # double delete tolerated
+        task.delete()
     assert (workdir / "output" / "r.txt").exists()
+
+
+@pytest.mark.skipif(
+    not (os.environ.get("SMOKE_TEST_ENABLE_AWS")
+         and os.environ.get("AWS_ACCESS_KEY_ID")),
+    reason="real-AWS smoke disabled (set SMOKE_TEST_ENABLE_AWS + AWS_* creds)")
+def test_aws_real_lifecycle(tmp_path):
+    from tpu_task.common.cloud import AWSCredentials, Credentials
+
+    cloud = Cloud(provider=Provider.AWS,
+                  region=os.environ.get("SMOKE_TEST_AWS_REGION", "us-east-1"),
+                  credentials=Credentials(aws=AWSCredentials.from_env()))
+    _sweep(cloud)
+    _lifecycle(cloud, os.environ.get("SMOKE_TEST_AWS_MACHINE", "s"), tmp_path)
+
+
+@pytest.mark.skipif(
+    not (os.environ.get("SMOKE_TEST_ENABLE_GCP") and HAS_CREDS),
+    reason="real-GCE smoke disabled (set SMOKE_TEST_ENABLE_GCP + GCP creds)")
+def test_gce_real_lifecycle(tmp_path):
+    from tpu_task.common.cloud import Credentials, GCPCredentials
+
+    cloud = Cloud(provider=Provider.GCP,
+                  region=os.environ.get("SMOKE_TEST_GCP_REGION", "us-west1-b"),
+                  credentials=Credentials(gcp=GCPCredentials.from_env()))
+    _sweep(cloud)
+    _lifecycle(cloud, os.environ.get("SMOKE_TEST_GCP_MACHINE", "s"), tmp_path)
+
+
+@pytest.mark.skipif(
+    not (os.environ.get("SMOKE_TEST_ENABLE_AZ")
+         and os.environ.get("AZURE_CLIENT_ID")),
+    reason="real-Azure smoke disabled (set SMOKE_TEST_ENABLE_AZ + AZURE_* creds)")
+def test_az_real_lifecycle(tmp_path):
+    from tpu_task.common.cloud import AZCredentials, Credentials
+
+    cloud = Cloud(provider=Provider.AZ,
+                  region=os.environ.get("SMOKE_TEST_AZ_REGION", "eastus"),
+                  credentials=Credentials(az=AZCredentials.from_env()))
+    _sweep(cloud)
+    _lifecycle(cloud, os.environ.get("SMOKE_TEST_AZ_MACHINE", "s"), tmp_path)
