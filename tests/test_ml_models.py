@@ -90,3 +90,58 @@ def test_embed_backward_chunked_matches_einsum(monkeypatch):
     chunked = jax.grad(loss)(table)
     np.testing.assert_allclose(np.asarray(chunked), np.asarray(ref),
                                atol=1e-5)
+
+
+def test_fused_xent_matches_reference():
+    """Blockwise cross-entropy (bounded logits memory for long-context)
+    equals the monolithic path exactly — loss and all gradients."""
+    cfg = transformer.TransformerConfig(
+        vocab_size=8192, d_model=32, n_layers=2, n_heads=4, d_head=8,
+        d_ff=64, dtype=jnp.float32)
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0, 8192)
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda p: transformer.loss_fn(p, cfg, tokens, fused=False))(params)
+    fused_loss, fused_grads = jax.value_and_grad(
+        lambda p: transformer.loss_fn(p, cfg, tokens, fused=True))(params)
+    assert abs(float(ref_loss) - float(fused_loss)) < 1e-5
+    for a, b in zip(jax.tree.leaves(ref_grads), jax.tree.leaves(fused_grads)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_fused_xent_nondivisible_vocab_padded_exactly():
+    """A vocab not divisible by the block is padded with masked columns —
+    the fused result stays exact (no silent fallback that would
+    rematerialize full logits for Llama/GPT-style vocab sizes)."""
+    cfg = transformer.TransformerConfig(
+        vocab_size=100, d_model=16, n_layers=1, n_heads=2, d_head=8,
+        d_ff=32, dtype=jnp.float32)
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 9), 0, 100)
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda p: transformer.loss_fn(p, cfg, tokens, fused=False))(params)
+    fused_loss, fused_grads = jax.value_and_grad(
+        lambda p: transformer.loss_fn(p, cfg, tokens, fused=True))(params)
+    assert abs(float(ref_loss) - float(fused_loss)) < 1e-6
+    for a, b in zip(jax.tree.leaves(ref_grads), jax.tree.leaves(fused_grads)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_fused_xent_bf16_stays_close_to_f32_reference():
+    """The shipped bf16 config: the fused backward accumulates in f32, so
+    gradients track the monolithic path at bf16-appropriate tolerance."""
+    cfg = transformer.TransformerConfig(
+        vocab_size=8192, d_model=32, n_layers=2, n_heads=4, d_head=8,
+        d_ff=64, dtype=jnp.bfloat16)
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0, 8192)
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda p: transformer.loss_fn(p, cfg, tokens, fused=False))(params)
+    fused_loss, fused_grads = jax.value_and_grad(
+        lambda p: transformer.loss_fn(p, cfg, tokens, fused=True))(params)
+    assert abs(float(ref_loss) - float(fused_loss)) < 2e-3
+    for a, b in zip(jax.tree.leaves(ref_grads), jax.tree.leaves(fused_grads)):
+        a = np.asarray(a, dtype=np.float32)
+        b = np.asarray(b, dtype=np.float32)
+        scale = np.abs(b).max() + 1e-9
+        assert np.abs(a - b).max() <= 0.03 * scale

@@ -44,7 +44,8 @@ def test_tpu_real_lifecycle(tmp_path):
         credentials=Credentials(gcp=GCPCredentials.from_env()),
     )
 
-    _sweep(cloud)
+    if _sweep(cloud):
+        return
     _lifecycle(cloud, os.environ.get("SMOKE_TEST_TPU_MACHINE", "v2-8"),
                tmp_path)
 
@@ -52,11 +53,15 @@ def test_tpu_real_lifecycle(tmp_path):
 # -- per-provider matrix (reference smoke.yml: SMOKE_TEST_ENABLE_{AWS,AZ,GCP}) --
 
 
-def _sweep(cloud) -> None:
-    """Always-run straggler cleanup (smoke.yml:96-101 role)."""
+def _sweep(cloud) -> bool:
+    """Always-run straggler cleanup (smoke.yml:96-101 role). Returns True in
+    sweep mode — the caller must then SKIP its lifecycle: the cleanup job
+    exists to delete leaked resources, not to provision new billed ones."""
     if os.environ.get("SMOKE_TEST_SWEEP"):
         for identifier in task_factory.list_tasks(cloud):
             task_factory.new(cloud, identifier, TaskSpec()).delete()
+        return True
+    return False
 
 
 def _lifecycle(cloud, machine: str, tmp_path, budget_s: int = 25 * 60):
@@ -109,7 +114,8 @@ def test_aws_real_lifecycle(tmp_path):
     cloud = Cloud(provider=Provider.AWS,
                   region=os.environ.get("SMOKE_TEST_AWS_REGION", "us-east-1"),
                   credentials=Credentials(aws=AWSCredentials.from_env()))
-    _sweep(cloud)
+    if _sweep(cloud):
+        return
     _lifecycle(cloud, os.environ.get("SMOKE_TEST_AWS_MACHINE", "s"), tmp_path)
 
 
@@ -122,7 +128,8 @@ def test_gce_real_lifecycle(tmp_path):
     cloud = Cloud(provider=Provider.GCP,
                   region=os.environ.get("SMOKE_TEST_GCP_REGION", "us-west1-b"),
                   credentials=Credentials(gcp=GCPCredentials.from_env()))
-    _sweep(cloud)
+    if _sweep(cloud):
+        return
     _lifecycle(cloud, os.environ.get("SMOKE_TEST_GCP_MACHINE", "s"), tmp_path)
 
 
@@ -136,5 +143,6 @@ def test_az_real_lifecycle(tmp_path):
     cloud = Cloud(provider=Provider.AZ,
                   region=os.environ.get("SMOKE_TEST_AZ_REGION", "eastus"),
                   credentials=Credentials(az=AZCredentials.from_env()))
-    _sweep(cloud)
+    if _sweep(cloud):
+        return
     _lifecycle(cloud, os.environ.get("SMOKE_TEST_AZ_MACHINE", "s"), tmp_path)
