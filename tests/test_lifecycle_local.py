@@ -171,8 +171,10 @@ def test_parallelism_runs_n_workers(cloud):
     task = task_factory.new(cloud, Identifier.deterministic("parallel-test"), spec)
     task.create()
     try:
+        # Generous timeout: 3 agent subprocesses + sync loops under full-
+        # suite load can take tens of seconds on a busy machine.
         poll(task, lambda t: t.status().get(StatusCode.SUCCEEDED, 0)
-             + t.status().get(StatusCode.FAILED, 0) >= 3, timeout=30)
+             + t.status().get(StatusCode.FAILED, 0) >= 3, timeout=90)
         logs = "".join(task.logs())
         for rank in range(3):
             assert f"worker-{rank}" in logs

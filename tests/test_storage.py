@@ -269,3 +269,26 @@ def test_native_copy_preserves_mtime(tmp_path):
     if not native.copy_files([(str(src), str(dst))]):
         pytest.skip("native toolchain unavailable")
     assert abs(os.path.getmtime(dst) - 1000000000) < 0.01
+
+
+def test_worker0_mirror_spares_other_workers_shards(tmp_path):
+    """The worker-0 agent mirror excludes other workers' checkpoint shard
+    files, so its sync cannot delete shards only worker N uploaded
+    (tpu-worker-script.sh.tpl data loop rules)."""
+    src = tmp_path / "workdir"
+    (src / "checkpoints").mkdir(parents=True)
+    (src / "checkpoints" / "ckpt-5.shard-0.npz").write_bytes(b"w0")
+    (src / "data.txt").write_text("payload")
+    dst = tmp_path / "bucket-data"
+    (dst / "checkpoints").mkdir(parents=True)
+    (dst / "checkpoints" / "ckpt-5.shard-1.npz").write_bytes(b"w1")
+    (dst / "stale.txt").write_text("old")
+
+    sync(str(src), str(dst), exclude=["+ **ckpt-*.shard-0.*",
+                                      "- **ckpt-*.shard-*"])
+    # Worker 0's own shard and files mirrored; worker 1's shard SURVIVES;
+    # genuinely stale files still deleted.
+    assert (dst / "checkpoints" / "ckpt-5.shard-0.npz").read_bytes() == b"w0"
+    assert (dst / "checkpoints" / "ckpt-5.shard-1.npz").read_bytes() == b"w1"
+    assert (dst / "data.txt").read_text() == "payload"
+    assert not (dst / "stale.txt").exists()

@@ -123,14 +123,31 @@ while sleep 5; do
 done &
 
 # Data/checkpoint stream: workdir → bucket, every 10 s when mtimes change.
-# Only worker 0 uploads (all workers share one bucket; checkpoints are
-# written via the task library with per-worker sharding when needed).
+# Worker 0 mirrors the whole workdir; every other worker copies ONLY its own
+# checkpoint shard files (ckpt-*.shard-$TPU_WORKER_ID.* — written by
+# tpu_task.ml.save_checkpoint_sharded), so multi-host sharded state reaches
+# the bucket without concurrent mirrors deleting each other's uploads.
 if test "${TPU_WORKER_ID:-0}" = "0"; then
   while sleep 10; do
     NEW_TPU_TASK_DATA_EPOCH="$(find "$TPU_TASK_DATA_DIRECTORY" -printf "%T@\n" | sort | tail -1)"
     if test "$NEW_TPU_TASK_DATA_EPOCH" != "$TPU_TASK_DATA_EPOCH"; then
       TPU_TASK_DATA_EPOCH="$NEW_TPU_TASK_DATA_EPOCH"
-      tpu-task storage sync "$TPU_TASK_DATA_DIRECTORY" "$TPU_TASK_REMOTE/data"
+      # Other workers' shard files exist only in the bucket — exclude them
+      # from the mirror so worker 0's sync can't delete them.
+      tpu-task storage sync "$TPU_TASK_DATA_DIRECTORY" "$TPU_TASK_REMOTE/data" \
+        --exclude "+ **ckpt-*.shard-0.*" --exclude "- **ckpt-*.shard-*"
+    fi
+  done &
+else
+  while sleep 10; do
+    NEW_TPU_TASK_DATA_EPOCH="$(find "$TPU_TASK_DATA_DIRECTORY" -name "ckpt-*.shard-$TPU_WORKER_ID.*" -printf "%T@\n" | sort | tail -1)"
+    if test "$NEW_TPU_TASK_DATA_EPOCH" != "$TPU_TASK_DATA_EPOCH"; then
+      TPU_TASK_DATA_EPOCH="$NEW_TPU_TASK_DATA_EPOCH"
+      # sync (not copy), scoped to this worker's shards: stale shard files
+      # pruned locally must also leave the bucket, or respawn restores drag
+      # an ever-growing pile onto every worker.
+      tpu-task storage sync "$TPU_TASK_DATA_DIRECTORY" "$TPU_TASK_REMOTE/data" \
+        --exclude "+ **ckpt-*.shard-$TPU_WORKER_ID.*" --exclude "- **"
     fi
   done &
 fi

@@ -10,7 +10,13 @@ makes the orchestrator's continuous data sync (machine-script.sh.tpl:118-124
 semantics) meaningful for training jobs.
 """
 
-from tpu_task.ml.checkpoint import latest_step, restore_checkpoint, save_checkpoint
+from tpu_task.ml.checkpoint import (
+    latest_step,
+    restore_checkpoint,
+    restore_checkpoint_sharded,
+    save_checkpoint,
+    save_checkpoint_sharded,
+)
 from tpu_task.ml.parallel.mesh import (
     balanced_mesh_shape,
     distributed_init_from_env,
@@ -23,5 +29,7 @@ __all__ = [
     "latest_step",
     "make_mesh",
     "restore_checkpoint",
+    "restore_checkpoint_sharded",
     "save_checkpoint",
+    "save_checkpoint_sharded",
 ]

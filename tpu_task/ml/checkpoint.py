@@ -69,6 +69,145 @@ def latest_step(directory) -> Optional[int]:
     return max(steps) if steps else None
 
 
+# -- process-sharded checkpoints (multi-host) ---------------------------------
+#
+# np.asarray on a multi-host sharded jax.Array would gather (or fail: shards
+# on other hosts aren't addressable). The sharded format writes, per process,
+# only the shards that process holds — ckpt-{step}.shard-{process}.npz with
+# entries keyed by each shard's GLOBAL index range — and restore reassembles
+# from whichever files hold the ranges the local devices need, so a respawned
+# slice restores correctly even if worker/process numbering changed. The
+# worker agent syncs each worker's own shard files to the bucket
+# (tpu-worker-script.sh.tpl), so the bucket always holds the full set.
+
+_SHARD_RE = re.compile(r"^ckpt-(\d+)\.shard-(\d+)\.npz$")
+
+
+def _index_key(leaf_index: int, index, shape) -> str:
+    """Stable string key for a shard's global index range."""
+    parts = []
+    for dim, slc in enumerate(index):
+        start = 0 if slc.start is None else int(slc.start)
+        stop = shape[dim] if slc.stop is None else int(slc.stop)
+        parts.append(f"{start}:{stop}")
+    return f"leaf_{leaf_index}|" + ",".join(parts)
+
+
+def save_checkpoint_sharded(directory, step: int, tree: Any) -> Path:
+    """Write this process's shards of a (possibly multi-host) pytree.
+
+    Every process calls this; each writes only its addressable, replica-0
+    shards. LATEST is written by process 0 only, and names the expected
+    shard-file count so restore can detect a partial set.
+    """
+    directory = Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    process = jax.process_index()
+
+    arrays = {}
+    for leaf_index, leaf in enumerate(jax.tree.leaves(tree)):
+        if isinstance(leaf, jax.Array) and hasattr(leaf, "addressable_shards"):
+            shape = leaf.shape
+            for shard in leaf.addressable_shards:
+                if shard.replica_id != 0:
+                    continue  # one copy of replicated shards is enough
+                arrays[_index_key(leaf_index, shard.index, shape)] = \
+                    np.asarray(shard.data)
+        else:
+            array = np.asarray(leaf)
+            if process == 0:  # plain host values: process 0's copy wins
+                index = tuple(slice(0, dim) for dim in array.shape)
+                arrays[_index_key(leaf_index, index, array.shape)] = array
+
+    final = directory / f"ckpt-{step}.shard-{process}.npz"
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as handle:
+            np.savez(handle, **arrays)
+        os.replace(tmp, final)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+    if process == 0:
+        pointer = directory / "LATEST.tmp"
+        pointer.write_text(json.dumps({
+            "step": step, "file": final.name, "sharded": True,
+            "process_count": jax.process_count()}))
+        os.replace(pointer, directory / "LATEST")
+    return final
+
+
+def restore_checkpoint_sharded(directory, template: Any,
+                               step: Optional[int] = None) -> Any:
+    """Reassemble a sharded checkpoint into ``template``'s shardings.
+
+    Reads every ``ckpt-{step}.shard-*.npz`` present (the workdir restore
+    pulls all of them from the bucket) and places, per template leaf, the
+    global index ranges each LOCAL device needs — shard files are matched
+    by index range, not by process number, so recovery survives process
+    renumbering. With no explicit ``step``, tries steps NEWEST → OLDEST and
+    falls back past incomplete sets: workers upload shards on independent
+    sync loops, so a preemption can land mid-upload and the newest step may
+    be partial — the last complete one must still restore.
+    """
+    directory = Path(directory)
+    if step is not None:
+        return _restore_sharded_step(directory, template, step)
+    steps = sorted({int(m.group(1))
+                    for p in (directory.iterdir()
+                              if directory.is_dir() else [])
+                    if (m := _SHARD_RE.match(p.name))}, reverse=True)
+    if not steps:
+        raise FileNotFoundError(f"no sharded checkpoint in {directory}")
+    last_error: Optional[Exception] = None
+    for candidate in steps:
+        try:
+            return _restore_sharded_step(directory, template, candidate)
+        except FileNotFoundError as error:
+            last_error = error
+    raise FileNotFoundError(
+        f"no complete sharded checkpoint in {directory} "
+        f"(tried steps {steps}): {last_error}")
+
+
+def _restore_sharded_step(directory: Path, template: Any, step: int) -> Any:
+    data: dict = {}
+    for path in sorted(directory.glob(f"ckpt-{step}.shard-*.npz")):
+        with np.load(path) as payload:
+            for key in payload.files:
+                data[key] = payload[key]
+    if not data:
+        raise FileNotFoundError(f"no shard files for step {step}")
+
+    def lookup(key: str):
+        if key not in data:
+            raise FileNotFoundError(
+                f"shard {key} missing at step {step} — incomplete "
+                f"checkpoint ({len(data)} entries present)")
+        return data[key]
+
+    leaves, treedef = jax.tree.flatten(template)
+    restored = []
+    for leaf_index, leaf in enumerate(leaves):
+        if isinstance(leaf, jax.Array) and hasattr(leaf, "sharding"):
+            shape = leaf.shape
+            index_map = leaf.sharding.addressable_devices_indices_map(shape)
+            device_arrays = []
+            for device, index in index_map.items():
+                key = _index_key(leaf_index, index, shape)
+                device_arrays.append(jax.device_put(
+                    lookup(key).astype(leaf.dtype), device))
+            restored.append(jax.make_array_from_single_device_arrays(
+                shape, leaf.sharding, device_arrays))
+        else:
+            array = np.asarray(leaf)
+            index = tuple(slice(0, dim) for dim in array.shape)
+            restored.append(lookup(_index_key(leaf_index, index, array.shape)))
+    return jax.tree.unflatten(treedef, restored)
+
+
 def restore_checkpoint(directory, template: Any, step: Optional[int] = None) -> Any:
     """Restore into ``template``'s structure (dtypes/shardings preserved)."""
     directory = Path(directory)
