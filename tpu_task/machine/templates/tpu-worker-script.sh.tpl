@@ -76,7 +76,7 @@ sudo tee /etc/systemd/system/tpu-task.service > /dev/null <<END
 [Service]
   Type=simple
   ExecStart=-$TPU_TASK_START_COMMAND
-  ExecStop=/bin/bash -c 'source /opt/task/credentials; if test "\$TPU_WORKER_ID" = "0"; then tpu-task storage sync "$TPU_TASK_DATA_DIRECTORY" "\$TPU_TASK_REMOTE/data"; fi; systemctl is-system-running | grep stopping || echo "{\\\\"result\\\\": \\\\"\$SERVICE_RESULT\\\\", \\\\"code\\\\": \\\\"\$EXIT_STATUS\\\\", \\\\"status\\\\": \\\\"\$EXIT_CODE\\\\"}" > "$TPU_TASK_LOG_DIRECTORY/status-$TPU_TASK_MACHINE_IDENTITY" && tpu-task storage copy "$TPU_TASK_LOG_DIRECTORY" "\$TPU_TASK_REMOTE/reports"'
+  ExecStop=/bin/bash -c 'source /opt/task/credentials; if test "\$TPU_WORKER_ID" = "0"; then tpu-task storage sync "$TPU_TASK_DATA_DIRECTORY" "\$TPU_TASK_REMOTE/data" --exclude "+ **ckpt-*.shard-0.*" --exclude "- **ckpt-*.shard-*"; else tpu-task storage sync "$TPU_TASK_DATA_DIRECTORY" "\$TPU_TASK_REMOTE/data" --exclude "+ **ckpt-*.shard-\$TPU_WORKER_ID.*" --exclude "- **"; fi; systemctl is-system-running | grep stopping || echo "{\\\\"result\\\\": \\\\"\$SERVICE_RESULT\\\\", \\\\"code\\\\": \\\\"\$EXIT_STATUS\\\\", \\\\"status\\\\": \\\\"\$EXIT_CODE\\\\"}" > "$TPU_TASK_LOG_DIRECTORY/status-$TPU_TASK_MACHINE_IDENTITY" && tpu-task storage copy "$TPU_TASK_LOG_DIRECTORY" "\$TPU_TASK_REMOTE/reports"'
   ExecStopPost=/usr/bin/tpu-task-shutdown
   Environment=HOME=/root
   EnvironmentFile=/opt/task/variables

@@ -97,8 +97,9 @@ def save_checkpoint_sharded(directory, step: int, tree: Any) -> Path:
     """Write this process's shards of a (possibly multi-host) pytree.
 
     Every process calls this; each writes only its addressable, replica-0
-    shards. LATEST is written by process 0 only, and names the expected
-    shard-file count so restore can detect a partial set.
+    shards. Process 0 also writes a LATEST_SHARDED pointer naming the step
+    and the expected shard-file count — restore uses it to reject partial
+    sets consistently across hosts (the plain-format LATEST is untouched).
     """
     directory = Path(directory)
     directory.mkdir(parents=True, exist_ok=True)
@@ -131,11 +132,14 @@ def save_checkpoint_sharded(directory, step: int, tree: Any) -> Path:
         raise
 
     if process == 0:
-        pointer = directory / "LATEST.tmp"
+        # A SEPARATE pointer file: repointing the plain LATEST at a shard
+        # file would make latest_step()/restore_checkpoint() chase a
+        # nonexistent ckpt-{step}.npz.
+        pointer = directory / "LATEST_SHARDED.tmp"
         pointer.write_text(json.dumps({
-            "step": step, "file": final.name, "sharded": True,
+            "step": step, "file": final.name,
             "process_count": jax.process_count()}))
-        os.replace(pointer, directory / "LATEST")
+        os.replace(pointer, directory / "LATEST_SHARDED")
     return final
 
 
@@ -161,11 +165,33 @@ def restore_checkpoint_sharded(directory, template: Any,
                     if (m := _SHARD_RE.match(p.name))}, reverse=True)
     if not steps:
         raise FileNotFoundError(f"no sharded checkpoint in {directory}")
+    # Step eligibility must be decided IDENTICALLY on every host — a
+    # per-host "whatever ranges my devices need" check would let different
+    # hosts resume from different steps after a partial upload. A step is
+    # eligible only when the full shard-file set is present (save-time
+    # process count from the pointer when available, else this topology's).
+    expected = jax.process_count()
+    pointer = directory / "LATEST_SHARDED"
+    pointer_step = None
+    if pointer.exists():
+        try:
+            meta = json.loads(pointer.read_text())
+            pointer_step = int(meta["step"])
+            if meta.get("process_count"):
+                expected = int(meta["process_count"])
+        except (ValueError, KeyError):
+            pass
     last_error: Optional[Exception] = None
     for candidate in steps:
+        present = len(list(directory.glob(f"ckpt-{candidate}.shard-*.npz")))
+        if present < expected and not (pointer_step == candidate
+                                       and present >= expected):
+            last_error = FileNotFoundError(
+                f"step {candidate}: {present}/{expected} shard files")
+            continue
         try:
             return _restore_sharded_step(directory, template, candidate)
-        except FileNotFoundError as error:
+        except Exception as error:  # torn file (BadZipFile), missing entry…
             last_error = error
     raise FileNotFoundError(
         f"no complete sharded checkpoint in {directory} "
@@ -173,39 +199,52 @@ def restore_checkpoint_sharded(directory, template: Any,
 
 
 def _restore_sharded_step(directory: Path, template: Any, step: int) -> Any:
-    data: dict = {}
-    for path in sorted(directory.glob(f"ckpt-{step}.shard-*.npz")):
-        with np.load(path) as payload:
-            for key in payload.files:
-                data[key] = payload[key]
-    if not data:
-        raise FileNotFoundError(f"no shard files for step {step}")
+    # NpzFile members decompress lazily on access: index key → handle and
+    # load only the ranges this host's devices actually need — each host
+    # must NOT materialize the whole global checkpoint (that's the point
+    # of sharded restore).
+    paths = sorted(directory.glob(f"ckpt-{step}.shard-*.npz"))
+    handles = []
+    try:
+        index: dict = {}
+        for path in paths:
+            handle = np.load(path)
+            handles.append(handle)
+            for key in handle.files:
+                index[key] = handle
 
-    def lookup(key: str):
-        if key not in data:
-            raise FileNotFoundError(
-                f"shard {key} missing at step {step} — incomplete "
-                f"checkpoint ({len(data)} entries present)")
-        return data[key]
+        if not index:
+            raise FileNotFoundError(f"no shard files for step {step}")
 
-    leaves, treedef = jax.tree.flatten(template)
-    restored = []
-    for leaf_index, leaf in enumerate(leaves):
-        if isinstance(leaf, jax.Array) and hasattr(leaf, "sharding"):
-            shape = leaf.shape
-            index_map = leaf.sharding.addressable_devices_indices_map(shape)
-            device_arrays = []
-            for device, index in index_map.items():
-                key = _index_key(leaf_index, index, shape)
-                device_arrays.append(jax.device_put(
-                    lookup(key).astype(leaf.dtype), device))
-            restored.append(jax.make_array_from_single_device_arrays(
-                shape, leaf.sharding, device_arrays))
-        else:
-            array = np.asarray(leaf)
-            index = tuple(slice(0, dim) for dim in array.shape)
-            restored.append(lookup(_index_key(leaf_index, index, array.shape)))
-    return jax.tree.unflatten(treedef, restored)
+        def lookup(key: str):
+            if key not in index:
+                raise FileNotFoundError(
+                    f"shard {key} missing at step {step} — incomplete "
+                    f"checkpoint ({len(index)} entries present)")
+            return index[key][key]
+
+        leaves, treedef = jax.tree.flatten(template)
+        restored = []
+        for leaf_index, leaf in enumerate(leaves):
+            if isinstance(leaf, jax.Array) and hasattr(leaf, "sharding"):
+                shape = leaf.shape
+                index_map = leaf.sharding.addressable_devices_indices_map(shape)
+                device_arrays = []
+                for device, device_index in index_map.items():
+                    key = _index_key(leaf_index, device_index, shape)
+                    device_arrays.append(jax.device_put(
+                        lookup(key).astype(leaf.dtype), device))
+                restored.append(jax.make_array_from_single_device_arrays(
+                    shape, leaf.sharding, device_arrays))
+            else:
+                array = np.asarray(leaf)
+                full = tuple(slice(0, dim) for dim in array.shape)
+                restored.append(lookup(_index_key(leaf_index, full,
+                                                  array.shape)))
+        return jax.tree.unflatten(treedef, restored)
+    finally:
+        for handle in handles:
+            handle.close()
 
 
 def restore_checkpoint(directory, template: Any, step: Optional[int] = None) -> Any:
