@@ -245,10 +245,11 @@ def bench_flash_kernel() -> dict:
     return out
 
 
-def _min_time_per_iter(fn, q, k, v, iters: int, repeats: int = 3) -> float:
+def _min_time_per_iter(fn, q, k, v, iters: int, repeats: int = 6) -> float:
     """Seconds per iteration for a jitted iters-chained loop: compile+sync
     first, then min-of-N wall times with a host-readback fence (tunnel
-    timing noise is ±40%; see the NOTE in bench_train_mfu)."""
+    timing noise is ±40% and drifts down over the first ~4 repeats; see the
+    NOTE in bench_train_mfu)."""
     import jax.numpy as jnp
 
     result = fn(q, k, v)
