@@ -83,7 +83,7 @@ def test_exec_on_workers_and_distributed_env(tpu_cloud, tmp_path):
     task = task_factory.new(tpu_cloud, Identifier.deterministic("fanout-exec"), spec)
     task.create()
     try:
-        poll(task, lambda t: len(t.get_addresses()) == 4, timeout=15)
+        poll(task, lambda t: len(t.get_addresses()) == 4, timeout=60)
         results = task.exec_on_workers("pwd && echo fanned-out")
         assert len(results) == 4
         assert all(r.ok and "fanned-out" in r.stdout for r in results)

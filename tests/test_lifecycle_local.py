@@ -101,9 +101,9 @@ def test_stop_scales_to_zero(cloud):
     task = task_factory.new(cloud, Identifier.deterministic("stop-test"), spec)
     task.create()
     try:
-        poll(task, lambda t: t.status().get(StatusCode.ACTIVE, 0) == 1, timeout=10)
+        poll(task, lambda t: t.status().get(StatusCode.ACTIVE, 0) == 1, timeout=45)
         task.stop()
-        poll(task, lambda t: t.status().get(StatusCode.ACTIVE, 0) == 0, timeout=10)
+        poll(task, lambda t: t.status().get(StatusCode.ACTIVE, 0) == 0, timeout=45)
         assert task.group.desired() == 0
     finally:
         task.delete()
@@ -145,7 +145,7 @@ def test_preemption_recovery_resumes_from_checkpoint(cloud):
     task.create()
     try:
         # Wait until the checkpoint reaches the bucket.
-        poll(task, lambda t: "cold-start" in "".join(t.logs()), timeout=15)
+        poll(task, lambda t: "cold-start" in "".join(t.logs()), timeout=60)
         deadline = time.time() + 15
         while time.time() < deadline:
             import os

@@ -314,7 +314,7 @@ def test_tpu_preemption_recovery_mttr(tpu_cloud, tmp_path):
     task = task_factory.new(tpu_cloud, Identifier.deterministic("tpu-preempt"), spec)
     task.create()
     try:
-        poll(task, lambda t: "cold-start" in "".join(t.logs()), timeout=15)
+        poll(task, lambda t: "cold-start" in "".join(t.logs()), timeout=60)
         bucket = task._bucket_dir
         deadline = time.time() + 15
         while time.time() < deadline:
@@ -349,7 +349,7 @@ def test_recovery_through_fresh_task_with_empty_spec(tpu_cloud):
     task.create()
     try:
         poll(task, lambda t: t.client.get_queued_resource(
-            t._qr_name(0)).state == tpu_api.QR_ACTIVE, timeout=15)
+            t._qr_name(0)).state == tpu_api.QR_ACTIVE, timeout=60)
         original = task.client.get_queued_resource(task._qr_name(0)).spec
         assert original.metadata.get("tpu-task-script-b64")
         task.client.preempt_node(task._qr_name(0))
