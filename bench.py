@@ -331,17 +331,24 @@ def bench_ring_schedule() -> dict:
 
 
 def bench_data_plane() -> dict:
-    """1 GiB synthetic-checkpoint push/pull through the streaming GCS client
-    (chunked resumable upload, parallel ranged download) against an
-    in-process loopback server. Zero-egress environment: this measures the
-    client/protocol path on loopback, not WAN bandwidth. Resident memory
-    stays O(chunk), never the full object — the point of the streaming path.
-    Note the resumable protocol is sequential per object by design; the sync
-    engine parallelizes across objects (TPU_TASK_TRANSFERS=16)."""
+    """1 GiB synthetic-checkpoint push/pull through each streaming cloud
+    client against an in-process loopback server: GCS (chunked resumable
+    upload + parallel ranged download), S3 (parallel multipart upload +
+    ranged download), Azure Blob (parallel Put Block + ranged download).
+    Zero-egress environment: this measures the client/protocol path on
+    loopback, not WAN bandwidth. Resident memory stays O(chunk × workers),
+    never the full object — the point of the streaming paths. GCS's
+    resumable protocol is sequential per object by design; S3/Azure part
+    uploads and all ranged downloads run parallel; the sync engine further
+    parallelizes across objects (TPU_TASK_TRANSFERS=16)."""
     import shutil
 
     from tpu_task.storage.backends import GCSBackend
+    from tpu_task.storage.cloud_backends import AzureBlobBackend, S3Backend
     from tpu_task.storage.gcs_emulator import LoopbackGCS
+    from tpu_task.storage.object_store_emulators import (
+        LoopbackAzureBlob, LoopbackS3,
+    )
 
     size = 1 << 30  # 1 GiB
     tmp = Path(tempfile.mkdtemp(prefix="tpu-task-dataplane-"))
@@ -350,24 +357,38 @@ def bench_data_plane() -> dict:
     with open(source, "wb") as handle:
         for _ in range(size // len(block)):
             handle.write(block)
+
+    def roundtrip(server, backend, label: str) -> dict:
+        server.attach(backend)
+        t0 = time.perf_counter()
+        backend.write_from_file("checkpoints/ckpt.bin", str(source))
+        push_s = time.perf_counter() - t0
+        restored = tmp / f"restored-{label}.bin"
+        t0 = time.perf_counter()
+        backend.read_to_file("checkpoints/ckpt.bin", str(restored))
+        pull_s = time.perf_counter() - t0
+        verified = os.path.getsize(restored) == size
+        restored.unlink()
+        return {"push_MBps": round(size / 1e6 / push_s, 1),
+                "pull_MBps": round(size / 1e6 / pull_s, 1),
+                "verified_size": verified}
+
     try:
+        results = {}
         with LoopbackGCS() as server:
-            backend = GCSBackend("bench")
-            server.attach(backend)
-            t0 = time.perf_counter()
-            backend.write_from_file("checkpoints/ckpt.bin", str(source))
-            push_s = time.perf_counter() - t0
-            restored = tmp / "restored.bin"
-            t0 = time.perf_counter()
-            backend.read_to_file("checkpoints/ckpt.bin", str(restored))
-            pull_s = time.perf_counter() - t0
-            verified = os.path.getsize(restored) == size
+            results["gcs"] = roundtrip(server, GCSBackend("bench"), "gcs")
+        with LoopbackS3() as server:
+            results["s3"] = roundtrip(server, S3Backend("bench", config={
+                "access_key_id": "AKID", "secret_access_key": "sk",
+                "region": "us-east-1"}), "s3")
+        with LoopbackAzureBlob() as server:
+            results["azureblob"] = roundtrip(
+                server, AzureBlobBackend("bench", config={
+                    "account": "acct", "key": "a2V5c2VjcmV0"}), "az")
         return {
             "object_gib": 1.0,
-            "push_MBps": round(size / 1e6 / push_s, 1),
-            "pull_MBps": round(size / 1e6 / pull_s, 1),
-            "verified_size": verified,
-            "conditions": ("loopback HTTP GCS emulator (zero-egress env): "
+            **results,
+            "conditions": ("loopback HTTP emulators (zero-egress env): "
                            "client+protocol throughput, not WAN"),
         }
     finally:

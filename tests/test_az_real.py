@@ -238,3 +238,40 @@ def test_nsg_rule_semantics():
                   for r in egress_rules]
     assert ("Outbound", "Allow") in directions
     assert ("Outbound", "Deny") in directions
+
+    # Multi-net rules must carry sourceAddressPrefixes ALONE — ARM rejects
+    # rules that specify both singular and plural source fields.
+    multi = rules(Firewall(ingress=FirewallRule(
+        ports=[22], nets=["10.0.0.0/8", "192.168.0.0/16"])))
+    assert multi[0]["properties"]["sourceAddressPrefixes"] == [
+        "10.0.0.0/8", "192.168.0.0/16"]
+    assert "sourceAddressPrefix" not in multi[0]["properties"]
+    single = rules(Firewall(ingress=FirewallRule(ports=[22],
+                                                 nets=["10.0.0.0/8"])))
+    assert single[0]["properties"]["sourceAddressPrefix"] == "10.0.0.0/8"
+    assert "sourceAddressPrefixes" not in single[0]["properties"]
+
+    # Egress nets with ports=None means every port to those nets
+    # (values.py): an any-port Allow must precede the deny-all, or the VM
+    # loses ALL outbound traffic.
+    any_port = rules(Firewall(egress=FirewallRule(nets=["10.1.0.0/16"])))
+    pairs = [(r["properties"]["direction"], r["properties"]["access"],
+              r["properties"]["destinationPortRange"]) for r in any_port]
+    assert ("Outbound", "Allow", "*") in pairs
+    assert ("Outbound", "Deny", "*") in pairs
+    allow = next(r for r in any_port
+                 if r["properties"]["access"] == "Allow"
+                 and r["properties"]["direction"] == "Outbound")
+    deny = next(r for r in any_port if r["properties"]["access"] == "Deny")
+    assert allow["properties"]["priority"] < deny["properties"]["priority"]
+    # Outbound nets constrain the DESTINATION side (the remote end).
+    assert allow["properties"]["destinationAddressPrefix"] == "10.1.0.0/16"
+    assert allow["properties"]["sourceAddressPrefix"] == "*"
+
+    # Egress allow-none: only the deny outbound (no pointless Allow rules;
+    # the default ingress still renders its inbound allow-any).
+    none_rules = rules(Firewall(egress=FirewallRule(nets=[])))
+    outbound = [(r["properties"]["direction"], r["properties"]["access"])
+                for r in none_rules
+                if r["properties"]["direction"] == "Outbound"]
+    assert outbound == [("Outbound", "Deny")]

@@ -302,3 +302,58 @@ def test_sharded_restore_falls_back_past_partial_newest_step(tmp_path):
                               jax.tree.leaves(restored)):
         np.testing.assert_allclose(np.asarray(original), np.asarray(back),
                                    atol=0)
+
+
+def test_sharded_restore_survives_topology_change(tmp_path):
+    """An older COMPLETE checkpoint saved under a different process count
+    must still restore during fallback: each step is judged by its OWN
+    save-time topology (per-step manifest), not the newest pointer's."""
+    import json as _json
+    import shutil as _shutil
+
+    from tpu_task.ml import (
+        restore_checkpoint_sharded, save_checkpoint_sharded, train,
+    )
+
+    mesh = meshlib.make_mesh(8)
+    state = train.init_state(jax.random.PRNGKey(0), TINY)
+    state, _ = train.shard_state(state, TINY, mesh)
+    complete = save_checkpoint_sharded(tmp_path, 5, state.params)
+
+    # Fake a newer step saved by a 2-process job whose shard-1 upload never
+    # landed: 1/2 shard files, manifest + pointer claim process_count=2.
+    _shutil.copy(complete, tmp_path / "ckpt-6.shard-0.npz")
+    (tmp_path / "ckpt-6.meta").write_text(
+        _json.dumps({"step": 6, "process_count": 2}))
+    (tmp_path / "LATEST_SHARDED").write_text(
+        _json.dumps({"step": 6, "file": "ckpt-6.shard-0.npz",
+                     "process_count": 2}))
+
+    restored = restore_checkpoint_sharded(tmp_path, state.params)
+    for original, back in zip(jax.tree.leaves(state.params),
+                              jax.tree.leaves(restored)):
+        np.testing.assert_allclose(np.asarray(original), np.asarray(back),
+                                   atol=0)
+
+
+def test_sharded_restore_accepts_legacy_steps_without_manifest(tmp_path):
+    """Checkpoints saved before the per-step manifest existed carry only
+    shard files; they are judged by the CURRENT topology's process count
+    (never by whatever files happen to be present, which would bless
+    truncated prefixes)."""
+    from tpu_task.ml import (
+        restore_checkpoint_sharded, save_checkpoint_sharded, train,
+    )
+
+    mesh = meshlib.make_mesh(8)
+    state = train.init_state(jax.random.PRNGKey(0), TINY)
+    state, _ = train.shard_state(state, TINY, mesh)
+    save_checkpoint_sharded(tmp_path, 2, state.params)
+    (tmp_path / "ckpt-2.meta").unlink()
+    (tmp_path / "LATEST_SHARDED").unlink()
+
+    restored = restore_checkpoint_sharded(tmp_path, state.params)
+    for original, back in zip(jax.tree.leaves(state.params),
+                              jax.tree.leaves(restored)):
+        np.testing.assert_allclose(np.asarray(original), np.asarray(back),
+                                   atol=0)

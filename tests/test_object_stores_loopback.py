@@ -10,9 +10,11 @@ import os
 
 import pytest
 
-from object_store_emulators import LoopbackAzureBlob, LoopbackS3
-
 from tpu_task.storage.cloud_backends import AzureBlobBackend, S3Backend
+from tpu_task.storage.object_store_emulators import (
+    LoopbackAzureBlob,
+    LoopbackS3,
+)
 
 
 @pytest.fixture()
@@ -97,6 +99,90 @@ def test_s3_sync_transfer_roundtrip(s3, tmp_path):
     assert (out / "a.txt").read_text() == "alpha"
     assert (out / "sub" / "b.bin").read_bytes() == \
         (src / "sub" / "b.bin").read_bytes()
+
+
+def _shrink(backend, chunk=1024):
+    """Tiny thresholds so streaming paths run with small test payloads."""
+    for name in ("MULTIPART_THRESHOLD", "BLOCK_THRESHOLD", "PART_SIZE",
+                 "BLOCK_SIZE", "DOWNLOAD_CHUNK"):
+        if hasattr(backend, name):
+            setattr(backend, name, chunk)
+
+
+def test_s3_multipart_upload_streams_large_files(s3, tmp_path):
+    """Above the threshold, write_from_file goes through the multipart
+    trio (initiate → parallel parts → complete) instead of one giant PUT."""
+    server, backend = s3
+    _shrink(backend)
+    payload = os.urandom(10 * 1024 + 37)  # 11 parts, last one short
+    source = tmp_path / "big.bin"
+    source.write_bytes(payload)
+
+    backend.write_from_file("ckpt/big.bin", str(source))
+    assert server.objects["task-1/ckpt/big.bin"] == payload
+    assert server.uploads == {}  # completed uploads are reaped
+
+
+def test_s3_multipart_abort_on_failure(s3, tmp_path):
+    """A failing part must abort the upload (no stray parts billed) and
+    surface the error."""
+    import urllib.error
+
+    server, backend = s3
+    _shrink(backend)
+    source = tmp_path / "big.bin"
+    source.write_bytes(os.urandom(5 * 1024))
+
+    real_urlopen = backend._urlopen
+
+    def failing_urlopen(request, timeout=None):
+        if "partNumber=3" in request.full_url:
+            raise urllib.error.HTTPError(
+                request.full_url, 400, "Bad Request", {}, None)
+        return real_urlopen(request, timeout=timeout)
+
+    backend._urlopen = failing_urlopen
+    with pytest.raises(urllib.error.HTTPError):
+        backend.write_from_file("ckpt/big.bin", str(source))
+    assert "task-1/ckpt/big.bin" not in server.objects
+    assert server.uploads == {}  # aborted
+
+
+def test_s3_ranged_parallel_download(s3, tmp_path):
+    server, backend = s3
+    _shrink(backend)
+    payload = os.urandom(7 * 1024 + 11)
+    server.objects["task-1/ckpt/big.bin"] = payload
+
+    target = tmp_path / "out" / "big.bin"
+    backend.read_to_file("ckpt/big.bin", str(target))
+    assert target.read_bytes() == payload
+    assert not list(tmp_path.glob("out/*.partial-*"))
+
+
+def test_azure_block_upload_streams_large_files(azure, tmp_path):
+    """Above the threshold, write_from_file stages Put Blocks in parallel
+    and commits them with Put Block List in order."""
+    server, backend = azure
+    _shrink(backend)
+    payload = os.urandom(9 * 1024 + 5)
+    source = tmp_path / "big.bin"
+    source.write_bytes(payload)
+
+    backend.write_from_file("ckpt/big.bin", str(source))
+    assert server.objects["task-1/ckpt/big.bin"] == payload
+    assert server.blocks == {}  # committed blocks are reaped
+
+
+def test_azure_ranged_parallel_download(azure, tmp_path):
+    server, backend = azure
+    _shrink(backend)
+    payload = os.urandom(6 * 1024 + 3)
+    server.objects["task-1/ckpt/big.bin"] = payload
+
+    target = tmp_path / "out" / "big.bin"
+    backend.read_to_file("ckpt/big.bin", str(target))
+    assert target.read_bytes() == payload
 
 
 def test_azure_roundtrip_and_auth(azure):

@@ -85,6 +85,17 @@ class SecurityGroup:
 
     def _rule(self, name: str, priority: int, direction: str, access: str,
               port: str, nets: List[str]) -> dict:
+        # The firewall's nets constrain the REMOTE side: sources for
+        # inbound rules, destinations for outbound. ARM rejects rules that
+        # carry both the singular and plural form of an address field, so
+        # emit exactly one per side.
+        def side(prefix: str) -> dict:
+            if len(nets) > 1:
+                return {f"{prefix}AddressPrefixes": nets}
+            return {f"{prefix}AddressPrefix": nets[0] if nets else "*"}
+
+        remote = "source" if direction == "Inbound" else "destination"
+        local = "destination" if direction == "Inbound" else "source"
         return {
             "name": name,
             "properties": {
@@ -94,9 +105,8 @@ class SecurityGroup:
                 "protocol": "*",
                 "sourcePortRange": "*",
                 "destinationPortRange": port,
-                "sourceAddressPrefix": nets[0] if len(nets) == 1 else "*",
-                **({"sourceAddressPrefixes": nets} if len(nets) > 1 else {}),
-                "destinationAddressPrefix": "*",
+                **side(remote),
+                f"{local}AddressPrefix": "*",
             },
         }
 
@@ -124,13 +134,21 @@ class SecurityGroup:
                        else [str(net) for net in egress.nets])
         if egress.ports is None and egress_nets is None:
             pass  # allow any: Azure's default outbound allow covers it
+        elif egress_nets == []:
+            rules.append(self._rule(f"{self.name}-out-deny", 4000,
+                                    "Outbound", "Deny", "*", []))
         else:
-            for index, port in enumerate(egress.ports or []):
-                if egress_nets == []:
-                    break  # allow none: just the deny below
-                rules.append(self._rule(f"{self.name}-out-{port}",
-                                        100 + index, "Outbound", "Allow",
-                                        str(port), egress_nets or []))
+            if egress.ports is None:
+                # ports None = every port (values.py:74-77): any-port Allow
+                # for the named nets, then the catch-all deny.
+                rules.append(self._rule(f"{self.name}-out-any", 100,
+                                        "Outbound", "Allow", "*",
+                                        egress_nets or []))
+            else:
+                for index, port in enumerate(egress.ports):
+                    rules.append(self._rule(f"{self.name}-out-{port}",
+                                            100 + index, "Outbound", "Allow",
+                                            str(port), egress_nets or []))
             rules.append(self._rule(f"{self.name}-out-deny", 4000,
                                     "Outbound", "Deny", "*", []))
         return {"location": self.location,

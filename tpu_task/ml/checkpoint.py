@@ -132,6 +132,13 @@ def save_checkpoint_sharded(directory, step: int, tree: Any) -> Path:
         raise
 
     if process == 0:
+        # Per-step manifest: records THIS step's save-time topology so a
+        # later restore under a different process count can still judge the
+        # step's completeness by the count it was saved with.
+        meta = directory / f"ckpt-{step}.meta.tmp"
+        meta.write_text(json.dumps({
+            "step": step, "process_count": jax.process_count()}))
+        os.replace(meta, directory / f"ckpt-{step}.meta")
         # A SEPARATE pointer file: repointing the plain LATEST at a shard
         # file would make latest_step()/restore_checkpoint() chase a
         # nonexistent ckpt-{step}.npz.
@@ -168,26 +175,42 @@ def restore_checkpoint_sharded(directory, template: Any,
     # Step eligibility must be decided IDENTICALLY on every host — a
     # per-host "whatever ranges my devices need" check would let different
     # hosts resume from different steps after a partial upload. A step is
-    # eligible only when the full shard-file set is present (save-time
-    # process count from the pointer when available, else this topology's).
-    expected = jax.process_count()
+    # eligible only when the full shard-file set FOR THAT STEP'S SAVE-TIME
+    # TOPOLOGY is present: the per-step manifest when available, the
+    # LATEST_SHARDED pointer for its own step, else (legacy checkpoints
+    # without a manifest) this topology's process count — never "whatever
+    # files happen to be present", which would bless truncated prefixes.
     pointer = directory / "LATEST_SHARDED"
-    pointer_step = None
+    pointer_step = pointer_count = None
     if pointer.exists():
         try:
             meta = json.loads(pointer.read_text())
             pointer_step = int(meta["step"])
             if meta.get("process_count"):
-                expected = int(meta["process_count"])
+                pointer_count = int(meta["process_count"])
         except (ValueError, KeyError):
             pass
     last_error: Optional[Exception] = None
     for candidate in steps:
-        present = len(list(directory.glob(f"ckpt-{candidate}.shard-*.npz")))
-        if present < expected and not (pointer_step == candidate
-                                       and present >= expected):
+        indices = {int(m.group(2))
+                   for p in directory.glob(f"ckpt-{candidate}.shard-*.npz")
+                   if (m := _SHARD_RE.match(p.name))}
+        expected = None
+        manifest = directory / f"ckpt-{candidate}.meta"
+        if manifest.exists():
+            try:
+                expected = int(json.loads(
+                    manifest.read_text())["process_count"])
+            except (ValueError, KeyError, TypeError):
+                pass
+        if expected is None and candidate == pointer_step:
+            expected = pointer_count
+        if expected is None:
+            expected = jax.process_count()
+        if not indices or indices != set(range(expected)):
             last_error = FileNotFoundError(
-                f"step {candidate}: {present}/{expected} shard files")
+                f"step {candidate}: shard indices {sorted(indices)} != "
+                f"expected 0..{expected - 1}")
             continue
         try:
             return _restore_sharded_step(directory, template, candidate)

@@ -139,6 +139,63 @@ class Backend:
         return None
 
 
+def parallel_map(fns, workers: int) -> list:
+    """Run zero-arg callables concurrently; on the FIRST failure cancel all
+    still-queued work and re-raise — a failed chunk must not let gigabytes
+    of doomed siblings keep transferring. Results in completion order."""
+    from concurrent.futures import ThreadPoolExecutor, as_completed
+
+    if workers <= 1 or len(fns) <= 1:
+        return [fn() for fn in fns]
+    results = []
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        futures = [pool.submit(fn) for fn in fns]
+        try:
+            for future in as_completed(futures):
+                results.append(future.result())
+        except BaseException:
+            for future in futures:
+                future.cancel()
+            raise
+    return results
+
+
+def atomic_ranged_download(path: str, size: int, fetch_range,
+                           chunk: int, workers: int) -> None:
+    """Download ``size`` bytes into ``path`` from ``fetch_range(start, end)``
+    (end inclusive) calls, parallel across chunks, into a temp file renamed
+    on success — an interrupted download never publishes a torn or
+    hole-filled file under the final name. Shared by every cloud backend so
+    the chunking/verification/atomic-publish logic exists exactly once."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    partial = f"{path}.partial-{os.getpid()}"
+    fd = os.open(partial, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+    try:
+        os.truncate(fd, size)
+
+        def fetch(start: int) -> None:
+            end = min(start + chunk, size) - 1
+            data = fetch_range(start, end)
+            if len(data) != end - start + 1:
+                raise RuntimeError(
+                    f"ranged fetch returned {len(data)} bytes for "
+                    f"bytes={start}-{end} of {path!r}")
+            os.pwrite(fd, data, start)
+
+        starts = list(range(0, size, chunk))
+        parallel_map([lambda start=start: fetch(start) for start in starts],
+                     min(workers, len(starts)))
+    except BaseException:
+        os.close(fd)
+        try:
+            os.remove(partial)
+        except OSError:
+            pass
+        raise
+    os.close(fd)
+    os.replace(partial, path)
+
+
 def contained_path(root: str, key: str) -> str:
     """Resolve ``key`` under ``root``, refusing escapes. Strict containment:
     the separator is required, so a sibling directory sharing the root as a
@@ -456,51 +513,20 @@ class GCSBackend(Backend):
                         f" of {total} for {key!r}")
 
     def read_to_file(self, key: str, path: str) -> None:
-        """Streaming download: large objects arrive as parallel ranged GETs,
-        so resident memory stays O(chunk × workers). Writes land in a temp
-        file renamed into place on success — an interrupted download never
-        publishes a full-size, hole-filled file under the final name."""
-        size = self._object_size(key)
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        if size <= self.DOWNLOAD_CHUNK:
-            with open(path, "wb") as handle:
-                handle.write(self.read(key))
-            return
-
+        """Streaming download: parallel ranged GETs (memory O(chunk ×
+        workers)) through the shared atomic-publish helper."""
         import urllib.parse
-        from concurrent.futures import ThreadPoolExecutor
 
+        size = self._object_size(key)
         url = (f"https://storage.googleapis.com/storage/v1/b/{self.container}/o/"
                f"{urllib.parse.quote(self._key(key), safe='')}?alt=media")
-        partial = f"{path}.partial-{os.getpid()}"
-        fd = os.open(partial, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
-        try:
-            os.truncate(fd, size)
 
-            def fetch(start: int) -> None:
-                end = min(start + self.DOWNLOAD_CHUNK, size) - 1
-                data = self._request("GET", url,
-                                     headers={"Range": f"bytes={start}-{end}"})
-                os.pwrite(fd, data, start)
+        def fetch_range(start: int, end: int) -> bytes:
+            return self._request("GET", url,
+                                 headers={"Range": f"bytes={start}-{end}"})
 
-            starts = list(range(0, size, self.DOWNLOAD_CHUNK))
-            workers = min(self.DOWNLOAD_WORKERS, len(starts))
-            if workers > 1:
-                with ThreadPoolExecutor(max_workers=workers) as pool:
-                    for _done in pool.map(fetch, starts):
-                        pass
-            else:
-                for start in starts:
-                    fetch(start)
-        except BaseException:
-            os.close(fd)
-            try:
-                os.remove(partial)
-            except OSError:
-                pass
-            raise
-        os.close(fd)
-        os.replace(partial, path)
+        atomic_ranged_download(path, size, fetch_range,
+                               self.DOWNLOAD_CHUNK, self.DOWNLOAD_WORKERS)
 
     def _object_size(self, key: str) -> int:
         import urllib.error

@@ -196,18 +196,8 @@ class LoopbackGCS:
     # -- client wiring ---------------------------------------------------------
     def attach(self, backend) -> None:
         """Point a GCSBackend at this server (token stubbed, URLs rewritten)."""
-        port = self.port
-
-        def loopback_urlopen(request, timeout=None):
-            import urllib.request
-
-            url = request.full_url.replace(
-                "https://storage.googleapis.com", f"http://127.0.0.1:{port}")
-            patched = urllib.request.Request(
-                url, data=request.data, method=request.get_method())
-            for key, value in request.header_items():
-                patched.add_header(key, value)
-            return urllib.request.urlopen(patched, timeout=timeout)
+        from tpu_task.storage.object_store_emulators import loopback_transport
 
         backend._token._fetch = lambda: ("loopback-token", 3600.0)
-        backend._urlopen = loopback_urlopen
+        backend._urlopen = loopback_transport(
+            "https://storage.googleapis.com", self.port)

@@ -1,0 +1,379 @@
+"""Loopback S3 and Azure Blob emulators (REST subsets over real HTTP).
+
+Role: drive the SigV4 S3 backend and SharedKey Azure backend through the
+full urllib/HTTP path hermetically — the rclone-local integration idea
+(storage_test.go:54-107) applied to the cloud backends. Lives in the
+package (like ``gcs_emulator``) so both the test suite and ``bench.py``'s
+data-plane measurement share one server implementation. Happy-path only:
+auth headers are checked for presence/format, not cryptographically
+verified (the signing math has its own vector tests in test_signing.py).
+Pagination is deliberately tiny (PAGE_SIZE) so the continuation loops run.
+Streaming surfaces covered: ranged GET + HEAD, the S3 multipart-upload
+trio (with ETag verification), and Azure Put Block / Put Block List.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict
+from xml.sax.saxutils import escape
+
+PAGE_SIZE = 2  # force pagination in list operations
+
+
+def loopback_transport(origin: str, port: int):
+    """``urlopen`` replacement rewriting ``origin`` URLs to the local
+    server — the one host-rewrite proxy shared by every loopback emulator
+    (this module and ``gcs_emulator``)."""
+
+    def opener(request, timeout=None):
+        import urllib.request
+
+        url = request.full_url.replace(origin, f"http://127.0.0.1:{port}")
+        patched = urllib.request.Request(
+            url, data=request.data, method=request.get_method())
+        for key, value in request.header_items():
+            patched.add_header(key, value)
+        return urllib.request.urlopen(patched, timeout=timeout)
+
+    return opener
+
+
+class _BaseHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def _store(self):
+        return self.server.emulator  # type: ignore[attr-defined]
+
+    def _reply(self, code: int, body: bytes = b"",
+               content_type: str = "application/xml") -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_body(self) -> bytes:
+        length = int(self.headers.get("Content-Length", "0"))
+        return self.rfile.read(length) if length else b""
+
+    def log_message(self, *args) -> None:
+        pass
+
+
+class _LoopbackStore:
+    def __init__(self, handler):
+        self.objects: Dict[str, bytes] = {}
+        self.uploads: Dict[str, dict] = {}  # S3 multipart uploads in flight
+        self.blocks: Dict[str, Dict[str, bytes]] = {}  # Azure uncommitted
+        self.auth_headers: list = []  # recorded for assertions
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        self._server.emulator = self  # type: ignore[attr-defined]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._server.shutdown()
+        self._server.server_close()
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def attach(self, backend) -> None:
+        """Point a backend at this server (host rewritten to loopback)."""
+        backend._urlopen = loopback_transport(
+            f"https://{backend.host}", self.port)
+
+
+def _parse_range(header: str, size: int):
+    """``bytes=a-b`` → (start, end inclusive), or None if absent/malformed."""
+    match = re.fullmatch(r"bytes=(\d+)-(\d+)", header or "")
+    if not match:
+        return None
+    start, end = int(match.group(1)), min(int(match.group(2)), size - 1)
+    if start > end:
+        return None
+    return start, end
+
+
+class _S3Handler(_BaseHandler):
+    """ListObjectsV2 + object GET/PUT/DELETE/HEAD, ranged GET, and the
+    multipart-upload trio (virtual-hosted style: the bucket is in the Host
+    header, the path is the key)."""
+
+    def _authorized(self) -> bool:
+        auth = self.headers.get("Authorization", "")
+        self._store().auth_headers.append(auth)
+        return auth.startswith("AWS4-HMAC-SHA256 Credential=")
+
+    def do_GET(self) -> None:
+        if not self._authorized():
+            self._reply(403, b"<Error>bad auth</Error>")
+            return
+        parsed = urllib.parse.urlparse(self.path)
+        query = urllib.parse.parse_qs(parsed.query)
+        store = self._store()
+        if query.get("list-type", [""])[0] == "2":
+            prefix = query.get("prefix", [""])[0]
+            start = int(query.get("continuation-token", ["0"])[0] or 0)
+            matching = sorted(k for k in store.objects if k.startswith(prefix))
+            page = matching[start:start + PAGE_SIZE]
+            items = "".join(
+                f"<Contents><Key>{escape(key)}</Key>"
+                f"<LastModified>2026-01-01T00:00:00.000Z</LastModified>"
+                f"<Size>{len(store.objects[key])}</Size></Contents>"
+                for key in page)
+            token = ""
+            if start + PAGE_SIZE < len(matching):
+                token = (f"<NextContinuationToken>{start + PAGE_SIZE}"
+                         "</NextContinuationToken>")
+            self._reply(200, (f"<ListBucketResult>{items}{token}"
+                              "</ListBucketResult>").encode())
+            return
+        key = urllib.parse.unquote(parsed.path.lstrip("/"))
+        data = store.objects.get(key)
+        if data is None:
+            self._reply(404, b"<Error><Code>NoSuchKey</Code></Error>")
+            return
+        ranged = _parse_range(self.headers.get("Range", ""), len(data))
+        if ranged:
+            start, end = ranged
+            self.send_response(206)
+            self.send_header("Content-Type", "application/octet-stream")
+            self.send_header("Content-Range",
+                             f"bytes {start}-{end}/{len(data)}")
+            self.send_header("Content-Length", str(end - start + 1))
+            self.end_headers()
+            self.wfile.write(data[start:end + 1])
+            return
+        self._reply(200, data, "application/octet-stream")
+
+    def do_HEAD(self) -> None:
+        if not self._authorized():
+            self._reply(403)
+            return
+        key = urllib.parse.unquote(
+            urllib.parse.urlparse(self.path).path.lstrip("/"))
+        data = self._store().objects.get(key)
+        if data is None:
+            self._reply(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+
+    def do_POST(self) -> None:
+        import hashlib
+
+        if not self._authorized():
+            self._reply(403, b"<Error>bad auth</Error>")
+            return
+        parsed = urllib.parse.urlparse(self.path)
+        query = urllib.parse.parse_qs(parsed.query, keep_blank_values=True)
+        key = urllib.parse.unquote(parsed.path.lstrip("/"))
+        store = self._store()
+        if "uploads" in query:  # CreateMultipartUpload
+            upload_id = f"upload-{len(store.uploads) + 1}"
+            store.uploads[upload_id] = {"key": key, "parts": {}}
+            self._reply(200, (
+                "<InitiateMultipartUploadResult>"
+                f"<Key>{escape(key)}</Key>"
+                f"<UploadId>{upload_id}</UploadId>"
+                "</InitiateMultipartUploadResult>").encode())
+            return
+        upload_id = query.get("uploadId", [""])[0]
+        upload = store.uploads.get(upload_id)
+        if upload is None or upload["key"] != key:
+            self._reply(404, b"<Error><Code>NoSuchUpload</Code></Error>")
+            return
+        # CompleteMultipartUpload: assemble parts in manifest order and
+        # verify each ETag matches what UploadPart returned.
+        manifest = self._read_body().decode()
+        assembled = []
+        for number, etag in re.findall(
+                r"<PartNumber>(\d+)</PartNumber>\s*<ETag>([^<]+)</ETag>",
+                manifest):
+            part = upload["parts"].get(int(number))
+            if part is None:
+                self._reply(400, b"<Error><Code>InvalidPart</Code></Error>")
+                return
+            expected = '"' + hashlib.md5(part).hexdigest() + '"'
+            if etag.strip() not in (expected, expected.strip('"')):
+                self._reply(400, b"<Error><Code>InvalidPart</Code></Error>")
+                return
+            assembled.append(part)
+        store.objects[key] = b"".join(assembled)
+        del store.uploads[upload_id]
+        self._reply(200, (
+            "<CompleteMultipartUploadResult>"
+            f"<Key>{escape(key)}</Key>"
+            "</CompleteMultipartUploadResult>").encode())
+
+    def do_PUT(self) -> None:
+        import hashlib
+
+        if not self._authorized():
+            self._reply(403, b"<Error>bad auth</Error>")
+            return
+        parsed = urllib.parse.urlparse(self.path)
+        query = urllib.parse.parse_qs(parsed.query)
+        key = urllib.parse.unquote(parsed.path.lstrip("/"))
+        store = self._store()
+        body = self._read_body()
+        if "partNumber" in query:  # UploadPart
+            upload_id = query.get("uploadId", [""])[0]
+            upload = store.uploads.get(upload_id)
+            if upload is None or upload["key"] != key:
+                self._reply(404, b"<Error><Code>NoSuchUpload</Code></Error>")
+                return
+            number = int(query["partNumber"][0])
+            upload["parts"][number] = body
+            self.send_response(200)
+            self.send_header("ETag",
+                             '"' + hashlib.md5(body).hexdigest() + '"')
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        store.objects[key] = body
+        self._reply(200)
+
+    def do_DELETE(self) -> None:
+        if not self._authorized():
+            self._reply(403, b"<Error>bad auth</Error>")
+            return
+        parsed = urllib.parse.urlparse(self.path)
+        query = urllib.parse.parse_qs(parsed.query)
+        key = urllib.parse.unquote(parsed.path.lstrip("/"))
+        store = self._store()
+        if "uploadId" in query:  # AbortMultipartUpload
+            store.uploads.pop(query["uploadId"][0], None)
+            self._reply(204)
+            return
+        store.objects.pop(key, None)
+        self._reply(204)
+
+
+class _AzureHandler(_BaseHandler):
+    """Container list + blob GET/PUT/DELETE (path: /container/blob)."""
+
+    def _authorized(self) -> bool:
+        auth = self.headers.get("Authorization", "")
+        self._store().auth_headers.append(auth)
+        return auth.startswith("SharedKey ")
+
+    def _split(self, path: str):
+        parts = urllib.parse.unquote(path.lstrip("/")).split("/", 1)
+        return parts[0], (parts[1] if len(parts) > 1 else "")
+
+    def do_GET(self) -> None:
+        if not self._authorized():
+            self._reply(403, b"<Error>bad auth</Error>")
+            return
+        parsed = urllib.parse.urlparse(self.path)
+        query = urllib.parse.parse_qs(parsed.query)
+        store = self._store()
+        if query.get("comp", [""])[0] == "list":
+            prefix = query.get("prefix", [""])[0]
+            start = int(query.get("marker", ["0"])[0] or 0)
+            matching = sorted(k for k in store.objects if k.startswith(prefix))
+            page = matching[start:start + PAGE_SIZE]
+            items = "".join(
+                f"<Blob><Name>{escape(name)}</Name><Properties>"
+                f"<Last-Modified>Thu, 01 Jan 2026 00:00:00 GMT</Last-Modified>"
+                f"<Content-Length>{len(store.objects[name])}</Content-Length>"
+                f"</Properties></Blob>"
+                for name in page)
+            marker = ""
+            if start + PAGE_SIZE < len(matching):
+                marker = f"<NextMarker>{start + PAGE_SIZE}</NextMarker>"
+            self._reply(200, (f"<EnumerationResults><Blobs>{items}</Blobs>"
+                              f"{marker}</EnumerationResults>").encode())
+            return
+        _, blob = self._split(parsed.path)
+        data = store.objects.get(blob)
+        if data is None:
+            self._reply(404, b"<Error>BlobNotFound</Error>")
+            return
+        ranged = _parse_range(self.headers.get("Range", ""), len(data))
+        if ranged:
+            start, end = ranged
+            self.send_response(206)
+            self.send_header("Content-Type", "application/octet-stream")
+            self.send_header("Content-Range",
+                             f"bytes {start}-{end}/{len(data)}")
+            self.send_header("Content-Length", str(end - start + 1))
+            self.end_headers()
+            self.wfile.write(data[start:end + 1])
+            return
+        self._reply(200, data, "application/octet-stream")
+
+    def do_HEAD(self) -> None:
+        if not self._authorized():
+            self._reply(403)
+            return
+        _, blob = self._split(urllib.parse.urlparse(self.path).path)
+        data = self._store().objects.get(blob)
+        if data is None:
+            self._reply(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+
+    def do_PUT(self) -> None:
+        if not self._authorized():
+            self._reply(403, b"<Error>bad auth</Error>")
+            return
+        parsed = urllib.parse.urlparse(self.path)
+        query = urllib.parse.parse_qs(parsed.query)
+        _, blob = self._split(parsed.path)
+        store = self._store()
+        comp = query.get("comp", [""])[0]
+        if comp == "block":  # Put Block: staged, not yet visible
+            block_id = query.get("blockid", [""])[0]
+            store.blocks.setdefault(blob, {})[block_id] = self._read_body()
+            self._reply(201)
+            return
+        if comp == "blocklist":  # Put Block List: commit in manifest order
+            manifest = self._read_body().decode()
+            staged = store.blocks.get(blob, {})
+            assembled = []
+            for block_id in re.findall(r"<Latest>([^<]+)</Latest>", manifest):
+                if block_id not in staged:
+                    self._reply(400, b"<Error>InvalidBlockId</Error>")
+                    return
+                assembled.append(staged[block_id])
+            store.objects[blob] = b"".join(assembled)
+            store.blocks.pop(blob, None)
+            self._reply(201)
+            return
+        store.objects[blob] = self._read_body()
+        self._reply(201)
+
+    def do_DELETE(self) -> None:
+        if not self._authorized():
+            self._reply(403, b"<Error>bad auth</Error>")
+            return
+        _, blob = self._split(urllib.parse.urlparse(self.path).path)
+        self._store().objects.pop(blob, None)
+        self._reply(202)
+
+
+class LoopbackS3(_LoopbackStore):
+    def __init__(self):
+        super().__init__(_S3Handler)
+
+
+class LoopbackAzureBlob(_LoopbackStore):
+    def __init__(self):
+        super().__init__(_AzureHandler)
