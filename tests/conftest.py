@@ -26,3 +26,8 @@ if not os.environ.get("TPU_TASK_TEST_REAL_TPU"):
         jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Bucket-probe caches (shutdown marker, durable events) add observation
+# latency that poll-based tests cannot afford; probe every read in tests.
+os.environ.setdefault("TPU_TASK_SHUTDOWN_PROBE_PERIOD", "0")
+os.environ.setdefault("TPU_TASK_EVENTS_PROBE_PERIOD", "0")

@@ -114,8 +114,65 @@ def test_k8s_manifests_single_pod():
     spec = TaskSpec(environment=Environment(script="x", timeout=None))
     _, pvc, job = render_manifests("tpi-a-b-c", spec)
     assert pvc["spec"]["accessModes"] == ["ReadWriteOnce"]
+    assert "storageClassName" not in pvc["spec"]  # cluster default applies
     assert "completionMode" not in job["spec"]
     assert "activeDeadlineSeconds" not in job["spec"]
+    pod = job["spec"]["template"]["spec"]
+    assert "serviceAccountName" not in pod  # no permission_set given
+
+
+def test_k8s_workdir_grammar():
+    from tpu_task.backends.k8s.manifests import parse_workdir
+
+    parsed = parse_workdir("fast-ssd:20:/data/work")
+    assert (parsed.storage_class, parsed.size_gb, parsed.path) == \
+        ("fast-ssd", 20, "/data/work")
+    parsed = parse_workdir("fast-ssd:/data/work")
+    assert (parsed.storage_class, parsed.size_gb, parsed.path) == \
+        ("fast-ssd", None, "/data/work")
+    parsed = parse_workdir("/plain/path")
+    assert (parsed.storage_class, parsed.size_gb, parsed.path) == \
+        ("", None, "/plain/path")
+    assert parse_workdir("").path == ""
+
+
+def test_k8s_manifests_storage_class_and_size_override():
+    spec = TaskSpec(size=Size(storage=30),
+                    environment=Environment(script="x",
+                                            directory="fast-ssd:20:/data/w"))
+    _, pvc, _ = render_manifests("tpi-a-b-c", spec)
+    assert pvc["spec"]["storageClassName"] == "fast-ssd"
+    assert pvc["spec"]["resources"]["requests"]["storage"] == "20Gi"
+    # Without the size segment, the task's disk size applies.
+    spec.environment.directory = "fast-ssd:/data/w"
+    _, pvc, _ = render_manifests("tpi-a-b-c", spec)
+    assert pvc["spec"]["resources"]["requests"]["storage"] == "30Gi"
+
+
+def test_k8s_manifests_service_account():
+    spec = TaskSpec(environment=Environment(script="x"),
+                    permission_set="train-sa")
+    *_, job = render_manifests("tpi-a-b-c", spec,
+                               automount_service_account_token=True)
+    pod = job["spec"]["template"]["spec"]
+    assert pod["serviceAccountName"] == "train-sa"
+    assert pod["automountServiceAccountToken"] is True
+
+
+def test_k8s_manifests_preallocated_claim():
+    from tpu_task.common.values import RemoteStorage
+
+    spec = TaskSpec(environment=Environment(script="x", directory="/w"),
+                    remote_storage=RemoteStorage(container="shared",
+                                                 path="/tasks/a/"))
+    manifests = render_manifests("tpi-a-b-c", spec)
+    assert [m["kind"] for m in manifests] == ["ConfigMap", "Job"]  # no PVC
+    pod = manifests[-1]["spec"]["template"]["spec"]
+    volume = next(v for v in pod["volumes"] if v["name"] == "workdir")
+    assert volume["persistentVolumeClaim"]["claimName"] == "shared"
+    mount = next(m for m in pod["containers"][0]["volumeMounts"]
+                 if m["name"] == "workdir")
+    assert mount["subPath"] == "tasks/a"
 
 
 # --- hermetic lifecycle through each backend --------------------------------

@@ -116,6 +116,17 @@ class FakeCluster:
             if ("Job", name) not in self.objects:
                 raise ResourceNotFoundError(f"job {name} not found")
             return json.dumps({"status": self.job_status.get(name, {})})
+        if kind == "serviceaccount":
+            name = argv[1]
+            if ("ServiceAccount", name) not in self.objects:
+                raise ResourceNotFoundError(
+                    f"serviceaccount {name} not found")
+            return json.dumps(self.objects[("ServiceAccount", name)])
+        if kind == "pvc":
+            name = argv[1]
+            if ("PersistentVolumeClaim", name) not in self.objects:
+                raise ResourceNotFoundError(f"pvc {name} not found")
+            return json.dumps(self.objects[("PersistentVolumeClaim", name)])
         raise AssertionError(f"unexpected kubectl get: {argv}")
 
     def _delete(self, argv):
@@ -176,13 +187,16 @@ def cluster(tmp_path, monkeypatch):
     return fake
 
 
-def make_task(tmp_path, directory=None, directory_out="", parallelism=1):
+def make_task(tmp_path, directory=None, directory_out="", parallelism=1,
+              permission_set="", remote_storage=None):
     spec = TaskSpec(
         size=Size(machine="m"),
         environment=Environment(script="#!/bin/sh\necho hi\n",
                                 directory=directory or "",
                                 directory_out=directory_out),
         parallelism=parallelism,
+        permission_set=permission_set,
+        remote_storage=remote_storage,
     )
     return K8STask(Cloud(provider=Provider.K8S), IDENTIFIER, spec)
 
@@ -270,6 +284,82 @@ def test_start_stop_not_implemented(cluster, tmp_path):
         task.start()
     with pytest.raises(ResourceNotImplementedError):
         task.stop()
+
+
+def test_permission_set_requires_existing_service_account(cluster, tmp_path):
+    """permission_set names a ServiceAccount that must already exist
+    (data_source_permission_set.go:34-50): missing → NotFound before any
+    object is applied; present → Job pods run as it, automount propagated."""
+    task = make_task(tmp_path, permission_set="train-sa")
+    with pytest.raises(ResourceNotFoundError, match="train-sa"):
+        task.create()
+    assert not cluster.objects  # nothing half-applied
+
+    cluster.objects[("ServiceAccount", "train-sa")] = {
+        "kind": "ServiceAccount",
+        "metadata": {"name": "train-sa"},
+        "automountServiceAccountToken": False,
+    }
+    task.create()
+    pod = cluster.objects[("Job", IDENTIFIER.long())]["spec"]["template"]["spec"]
+    assert pod["serviceAccountName"] == "train-sa"
+    assert pod["automountServiceAccountToken"] is False
+
+
+def test_preallocated_pvc_used_and_survives_delete(cluster, tmp_path):
+    """storage.container names a pre-allocated PVC: it backs the workdir
+    (with its path as subPath), no task-owned PVC is created, and delete
+    leaves the claim intact (data_source_persistent_volume.go:29-51)."""
+    from tpu_task.common.values import RemoteStorage
+
+    workdir = tmp_path / "work"
+    workdir.mkdir()
+    (workdir / "input.txt").write_text("payload")
+
+    task = make_task(tmp_path, directory=str(workdir), directory_out="",
+                     remote_storage=RemoteStorage(container="shared-claim",
+                                                  path="tasks/a"))
+    with pytest.raises(ResourceNotFoundError, match="shared-claim"):
+        task.create()
+
+    cluster.objects[("PersistentVolumeClaim", "shared-claim")] = {
+        "kind": "PersistentVolumeClaim",
+        "metadata": {"name": "shared-claim"},  # unlabeled: not task-owned
+    }
+    task.create()
+    assert ("PersistentVolumeClaim",
+            f"{IDENTIFIER.long()}-workdir") not in cluster.objects
+    job = cluster.objects[("Job", IDENTIFIER.long())]
+    pod = job["spec"]["template"]["spec"]
+    claim_volume = next(v for v in pod["volumes"] if v["name"] == "workdir")
+    assert claim_volume["persistentVolumeClaim"]["claimName"] == "shared-claim"
+    mount = next(m for m in pod["containers"][0]["volumeMounts"]
+                 if m["name"] == "workdir")
+    assert mount["subPath"] == "tasks/a"
+    # Push landed on the pre-allocated claim via the transfer pod.
+    assert (cluster.pvc_dir("shared-claim") / "input.txt").read_text() == \
+        "payload"
+
+    task.delete()
+    assert ("PersistentVolumeClaim", "shared-claim") in cluster.objects
+
+
+def test_storage_class_grammar_drives_pvc_and_sync_path(cluster, tmp_path):
+    """directory='class:[size:]path' puts the task PVC on the named storage
+    class with the given size, while push/pull use the path part
+    (task/k8s/task.go:76-92)."""
+    workdir = tmp_path / "work"
+    workdir.mkdir()
+    (workdir / "input.txt").write_text("payload")
+
+    task = make_task(tmp_path, directory=f"fast-ssd:20:{workdir}")
+    task.create()
+    pvc = cluster.objects[("PersistentVolumeClaim",
+                           f"{IDENTIFIER.long()}-workdir")]
+    assert pvc["spec"]["storageClassName"] == "fast-ssd"
+    assert pvc["spec"]["resources"]["requests"]["storage"] == "20Gi"
+    assert (cluster.pvc_dir(f"{IDENTIFIER.long()}-workdir")
+            / "input.txt").read_text() == "payload"
 
 
 def test_transfer_job_manifest_shape(tmp_path):

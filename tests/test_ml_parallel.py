@@ -336,6 +336,29 @@ def test_sharded_restore_survives_topology_change(tmp_path):
                                    atol=0)
 
 
+def test_resave_after_topology_shrink_reaps_stale_shards(tmp_path):
+    """Re-saving a step under a smaller process count must remove the old
+    topology's higher-index shard files, or the completeness check
+    (indices == 0..expected-1) would reject the step forever."""
+    from tpu_task.ml import (
+        restore_checkpoint_sharded, save_checkpoint_sharded, train,
+    )
+
+    mesh = meshlib.make_mesh(8)
+    state = train.init_state(jax.random.PRNGKey(0), TINY)
+    state, _ = train.shard_state(state, TINY, mesh)
+    # Leftover from a previous 6-process save of the same step.
+    (tmp_path / "ckpt-6.shard-5.npz").write_bytes(b"stale")
+
+    save_checkpoint_sharded(tmp_path, 6, state.params)
+    assert not (tmp_path / "ckpt-6.shard-5.npz").exists()
+    restored = restore_checkpoint_sharded(tmp_path, state.params)
+    for original, back in zip(jax.tree.leaves(state.params),
+                              jax.tree.leaves(restored)):
+        np.testing.assert_allclose(np.asarray(original), np.asarray(back),
+                                   atol=0)
+
+
 def test_sharded_restore_accepts_legacy_steps_without_manifest(tmp_path):
     """Checkpoints saved before the per-step manifest existed carry only
     shard files; they are judged by the CURRENT topology's process count

@@ -134,6 +134,25 @@ def test_gce_real_lifecycle(tmp_path):
 
 
 @pytest.mark.skipif(
+    not (os.environ.get("SMOKE_TEST_ENABLE_K8S")
+         and (os.environ.get("KUBECONFIG")
+              or os.environ.get("KUBECONFIG_DATA"))),
+    reason="real-K8s smoke disabled (set SMOKE_TEST_ENABLE_K8S + a "
+           "kubeconfig; any cluster works — see "
+           "docs/guides/testing-kubernetes.md for the kind recipe)")
+def test_k8s_real_lifecycle(tmp_path):
+    """The one real backend provable without cloud credentials: a kind
+    cluster needs only Docker (reference smoke.yml:102-152 runs the same
+    lifecycle against a throwaway AKS cluster)."""
+    cloud = Cloud(provider=Provider.K8S,
+                  region=os.environ.get("SMOKE_TEST_K8S_REGION", ""))
+    if _sweep(cloud):
+        return
+    _lifecycle(cloud, os.environ.get("SMOKE_TEST_K8S_MACHINE", "s"),
+               tmp_path, budget_s=10 * 60)
+
+
+@pytest.mark.skipif(
     not (os.environ.get("SMOKE_TEST_ENABLE_AZ")
          and os.environ.get("AZURE_CLIENT_ID")),
     reason="real-Azure smoke disabled (set SMOKE_TEST_ENABLE_AZ + AZURE_* creds)")

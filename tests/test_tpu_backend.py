@@ -363,6 +363,17 @@ def test_recovery_through_fresh_task_with_empty_spec(tpu_cloud):
         assert requeued.spec.metadata.get("tpu-task-script-b64") == \
             original.metadata.get("tpu-task-script-b64")
         assert requeued.spec.spot  # the re-queued slice stays a spot slice
+
+        # The MTTR record is DURABLE: a second observer that performed no
+        # recovery itself sees the recovery event from the bucket mailbox
+        # (reports/events-*), the way the reference folds ASG scaling
+        # activities into Events (resource_auto_scaling_group.go:158-183).
+        observer = task_factory.new(tpu_cloud, identifier, TaskSpec())
+        assert observer._recovery_events == []  # nothing in-memory
+        recovered = [event for event in observer.events()
+                     if event.code == "recover"]
+        assert recovered, "recovery event not visible to a fresh observer"
+        assert recovered[0].time.tzinfo is not None  # MTTR-computable stamp
     finally:
         task.delete()
 

@@ -95,7 +95,7 @@ class GroupBackedTask(Task):
         self.spec.events = self.events()
 
     def delete(self) -> None:
-        if self.group.exists() and self.spec.environment.directory:
+        if self.group.exists() and self.workdir():
             try:
                 self.pull()
             except ResourceNotFoundError:
@@ -116,20 +116,25 @@ class GroupBackedTask(Task):
         return self.group.reconcile().parallelism or None
 
     # -- data plane ------------------------------------------------------------
+    def workdir(self) -> str:
+        """Local directory the data plane syncs; backends with a richer
+        directory grammar (K8s ``class:[size:]path``) override this."""
+        return self.spec.environment.directory
+
     def push(self) -> None:
-        if not self.spec.environment.directory:
+        directory = self.workdir()
+        if not directory:
             return
-        transfer(self.spec.environment.directory,
-                 os.path.join(self.group.bucket, "data"),
+        transfer(directory, os.path.join(self.group.bucket, "data"),
                  self.spec.environment.exclude_list)
 
     def pull(self) -> None:
-        if not self.spec.environment.directory:
+        directory = self.workdir()
+        if not directory:
             return
         rules = limit_transfer(self.spec.environment.directory_out,
                                list(self.spec.environment.exclude_list))
-        transfer(os.path.join(self.group.bucket, "data"),
-                 self.spec.environment.directory, rules)
+        transfer(os.path.join(self.group.bucket, "data"), directory, rules)
 
     # -- observation -----------------------------------------------------------
     def status(self) -> Status:

@@ -13,6 +13,8 @@ so they golden-test cleanly and feed kubectl directly.
 
 from __future__ import annotations
 
+import re
+from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
 from tpu_task.backends.k8s.machines import (
@@ -25,9 +27,60 @@ from tpu_task.common.values import Task as TaskSpec
 
 MAX_BACKOFF = 2147483647  # reference uses math.MaxInt32
 
+# The workdir storage-class grammar ``class:[size:]path``
+# (task/k8s/task.go:76-92): a directory of "fast-ssd:20:/data/work" puts the
+# workdir PVC on storage class "fast-ssd" with a 20 Gi claim and uploads
+# from/downloads to /data/work.
+_WORKDIR_RE = re.compile(r"^([^:]+):(?:(\d+):)?(.+)$")
+
+
+@dataclass
+class Workdir:
+    """Parsed ``environment.directory`` for the K8s backend."""
+
+    path: str = ""
+    storage_class: str = ""
+    size_gb: Optional[int] = None
+
+
+def parse_workdir(directory: str) -> Workdir:
+    """Split the K8s ``class:[size:]path`` workdir grammar; a plain path
+    (no colon) passes through unchanged (task/k8s/task.go:76-92)."""
+    match = _WORKDIR_RE.match(directory or "")
+    if match:
+        return Workdir(
+            path=match.group(3),
+            storage_class=match.group(1),
+            size_gb=int(match.group(2)) if match.group(2) else None,
+        )
+    return Workdir(path=directory or "")
+
+
+def _workdir_volume(identifier: str, spec: TaskSpec) -> Dict[str, Any]:
+    """The Job/transfer-pod workdir volume: the task's own PVC, or the
+    pre-allocated claim named by ``storage.container``
+    (data_source_persistent_volume.go:46-51)."""
+    claim = (spec.remote_storage.container if spec.remote_storage
+             else f"{identifier}-workdir")
+    return {"name": "workdir", "persistentVolumeClaim": {"claimName": claim}}
+
+
+def _workdir_mount(spec: TaskSpec) -> Dict[str, Any]:
+    """Mount for the workdir volume; a pre-allocated claim's ``path``
+    becomes the mount subPath (resource_job.go:184-189)."""
+    mount: Dict[str, Any] = {"name": "workdir", "mountPath": "/workdir"}
+    if spec.remote_storage and spec.remote_storage.path:
+        mount["subPath"] = spec.remote_storage.path.strip("/")
+    return mount
+
 
 def render_manifests(identifier: str, spec: TaskSpec, namespace: str = "default",
-                     region: str = "") -> List[Dict[str, Any]]:
+                     region: str = "",
+                     automount_service_account_token: Optional[bool] = None,
+                     ) -> List[Dict[str, Any]]:
+    """ConfigMap [+ PVC] + Job. The PVC is omitted when ``remote_storage``
+    names a pre-allocated claim (task/k8s/task.go:66-70) — the existing PVC
+    is referenced, never owned, so delete won't touch it."""
     resources = parse_k8s_machine(spec.size.machine or "m")
     selectors = parse_node_selectors(region)
     selectors.update(resources.node_selector())
@@ -48,6 +101,9 @@ def render_manifests(identifier: str, spec: TaskSpec, namespace: str = "default"
         "data": {"script": spec.environment.script},
     }
 
+    workdir = parse_workdir(spec.environment.directory)
+    size_gb = workdir.size_gb or (spec.size.storage
+                                  if spec.size.storage > 0 else 10)
     pvc = {
         "apiVersion": "v1",
         "kind": "PersistentVolumeClaim",
@@ -58,9 +114,12 @@ def render_manifests(identifier: str, spec: TaskSpec, namespace: str = "default"
             # (resource_persistent_volume_claim.go:41-44).
             "accessModes": ["ReadWriteMany" if spec.parallelism > 1
                             else "ReadWriteOnce"],
-            "resources": {"requests": {
-                "storage": f"{spec.size.storage if spec.size.storage > 0 else 10}Gi",
-            }},
+            # storageClassName only when the workdir grammar names one —
+            # otherwise the cluster default applies
+            # (resource_persistent_volume_claim.go:66-70).
+            **({"storageClassName": workdir.storage_class}
+               if workdir.storage_class else {}),
+            "resources": {"requests": {"storage": f"{size_gb}Gi"}},
         },
     }
 
@@ -75,23 +134,35 @@ def render_manifests(identifier: str, spec: TaskSpec, namespace: str = "default"
                 "restartPolicy": "Never",
                 "terminationGracePeriodSeconds": 30,
                 **({"nodeSelector": selectors} if selectors else {}),
+                # permission_set names an existing ServiceAccount the pods
+                # run as (resource_job.go:259-260).
+                **({"serviceAccountName": spec.permission_set}
+                   if spec.permission_set else {}),
+                **({"automountServiceAccountToken":
+                    automount_service_account_token}
+                   if automount_service_account_token is not None else {}),
                 "containers": [{
                     "name": "task",
                     "image": image,
                     "command": ["/bin/sh", "-c", "exec /script/script"],
                     "env": env,
-                    "resources": {"limits": resources.limits(spec.size.storage)},
+                    # Requests pinned to 0 (resource_job.go:245-249): without
+                    # them K8s defaults requests to the limits, leaving pods
+                    # Pending on nodes smaller than the cap.
+                    "resources": {
+                        "limits": resources.limits(spec.size.storage),
+                        "requests": {"cpu": "0", "memory": "0"},
+                    },
                     "workingDir": "/workdir",
                     "volumeMounts": [
                         {"name": "script", "mountPath": "/script"},
-                        {"name": "workdir", "mountPath": "/workdir"},
+                        _workdir_mount(spec),
                     ],
                 }],
                 "volumes": [
                     {"name": "script", "configMap": {
                         "name": f"{identifier}-script", "defaultMode": 0o755}},
-                    {"name": "workdir", "persistentVolumeClaim": {
-                        "claimName": f"{identifier}-workdir"}},
+                    _workdir_volume(identifier, spec),
                 ],
             },
         },
@@ -109,6 +180,8 @@ def render_manifests(identifier: str, spec: TaskSpec, namespace: str = "default"
         "metadata": {"name": identifier, "namespace": namespace, "labels": labels},
         "spec": job_spec,
     }
+    if spec.remote_storage:
+        return [config_map, job]
     return [config_map, pvc, job]
 
 
@@ -151,12 +224,11 @@ def render_transfer_job(identifier: str, spec: TaskSpec,
                         "command": ["/bin/sh", "-c", "sleep infinity"],
                         "workingDir": "/workdir",
                         "volumeMounts": [
-                            {"name": "workdir", "mountPath": "/workdir"},
+                            _workdir_mount(spec),
                         ],
                     }],
                     "volumes": [
-                        {"name": "workdir", "persistentVolumeClaim": {
-                            "claimName": f"{identifier}-workdir"}},
+                        _workdir_volume(identifier, spec),
                     ],
                 },
             },

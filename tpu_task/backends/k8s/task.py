@@ -35,7 +35,11 @@ from typing import Any, Dict, Iterator, List, Optional
 
 from tpu_task.backends.group_task import GroupBackedTask
 from tpu_task.backends.k8s.machines import parse_k8s_machine
-from tpu_task.backends.k8s.manifests import render_manifests, render_transfer_job
+from tpu_task.backends.k8s.manifests import (
+    parse_workdir,
+    render_manifests,
+    render_transfer_job,
+)
 from tpu_task.common.cloud import Cloud
 from tpu_task.common.errors import (
     ResourceNotFoundError,
@@ -137,20 +141,56 @@ class K8STask(GroupBackedTask):
     def get_key_pair(self) -> Optional[DeterministicSSHKeyPair]:
         return None  # no SSH on k8s (task/k8s/task.go:330)
 
+    def workdir(self) -> str:
+        """The grammar ``class:[size:]path`` puts the PVC on a storage
+        class; the local sync directory is the path part
+        (task/k8s/task.go:76-92)."""
+        return parse_workdir(self.spec.environment.directory).path
+
+    def _service_account_automount(self) -> Optional[bool]:
+        """Verify ``permission_set`` names an existing ServiceAccount and
+        return its automount setting (data_source_permission_set.go:34-50)."""
+        name = self.spec.permission_set
+        if not name:
+            return None
+        try:
+            account = _kubectl_json("get", "serviceaccount", name)
+        except ResourceNotFoundError:
+            raise ResourceNotFoundError(
+                f"service account {name!r} does not exist in namespace "
+                f"{namespace()!r}") from None
+        return account.get("automountServiceAccountToken")
+
+    def _verify_remote_storage(self) -> None:
+        """A pre-allocated PVC must exist before the Job references it
+        (data_source_persistent_volume.go:31-41)."""
+        if not self.spec.remote_storage:
+            return
+        claim = self.spec.remote_storage.container
+        try:
+            _kubectl_json("get", "pvc", claim)
+        except ResourceNotFoundError:
+            raise ResourceNotFoundError(
+                f"persistent volume claim {claim!r} does not exist in "
+                f"namespace {namespace()!r}") from None
+
     # -- real-cluster lifecycle -----------------------------------------------
     def create(self) -> None:
         if not real_mode():
             super().create()
             return
-        manifests = render_manifests(self.identifier.long(), self.spec,
-                                     namespace=namespace(),
-                                     region=str(self.cloud.region))
-        config_map, pvc, job = manifests
-        # ConfigMap + PVC first, then data upload through a transfer pod
-        # while the PVC is unclaimed, then the real Job (task.go:129-176;
-        # ordering matters for ReadWriteOnce claims).
-        kubectl("apply", "-f", "-", manifest=[config_map, pvc])
-        if self.spec.environment.directory:
+        automount = self._service_account_automount()
+        self._verify_remote_storage()
+        manifests = render_manifests(
+            self.identifier.long(), self.spec, namespace=namespace(),
+            region=str(self.cloud.region),
+            automount_service_account_token=automount)
+        *storage_objects, job = manifests
+        # ConfigMap (+ PVC unless pre-allocated) first, then data upload
+        # through a transfer pod while the claim is unclaimed, then the
+        # real Job (task.go:129-176; ordering matters for ReadWriteOnce).
+        kubectl("apply", "-f", "-", manifest=storage_objects)
+        if self.workdir():
             self.push()
         kubectl("apply", "-f", "-", manifest=[job])
 
@@ -158,7 +198,7 @@ class K8STask(GroupBackedTask):
         if not real_mode():
             super().delete()
             return
-        if self.spec.environment.directory and self._alive():
+        if self.workdir() and self._alive():
             try:
                 # Free the PVC from the main Job before mounting it in the
                 # transfer pod (task.go:207-230 deletes the Job first; the
@@ -315,7 +355,7 @@ class K8STask(GroupBackedTask):
         if not real_mode():
             super().push()
             return
-        directory = self.spec.environment.directory
+        directory = self.workdir()
         if not directory:
             return
         # Apply the exclude rules locally before cp — kubectl cp has no
@@ -333,7 +373,7 @@ class K8STask(GroupBackedTask):
         if not real_mode():
             super().pull()
             return
-        directory = self.spec.environment.directory
+        directory = self.workdir()
         if not directory:
             return
         with self._transfer_pod() as pod:

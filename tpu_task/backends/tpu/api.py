@@ -427,6 +427,18 @@ class RestTpuClient:
         self._token = OAuthToken(self._fetch_token)
         self._urlopen = None  # test hook: injectable transport
         self._sleep = None    # test hook: injectable backoff sleep
+        self._event_stamps: dict = {}  # (qr, code, description) → first-seen
+
+    def _first_seen(self, name: str, code: str, description: str) -> str:
+        """Stable timestamp for a synthesized state event: stamped when the
+        condition is first observed by this client, reused on later polls."""
+        import time as _time
+
+        key = (name, code, description)
+        if key not in self._event_stamps:
+            self._event_stamps[key] = _time.strftime(
+                "%Y-%m-%dT%H:%M:%S+00:00", _time.gmtime())
+        return self._event_stamps[key]
 
     # -- plumbing -------------------------------------------------------------
     def _parent(self) -> str:
@@ -518,7 +530,27 @@ class RestTpuClient:
 
     def get_queued_resource(self, name: str) -> QueuedResourceInfo:
         payload = self._request("GET", f"{self._parent()}/queuedResources/{name}")
-        state = payload.get("state", {}).get("state", QR_WAITING)
+        state_payload = payload.get("state", {})
+        state = state_payload.get("state", QR_WAITING)
+        # The v2 API exposes no transition timeline, but the state record
+        # carries who initiated the current state and, on FAILED, the error
+        # — fold what exists into events so `read --follow` surfaces it.
+        # Stamped at FIRST observation and cached: a fresh stamp per poll
+        # would make each poll look like a new event to follow-loop dedup.
+        events = []
+        failed = state_payload.get("failedData", {})
+        if failed:
+            message = failed.get("error", {}).get("message", "")
+            events.append({
+                "time": self._first_seen(name, "FAILED", message),
+                "code": "FAILED",
+                "description": message or "queued resource failed"})
+        initiator = state_payload.get("stateInitiator", "")
+        if initiator:
+            description = f"state set by {initiator}"
+            events.append({
+                "time": self._first_seen(name, state, description),
+                "code": state, "description": description})
         node_id = ""
         spec_payload = payload.get("tpu", {}).get("nodeSpec", [])
         spec = QueuedResourceSpec(node_id="", accelerator_type="", runtime_version="")
@@ -547,7 +579,8 @@ class RestTpuClient:
                                          .get("enableExternalIps", True)),
                 tags=list(node.get("tags", [])),
             )
-        return QueuedResourceInfo(name=name, state=state, spec=spec, node_name=node_id)
+        return QueuedResourceInfo(name=name, state=state, spec=spec,
+                                  node_name=node_id, events=events)
 
     def delete_queued_resource(self, name: str, force: bool = True) -> None:
         operation = self._request(

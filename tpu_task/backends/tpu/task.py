@@ -20,8 +20,10 @@ from __future__ import annotations
 
 import base64
 import json
+import logging
 import os
 import time
+import uuid
 from datetime import datetime, timezone
 from typing import Dict, List, Optional
 
@@ -74,6 +76,9 @@ def fake_mode() -> bool:
     return bool(os.environ.get("TPU_TASK_FAKE_TPU_ROOT"))
 
 
+logger = logging.getLogger("tpu-task")
+
+
 class TPUTask(GcsRemoteMixin, Task):
     def __init__(self, cloud: Cloud, identifier: Identifier, spec: TaskSpec):
         self.cloud = cloud
@@ -83,8 +88,18 @@ class TPUTask(GcsRemoteMixin, Task):
         self.zone = resolve_zone(str(cloud.region))
         self._events: List[Event] = []
         # Recovery events survive across reads — they are the MTTR record.
+        # Each is ALSO persisted to the bucket mailbox (reports/events-*)
+        # so a fresh observer process sees past recoveries; the in-memory
+        # list is the fallback when the bucket write failed.
         self._recovery_events: List[Event] = []
         self._remote_record: Optional[str] = None  # lazy QR-metadata lookup
+        # Bucket probe caches: every read() would otherwise pay two storage
+        # round-trips (shutdown marker + durable events).
+        self._shutdown_seen = False
+        self._shutdown_checked_at = float("-inf")
+        self._bucket_events_cache: List[Event] = []
+        self._bucket_events_at = float("-inf")
+        self._warned: Dict[str, bool] = {}  # one warning per failure kind
 
         if fake_mode():
             self.client = FakeTpuControlPlane()
@@ -322,7 +337,7 @@ class TPUTask(GcsRemoteMixin, Task):
         # credentials); observing it releases the TPU capacity
         # (machine-script.sh.tpl:10-14 semantics).
         if self._existing_qrs() and self._shutdown_requested():
-            self._recovery_events.append(Event(
+            self._record_recovery(Event(
                 time=datetime.now(timezone.utc), code="self-destruct",
                 description=["shutdown marker observed; releasing slices"]))
             self.stop()
@@ -362,14 +377,92 @@ class TPUTask(GcsRemoteMixin, Task):
         self.spec.events = self.events()
 
     def _shutdown_requested(self) -> bool:
+        """Has worker 0 left a shutdown marker in the bucket?
+
+        The probe costs a storage round-trip, so a negative answer is
+        cached for TPU_TASK_SHUTDOWN_PROBE_PERIOD seconds (default 20 —
+        self-destruct latency, not correctness) and a positive one latches.
+        Storage failures are logged (once per failure kind), not silently
+        swallowed: a persistently broken bucket should not invisibly
+        disable self-destruct observation."""
+        from tpu_task.common.errors import ResourceNotFoundError as _NotFound
         from tpu_task.storage.backends import open_backend
 
+        if self._shutdown_seen:
+            return True
+        period = float(os.environ.get("TPU_TASK_SHUTDOWN_PROBE_PERIOD", "20"))
+        now = time.monotonic()
+        if now - self._shutdown_checked_at < period:
+            return False
+        self._shutdown_checked_at = now
         try:
             backend, _ = open_backend(self._remote())
             backend.read("shutdown")
+            self._shutdown_seen = True
             return True
-        except Exception:
+        except (_NotFound, FileNotFoundError):
+            return False  # no marker yet: the expected steady state
+        except Exception as error:
+            self._warn_once("shutdown-probe",
+                            f"shutdown-marker probe failed: {error}")
             return False
+
+    def _warn_once(self, kind: str, message: str) -> None:
+        if not self._warned.get(kind):
+            self._warned[kind] = True
+            logger.warning("%s", message)
+
+    # -- durable recovery/MTTR events -----------------------------------------
+    def _record_recovery(self, event: Event) -> None:
+        """Remember a recovery event AND persist it to the bucket mailbox
+        (reports/events-*), so a second observer — a fresh `read --follow`
+        process — sees the recovery history the way the reference surfaces
+        ASG scaling activities (resource_auto_scaling_group.go:158-183)."""
+        self._recovery_events.append(event)
+        from tpu_task.storage.backends import open_backend
+
+        key = (f"reports/events-{event.time.strftime('%Y%m%dT%H%M%S')}"
+               f"-{uuid.uuid4().hex[:8]}.json")
+        try:
+            backend, _ = open_backend(self._remote())
+            backend.write(key, json.dumps({
+                "time": event.time.isoformat(),
+                "code": event.code,
+                "description": list(event.description),
+            }).encode())
+            self._bucket_events_at = float("-inf")  # cache now stale
+        except Exception as error:
+            self._warn_once("event-persist",
+                            f"could not persist recovery event: {error}")
+
+    def _bucket_events(self) -> List[Event]:
+        """Durable events from the bucket mailbox, cached for
+        TPU_TASK_EVENTS_PROBE_PERIOD seconds (default 20)."""
+        period = float(os.environ.get("TPU_TASK_EVENTS_PROBE_PERIOD", "20"))
+        now = time.monotonic()
+        if now - self._bucket_events_at < period:
+            return self._bucket_events_cache
+        from tpu_task.storage.backends import open_backend
+
+        events: List[Event] = []
+        try:
+            backend, _ = open_backend(self._remote())
+            for key in sorted(backend.list("reports/")):
+                name = key.rsplit("/", 1)[-1]
+                if not (name.startswith("events-") and name.endswith(".json")):
+                    continue
+                payload = json.loads(backend.read(key))
+                events.append(Event(
+                    time=datetime.fromisoformat(payload["time"]),
+                    code=payload.get("code", ""),
+                    description=list(payload.get("description", []))))
+        except Exception as error:
+            self._warn_once("event-read",
+                            f"could not read durable events: {error}")
+            return self._bucket_events_cache  # last known good
+        self._bucket_events_cache = events
+        self._bucket_events_at = now
+        return events
 
     def _recover(self, info: QueuedResourceInfo) -> None:
         """The preemption-recovery reconciler: SUSPENDED → delete → re-queue.
@@ -378,7 +471,7 @@ class TPUTask(GcsRemoteMixin, Task):
         (render_script / local agent restore path), so user scripts resume
         from the last synced checkpoint — ASG-respawn semantics made explicit.
         """
-        self._recovery_events.append(Event(
+        self._record_recovery(Event(
             time=datetime.now(timezone.utc), code="recover",
             description=[f"re-queueing preempted {info.name}"]))
         # Recover the staged agent-wheel URL from the QR's own metadata —
@@ -440,7 +533,15 @@ class TPUTask(GcsRemoteMixin, Task):
         return self._folded_status(running)
 
     def events(self) -> List[Event]:
-        return list(self._events) + list(self._recovery_events)
+        """QR events + recovery history. Durable bucket events are the
+        authoritative recovery record (visible to every observer); local
+        recovery events are folded in only when missing there (persist
+        failed), deduped by (time, code)."""
+        durable = self._bucket_events()
+        seen = {(event.time, event.code) for event in durable}
+        local_only = [event for event in self._recovery_events
+                      if (event.time, event.code) not in seen]
+        return list(self._events) + durable + local_only
 
     # -- multi-host fan-out ---------------------------------------------------
     def worker_addresses(self) -> List[str]:

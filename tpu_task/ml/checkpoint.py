@@ -132,6 +132,17 @@ def save_checkpoint_sharded(directory, step: int, tree: Any) -> Path:
         raise
 
     if process == 0:
+        # Reap shard files beyond this topology: a re-save of the same step
+        # after a topology SHRINK would otherwise leave stale higher-index
+        # shards that make the completeness check (indices == 0..expected-1)
+        # reject the step forever.
+        for stale in directory.glob(f"ckpt-{step}.shard-*.npz"):
+            match = _SHARD_RE.match(stale.name)
+            if match and int(match.group(2)) >= jax.process_count():
+                try:
+                    stale.unlink()
+                except OSError:
+                    pass
         # Per-step manifest: records THIS step's save-time topology so a
         # later restore under a different process count can still judge the
         # step's completeness by the count it was saved with.
