@@ -1,0 +1,286 @@
+"""Loopback EC2 + Auto Scaling Query emulator (control-plane over HTTP).
+
+Drives :class:`~tpu_task.backends.aws.api.QueryClient` through real
+sockets: SigV4-signed form POSTs, the shared retry layer, namespace-
+stripped XML parsing, and the AWS error-code → NotFound/AlreadyExists
+mapping all run for real — the control-plane analog of the S3 loopback in
+``storage/object_store_emulators.py``. Stateful: security groups, key
+pairs, launch templates (with their tag specifications), auto-scaling
+groups, instances and scaling activities live across calls so the REAL
+``AWSRealTask`` composition can run a full create → read → delete
+lifecycle against it (reference smoke shape, task_smoke_test.go:162-233).
+
+Happy-path + idempotency semantics: duplicate creates answer the same
+AWS error codes the live services use (InvalidGroup.Duplicate,
+InvalidLaunchTemplateName.AlreadyExistsException, …) and missing
+resources the NotFound variants, because that mapping IS the behavior
+under test. Auth headers are checked for SigV4 shape, not verified
+cryptographically (test_signing.py holds the vector tests).
+
+Attach BOTH Query clients (ec2 + autoscaling) — actions dispatch by name.
+"""
+
+from __future__ import annotations
+
+import urllib.parse
+from typing import Dict, List
+from xml.sax.saxutils import escape
+
+from tpu_task.backends.loopback import LoopbackControlPlane, LoopbackHandler
+
+
+def _error(code: str, message: str = "") -> bytes:
+    return (f"<Response><Errors><Error><Code>{escape(code)}</Code>"
+            f"<Message>{escape(message)}</Message></Error></Errors>"
+            "</Response>").encode()
+
+
+class _AwsHandler(LoopbackHandler):
+    def do_POST(self) -> None:
+        auth = self.headers.get("Authorization", "")
+        self.emulator.auth_headers.append(auth)
+        if not auth.startswith("AWS4-HMAC-SHA256 Credential="):
+            self.reply(403, _error("AuthFailure"), "text/xml")
+            return
+        form = dict(urllib.parse.parse_qsl(self.read_body().decode()))
+        code, body = self.emulator.handle(form)
+        self.reply(code, body, "text/xml")
+
+
+class LoopbackAws(LoopbackControlPlane):
+    handler_class = _AwsHandler
+
+    def __init__(self):
+        super().__init__()
+        self.security_groups: Dict[str, str] = {}  # name -> groupId
+        self.sg_rules: List[dict] = []
+        self.key_pairs: Dict[str, str] = {}        # name -> material
+        self.launch_templates: Dict[str, dict] = {}  # name -> create form
+        self.asgs: Dict[str, dict] = {}  # name -> {params, desired, instances}
+        self.instances: Dict[str, dict] = {}  # id -> {state, ip}
+        self.activities: Dict[str, list] = {}  # asg -> [activity]
+        self.auth_headers: List[str] = []
+        self.forms: List[dict] = []
+        self._counter = 0
+
+    def attach(self, client) -> None:
+        from tpu_task.storage.object_store_emulators import loopback_transport
+
+        client._urlopen = loopback_transport(
+            f"https://{client.host}", self.port)
+
+    def _next(self, prefix: str) -> str:
+        with self._lock:
+            self._counter += 1
+            return f"{prefix}-{self._counter}"
+
+    # -- dispatch --------------------------------------------------------------
+    def handle(self, form: dict):
+        self.forms.append(form)
+        action = form.get("Action", "")
+        handler = getattr(self, f"_do_{action}", None)
+        if handler is None:
+            return 400, _error("InvalidAction", action)
+        return handler(form)
+
+    # -- EC2: network/image data sources ---------------------------------------
+    def _do_DescribeVpcs(self, form):
+        return 200, (b"<r><vpcSet><item><vpcId>vpc-default</vpcId>"
+                     b"<isDefault>true</isDefault></item></vpcSet></r>")
+
+    def _do_DescribeSubnets(self, form):
+        items = "".join(f"<item><subnetId>{sn}</subnetId></item>"
+                        for sn in ("subnet-a", "subnet-b"))
+        return 200, f"<r><subnetSet>{items}</subnetSet></r>".encode()
+
+    def _do_DescribeImages(self, form):
+        # Two candidates so the newest-CreationDate-wins rule is exercised.
+        return 200, (
+            b"<r><imagesSet>"
+            b"<item><imageId>ami-old</imageId>"
+            b"<creationDate>2020-01-01T00:00:00.000Z</creationDate></item>"
+            b"<item><imageId>ami-newest</imageId>"
+            b"<creationDate>2024-06-01T00:00:00.000Z</creationDate></item>"
+            b"</imagesSet></r>")
+
+    # -- EC2: security groups --------------------------------------------------
+    def _do_CreateSecurityGroup(self, form):
+        name = form["GroupName"]
+        if name in self.security_groups:
+            return 400, _error("InvalidGroup.Duplicate", name)
+        group_id = self._next("sg")
+        self.security_groups[name] = group_id
+        return 200, f"<r><groupId>{group_id}</groupId></r>".encode()
+
+    def _rule_change(self, form):
+        if form["GroupId"] not in self.security_groups.values():
+            return 400, _error("InvalidGroup.NotFound", form["GroupId"])
+        self.sg_rules.append(form)
+        return 200, b"<r><return>true</return></r>"
+
+    _do_AuthorizeSecurityGroupIngress = _rule_change
+    _do_AuthorizeSecurityGroupEgress = _rule_change
+    _do_RevokeSecurityGroupEgress = _rule_change
+
+    def _do_DescribeSecurityGroups(self, form):
+        name = form.get("Filter.1.Value.1", "")
+        group_id = self.security_groups.get(name)
+        if not group_id:
+            return 200, b"<r><securityGroupInfo/></r>"
+        return 200, (f"<r><securityGroupInfo><item>"
+                     f"<groupId>{group_id}</groupId>"
+                     f"<groupName>{escape(name)}</groupName>"
+                     f"</item></securityGroupInfo></r>").encode()
+
+    def _do_DeleteSecurityGroup(self, form):
+        group_id = form.get("GroupId", "")
+        for name, known in list(self.security_groups.items()):
+            if known == group_id:
+                del self.security_groups[name]
+                return 200, b"<r><return>true</return></r>"
+        return 400, _error("InvalidGroup.NotFound", group_id)
+
+    # -- EC2: key pairs --------------------------------------------------------
+    def _do_ImportKeyPair(self, form):
+        name = form["KeyName"]
+        if name in self.key_pairs:
+            return 400, _error("InvalidKeyPair.Duplicate", name)
+        self.key_pairs[name] = form.get("PublicKeyMaterial", "")
+        return 200, f"<r><keyName>{escape(name)}</keyName></r>".encode()
+
+    def _do_DeleteKeyPair(self, form):
+        if form["KeyName"] not in self.key_pairs:
+            return 400, _error("InvalidKeyPair.NotFound", form["KeyName"])
+        del self.key_pairs[form["KeyName"]]
+        return 200, b"<r><return>true</return></r>"
+
+    # -- EC2: launch templates -------------------------------------------------
+    def _do_CreateLaunchTemplate(self, form):
+        name = form["LaunchTemplateName"]
+        if name in self.launch_templates:
+            return 400, _error(
+                "InvalidLaunchTemplateName.AlreadyExistsException", name)
+        self.launch_templates[name] = form
+        return 200, (f"<r><launchTemplate><launchTemplateName>{escape(name)}"
+                     f"</launchTemplateName></launchTemplate></r>").encode()
+
+    def _do_DescribeLaunchTemplateVersions(self, form):
+        name = form.get("LaunchTemplateName", "")
+        stored = self.launch_templates.get(name)
+        if stored is None:
+            return 400, _error(
+                "InvalidLaunchTemplateName.NotFoundException", name)
+        tags = []
+        index = 1
+        while f"LaunchTemplateData.TagSpecification.1.Tag.{index}.Key" in stored:
+            key = stored[f"LaunchTemplateData.TagSpecification.1.Tag.{index}.Key"]
+            value = stored[
+                f"LaunchTemplateData.TagSpecification.1.Tag.{index}.Value"]
+            tags.append(f"<item><key>{escape(key)}</key>"
+                        f"<value>{escape(value)}</value></item>")
+            index += 1
+        return 200, (
+            "<r><launchTemplateVersionSet><item><launchTemplateData>"
+            "<tagSpecificationSet><item>"
+            f"<tagSet>{''.join(tags)}</tagSet>"
+            "</item></tagSpecificationSet>"
+            "</launchTemplateData></item></launchTemplateVersionSet></r>"
+        ).encode()
+
+    def _do_DeleteLaunchTemplate(self, form):
+        name = form.get("LaunchTemplateName", "")
+        if name not in self.launch_templates:
+            return 400, _error(
+                "InvalidLaunchTemplateName.NotFoundException", name)
+        del self.launch_templates[name]
+        return 200, b"<r><return>true</return></r>"
+
+    # -- EC2: instances --------------------------------------------------------
+    def _do_DescribeInstances(self, form):
+        wanted = [value for key, value in form.items()
+                  if key.startswith("InstanceId.")]
+        items = []
+        for instance_id in wanted:
+            record = self.instances.get(instance_id)
+            if record is None:
+                continue
+            items.append(
+                f"<item><instanceId>{instance_id}</instanceId>"
+                f"<instanceState><name>{record['state']}</name>"
+                f"</instanceState>"
+                f"<ipAddress>{record['ip']}</ipAddress></item>")
+        return 200, (f"<r><reservationSet><item>"
+                     f"<instancesSet>{''.join(items)}</instancesSet>"
+                     f"</item></reservationSet></r>").encode()
+
+    # -- Auto Scaling ----------------------------------------------------------
+    def _do_CreateAutoScalingGroup(self, form):
+        name = form["AutoScalingGroupName"]
+        if name in self.asgs:
+            return 400, _error("AlreadyExists", name)
+        self.asgs[name] = {"params": form, "desired": 0, "instances": []}
+        self.activities.setdefault(name, [])
+        return 200, b"<r/>"
+
+    def _do_SetDesiredCapacity(self, form):
+        name = form["AutoScalingGroupName"]
+        group = self.asgs.get(name)
+        if group is None:
+            return 400, _error("ValidationError",
+                               f"AutoScalingGroup name not found: {name}")
+        desired = int(form["DesiredCapacity"])
+        group["desired"] = desired
+        while len(group["instances"]) < desired:  # scale out
+            instance_id = self._next("i")
+            self.instances[instance_id] = {
+                "state": "running",
+                "ip": f"54.0.0.{len(self.instances) + 10}"}
+            group["instances"].append(instance_id)
+            self.activities[name].append({
+                "StatusCode": "Successful",
+                "StartTime": "2026-07-30T00:00:00Z",
+                "Cause": "scale out",
+                "Description": f"Launching {instance_id}"})
+        while len(group["instances"]) > desired:  # scale in
+            instance_id = group["instances"].pop()
+            self.instances[instance_id]["state"] = "terminated"
+            self.activities[name].append({
+                "StatusCode": "Successful",
+                "StartTime": "2026-07-30T00:00:00Z",
+                "Cause": "scale in",
+                "Description": f"Terminating {instance_id}"})
+        return 200, b"<r/>"
+
+    def _do_DescribeAutoScalingGroups(self, form):
+        name = form.get("AutoScalingGroupNames.member.1", "")
+        group = self.asgs.get(name)
+        if group is None:
+            return 200, b"<r><AutoScalingGroups/></r>"
+        members = "".join(
+            f"<member><InstanceId>{instance_id}</InstanceId></member>"
+            for instance_id in group["instances"])
+        return 200, (
+            f"<r><AutoScalingGroups><member>"
+            f"<AutoScalingGroupName>{escape(name)}</AutoScalingGroupName>"
+            f"<DesiredCapacity>{group['desired']}</DesiredCapacity>"
+            f"<Instances>{members}</Instances>"
+            f"</member></AutoScalingGroups></r>").encode()
+
+    def _do_DescribeScalingActivities(self, form):
+        name = form.get("AutoScalingGroupName", "")
+        members = "".join(
+            "<member>" + "".join(
+                f"<{field}>{escape(value)}</{field}>"
+                for field, value in activity.items()) + "</member>"
+            for activity in self.activities.get(name, []))
+        return 200, f"<r><Activities>{members}</Activities></r>".encode()
+
+    def _do_DeleteAutoScalingGroup(self, form):
+        name = form.get("AutoScalingGroupName", "")
+        group = self.asgs.pop(name, None)
+        if group is None:
+            return 400, _error("ValidationError",
+                               f"AutoScalingGroup name not found: {name}")
+        for instance_id in group["instances"]:
+            self.instances[instance_id]["state"] = "terminated"
+        return 200, b"<r/>"

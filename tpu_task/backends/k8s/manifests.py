@@ -146,12 +146,14 @@ def render_manifests(identifier: str, spec: TaskSpec, namespace: str = "default"
                     "image": image,
                     "command": ["/bin/sh", "-c", "exec /script/script"],
                     "env": env,
-                    # Requests pinned to 0 (resource_job.go:245-249): without
-                    # them K8s defaults requests to the limits, leaving pods
-                    # Pending on nodes smaller than the cap.
+                    # Requests pinned to 0 (resource_job.go:245-249): K8s
+                    # defaults each resource's request to its limit, leaving
+                    # pods Pending on nodes smaller than the cap. Every
+                    # requestable resource the limits can contain needs a pin.
                     "resources": {
                         "limits": resources.limits(spec.size.storage),
-                        "requests": {"cpu": "0", "memory": "0"},
+                        "requests": {"cpu": "0", "memory": "0",
+                                     "ephemeral-storage": "0"},
                     },
                     "workingDir": "/workdir",
                     "volumeMounts": [

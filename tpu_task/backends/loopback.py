@@ -1,0 +1,66 @@
+"""Loopback control-plane emulator scaffolding.
+
+The storage emulators (``storage/gcs_emulator.py``,
+``storage/object_store_emulators.py``) prove the DATA-plane clients over
+real sockets; the per-backend control-plane emulators built on this base
+(``backends/tpu/emulator.py``, ``backends/aws/emulator.py``) do the same
+for the CONTROL planes — auth headers, retry/backoff, XML/JSON parsing and
+LRO polling all run through the real urllib/HTTP stack instead of injected
+transports. Stateful by design: unlike the scripted ``FakeTransport``
+responses in the unit suites, these servers hold resource state so whole
+lifecycles (create → read → recover → delete) drive against them.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class LoopbackHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    @property
+    def emulator(self):
+        return self.server.emulator  # type: ignore[attr-defined]
+
+    def reply(self, code: int, body: bytes = b"",
+              content_type: str = "application/octet-stream") -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def read_body(self) -> bytes:
+        length = int(self.headers.get("Content-Length", "0"))
+        return self.rfile.read(length) if length else b""
+
+    def log_message(self, *args) -> None:
+        pass
+
+
+class LoopbackControlPlane:
+    """Context-managed threaded HTTP server bound to an ephemeral port."""
+
+    handler_class = LoopbackHandler
+
+    def __init__(self):
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0),
+                                           self.handler_class)
+        self._server.emulator = self  # type: ignore[attr-defined]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._lock = threading.Lock()
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._server.shutdown()
+        self._server.server_close()
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]

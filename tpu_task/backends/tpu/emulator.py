@@ -1,0 +1,173 @@
+"""Loopback Cloud TPU v2 REST emulator (control-plane subset over HTTP).
+
+Drives :class:`~tpu_task.backends.tpu.api.RestTpuClient` through real
+sockets: Bearer auth, the shared retry layer, JSON parsing, and the LRO
+operation poller all run for real — the control-plane analog of
+``storage/gcs_emulator.py``. Stateful: queued resources are stored from
+the POSTed create body and echoed back in the real GET shape
+(``tpu.nodeSpec[0].node`` with metadata/startup-script/schedulingConfig),
+so the bare-read recovery path parses exactly what it created.
+
+API shapes per https://cloud.google.com/tpu/docs/reference/rest/v2 — the
+happy path plus 404/409 semantics and one-poll LRO operations for create
+(delete operations return done immediately to keep the 2 s op-poller from
+dominating test wall-clock).
+
+Test hooks: ``preempt(name)`` flips a QR to SUSPENDED the way a spot
+reclaim does; ``auth_headers`` records every Authorization header seen.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import urllib.parse
+from typing import Dict, List
+
+from tpu_task.backends.loopback import LoopbackControlPlane, LoopbackHandler
+
+_QR_PATH = re.compile(
+    r"^/v2/projects/([^/]+)/locations/([^/]+)/queuedResources(?:/([^/?]+))?$")
+_NODE_PATH = re.compile(
+    r"^/v2/projects/([^/]+)/locations/([^/]+)/nodes(?:/([^/?]+))?$")
+_OP_PATH = re.compile(
+    r"^/v2/projects/([^/]+)/locations/([^/]+)/operations/([^/?]+)$")
+
+
+class _TpuHandler(LoopbackHandler):
+    def _authorized(self) -> bool:
+        auth = self.headers.get("Authorization", "")
+        self.emulator.auth_headers.append(auth)
+        return auth.startswith("Bearer ")
+
+    def _dispatch(self, method: str) -> None:
+        if not self._authorized():
+            self.reply(401, b'{"error": {"code": 401}}', "application/json")
+            return
+        parsed = urllib.parse.urlparse(self.path)
+        query = urllib.parse.parse_qs(parsed.query)
+        body = self.read_body()
+        code, payload = self.emulator.handle(
+            method, parsed.path, query,
+            json.loads(body) if body else {})
+        self.reply(code, json.dumps(payload).encode(), "application/json")
+
+    def do_GET(self) -> None:
+        self._dispatch("GET")
+
+    def do_POST(self) -> None:
+        self._dispatch("POST")
+
+    def do_DELETE(self) -> None:
+        self._dispatch("DELETE")
+
+
+class LoopbackTpu(LoopbackControlPlane):
+    handler_class = _TpuHandler
+
+    def __init__(self):
+        super().__init__()
+        self.qrs: Dict[str, dict] = {}        # name -> {"body", "state"}
+        self.operations: Dict[str, int] = {}  # op name -> remaining polls
+        self.auth_headers: List[str] = []
+        self._op_counter = 0
+
+    # -- client wiring ---------------------------------------------------------
+    def attach(self, client) -> None:
+        from tpu_task.storage.object_store_emulators import loopback_transport
+
+        client._token._fetch = lambda: ("loopback-token", 3600.0)
+        client._urlopen = loopback_transport(
+            "https://tpu.googleapis.com", self.port)
+
+    # -- test hooks ------------------------------------------------------------
+    def preempt(self, name: str) -> None:
+        """Spot reclaim: node gone, queued resource SUSPENDED."""
+        self.qrs[name]["state"] = "SUSPENDED"
+
+    # -- request handling ------------------------------------------------------
+    def _operation(self, parent: str, pending_polls: int) -> dict:
+        with self._lock:
+            self._op_counter += 1
+            name = f"projects/{parent}/operations/op-{self._op_counter}"
+        self.operations[name] = pending_polls
+        return {"name": name, "done": pending_polls == 0}
+
+    def handle(self, method: str, path: str, query: dict, body: dict):
+        op = _OP_PATH.match(path)
+        if op:
+            name = path[len("/v2/"):]
+            if name not in self.operations:
+                return 404, {"error": {"code": 404, "message": name}}
+            remaining = self.operations[name]
+            if remaining > 0:
+                self.operations[name] = remaining - 1
+                return 200, {"name": name, "done": False}
+            return 200, {"name": name, "done": True}
+
+        qr = _QR_PATH.match(path)
+        if qr:
+            project, zone, name = qr.groups()
+            parent = f"{project}/locations/{zone}"
+            if method == "POST":
+                name = query.get("queuedResourceId", [""])[0]
+                if name in self.qrs:
+                    return 409, {"error": {"code": 409,
+                                           "message": "ALREADY_EXISTS"}}
+                self.qrs[name] = {"body": body, "state": "ACTIVE"}
+                # One pending poll: the LRO waiter's 308-style loop runs.
+                return 200, self._operation(parent, pending_polls=1)
+            if method == "DELETE":
+                if name not in self.qrs:
+                    return 404, {"error": {"code": 404, "message": name}}
+                del self.qrs[name]
+                return 200, self._operation(parent, pending_polls=0)
+            if name:  # GET one
+                record = self.qrs.get(name)
+                if record is None:
+                    return 404, {"error": {"code": 404, "message": name}}
+                return 200, {
+                    "name": f"projects/{parent}/queuedResources/{name}",
+                    "state": {"state": record["state"],
+                              **record.get("state_extras", {})},
+                    "tpu": record["body"].get("tpu", {}),
+                }
+            return 200, {"queuedResources": [
+                {"name": f"projects/{parent}/queuedResources/{qr_name}"}
+                for qr_name in sorted(self.qrs)]}
+
+        node = _NODE_PATH.match(path)
+        if node:
+            project, zone, name = node.groups()
+            if name and method == "DELETE":
+                for record in self.qrs.values():
+                    spec = record["body"].get("tpu", {}).get("nodeSpec", [{}])
+                    if spec[0].get("nodeId") == name:
+                        record["state"] = "SUSPENDED"
+                return 200, self._operation(f"{project}/locations/{zone}",
+                                            pending_polls=0)
+            if name:
+                record = next(
+                    (qr for qr in self.qrs.values()
+                     if qr["body"].get("tpu", {}).get("nodeSpec",
+                                                      [{}])[0].get("nodeId")
+                     == name and qr["state"] == "ACTIVE"), None)
+                if record is None:
+                    return 404, {"error": {"code": 404, "message": name}}
+                spec_node = record["body"]["tpu"]["nodeSpec"][0].get("node", {})
+                accelerator = spec_node.get("acceleratorType", "v2-8")
+                workers = 1
+                match = re.match(r"v\d+\w*-(\d+)", accelerator)
+                if match:  # chips/8 hosts, ≥1 (v4-16 → 2 workers)
+                    workers = max(1, int(match.group(1)) // 8)
+                return 200, {
+                    "name": name, "state": "READY",
+                    "acceleratorType": accelerator,
+                    "health": "HEALTHY",
+                    "networkEndpoints": [
+                        {"ipAddress": f"10.164.0.{index + 2}"}
+                        for index in range(workers)],
+                }
+            return 200, {"nodes": []}
+
+        return 404, {"error": {"code": 404, "message": path}}

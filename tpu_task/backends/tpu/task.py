@@ -98,6 +98,7 @@ class TPUTask(GcsRemoteMixin, Task):
         self._shutdown_seen = False
         self._shutdown_checked_at = float("-inf")
         self._bucket_events_cache: List[Event] = []
+        self._bucket_event_records: Dict[str, Event] = {}
         self._bucket_events_at = float("-inf")
         self._warned: Dict[str, bool] = {}  # one warning per failure kind
 
@@ -339,7 +340,8 @@ class TPUTask(GcsRemoteMixin, Task):
         if self._existing_qrs() and self._shutdown_requested():
             self._record_recovery(Event(
                 time=datetime.now(timezone.utc), code="self-destruct",
-                description=["shutdown marker observed; releasing slices"]))
+                description=["shutdown marker observed; releasing slices"]),
+                key_hint="self-destruct")
             self.stop()
 
         addresses: List[str] = []
@@ -413,16 +415,23 @@ class TPUTask(GcsRemoteMixin, Task):
             logger.warning("%s", message)
 
     # -- durable recovery/MTTR events -----------------------------------------
-    def _record_recovery(self, event: Event) -> None:
+    def _record_recovery(self, event: Event, key_hint: str = "") -> None:
         """Remember a recovery event AND persist it to the bucket mailbox
         (reports/events-*), so a second observer — a fresh `read --follow`
         process — sees the recovery history the way the reference surfaces
-        ASG scaling activities (resource_auto_scaling_group.go:158-183)."""
+        ASG scaling activities (resource_auto_scaling_group.go:158-183).
+
+        ``key_hint`` makes the record idempotent under concurrent
+        observers: every process that witnesses the same occurrence
+        computes the same object key (self-destruct is one-shot; a
+        recovery is keyed by slice + observation minute), so duplicate
+        writes collapse into one record instead of inflating the MTTR
+        history forever."""
         self._recovery_events.append(event)
         from tpu_task.storage.backends import open_backend
 
-        key = (f"reports/events-{event.time.strftime('%Y%m%dT%H%M%S')}"
-               f"-{uuid.uuid4().hex[:8]}.json")
+        hint = key_hint or f"{event.code}-{uuid.uuid4().hex[:8]}"
+        key = f"reports/events-{hint}.json"
         try:
             backend, _ = open_backend(self._remote())
             backend.write(key, json.dumps({
@@ -437,32 +446,39 @@ class TPUTask(GcsRemoteMixin, Task):
 
     def _bucket_events(self) -> List[Event]:
         """Durable events from the bucket mailbox, cached for
-        TPU_TASK_EVENTS_PROBE_PERIOD seconds (default 20)."""
+        TPU_TASK_EVENTS_PROBE_PERIOD seconds (default 20). Event files are
+        immutable once written, so refreshes list keys but only fetch
+        bodies not seen before — O(new events) reads per poll, not O(all)."""
         period = float(os.environ.get("TPU_TASK_EVENTS_PROBE_PERIOD", "20"))
         now = time.monotonic()
         if now - self._bucket_events_at < period:
             return self._bucket_events_cache
         from tpu_task.storage.backends import open_backend
 
-        events: List[Event] = []
+        records: Dict[str, Event] = {}
         try:
             backend, _ = open_backend(self._remote())
             for key in sorted(backend.list("reports/")):
                 name = key.rsplit("/", 1)[-1]
                 if not (name.startswith("events-") and name.endswith(".json")):
                     continue
+                cached = self._bucket_event_records.get(key)
+                if cached is not None:
+                    records[key] = cached
+                    continue
                 payload = json.loads(backend.read(key))
-                events.append(Event(
+                records[key] = Event(
                     time=datetime.fromisoformat(payload["time"]),
                     code=payload.get("code", ""),
-                    description=list(payload.get("description", []))))
+                    description=list(payload.get("description", [])))
         except Exception as error:
             self._warn_once("event-read",
                             f"could not read durable events: {error}")
             return self._bucket_events_cache  # last known good
-        self._bucket_events_cache = events
+        self._bucket_event_records = records
+        self._bucket_events_cache = [records[key] for key in sorted(records)]
         self._bucket_events_at = now
-        return events
+        return self._bucket_events_cache
 
     def _recover(self, info: QueuedResourceInfo) -> None:
         """The preemption-recovery reconciler: SUSPENDED → delete → re-queue.
@@ -471,9 +487,11 @@ class TPUTask(GcsRemoteMixin, Task):
         (render_script / local agent restore path), so user scripts resume
         from the last synced checkpoint — ASG-respawn semantics made explicit.
         """
-        self._record_recovery(Event(
-            time=datetime.now(timezone.utc), code="recover",
-            description=[f"re-queueing preempted {info.name}"]))
+        stamp = datetime.now(timezone.utc)
+        self._record_recovery(
+            Event(time=stamp, code="recover",
+                  description=[f"re-queueing preempted {info.name}"]),
+            key_hint=f"recover-{info.name}-{stamp.strftime('%Y%m%dT%H%M')}")
         # Recover the staged agent-wheel URL from the QR's own metadata —
         # a bare-read process never staged one itself, and a re-rendered
         # bootstrap without it would fall back to the package index.
