@@ -585,12 +585,16 @@ class RestTpuClient:
     def delete_queued_resource(self, name: str, force: bool = True) -> None:
         operation = self._request(
             "DELETE", f"{self._parent()}/queuedResources/{name}?force={str(force).lower()}")
-        self._wait_operation(operation)
-        # A re-created QR under the same name is a new incarnation: its
-        # state events must get fresh first-seen stamps, not the old ones
-        # (which follow-loop dedup would suppress).
-        for key in [k for k in self._event_stamps if k[0] == name]:
-            del self._event_stamps[key]
+        try:
+            self._wait_operation(operation)
+        finally:
+            # A re-created QR under the same name is a new incarnation: its
+            # state events must get fresh first-seen stamps, not the old
+            # ones (which follow-loop dedup would suppress). Clear even when
+            # the wait fails — the DELETE was accepted, so the next
+            # observation of this name may already be the new incarnation.
+            for key in [k for k in self._event_stamps if k[0] == name]:
+                del self._event_stamps[key]
 
     def list_queued_resources(self) -> List[str]:
         payload = self._request("GET", f"{self._parent()}/queuedResources")

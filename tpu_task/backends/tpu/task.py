@@ -433,7 +433,17 @@ class TPUTask(GcsRemoteMixin, Task):
         hint = key_hint or f"{event.code}-{uuid.uuid4().hex[:8]}"
         key = f"reports/events-{hint}.json"
         try:
+            from tpu_task.common.errors import ResourceNotFoundError
             backend, _ = open_backend(self._remote())
+            # First writer wins: concurrent observers of one occurrence
+            # compute the same key but stamp their own clocks — an
+            # overwrite would mutate a record other processes may have
+            # cached under the immutability contract (_bucket_events).
+            try:
+                backend.read(key)
+                return
+            except ResourceNotFoundError:
+                pass
             backend.write(key, json.dumps({
                 "time": event.time.isoformat(),
                 "code": event.code,
@@ -476,7 +486,11 @@ class TPUTask(GcsRemoteMixin, Task):
                             f"could not read durable events: {error}")
             return self._bucket_events_cache  # last known good
         self._bucket_event_records = records
-        self._bucket_events_cache = [records[key] for key in sorted(records)]
+        # Chronological, not key order: the dedup keys (recover-<slice>-
+        # <minute>, self-destruct) don't sort by time lexically.
+        self._bucket_events_cache = [
+            records[key] for key in
+            sorted(records, key=lambda k: (records[k].time, k))]
         self._bucket_events_at = now
         return self._bucket_events_cache
 

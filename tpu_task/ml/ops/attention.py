@@ -166,9 +166,16 @@ def flash_attention(
     if q_offset is None:
         q_offset = sk - sq
     # Default to the largest MXU-friendly block that DIVIDES the length —
-    # a fixed default would reject e.g. 1536-chunk ring shards.
-    block_q = min(block_q or _pick_block(sq), sq)
-    block_k = min(block_k or _pick_block(sk), sk)
+    # a fixed default would reject e.g. 1536-chunk ring shards. The
+    # aggressive 2048-q / whole-kv picks apply only to the pure forward:
+    # with the f32 lane-broadcast lse output in the pipeline they push the
+    # kernel past v5e's 16M scoped-vmem limit (measured 17.8M at seq 2048).
+    if return_lse:
+        block_q = min(block_q or _pick_block(sq), sq)
+        block_k = min(block_k or _pick_block(sk), sk)
+    else:
+        block_q = min(block_q or _pick_block_fwd_q(sq), sq)
+        block_k = min(block_k or _pick_block_fwd_k(sk, causal), sk)
     if sq % block_q or sk % block_k:
         raise ValueError(
             f"seq lengths ({sq},{sk}) must divide blocks ({block_q},{block_k})")
@@ -511,13 +518,36 @@ def _use_pallas() -> bool:
     return jax.default_backend() == "tpu"
 
 
-def _pick_block(s: int) -> int:
-    """Largest MXU-friendly block dividing s (1024 wins on v5e with the
-    grid-streamed kernels — min-of-3 timings at seq 2048/8192; see bench)."""
-    for b in (1024, 512, 256, 128):
-        if s % b == 0:
+def _pick_block(s: int, cap: int = 1024) -> int:
+    """Largest MXU-friendly block dividing s, bounded by ``cap``.
+
+    The default 1024 cap is the backward kernels' (and the lse-emitting
+    forward's) sweet spot on v5e: bq=2048 slows dq by 1.6x at seq 2048 and
+    fails to compile at 8192 (min-of-5 timings on chip)."""
+    for b in (2048, 1024, 512, 256, 128):
+        if b <= cap and s % b == 0:
             return b
     return s
+
+
+def _pick_block_fwd_q(s: int) -> int:
+    """Pure-forward q-block: 2048 beats 1024 on v5e (1.73x vs 1.11x over
+    XLA at seq 2048, 2.19x vs 2.18x at 8192 — the no-lse forward holds few
+    enough VMEM tiles that the larger tile fits and amortizes the softmax
+    rescale passes)."""
+    return _pick_block(s, cap=2048)
+
+
+def _pick_block_fwd_k(sk: int, causal: bool) -> int:
+    """Pure-forward k-block: single block when the whole kv sequence fits
+    one (<=2048: with bq=2048 that is 1.79x over XLA at seq 2048 — no grid
+    streaming, no rescale passes). Causal only: the non-causal kernel with
+    a 2048 k-tile exceeds the 16M scoped-vmem limit on v5e (Mosaic keeps
+    the full rectangle live without the diagonal gating), so it stays on
+    the 1024 cap, as does any longer kv sequence."""
+    if causal and sk <= 2048:
+        return sk
+    return _pick_block(sk)
 
 
 def _pallas_ok(q, k, causal: bool, block: int = 128) -> bool:
@@ -532,15 +562,23 @@ def _pallas_ok(q, k, causal: bool, block: int = 128) -> bool:
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _pallas_attention(q, k, v, causal, interpret):
+    # Same 1024 blocks as _pa_fwd, NOT the tuned pure-forward picks: the
+    # primal runs outside jax.grad and the fwd rule inside it, and a block
+    # mismatch would give train and eval bitwise-different activations
+    # (bf16 accumulation order). Pure inference wanting the big-block
+    # forward calls flash_attention directly.
     return flash_attention(
         q, k, v, causal, block_q=_pick_block(q.shape[1]),
         block_k=_pick_block(k.shape[1]), interpret=interpret)
 
 
 def _pa_fwd(q, k, v, causal, interpret):
+    # lse path: conservative 1024 blocks (see the scoped-vmem note in
+    # flash_attention's default-block selection).
     o, lse = flash_attention(
         q, k, v, causal, block_q=_pick_block(q.shape[1]),
-        block_k=_pick_block(k.shape[1]), interpret=interpret, return_lse=True)
+        block_k=_pick_block(k.shape[1]),
+        interpret=interpret, return_lse=True)
     return o, (q, k, v, o, lse)
 
 
