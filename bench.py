@@ -283,10 +283,10 @@ def _min_time_per_iter_pair(fa, fb, q, k, v, iters: int,
 
 
 def _min_time_per_iter(fn, q, k, v, iters: int, repeats: int = 6) -> float:
-    """Seconds per iteration for a jitted iters-chained loop: compile+sync
-    first, then min-of-N wall times with a host-readback fence (tunnel
-    timing noise is ±40% and drifts down over the first ~4 repeats; see the
-    NOTE in bench_train_mfu)."""
+    """Seconds per iteration for ONE jitted iters-chained loop (min-of-N
+    with a host-readback fence). For A-vs-B comparisons use
+    :func:`_min_time_per_iter_pair` — separate timing windows let
+    shared-chip load drift bias the ratio."""
     import jax.numpy as jnp
 
     result = fn(q, k, v)
@@ -334,8 +334,10 @@ def bench_ring_schedule() -> dict:
                 0, iters, lambda i, q: flash_attention(q, k, v, causal), q)
         return loop
 
-    t_half = _min_time_per_iter(make_loop(True), q, k, v, iters)   # causal
-    t_full = _min_time_per_iter(make_loop(False), q, k, v, iters)  # full
+    # Interleaved, like the flash-vs-XLA pair: shared-chip load drift must
+    # hit both schedules equally or the speedup ratio absorbs the drift.
+    t_half, t_full = _min_time_per_iter_pair(
+        make_loop(True), make_loop(False), q, k, v, iters)
 
     # Exact per-device block-FLOP count (units of c² block pairs) at P=8:
     # uniform = P steps × 4c² rectangle = 32c²; zigzag = 2c² diagonal +

@@ -221,3 +221,261 @@ def test_aws_bare_read_recovers_remote_over_http(aws_task):
     assert remote.startswith(":s3,")
     assert "access_key_id='AKIDEXAMPLE'" in remote  # re-injected locally
     assert remote.endswith(f":{task.identifier.long()}")
+
+
+# -- ARM (Azure) over HTTP -----------------------------------------------------
+
+
+def _az_cloud():
+    from tpu_task.common.cloud import AZCredentials, Cloud, Credentials, Provider
+
+    return Cloud(provider=Provider.AZ, region="us-east",
+                 credentials=Credentials(az=AZCredentials(
+                     client_id="cid", client_secret="csecret",
+                     subscription_id="sub-1", tenant_id="tenant-1")))
+
+
+@pytest.fixture()
+def az_task(monkeypatch):
+    from tpu_task.backends.az import resources as az_resources
+    from tpu_task.backends.az.emulator import LoopbackArm
+    from tpu_task.backends.az.task import AZRealTask
+    from tpu_task.storage.object_store_emulators import LoopbackAzureBlob
+
+    spec = TaskSpec(size=Size(machine="m", storage=64),
+                    environment=Environment(script="#!/bin/sh\necho hi\n"),
+                    parallelism=2, spot=SPOT_ENABLED)
+    with LoopbackArm() as control, LoopbackAzureBlob() as blob:
+        task = AZRealTask(_az_cloud(), Identifier.deterministic("loopback-az"),
+                          spec)
+        control.attach(task.client)
+        task.client._sleep = FakeSleep()
+
+        # Every BlobContainer (they are built per call) gets its data-plane
+        # backend pointed at the blob loopback — still real HTTP.
+        original_container = az_resources.BlobContainer
+
+        class AttachedContainer(original_container):
+            def __init__(self, account, key, name):
+                super().__init__(account, key, name)
+                blob.attach(self.backend)
+
+        monkeypatch.setattr(az_resources, "BlobContainer", AttachedContainer)
+
+        shared = AttachedContainer(task.identifier.short(), "a2V5",
+                                   task.identifier.long()).backend
+
+        import importlib
+
+        from tpu_task.storage import Connection
+        from tpu_task.storage import backends as backends_mod
+
+        sync_mod = importlib.import_module("tpu_task.storage.sync")
+
+        def loop_open(remote):
+            conn = (Connection.parse(remote) if remote.startswith(":")
+                    else Connection(backend="local", container="", path=remote))
+            return shared, conn
+
+        for module in (sync_mod, backends_mod):
+            monkeypatch.setattr(module, "open_backend", loop_open)
+        yield control, blob, task
+
+
+def test_az_full_lifecycle_over_http(az_task):
+    """The real AZRealTask composition end-to-end against the stateful ARM
+    loopback: create → read → stop → delete, resource-group containment."""
+    control, blob, task = az_task
+    name = task.identifier.long()
+
+    task.create()
+    task.create()  # idempotent: ARM PUT upserts, container 409 tolerated
+    group = control.groups[name]
+    assert f"Microsoft.Storage/storageAccounts/{task.identifier.short()}" \
+        in group
+    assert f"Microsoft.Network/networkSecurityGroups/{name}" in group
+    assert f"Microsoft.Network/virtualNetworks/{name}" in group
+    vmss = group[f"Microsoft.Compute/virtualMachineScaleSets/{name}"]
+    assert vmss["sku"]["capacity"] == 2  # Start = parallelism via PATCH
+    profile = vmss["properties"]["virtualMachineProfile"]
+    assert profile["priority"] == "Spot"
+    assert profile["billingProfile"]["maxPrice"] == -1  # spot 0 → no cap
+    assert profile["osProfile"]["customData"]  # bootstrap rendered
+    assert vmss["tags"]["tpu-task-remote"].startswith(":azureblob")
+    assert "key" not in vmss["tags"]["tpu-task-remote"]
+
+    task.read()
+    assert task.spec.status.get(StatusCode.ACTIVE) == 2
+    assert task.get_addresses() == ["20.0.0.4", "20.0.0.5"]
+    assert any(event.code == "ProvisioningState/succeeded"
+               for event in task.spec.events)
+    assert task.observed_parallelism() == 2
+
+    task.stop()
+    task.read()
+    assert task.spec.status.get(StatusCode.ACTIVE, 0) == 0
+
+    task.delete()
+    task.delete()  # idempotent: RG 404 tolerated
+    assert name not in control.groups
+    assert all(a.startswith("Bearer ") for a in control.auth_headers)
+
+
+def test_az_multinet_nsg_rule_passes_arm_validation(az_task):
+    """A multi-net firewall rule must emit AddressPrefixes ONLY — the
+    emulator rejects the singular+plural combination exactly like live ARM
+    (ADVICE r3 regression guard)."""
+    from tpu_task.backends.az.resources import SecurityGroup
+    from tpu_task.common.values import Firewall, FirewallRule
+
+    control, blob, task = az_task
+    task.resource_group.create()
+    firewall = Firewall(
+        ingress=FirewallRule(ports=[22], nets=["1.2.3.0/24", "5.6.7.0/24"]),
+        egress=FirewallRule(ports=None, nets=["10.0.0.0/8", "11.0.0.0/8"]))
+    nsg = SecurityGroup(task.client, task.identifier.long(), "multi",
+                        task.region, firewall)
+    nsg.create()  # live-ARM shape check: 400 would raise HTTPError
+    stored = control.groups[task.identifier.long()][
+        "Microsoft.Network/networkSecurityGroups/multi"]
+    rules = {rule["name"]: rule["properties"]
+             for rule in stored["properties"]["securityRules"]}
+    assert rules["multi-in-22"]["sourceAddressPrefixes"] == \
+        ["1.2.3.0/24", "5.6.7.0/24"]
+    assert "sourceAddressPrefix" not in rules["multi-in-22"]
+    # ports=None egress with nets: any-port Allow precedes the deny-all.
+    assert rules["multi-out-any"]["destinationPortRange"] == "*"
+    assert rules["multi-out-deny"]["access"] == "Deny"
+
+
+def test_az_bare_read_recovers_remote_over_http(az_task):
+    """A fresh task (empty spec) resolves its storage from the VMSS tag and
+    re-fetches the account key via listKeys — nothing secret in the tag."""
+    from tpu_task.backends.az.emulator import FIXED_ACCOUNT_KEY
+    from tpu_task.backends.az.task import AZRealTask
+
+    control, blob, task = az_task
+    task.create()
+
+    fresh = AZRealTask(task.cloud, task.identifier, TaskSpec())
+    control.attach(fresh.client)
+    fresh.client._sleep = FakeSleep()
+    remote = fresh._remote()
+    assert remote.startswith(":azureblob")
+    assert f"key='{FIXED_ACCOUNT_KEY}'" in remote  # re-fetched, not recorded
+
+
+# -- GCE compute over HTTP -----------------------------------------------------
+
+
+@pytest.fixture()
+def gce_task(monkeypatch):
+    import json as _json
+
+    from tpu_task.backends.gcp.emulator import LoopbackCompute
+    from tpu_task.backends.gcp.task import GCERealTask
+    from tpu_task.common.cloud import Cloud, Credentials, GCPCredentials, Provider
+    from tpu_task.storage.gcs_emulator import LoopbackGCS
+
+    cloud = Cloud(provider=Provider.GCP, region="us-west1-b",
+                  credentials=Credentials(gcp=GCPCredentials(
+                      application_credentials=_json.dumps(
+                          {"project_id": "proj", "client_email": "sa@proj",
+                           "private_key": "unused"}))))
+    spec = TaskSpec(size=Size(machine="m", storage=64),
+                    environment=Environment(script="#!/bin/sh\necho hi\n"),
+                    parallelism=2, spot=SPOT_ENABLED)
+    with LoopbackCompute() as control, LoopbackGCS() as gcs:
+        task = GCERealTask(cloud, Identifier.deterministic("loopback-gce"),
+                           spec)
+        control.attach(task.client)
+        task.client._sleep = FakeSleep()
+        gcs.attach(task.bucket.backend)
+
+        import importlib
+
+        from tpu_task.storage import Connection
+        from tpu_task.storage import backends as backends_mod
+
+        sync_mod = importlib.import_module("tpu_task.storage.sync")
+
+        def loop_open(remote):
+            conn = (Connection.parse(remote) if remote.startswith(":")
+                    else Connection(backend="local", container="", path=remote))
+            return task.bucket.backend, conn
+
+        for module in (sync_mod, backends_mod):
+            monkeypatch.setattr(module, "open_backend", loop_open)
+        yield control, gcs, task
+
+
+def test_gce_full_lifecycle_over_http(gce_task):
+    """The real GCERealTask composition end-to-end against the stateful
+    compute loopback: create → read → stop → delete, with the 6-rule
+    firewall scheme and operation polling on real sockets."""
+    control, gcs, task = gce_task
+    name = task.identifier.long()
+
+    task.create()
+    assert name in gcs.buckets
+    assert len(control.firewalls) == 6
+    assert sorted(control.firewalls) == sorted(
+        f"{name}-{suffix}" for suffix in ("e1", "i1", "e2", "i2", "e3", "i3"))
+    template = control.templates[name]
+    disks = template["properties"]["disks"]
+    assert disks[0]["initializeParams"]["diskSizeGb"] == 64
+    metadata = {item["key"]: item["value"]
+                for item in template["properties"]["metadata"]["items"]}
+    assert metadata["startup-script"].startswith("#!/")
+    assert metadata["tpu-task-remote"].startswith(":googlecloudstorage")
+    assert "private_key" not in metadata["tpu-task-remote"]  # sanitized
+    assert control.migs[name]["target_size"] == 2  # Start = parallelism
+
+    task.read()
+    assert task.spec.status.get(StatusCode.ACTIVE) == 2
+    assert len(task.get_addresses()) == 2
+    assert task.observed_parallelism() == 2
+
+    control.fail(name, "QUOTA_EXCEEDED", "zone exhausted")
+    task.spec.status = {}
+    task.read()
+    assert any(event.code == "QUOTA_EXCEEDED" for event in task.spec.events)
+
+    task.stop()
+    assert control.migs[name]["target_size"] == 0
+
+    task.delete()
+    task.delete()  # idempotent: 404s tolerated throughout
+    assert name not in control.migs
+    assert name not in control.templates
+    assert not control.firewalls
+    assert name not in gcs.buckets
+    assert all(a.startswith("Bearer ") for a in control.auth_headers)
+
+
+def test_gce_image_family_fallback_over_http(gce_task):
+    """Direct image 404 → family endpoint, through the real retry stack."""
+    from tpu_task.backends.gcp.resources import Image
+
+    control, gcs, task = gce_task
+    image = Image(task.client, "me@my-proj/my-family")
+    image.read()
+    assert image.ssh_user == "me"
+    assert image.resource["selfLink"] == "family-link/my-proj/my-family"
+
+
+def test_gce_bare_read_recovers_remote_over_http(gce_task):
+    """A fresh task (empty spec) resolves its storage from the template
+    metadata through the real wire path, re-injecting local credentials."""
+    from tpu_task.backends.gcp.task import GCERealTask
+
+    control, gcs, task = gce_task
+    task.create()
+
+    fresh = GCERealTask(task.cloud, task.identifier, TaskSpec())
+    control.attach(fresh.client)
+    fresh.client._sleep = FakeSleep()
+    remote = fresh._remote()
+    assert remote.startswith(":googlecloudstorage")
+    assert "service_account_credentials" in remote  # re-injected locally
+    assert remote.endswith(f":{task.identifier.long()}")

@@ -1,0 +1,246 @@
+"""Loopback GCE compute/v1 REST emulator over HTTP.
+
+Drives :class:`~tpu_task.backends.gcp.api.RestComputeClient` through real
+sockets: Bearer auth, the shared retry layer, JSON parsing, and the
+operation poller (``wait_operation`` following ``selfLink`` until DONE) all
+run for real — the control-plane analog of ``storage/gcs_emulator.py``,
+completing the loopback set (TPU, EC2/ASG, ARM, compute) so every real
+backend's wire path is socket-tested without cloud credentials.
+
+Stateful: networks/images are seeded data sources; firewalls, instance
+templates and managed instance groups are stored from POSTed bodies and
+echoed back in the real GET shapes (template ``properties`` with metadata
+items — what bare-read remote recovery parses; MIG ``targetSize`` driving
+``listInstances`` and per-instance NAT IPs). Insert/resize/delete return
+one-poll PENDING operations so the exponential-backoff waiter actually
+loops (task/gcp/resources/common.go:15-35 semantics).
+
+Test hooks: ``auth_headers`` records Authorization headers; ``fail(name,
+code, message)`` plants a MIG listErrors entry the way a quota-starved
+scale-up surfaces (resource_instance_group_manager.go:45-67).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import urllib.parse
+from typing import Dict, List
+
+from tpu_task.backends.loopback import LoopbackControlPlane, LoopbackHandler
+
+_PREFIX = "/compute/v1"
+
+_GLOBAL_PATH = re.compile(
+    r"^/compute/v1/projects/([^/]+)/global/([^/]+)(?:/(.+?))?$")
+_ZONAL_PATH = re.compile(
+    r"^/compute/v1/projects/([^/]+)/zones/([^/]+)/([^/]+)(?:/(.+?))?$")
+
+
+class _ComputeHandler(LoopbackHandler):
+    def _dispatch(self, method: str) -> None:
+        auth = self.headers.get("Authorization", "")
+        self.emulator.auth_headers.append(auth)
+        if not auth.startswith("Bearer "):
+            self.reply(401, b'{"error": {"code": 401}}', "application/json")
+            return
+        parsed = urllib.parse.urlparse(self.path)
+        query = urllib.parse.parse_qs(parsed.query)
+        body = self.read_body()
+        code, payload = self.emulator.handle(
+            method, parsed.path, query, json.loads(body) if body else {})
+        self.reply(code, json.dumps(payload).encode(), "application/json")
+
+    def do_GET(self) -> None:
+        self._dispatch("GET")
+
+    def do_POST(self) -> None:
+        self._dispatch("POST")
+
+    def do_DELETE(self) -> None:
+        self._dispatch("DELETE")
+
+
+def _not_found(path: str):
+    return 404, {"error": {"code": 404, "message": path}}
+
+
+def _conflict(name: str):
+    return 409, {"error": {"code": 409, "message": f"{name} alreadyExists"}}
+
+
+class LoopbackCompute(LoopbackControlPlane):
+    handler_class = _ComputeHandler
+
+    def __init__(self):
+        super().__init__()
+        self.networks = {"default"}
+        # "project/name" direct images and "project/family" families
+        self.images = {"ubuntu-os-cloud/ubuntu-2004-lts"}
+        self.image_families = {"my-proj/my-family"}
+        self.firewalls: Dict[str, dict] = {}
+        self.templates: Dict[str, dict] = {}
+        self.migs: Dict[str, dict] = {}  # name -> {"body", "target_size"}
+        self.mig_errors: Dict[str, List[dict]] = {}
+        self.operations: Dict[str, int] = {}  # op name -> remaining polls
+        self.auth_headers: List[str] = []
+        self._op_counter = 0
+
+    # -- client wiring ---------------------------------------------------------
+    def attach(self, client) -> None:
+        from tpu_task.storage.object_store_emulators import loopback_transport
+
+        client._token._fetch = lambda: ("loopback-token", 3600.0)
+        client._urlopen = loopback_transport(
+            "https://compute.googleapis.com", self.port)
+
+    # -- test hooks ------------------------------------------------------------
+    def fail(self, name: str, code: str, message: str) -> None:
+        """Plant a MIG error the way a quota-starved scale-up surfaces."""
+        self.mig_errors.setdefault(name, []).append({
+            "timestamp": "2026-07-30T00:00:00Z",
+            "error": {"code": code, "message": message},
+            "instanceActionDetails": {"action": "CREATING"},
+        })
+
+    # -- operations ------------------------------------------------------------
+    def _operation(self, scope: str, pending_polls: int = 1) -> dict:
+        with self._lock:
+            self._op_counter += 1
+            name = f"op-{self._op_counter}"
+        self.operations[name] = pending_polls
+        return {
+            "name": name,
+            "status": "PENDING" if pending_polls else "DONE",
+            "selfLink": f"https://compute.googleapis.com{_PREFIX}/{scope}"
+                        f"/operations/{name}",
+        }
+
+    def _poll_operation(self, scope: str, name: str):
+        if name not in self.operations:
+            return _not_found(name)
+        self_link = (f"https://compute.googleapis.com{_PREFIX}/{scope}"
+                     f"/operations/{name}")
+        remaining = self.operations[name]
+        if remaining > 0:
+            self.operations[name] = remaining - 1
+            return 200, {"name": name, "status": "RUNNING",
+                         "selfLink": self_link}
+        return 200, {"name": name, "status": "DONE", "selfLink": self_link}
+
+    # -- request handling ------------------------------------------------------
+    def handle(self, method: str, path: str, query: dict, body: dict):
+        match = _GLOBAL_PATH.match(path)
+        if match:
+            project, collection, rest = match.groups()
+            return self._global(method, project, collection, rest, body)
+        match = _ZONAL_PATH.match(path)
+        if match:
+            project, zone, collection, rest = match.groups()
+            return self._zonal(method, project, zone, collection, rest,
+                               query, body)
+        return _not_found(path)
+
+    def _global(self, method: str, project: str, collection: str,
+                rest, body: dict):
+        scope = f"projects/{project}/global"
+        if collection == "operations" and rest:
+            return self._poll_operation(scope, rest)
+        if collection == "networks" and rest:
+            if rest not in self.networks:
+                return _not_found(rest)
+            return 200, {"name": rest, "selfLink":
+                         f"https://compute.googleapis.com{_PREFIX}/{scope}"
+                         f"/networks/{rest}"}
+        if collection == "images" and rest:
+            if rest.startswith("family/"):
+                family = rest[len("family/"):]
+                if f"{project}/{family}" not in self.image_families:
+                    return _not_found(rest)
+                return 200, {"selfLink": f"family-link/{project}/{family}"}
+            if f"{project}/{rest}" not in self.images:
+                return _not_found(rest)
+            return 200, {"selfLink": f"image-link/{project}/{rest}"}
+        if collection == "firewalls":
+            return self._crud(self.firewalls, method, rest, body, scope)
+        if collection == "instanceTemplates":
+            code, payload = self._crud(self.templates, method, rest, body,
+                                       scope)
+            if method == "GET" and code == 200 and rest:
+                payload = {
+                    "name": rest,
+                    "selfLink": f"https://compute.googleapis.com{_PREFIX}"
+                                f"/{scope}/instanceTemplates/{rest}",
+                    "properties": self.templates[rest].get("properties", {}),
+                }
+            return code, payload
+        return _not_found(f"{collection}/{rest}")
+
+    def _crud(self, store: Dict[str, dict], method: str, rest, body: dict,
+              scope: str):
+        if method == "POST":
+            name = body.get("name", "")
+            if name in store:
+                return _conflict(name)
+            store[name] = body
+            return 200, self._operation(scope)
+        if not rest or rest not in store:
+            return _not_found(str(rest))
+        if method == "DELETE":
+            del store[rest]
+            return 200, self._operation(scope)
+        return 200, store[rest]
+
+    def _zonal(self, method: str, project: str, zone: str, collection: str,
+               rest, query: dict, body: dict):
+        scope = f"projects/{project}/zones/{zone}"
+        if collection == "operations" and rest:
+            return self._poll_operation(scope, rest)
+        if collection == "instanceGroupManagers":
+            if rest is None:
+                if method == "POST":  # insert
+                    name = body.get("name", "")
+                    if name in self.migs:
+                        return _conflict(name)
+                    self.migs[name] = {"body": body,
+                                       "target_size":
+                                           int(body.get("targetSize", 0))}
+                    return 200, self._operation(scope)
+                return 200, {"items": [  # list
+                    {"name": name} for name in sorted(self.migs)]}
+            name, _, action = rest.partition("/")
+            if name not in self.migs:
+                return _not_found(name)
+            mig = self.migs[name]
+            if action == "resize":
+                mig["target_size"] = int(query.get("size", ["0"])[0])
+                return 200, self._operation(scope)
+            if action == "listErrors":
+                return 200, {"items": list(self.mig_errors.get(name, []))}
+            if action:
+                return _not_found(action)
+            if method == "DELETE":
+                del self.migs[name]
+                return 200, self._operation(scope)
+            return 200, {"name": name, "targetSize": mig["target_size"],
+                         "instanceTemplate":
+                             mig["body"].get("instanceTemplate", "")}
+        if collection == "instanceGroups" and rest:
+            name, _, action = rest.partition("/")
+            if name not in self.migs:
+                return _not_found(name)
+            if action == "listInstances":
+                size = self.migs[name]["target_size"]
+                return 200, {"items": [
+                    {"status": "RUNNING",
+                     "instance": f"https://compute.googleapis.com{_PREFIX}"
+                                 f"/{scope}/instances/{name}-{index}"}
+                    for index in range(size)]}
+            return _not_found(action)
+        if collection == "instances" and rest:
+            import zlib
+
+            octet = zlib.crc32(rest.encode()) % 250 + 2  # stable per name
+            return 200, {"name": rest, "networkInterfaces": [
+                {"accessConfigs": [{"natIP": f"34.10.0.{octet}"}]}]}
+        return _not_found(f"{collection}/{rest}")

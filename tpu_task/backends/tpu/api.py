@@ -527,6 +527,12 @@ class RestTpuClient:
         except RuntimeError as error:
             if "ALREADY_EXISTS" not in str(error):
                 raise
+        else:
+            # A fresh incarnation was accepted: drop first-seen event stamps
+            # a prior same-named QR may have left behind (e.g. its delete
+            # wait failed transiently), so the new incarnation's events
+            # aren't suppressed by follow-loop dedup.
+            self._clear_event_stamps(name)
 
     def get_queued_resource(self, name: str) -> QueuedResourceInfo:
         payload = self._request("GET", f"{self._parent()}/queuedResources/{name}")
@@ -585,16 +591,19 @@ class RestTpuClient:
     def delete_queued_resource(self, name: str, force: bool = True) -> None:
         operation = self._request(
             "DELETE", f"{self._parent()}/queuedResources/{name}?force={str(force).lower()}")
-        try:
-            self._wait_operation(operation)
-        finally:
-            # A re-created QR under the same name is a new incarnation: its
-            # state events must get fresh first-seen stamps, not the old
-            # ones (which follow-loop dedup would suppress). Clear even when
-            # the wait fails — the DELETE was accepted, so the next
-            # observation of this name may already be the new incarnation.
-            for key in [k for k in self._event_stamps if k[0] == name]:
-                del self._event_stamps[key]
+        self._wait_operation(operation)
+        # The QR is confirmed gone: a re-created QR under this name is a new
+        # incarnation whose state events must get fresh first-seen stamps,
+        # not the old ones (which follow-loop dedup would suppress). Only on
+        # confirmed deletion — a failed delete leaves the SAME incarnation
+        # alive, and wiping its stamps would re-emit its whole history as
+        # duplicates. The create path clears stamps too, which covers a
+        # same-name re-create after an unconfirmed delete.
+        self._clear_event_stamps(name)
+
+    def _clear_event_stamps(self, name: str) -> None:
+        for key in [k for k in self._event_stamps if k[0] == name]:
+            del self._event_stamps[key]
 
     def list_queued_resources(self) -> List[str]:
         payload = self._request("GET", f"{self._parent()}/queuedResources")

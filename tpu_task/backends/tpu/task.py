@@ -433,23 +433,20 @@ class TPUTask(GcsRemoteMixin, Task):
         hint = key_hint or f"{event.code}-{uuid.uuid4().hex[:8]}"
         key = f"reports/events-{hint}.json"
         try:
-            from tpu_task.common.errors import ResourceNotFoundError
             backend, _ = open_backend(self._remote())
             # First writer wins: concurrent observers of one occurrence
             # compute the same key but stamp their own clocks — an
             # overwrite would mutate a record other processes may have
             # cached under the immutability contract (_bucket_events).
-            try:
-                backend.read(key)
-                return
-            except ResourceNotFoundError:
-                pass
-            backend.write(key, json.dumps({
+            # write_if_absent is atomic on local (O_EXCL) and GCS
+            # (ifGenerationMatch=0) — the deployed mailbox backends.
+            wrote = backend.write_if_absent(key, json.dumps({
                 "time": event.time.isoformat(),
                 "code": event.code,
                 "description": list(event.description),
             }).encode())
-            self._bucket_events_at = float("-inf")  # cache now stale
+            if wrote:
+                self._bucket_events_at = float("-inf")  # cache now stale
         except Exception as error:
             self._warn_once("event-persist",
                             f"could not persist recovery event: {error}")

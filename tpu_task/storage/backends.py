@@ -118,6 +118,22 @@ class Backend:
     def delete(self, key: str) -> None:
         raise NotImplementedError
 
+    def write_if_absent(self, key: str, data: bytes) -> bool:
+        """Write only if the object doesn't exist; True when this call wrote.
+
+        Default is read-then-write — a narrowed race window, not a closed
+        one. LocalBackend (O_EXCL) and GCSBackend (ifGenerationMatch=0)
+        override with genuinely atomic first-writer-wins."""
+        from tpu_task.common.errors import ResourceNotFoundError
+
+        try:
+            self.read(key)
+            return False
+        except ResourceNotFoundError:
+            pass
+        self.write(key, data)
+        return True
+
     def exists(self) -> bool:
         raise NotImplementedError
 
@@ -245,6 +261,17 @@ class LocalBackend(Backend):
         os.makedirs(os.path.dirname(path), exist_ok=True)
         with open(path, "wb") as handle:
             handle.write(data)
+
+    def write_if_absent(self, key: str, data: bytes) -> bool:
+        path = self._abs(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        try:
+            fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL)
+        except FileExistsError:
+            return False
+        with os.fdopen(fd, "wb") as handle:
+            handle.write(data)
+        return True
 
     def read_to_file(self, key: str, path: str) -> None:
         source = self._abs(key)
@@ -432,6 +459,25 @@ class GCSBackend(Backend):
                f"?uploadType=media&name={urllib.parse.quote(self._key(key), safe='')}")
         self._request("POST", url, data=data,
                       headers={"Content-Type": "application/octet-stream"})
+
+    def write_if_absent(self, key: str, data: bytes) -> bool:
+        """Atomic first-writer-wins via GCS's ifGenerationMatch=0
+        precondition: generation 0 matches only a non-existent object, so a
+        concurrent duplicate write answers 412 instead of overwriting."""
+        import urllib.error
+        import urllib.parse
+
+        url = (f"https://storage.googleapis.com/upload/storage/v1/b/{self.container}/o"
+               f"?uploadType=media&ifGenerationMatch=0"
+               f"&name={urllib.parse.quote(self._key(key), safe='')}")
+        try:
+            self._request("POST", url, data=data,
+                          headers={"Content-Type": "application/octet-stream"})
+            return True
+        except urllib.error.HTTPError as error:
+            if error.code == 412:  # precondition failed: already exists
+                return False
+            raise
 
     def write_from_file(self, key: str, path: str) -> None:
         """Streaming upload: the file is read one UPLOAD_CHUNK at a time, so

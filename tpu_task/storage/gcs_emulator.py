@@ -53,9 +53,24 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self) -> None:
         parsed = urllib.parse.urlparse(self.path)
         query = urllib.parse.parse_qs(parsed.query)
+        if parsed.path == "/storage/v1/b":  # bucket insert (resource_bucket.go)
+            body = json.loads(self._read_body() or b"{}")
+            bucket = body.get("name", "")
+            if bucket in self._store().buckets:
+                self._reply(409, b'{"error": {"code": 409}}')
+                return
+            self._store().buckets.add(bucket)
+            self._reply(200, json.dumps({"name": bucket}).encode())
+            return
         name = urllib.parse.unquote(query.get("name", [""])[0])
         upload_type = query.get("uploadType", [""])[0]
         if upload_type == "media":
+            if (query.get("ifGenerationMatch", [""])[0] == "0"
+                    and name in self._store().objects):
+                # Precondition: generation 0 = object must not exist yet —
+                # the write_if_absent first-writer-wins contract.
+                self._reply(412, b'{"error": {"code": 412}}')
+                return
             self._store().objects[name] = self._read_body()
             self._reply(200, b"{}")
         elif upload_type == "resumable":
@@ -121,8 +136,12 @@ class _Handler(BaseHTTPRequestHandler):
                      if key.startswith(prefix)]
             self._reply(200, json.dumps({"items": items}).encode())
             return
-        if re.match(r"^/storage/v1/b/[^/]+$", parsed.path):  # bucket probe
-            self._reply(200, b"{}")
+        bucket_match = re.match(r"^/storage/v1/b/([^/]+)$", parsed.path)
+        if bucket_match:  # bucket probe: only attached/created buckets exist
+            if bucket_match.group(1) in store.buckets:
+                self._reply(200, b"{}")
+            else:
+                self._reply(404, b"bucket not found")
             return
         self._reply(404, b"not found")
 
@@ -130,6 +149,18 @@ class _Handler(BaseHTTPRequestHandler):
         parsed = urllib.parse.urlparse(self.path)
         object_match = re.match(r"^/storage/v1/b/([^/]+)/o/(.+)$", parsed.path)
         if not object_match:
+            bucket_match = re.match(r"^/storage/v1/b/([^/]+)$", parsed.path)
+            if bucket_match:  # bucket delete (empty-then-delete teardown)
+                if self._store().objects:
+                    # Live GCS answers 409 bucketNotEmpty: the teardown
+                    # contract is empty-THEN-delete, and a regression that
+                    # skips the emptying must fail here, not pass silently.
+                    self._reply(409, b'{"error": {"code": 409, '
+                                     b'"message": "bucketNotEmpty"}}')
+                    return
+                self._store().buckets.discard(bucket_match.group(1))
+                self._reply(204)
+                return
             self._reply(404, b"not found")
             return
         key = urllib.parse.unquote(object_match.group(2))
@@ -145,6 +176,7 @@ class LoopbackGCS:
 
     def __init__(self):
         self.objects: Dict[str, bytes] = {}
+        self.buckets: set = set()
         self._sessions: Dict[int, Tuple[str, bytearray, int]] = {}
         self._next_session = 1
         self._lock = threading.Lock()
@@ -195,9 +227,15 @@ class LoopbackGCS:
 
     # -- client wiring ---------------------------------------------------------
     def attach(self, backend) -> None:
-        """Point a GCSBackend at this server (token stubbed, URLs rewritten)."""
+        """Point a GCSBackend at this server (token stubbed, URLs rewritten).
+
+        The backend's container is registered as existing — data-plane-only
+        tests never POST a bucket insert, but their existence probes should
+        still answer 200; lifecycle tests that DELETE the bucket then see a
+        genuine 404."""
         from tpu_task.storage.object_store_emulators import loopback_transport
 
         backend._token._fetch = lambda: ("loopback-token", 3600.0)
         backend._urlopen = loopback_transport(
             "https://storage.googleapis.com", self.port)
+        self.buckets.add(backend.container)
