@@ -36,13 +36,13 @@ multichip:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 		$(PYTHON) __graft_entry__.py
 
-# Compiled-path kernel correctness on an attached real TPU (not interpret
-# mode): flash fwd+bwd vs the XLA reference at bf16 tolerance. Selects the
-# test_compiled_* set — the interpret-mode math tests are f32-exact and run
-# in the hermetic suite on CPU.
+# Compiled-path correctness on an attached real TPU (not interpret mode):
+# flash fwd+bwd + zigzag ring vs the XLA reference, fused cross-entropy,
+# MoE routing, and the full train step, all at bf16 tolerance. Selects
+# every test_compiled_* across the suite — the interpret-mode math tests
+# are f32-exact and run in the hermetic suite on CPU.
 kernels-tpu:
-	TPU_TASK_TEST_REAL_TPU=1 $(PYTHON) -m pytest tests/test_ops_attention.py \
-		-k compiled -q
+	TPU_TASK_TEST_REAL_TPU=1 $(PYTHON) -m pytest tests/ -k compiled -q
 
 clean:
 	rm -rf dist build *.egg-info ~/.tpu-task/wheels

@@ -376,10 +376,11 @@ def bench_data_plane() -> dict:
     ranged download), Azure Blob (parallel Put Block + ranged download).
     Zero-egress environment: this measures the client/protocol path on
     loopback, not WAN bandwidth. Resident memory stays O(chunk × workers),
-    never the full object — the point of the streaming paths. GCS's
-    resumable protocol is sequential per object by design; S3/Azure part
-    uploads and all ranged downloads run parallel; the sync engine further
-    parallelizes across objects (TPU_TASK_TRANSFERS=16)."""
+    never the full object — the point of the streaming paths. All three
+    backends upload in parallel (S3 multipart, Azure Put Block, GCS
+    parallel composite parts + one compose call) and download via parallel
+    ranged reads; the sync engine further parallelizes across objects
+    (TPU_TASK_TRANSFERS=16)."""
     import shutil
 
     from tpu_task.storage.backends import GCSBackend

@@ -80,3 +80,50 @@ def test_list_meta_sizes(loopback):
     meta = backend.list_meta()
     assert meta["a.txt"][0] == 3
     assert meta["b/c.txt"][0] == 5
+
+
+def test_composite_upload_parallel_parts(loopback, tmp_path):
+    """Above COMPOSE_THRESHOLD the object goes up as parallel part objects
+    stitched by one compose call: byte-identical result, no part residue."""
+    backend = _backend(loopback, prefix="task-9")
+    backend.RESUMABLE_THRESHOLD = 64 * 1024
+    backend.UPLOAD_CHUNK = 64 * 1024
+    backend.COMPOSE_THRESHOLD = 256 * 1024
+    backend.COMPOSE_PART = 128 * 1024
+
+    content = os.urandom(1024 * 1024 + 999)  # 9 uneven parts
+    source = tmp_path / "big.bin"
+    source.write_bytes(content)
+
+    backend.write_from_file("checkpoints/big.bin", str(source))
+    assert loopback.objects["task-9/checkpoints/big.bin"] == content
+    assert [k for k in loopback.objects if ".gcs-part-" in k] == []
+
+    restored = tmp_path / "restored.bin"
+    backend.read_to_file("checkpoints/big.bin", str(restored))
+    assert restored.read_bytes() == content
+
+
+def test_composite_upload_cleans_parts_on_failure(loopback, tmp_path):
+    """A failed compose must not leak part objects (best-effort cleanup)."""
+    backend = _backend(loopback)
+    backend.RESUMABLE_THRESHOLD = 64 * 1024
+    backend.UPLOAD_CHUNK = 64 * 1024
+    backend.COMPOSE_THRESHOLD = 128 * 1024
+    backend.COMPOSE_PART = 128 * 1024
+
+    source = tmp_path / "big.bin"
+    source.write_bytes(os.urandom(512 * 1024))
+
+    original = backend._request
+
+    def failing_request(method, url, **kwargs):
+        if url.endswith("/compose"):
+            raise RuntimeError("compose exploded")
+        return original(method, url, **kwargs)
+
+    backend._request = failing_request
+    with pytest.raises(RuntimeError, match="compose exploded"):
+        backend.write_from_file("checkpoints/big.bin", str(source))
+    assert [k for k in loopback.objects if ".gcs-part-" in k] == []
+    assert "checkpoints/big.bin" not in loopback.objects

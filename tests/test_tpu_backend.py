@@ -280,10 +280,11 @@ def test_tpu_multihost_workers_all_run(tpu_cloud, tmp_path):
     try:
         # While the slice is alive: all 4 worker endpoints exported.
         # Generous timeouts: 4 agent subprocesses + sync loops under full-
-        # suite load can take tens of seconds on a busy machine.
-        poll(task, lambda t: len(t.get_addresses()) == 4, timeout=60)
+        # suite load can take tens of seconds on a busy machine (observed
+        # >90 s once with a concurrent 1 GiB data-plane bench running).
+        poll(task, lambda t: len(t.get_addresses()) == 4, timeout=90)
         poll(task, lambda t: t.status().get(StatusCode.SUCCEEDED, 0) >= 4,
-             timeout=90)
+             timeout=180)
         logs = "".join(task.logs())
         for rank in range(4):
             assert f"rank={rank}" in logs

@@ -29,12 +29,10 @@ does (capacity stays — Azure bills intent, not instances).
 
 from __future__ import annotations
 
-import json
 import re
-import urllib.parse
 from typing import Dict, List
 
-from tpu_task.backends.loopback import LoopbackControlPlane, LoopbackHandler
+from tpu_task.backends.loopback import JsonBearerHandler, LoopbackControlPlane
 
 _RG_PATH = re.compile(r"^/subscriptions/([^/]+)/resourcegroups(?:/([^/?]+))?$",
                       re.IGNORECASE)
@@ -60,42 +58,12 @@ def _validate_nsg(body: dict) -> str:
     return ""
 
 
-class _ArmHandler(LoopbackHandler):
-    def _dispatch(self, method: str) -> None:
-        auth = self.headers.get("Authorization", "")
-        self.emulator.auth_headers.append(auth)
-        if not auth.startswith("Bearer "):
-            self.reply(401, b'{"error": {"code": "AuthenticationFailed"}}',
-                       "application/json")
-            return
-        parsed = urllib.parse.urlparse(self.path)
-        body = self.read_body()
-        code, payload = self.emulator.handle(
-            method, parsed.path, json.loads(body) if body else {})
-        self.reply(code, json.dumps(payload).encode(), "application/json")
-
-    def do_GET(self) -> None:
-        self._dispatch("GET")
-
-    def do_PUT(self) -> None:
-        self._dispatch("PUT")
-
-    def do_POST(self) -> None:
-        self._dispatch("POST")
-
-    def do_PATCH(self) -> None:
-        self._dispatch("PATCH")
-
-    def do_DELETE(self) -> None:
-        self._dispatch("DELETE")
-
-
 def _not_found(path: str):
     return 404, {"error": {"code": "ResourceNotFound", "message": path}}
 
 
 class LoopbackArm(LoopbackControlPlane):
-    handler_class = _ArmHandler
+    handler_class = JsonBearerHandler
 
     def __init__(self):
         super().__init__()
@@ -120,7 +88,7 @@ class LoopbackArm(LoopbackControlPlane):
         self._evicted[name] = True
 
     # -- request handling ------------------------------------------------------
-    def handle(self, method: str, path: str, body: dict):
+    def handle(self, method: str, path: str, query: dict, body: dict):
         rg = _RG_PATH.match(path)
         if rg:
             _sub, name = rg.groups()

@@ -22,12 +22,10 @@ scale-up surfaces (resource_instance_group_manager.go:45-67).
 
 from __future__ import annotations
 
-import json
 import re
-import urllib.parse
 from typing import Dict, List
 
-from tpu_task.backends.loopback import LoopbackControlPlane, LoopbackHandler
+from tpu_task.backends.loopback import JsonBearerHandler, LoopbackControlPlane
 
 _PREFIX = "/compute/v1"
 
@@ -35,30 +33,6 @@ _GLOBAL_PATH = re.compile(
     r"^/compute/v1/projects/([^/]+)/global/([^/]+)(?:/(.+?))?$")
 _ZONAL_PATH = re.compile(
     r"^/compute/v1/projects/([^/]+)/zones/([^/]+)/([^/]+)(?:/(.+?))?$")
-
-
-class _ComputeHandler(LoopbackHandler):
-    def _dispatch(self, method: str) -> None:
-        auth = self.headers.get("Authorization", "")
-        self.emulator.auth_headers.append(auth)
-        if not auth.startswith("Bearer "):
-            self.reply(401, b'{"error": {"code": 401}}', "application/json")
-            return
-        parsed = urllib.parse.urlparse(self.path)
-        query = urllib.parse.parse_qs(parsed.query)
-        body = self.read_body()
-        code, payload = self.emulator.handle(
-            method, parsed.path, query, json.loads(body) if body else {})
-        self.reply(code, json.dumps(payload).encode(), "application/json")
-
-    def do_GET(self) -> None:
-        self._dispatch("GET")
-
-    def do_POST(self) -> None:
-        self._dispatch("POST")
-
-    def do_DELETE(self) -> None:
-        self._dispatch("DELETE")
 
 
 def _not_found(path: str):
@@ -70,7 +44,7 @@ def _conflict(name: str):
 
 
 class LoopbackCompute(LoopbackControlPlane):
-    handler_class = _ComputeHandler
+    handler_class = JsonBearerHandler
 
     def __init__(self):
         super().__init__()

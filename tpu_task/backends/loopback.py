@@ -40,6 +40,45 @@ class LoopbackHandler(BaseHTTPRequestHandler):
         pass
 
 
+class JsonBearerHandler(LoopbackHandler):
+    """Bearer-auth JSON dispatch shared by the REST-shaped control-plane
+    emulators (TPU, ARM, GCE compute — the EC2/ASG one speaks SigV4 form
+    POSTs and keeps its own handler). Records every Authorization header on
+    ``emulator.auth_headers``, rejects non-Bearer with 401, and routes to
+    ``emulator.handle(method, path, query, body) -> (code, payload)``."""
+
+    def _dispatch(self, method: str) -> None:
+        import json
+        import urllib.parse
+
+        auth = self.headers.get("Authorization", "")
+        self.emulator.auth_headers.append(auth)
+        if not auth.startswith("Bearer "):
+            self.reply(401, b'{"error": {"code": 401}}', "application/json")
+            return
+        parsed = urllib.parse.urlparse(self.path)
+        query = urllib.parse.parse_qs(parsed.query)
+        body = self.read_body()
+        code, payload = self.emulator.handle(
+            method, parsed.path, query, json.loads(body) if body else {})
+        self.reply(code, json.dumps(payload).encode(), "application/json")
+
+    def do_GET(self) -> None:
+        self._dispatch("GET")
+
+    def do_PUT(self) -> None:
+        self._dispatch("PUT")
+
+    def do_POST(self) -> None:
+        self._dispatch("POST")
+
+    def do_PATCH(self) -> None:
+        self._dispatch("PATCH")
+
+    def do_DELETE(self) -> None:
+        self._dispatch("DELETE")
+
+
 class LoopbackControlPlane:
     """Context-managed threaded HTTP server bound to an ephemeral port."""
 

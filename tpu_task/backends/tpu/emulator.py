@@ -19,12 +19,10 @@ reclaim does; ``auth_headers`` records every Authorization header seen.
 
 from __future__ import annotations
 
-import json
 import re
-import urllib.parse
 from typing import Dict, List
 
-from tpu_task.backends.loopback import LoopbackControlPlane, LoopbackHandler
+from tpu_task.backends.loopback import JsonBearerHandler, LoopbackControlPlane
 
 _QR_PATH = re.compile(
     r"^/v2/projects/([^/]+)/locations/([^/]+)/queuedResources(?:/([^/?]+))?$")
@@ -34,36 +32,8 @@ _OP_PATH = re.compile(
     r"^/v2/projects/([^/]+)/locations/([^/]+)/operations/([^/?]+)$")
 
 
-class _TpuHandler(LoopbackHandler):
-    def _authorized(self) -> bool:
-        auth = self.headers.get("Authorization", "")
-        self.emulator.auth_headers.append(auth)
-        return auth.startswith("Bearer ")
-
-    def _dispatch(self, method: str) -> None:
-        if not self._authorized():
-            self.reply(401, b'{"error": {"code": 401}}', "application/json")
-            return
-        parsed = urllib.parse.urlparse(self.path)
-        query = urllib.parse.parse_qs(parsed.query)
-        body = self.read_body()
-        code, payload = self.emulator.handle(
-            method, parsed.path, query,
-            json.loads(body) if body else {})
-        self.reply(code, json.dumps(payload).encode(), "application/json")
-
-    def do_GET(self) -> None:
-        self._dispatch("GET")
-
-    def do_POST(self) -> None:
-        self._dispatch("POST")
-
-    def do_DELETE(self) -> None:
-        self._dispatch("DELETE")
-
-
 class LoopbackTpu(LoopbackControlPlane):
-    handler_class = _TpuHandler
+    handler_class = JsonBearerHandler
 
     def __init__(self):
         super().__init__()

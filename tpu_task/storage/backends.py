@@ -157,8 +157,12 @@ class Backend:
 
 def parallel_map(fns, workers: int) -> list:
     """Run zero-arg callables concurrently; on the FIRST failure cancel all
-    still-queued work and re-raise — a failed chunk must not let gigabytes
-    of doomed siblings keep transferring. Results in completion order."""
+    still-queued work, WAIT for in-flight siblings to settle, and re-raise —
+    a failed chunk must not let gigabytes of doomed siblings keep
+    transferring, and callers' cleanup (deleting part objects, closing the
+    destination fd) must not race work that is still running. Results in
+    completion order."""
+    import concurrent.futures
     from concurrent.futures import ThreadPoolExecutor, as_completed
 
     if workers <= 1 or len(fns) <= 1:
@@ -172,8 +176,39 @@ def parallel_map(fns, workers: int) -> list:
         except BaseException:
             for future in futures:
                 future.cancel()
+            concurrent.futures.wait(futures)
             raise
     return results
+
+
+class _FileSlice:
+    """Seekable read-only view of fd bytes [offset, offset+length) — lets
+    parallel part uploads stream the SAME open file through the chunked
+    resumable protocol without a shared file position or whole-part
+    buffering. ``read`` loops pread to the requested count (pread may
+    return short, and caps near 2 GiB on Linux)."""
+
+    def __init__(self, fd: int, offset: int, length: int):
+        self._fd = fd
+        self._offset = offset
+        self._length = length
+        self._pos = 0
+
+    def seek(self, position: int) -> None:
+        self._pos = position
+
+    def read(self, count: int = -1) -> bytes:
+        remaining = self._length - self._pos
+        count = remaining if count < 0 else min(count, remaining)
+        pieces = []
+        while count > 0:
+            piece = os.pread(self._fd, count, self._offset + self._pos)
+            if not piece:
+                break  # source truncated under us; caller length-checks
+            pieces.append(piece)
+            self._pos += len(piece)
+            count -= len(piece)
+        return b"".join(pieces)
 
 
 def atomic_ranged_download(path: str, size: int, fetch_range,
@@ -351,6 +386,13 @@ class GCSBackend(Backend):
     UPLOAD_CHUNK = 8 * 1024 * 1024    # multiple of 256 KiB per GCS spec
     DOWNLOAD_CHUNK = 16 * 1024 * 1024
     DOWNLOAD_WORKERS = 8              # parallel ranged GETs per object
+    # Composite upload: the resumable protocol is sequential per object by
+    # design, so very large objects upload as <=32 parts in parallel and
+    # one compose call stitches them (rclone's --gcs-upload-concurrency
+    # role; 32 is GCS's per-compose component limit).
+    COMPOSE_THRESHOLD = 64 * 1024 * 1024
+    COMPOSE_PART = 32 * 1024 * 1024
+    UPLOAD_WORKERS = 8                # parallel part uploads per object
 
     def __init__(self, container: str, path: str = "", config: Optional[Dict[str, str]] = None):
         from tpu_task.storage.http_util import OAuthToken
@@ -481,14 +523,75 @@ class GCSBackend(Backend):
 
     def write_from_file(self, key: str, path: str) -> None:
         """Streaming upload: the file is read one UPLOAD_CHUNK at a time, so
-        resident memory stays O(chunk) regardless of object size."""
+        resident memory stays O(chunk × workers) regardless of object size.
+        Above COMPOSE_THRESHOLD the parts upload in parallel and a compose
+        call stitches them — the sequential resumable protocol otherwise
+        caps push throughput at single-stream speed."""
         size = os.path.getsize(path)
         if size <= self.RESUMABLE_THRESHOLD:
             with open(path, "rb") as handle:
                 self.write(key, handle.read())
             return
+        if size > self.COMPOSE_THRESHOLD:
+            self._write_composite(key, path, size)
+            return
         with open(path, "rb") as handle:
             self._write_resumable_stream(key, handle, size)
+
+    def _write_composite(self, key: str, path: str, size: int) -> None:
+        """Parallel composite upload: <=32 temporary part objects uploaded
+        concurrently — each STREAMED through the resumable protocol from a
+        file-offset view, so residency stays O(UPLOAD_CHUNK × workers) at
+        any object size — then one compose request, then parts deleted.
+        Part names carry a per-call token: concurrent writers of the same
+        key (a retry racing a hung original) must not interleave parts or
+        delete each other's. Cleanup is best-effort; parallel_map settles
+        in-flight parts before the failure path deletes, so nothing
+        re-creates a part after its delete."""
+        import math
+        import urllib.parse
+        import uuid as _uuid
+
+        part_size = max(self.COMPOSE_PART, math.ceil(size / 32))
+        # Round up to the 256 KiB granularity GCS requires of non-final
+        # resumable chunks, so part streaming never emits a ragged chunk.
+        part_size = -(-part_size // (256 * 1024)) * (256 * 1024)
+        token = _uuid.uuid4().hex[:8]
+        starts = list(range(0, size, part_size))
+        part_keys = [f"{key}.gcs-part-{token}-{index:02d}"
+                     for index in range(len(starts))]
+
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            def upload_part(start: int, part_key: str) -> None:
+                length = min(part_size, size - start)
+                view = _FileSlice(fd, start, length)
+                if length <= self.RESUMABLE_THRESHOLD:
+                    self.write(part_key, view.read(length))
+                else:
+                    self._write_resumable_stream(part_key, view, length)
+
+            try:
+                parallel_map(
+                    [lambda s=s, pk=pk: upload_part(s, pk)
+                     for s, pk in zip(starts, part_keys)],
+                    min(self.UPLOAD_WORKERS, len(starts)))
+                compose_url = (
+                    f"https://storage.googleapis.com/storage/v1/b/{self.container}/o/"
+                    f"{urllib.parse.quote(self._key(key), safe='')}/compose")
+                self._request(
+                    "POST", compose_url,
+                    data=json.dumps({"sourceObjects": [
+                        {"name": self._key(pk)} for pk in part_keys]}).encode(),
+                    headers={"Content-Type": "application/json"})
+            finally:
+                for part_key in part_keys:
+                    try:
+                        self.delete(part_key)
+                    except Exception:
+                        pass  # best-effort; unique names can't hit siblings
+        finally:
+            os.close(fd)
 
     def _write_resumable_stream(self, key: str, handle, total: int) -> None:
         """Chunked resumable upload: initiate a session, PUT fixed-size chunks

@@ -53,6 +53,22 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self) -> None:
         parsed = urllib.parse.urlparse(self.path)
         query = urllib.parse.parse_qs(parsed.query)
+        compose = re.match(r"^/storage/v1/b/([^/]+)/o/(.+)/compose$",
+                           parsed.path)
+        if compose:  # stitch parallel-uploaded parts (composite upload)
+            destination = urllib.parse.unquote(compose.group(2))
+            body = json.loads(self._read_body() or b"{}")
+            store = self._store()
+            pieces = []
+            for source in body.get("sourceObjects", []):
+                data = store.objects.get(source.get("name", ""))
+                if data is None:
+                    self._reply(404, b"component not found")
+                    return
+                pieces.append(data)
+            store.objects[destination] = b"".join(pieces)
+            self._reply(200, json.dumps({"name": destination}).encode())
+            return
         if parsed.path == "/storage/v1/b":  # bucket insert (resource_bucket.go)
             body = json.loads(self._read_body() or b"{}")
             bucket = body.get("name", "")
