@@ -109,6 +109,32 @@ def test_fused_xent_matches_reference():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
 
+def test_fused_xent_multiblock_carry_matches_reference(monkeypatch):
+    """The MULTI-block path — cross-block m/l renormalization and the
+    in-block target gather — must stay exact. The auto-sizer picks a
+    whole-vocab single step at these tiny hermetic shapes, so pin the tile
+    budget down to force several scan steps (the code path long-context
+    production runs)."""
+    # 64 tokens x 4 B -> blocks of 4096: vocab 8192 = 2 scan steps; the
+    # floor keeps it >1 even if the floor constant changes.
+    monkeypatch.setenv("TPU_TASK_XENT_TILE_BYTES", str(64 * 4 * 4096))
+    cfg = transformer.TransformerConfig(
+        vocab_size=8192, d_model=32, n_layers=2, n_heads=4, d_head=8,
+        d_ff=64, dtype=jnp.float32)
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0, 8192)
+    from tpu_task.ml.models.transformer import _auto_xent_block
+
+    assert _auto_xent_block(64, 8192) < 8192  # really multi-block
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda p: transformer.loss_fn(p, cfg, tokens, fused=False))(params)
+    fused_loss, fused_grads = jax.value_and_grad(
+        lambda p: transformer.loss_fn(p, cfg, tokens, fused=True))(params)
+    assert abs(float(ref_loss) - float(fused_loss)) < 1e-5
+    for a, b in zip(jax.tree.leaves(ref_grads), jax.tree.leaves(fused_grads)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
 def test_fused_xent_nondivisible_vocab_padded_exactly():
     """A vocab not divisible by the block is padded with masked columns —
     the fused result stays exact (no silent fallback that would

@@ -62,8 +62,13 @@ def _not_found(path: str):
     return 404, {"error": {"code": "ResourceNotFound", "message": path}}
 
 
+class _ArmHandler(JsonBearerHandler):
+    # ARM's 401 shape carries a string error code, not a numeric one.
+    unauthorized_body = b'{"error": {"code": "AuthenticationFailed"}}'
+
+
 class LoopbackArm(LoopbackControlPlane):
-    handler_class = JsonBearerHandler
+    handler_class = _ArmHandler
 
     def __init__(self):
         super().__init__()

@@ -45,7 +45,11 @@ class JsonBearerHandler(LoopbackHandler):
     emulators (TPU, ARM, GCE compute — the EC2/ASG one speaks SigV4 form
     POSTs and keeps its own handler). Records every Authorization header on
     ``emulator.auth_headers``, rejects non-Bearer with 401, and routes to
-    ``emulator.handle(method, path, query, body) -> (code, payload)``."""
+    ``emulator.handle(method, path, query, body) -> (code, payload)``.
+    Subclasses override ``unauthorized_body`` to keep each cloud's own 401
+    error shape (ARM answers a string code, Google APIs a numeric one)."""
+
+    unauthorized_body = b'{"error": {"code": 401}}'
 
     def _dispatch(self, method: str) -> None:
         import json
@@ -54,7 +58,7 @@ class JsonBearerHandler(LoopbackHandler):
         auth = self.headers.get("Authorization", "")
         self.emulator.auth_headers.append(auth)
         if not auth.startswith("Bearer "):
-            self.reply(401, b'{"error": {"code": 401}}', "application/json")
+            self.reply(401, self.unauthorized_body, "application/json")
             return
         parsed = urllib.parse.urlparse(self.path)
         query = urllib.parse.parse_qs(parsed.query)

@@ -567,7 +567,14 @@ class GCSBackend(Backend):
                 length = min(part_size, size - start)
                 view = _FileSlice(fd, start, length)
                 if length <= self.RESUMABLE_THRESHOLD:
-                    self.write(part_key, view.read(length))
+                    data = view.read(length)
+                    if len(data) != length:
+                        # Same contract as the streamed branch: a source
+                        # truncated mid-upload must fail, not compose short.
+                        raise RuntimeError(
+                            f"composite upload: source truncated at "
+                            f"{start + len(data)}/{size} of {path!r}")
+                    self.write(part_key, data)
                 else:
                     self._write_resumable_stream(part_key, view, length)
 
