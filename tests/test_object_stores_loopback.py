@@ -211,3 +211,17 @@ def test_azure_missing_blob_maps_not_found(azure):
     _, backend = azure
     with pytest.raises(ResourceNotFoundError):
         backend.read("missing")
+
+
+def test_s3_write_if_absent_first_writer_wins(s3):
+    server, backend = s3
+    assert backend.write_if_absent("events/e1.json", b"first") is True
+    assert backend.write_if_absent("events/e1.json", b"second") is False
+    assert backend.read("events/e1.json") == b"first"
+
+
+def test_azure_write_if_absent_first_writer_wins(azure):
+    server, backend = azure
+    assert backend.write_if_absent("events/e1.json", b"first") is True
+    assert backend.write_if_absent("events/e1.json", b"second") is False
+    assert backend.read("events/e1.json") == b"first"

@@ -292,3 +292,30 @@ def test_worker0_mirror_spares_other_workers_shards(tmp_path):
     assert (dst / "checkpoints" / "ckpt-5.shard-1.npz").read_bytes() == b"w1"
     assert (dst / "data.txt").read_text() == "payload"
     assert not (dst / "stale.txt").exists()
+
+
+def test_local_write_if_absent_race_single_winner(tmp_path):
+    """N threads racing the same key: exactly one write wins (O_EXCL), and
+    the record is never a torn mix — the property durable recovery events
+    rely on for concurrent observers."""
+    import threading
+
+    from tpu_task.storage.backends import LocalBackend
+
+    backend = LocalBackend(str(tmp_path))
+    winners = []
+    barrier = threading.Barrier(8)
+
+    def attempt(i):
+        barrier.wait()
+        if backend.write_if_absent("events/e.json", f"writer-{i}".encode() * 64):
+            winners.append(i)
+
+    threads = [threading.Thread(target=attempt, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(winners) == 1
+    content = backend.read("events/e.json")
+    assert content == f"writer-{winners[0]}".encode() * 64

@@ -181,6 +181,23 @@ def parallel_map(fns, workers: int) -> list:
     return results
 
 
+def _resolve_conditional_loss(backend, key: str, data: bytes) -> bool:
+    """Disambiguate a failed conditional create (shared by the GCS/S3/Azure
+    ``write_if_absent`` overrides).
+
+    The retry layer may RESEND a conditional PUT whose first attempt
+    committed but whose response was lost; the retry then fails the
+    precondition against the caller's own object, which must still count
+    as a win (callers key cache invalidation off the return). One read
+    settles it: if the stored record is byte-identical to what we sent, we
+    wrote it (or an identical twin did — indistinguishable and
+    equivalent); anything else is a genuine lost race."""
+    try:
+        return backend.read(key) == data
+    except Exception:
+        return False  # couldn't read it back: report the conservative loss
+
+
 class _FileSlice:
     """Seekable read-only view of fd bytes [offset, offset+length) — lets
     parallel part uploads stream the SAME open file through the chunked
@@ -518,7 +535,7 @@ class GCSBackend(Backend):
             return True
         except urllib.error.HTTPError as error:
             if error.code == 412:  # precondition failed: already exists
-                return False
+                return _resolve_conditional_loss(self, key, data)
             raise
 
     def write_from_file(self, key: str, path: str) -> None:

@@ -25,7 +25,7 @@ from typing import Dict, List, Optional
 from xml.sax.saxutils import unescape as _xml_unescape
 
 from tpu_task.common.errors import ResourceNotFoundError
-from tpu_task.storage.backends import Backend, atomic_ranged_download
+from tpu_task.storage.backends import Backend, _resolve_conditional_loss, atomic_ranged_download
 from tpu_task.storage.signing import (
     EMPTY_SHA256,
     azure_shared_key_auth,
@@ -167,6 +167,21 @@ class S3Backend(Backend):
 
     def write(self, key: str, data: bytes) -> None:
         self._request("PUT", self._key(key), {}, body=data)
+
+    def write_if_absent(self, key: str, data: bytes) -> bool:
+        """Atomic first-writer-wins via S3 conditional writes: PutObject
+        with ``If-None-Match: *`` answers 412 when the object exists and
+        409 ConditionalRequestConflict when racing an in-flight write —
+        both mean this caller didn't win. ``_resolve_conditional_loss``
+        disambiguates the retry-after-lost-response case."""
+        try:
+            self._request("PUT", self._key(key), {}, body=data,
+                          extra_headers={"If-None-Match": "*"})
+            return True
+        except urllib.error.HTTPError as error:
+            if error.code in (409, 412):
+                return _resolve_conditional_loss(self, key, data)
+            raise
 
     def write_from_file(self, key: str, path: str) -> None:
         """Streaming upload: multipart with parallel parts above the
@@ -385,6 +400,21 @@ class AzureBlobBackend(Backend):
     def write(self, key: str, data: bytes) -> None:
         self._request("PUT", self._blob_path(key), {}, body=data,
                       extra_headers={"x-ms-blob-type": "BlockBlob"})
+
+    def write_if_absent(self, key: str, data: bytes) -> bool:
+        """Atomic first-writer-wins: Put Blob with ``If-None-Match: *``
+        answers 409 BlobAlreadyExists (some stacks 412) when present.
+        The SharedKey string-to-sign carries the conditional header in its
+        fixed position (signing.py), so this stays authenticated."""
+        try:
+            self._request("PUT", self._blob_path(key), {}, body=data,
+                          extra_headers={"x-ms-blob-type": "BlockBlob",
+                                         "If-None-Match": "*"})
+            return True
+        except urllib.error.HTTPError as error:
+            if error.code in (409, 412):
+                return _resolve_conditional_loss(self, key, data)
+            raise
 
     def write_from_file(self, key: str, path: str) -> None:
         """Streaming upload: Put Block (parallel) + Put Block List above

@@ -81,13 +81,14 @@ class _Handler(BaseHTTPRequestHandler):
         name = urllib.parse.unquote(query.get("name", [""])[0])
         upload_type = query.get("uploadType", [""])[0]
         if upload_type == "media":
+            body = self._read_body()  # drain before any reply: keep-alive
             if (query.get("ifGenerationMatch", [""])[0] == "0"
                     and name in self._store().objects):
                 # Precondition: generation 0 = object must not exist yet —
                 # the write_if_absent first-writer-wins contract.
                 self._reply(412, b'{"error": {"code": 412}}')
                 return
-            self._store().objects[name] = self._read_body()
+            self._store().objects[name] = body
             self._reply(200, b"{}")
         elif upload_type == "resumable":
             self._read_body()

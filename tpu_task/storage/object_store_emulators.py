@@ -243,6 +243,11 @@ class _S3Handler(_BaseHandler):
             self.send_header("Content-Length", "0")
             self.end_headers()
             return
+        if (self.headers.get("If-None-Match") == "*"
+                and key in store.objects):
+            # S3 conditional write: the object exists, precondition fails.
+            self._reply(412, b"<Error><Code>PreconditionFailed</Code></Error>")
+            return
         store.objects[key] = body
         self._reply(200)
 
@@ -357,7 +362,13 @@ class _AzureHandler(_BaseHandler):
             store.blocks.pop(blob, None)
             self._reply(201)
             return
-        store.objects[blob] = self._read_body()
+        body = self._read_body()  # drain before any reply: keep-alive safety
+        if (self.headers.get("If-None-Match") == "*"
+                and blob in store.objects):
+            # Put Blob conditional create: Azure answers 409 BlobAlreadyExists.
+            self._reply(409, b"<Error>BlobAlreadyExists</Error>")
+            return
+        store.objects[blob] = body
         self._reply(201)
 
     def do_DELETE(self) -> None:
