@@ -191,11 +191,21 @@ def _resolve_conditional_loss(backend, key: str, data: bytes) -> bool:
     as a win (callers key cache invalidation off the return). One read
     settles it: if the stored record is byte-identical to what we sent, we
     wrote it (or an identical twin did — indistinguishable and
-    equivalent); anything else is a genuine lost race."""
+    equivalent); anything else is a genuine lost race. The read-back
+    transfers the winner's object, so this API is meant for small records
+    (event/marker files); races on large objects should compare a content
+    hash via object metadata instead.
+
+    Only a vanished object maps to a plain loss (deleted between the 412
+    and the read) — any other read failure PROPAGATES, so callers'
+    persistence-error handling still fires instead of mistaking a broken
+    store for a benign lost race."""
+    from tpu_task.common.errors import ResourceNotFoundError
+
     try:
         return backend.read(key) == data
-    except Exception:
-        return False  # couldn't read it back: report the conservative loss
+    except ResourceNotFoundError:
+        return False  # winner's record already gone: still not our win
 
 
 class _FileSlice:
