@@ -171,3 +171,38 @@ def test_fused_xent_bf16_stays_close_to_f32_reference():
         b = np.asarray(b, dtype=np.float32)
         scale = np.abs(b).max() + 1e-9
         assert np.abs(a - b).max() <= 0.03 * scale
+
+
+def test_profiler_trace_writes_capture_files(tmp_path, monkeypatch):
+    """profiling.trace captures a real XLA trace under an explicit dir (the
+    workdir sync loop exports it); step_window gates on the step index; the
+    default (no dir) is env-gated: no-op when TPU_TASK_PROFILE is unset,
+    traced into the env dir when set."""
+    from tpu_task.ml import profiling
+
+    log_dir = tmp_path / "profiles"
+    with profiling.trace(str(log_dir)):
+        with profiling.annotate("unit-span"):
+            jax.jit(lambda x: x * 2)(jnp.ones((8, 8))).block_until_ready()
+    captured = [p for p in log_dir.rglob("*") if p.is_file()]
+    assert captured, "no trace files written"
+
+    # Window gating: outside [start, stop) nothing is captured.
+    with profiling.step_window(5, start=10, stop=12,
+                               log_dir=str(tmp_path / "none")):
+        pass
+    assert not (tmp_path / "none").exists()
+
+    # Env-gated default: unset -> no-op, nothing touches the filesystem.
+    monkeypatch.delenv("TPU_TASK_PROFILE", raising=False)
+    monkeypatch.chdir(tmp_path)  # any stray relative writes would land here
+    before = sorted(p.name for p in tmp_path.iterdir())
+    with profiling.trace():
+        pass
+    assert sorted(p.name for p in tmp_path.iterdir()) == before
+
+    # Env-gated default: set -> traced into the env-named directory.
+    monkeypatch.setenv("TPU_TASK_PROFILE", str(tmp_path / "profiles-env"))
+    with profiling.trace():
+        jax.jit(lambda x: x + 1)(jnp.ones((4,))).block_until_ready()
+    assert [p for p in (tmp_path / "profiles-env").rglob("*") if p.is_file()]

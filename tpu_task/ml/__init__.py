@@ -22,9 +22,11 @@ from tpu_task.ml.parallel.mesh import (
     distributed_init_from_env,
     make_mesh,
 )
+from tpu_task.ml import profiling
 
 __all__ = [
     "balanced_mesh_shape",
+    "profiling",
     "distributed_init_from_env",
     "latest_step",
     "make_mesh",

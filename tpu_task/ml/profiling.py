@@ -1,0 +1,90 @@
+"""XLA/TPU trace capture wired into the task data plane.
+
+The reference ships no tracing or profiling at all — its closest facility is
+step-progress logging (SURVEY.md §5; /root/reference/task/common/steps.go:19).
+On TPU the record that matters is the XLA profiler trace (TensorBoard's
+profile plugin reads it: per-op device timelines, HLO cost breakdowns, MXU
+utilization), and the orchestrator's existing data plane gives a free export
+path: anything written under the task WORKDIR is picked up by the on-worker
+10 s sync loop and lands in the bucket, so ``tpu-task delete``/``storage
+pull`` brings traces home with the checkpoints — no extra channel needed.
+
+Usage in a task script::
+
+    from tpu_task.ml import profiling
+
+    with profiling.trace("profiles"):        # explicit dir: always traced
+        state, metrics = step(state, batch)
+
+    with profiling.trace():                  # env-gated: no-op unless
+        state, metrics = step(state, batch)  # TPU_TASK_PROFILE=<dir> is set
+
+    for step_ix in range(n):                 # or: trace a step window
+        with profiling.step_window(step_ix, start=100, stop=105):
+            state, metrics = step(state, batch)
+
+    with profiling.annotate("data-load"):    # named span inside a trace
+        batch = next(batches)
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager, nullcontext
+from typing import Optional
+
+
+@contextmanager
+def trace(log_dir: Optional[str] = None):
+    """Capture an XLA profiler trace of the enclosed block.
+
+    An explicit ``log_dir`` always traces. With ``log_dir=None`` the
+    capture is gated on ``TPU_TASK_PROFILE``: unset → no-op (and nothing
+    touches the filesystem), set → its value is the trace directory — so
+    production scripts leave the call sites in place and opt in per run."""
+    import jax
+
+    if log_dir is None:
+        log_dir = os.environ.get("TPU_TASK_PROFILE", "")
+        if not log_dir:
+            yield
+            return
+    os.makedirs(log_dir, exist_ok=True)
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+def annotate(name: str):
+    """Named span visible on the device timeline (TraceAnnotation)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+def step_window(step: int, *, start: int, stop: int,
+                log_dir: Optional[str] = None):
+    """Trace only steps in [start, stop) — the usual capture pattern: skip
+    compilation/warmup, record a handful of steady-state steps. The
+    ``log_dir``/env gating matches :func:`trace`."""
+    if start <= step < stop:
+        return trace(log_dir)
+    return nullcontext()
+
+
+def device_memory_summary() -> str:
+    """Human-readable live-buffer summary per device (HBM pressure at a
+    glance; empty string when the runtime doesn't expose stats)."""
+    import jax
+
+    lines = []
+    for device in jax.devices():
+        stats = getattr(device, "memory_stats", lambda: None)()
+        if not stats:
+            continue
+        in_use = stats.get("bytes_in_use", 0)
+        limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+        line = f"{device.device_kind} {device.id}: {in_use / 1e9:.2f} GB in use"
+        if limit:
+            line += f" of {limit / 1e9:.2f} GB ({in_use / limit:.0%})"
+        lines.append(line)
+    return "\n".join(lines)

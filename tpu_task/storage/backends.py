@@ -200,8 +200,6 @@ def _resolve_conditional_loss(backend, key: str, data: bytes) -> bool:
     and the read) — any other read failure PROPAGATES, so callers'
     persistence-error handling still fires instead of mistaking a broken
     store for a benign lost race."""
-    from tpu_task.common.errors import ResourceNotFoundError
-
     try:
         return backend.read(key) == data
     except ResourceNotFoundError:
