@@ -51,3 +51,46 @@ def test_prefetch_places_on_sharding():
 def test_prefetch_short_iterator():
     assert list(prefetch_to_device(iter([np.zeros(2)]), depth=4))[0].shape == (2,)
     assert list(prefetch_to_device(iter([]), depth=2)) == []
+
+
+def test_epoch_batches_host_shards_reassemble_global_batch():
+    """Per-host slices concatenate (in process order) to exactly the
+    single-host global batch — the zero-communication multi-host contract."""
+    from tpu_task.ml.data import epoch_batches
+
+    data = np.arange(64, dtype=np.float32).reshape(32, 2)
+    whole = list(epoch_batches(data, None, 8, seed=3, epochs=2,
+                               process_index=0, process_count=1))
+    shards = [list(epoch_batches(data, None, 8, seed=3, epochs=2,
+                                 process_index=i, process_count=4))
+              for i in range(4)]
+    assert len(whole) == len(shards[0]) == 8  # 4 steps/epoch x 2
+    for step, full in enumerate(whole):
+        stitched = np.concatenate([shards[i][step] for i in range(4)])
+        np.testing.assert_array_equal(stitched, full)
+        assert shards[0][step].shape == (2, 2)  # 8 global / 4 hosts
+
+
+def test_epoch_batches_start_step_resumes_exact_sequence():
+    """start_step=N yields exactly the tail the unbroken run would have
+    produced — across epoch boundaries (checkpoint-resume contract)."""
+    from tpu_task.ml.data import epoch_batches
+
+    data = np.arange(40, dtype=np.int64)
+    full = list(epoch_batches(data, None, 10, seed=7, epochs=3,
+                              process_index=0, process_count=1))
+    for start in (0, 3, 4, 5, 11):
+        resumed = list(epoch_batches(data, None, 10, seed=7, epochs=3,
+                                     process_index=0, process_count=1,
+                                     start_step=start))
+        assert len(resumed) == len(full) - start
+        for a, b in zip(resumed, full[start:]):
+            np.testing.assert_array_equal(a, b)
+
+
+def test_epoch_batches_rejects_indivisible_global_batch():
+    from tpu_task.ml.data import epoch_batches
+
+    with np.testing.assert_raises(ValueError):
+        next(epoch_batches(np.zeros((16, 1)), None, 10,
+                           process_index=0, process_count=4))

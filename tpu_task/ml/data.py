@@ -19,20 +19,61 @@ import numpy as np
 
 def epoch_batches(data: np.ndarray, labels: Optional[np.ndarray],
                   batch_size: int, *, seed: int = 0,
-                  epochs: Optional[int] = None) -> Iterator:
-    """Shuffled, drop-remainder batches; deterministic per (seed, epoch)."""
+                  epochs: Optional[int] = None,
+                  process_index: Optional[int] = None,
+                  process_count: Optional[int] = None,
+                  start_step: int = 0) -> Iterator:
+    """Shuffled, drop-remainder batches; deterministic per (seed, epoch).
+
+    Multi-host: ``batch_size`` is the GLOBAL batch; with
+    ``process_count > 1`` each host yields only its contiguous slice of
+    every global batch. The permutation depends only on (seed, epoch), so
+    all hosts agree on the global batch with zero communication — the
+    orchestrator's `TPU_TASK_WORKER_ID`/`NUM_WORKERS` contract supplies the
+    indices (defaults: `jax.process_index()`/`jax.process_count()`).
+
+    Resume: ``start_step`` skips the first N GLOBAL steps, so a restored
+    task continues the exact sequence it would have seen — pair it with the
+    step restored from the checkpoint. Whole skipped epochs don't pay their
+    permutation."""
     n = len(data)
     if batch_size > n:
         raise ValueError(f"batch_size {batch_size} > dataset size {n}")
+    if process_index is None:
+        process_index = jax.process_index()
+    if process_count is None:
+        process_count = jax.process_count()
+    if batch_size % process_count:
+        raise ValueError(f"global batch {batch_size} not divisible by "
+                         f"{process_count} processes")
+    if not 0 <= process_index < process_count:
+        # Fail here, not as a cross-host shape mismatch deep in the sharded
+        # step (a 1-based worker id would otherwise slice empty batches).
+        raise ValueError(f"process_index {process_index} out of range for "
+                         f"process_count {process_count}")
+    local = batch_size // process_count
+    steps_per_epoch = (n - batch_size) // batch_size + 1
+    if start_step < 0:
+        raise ValueError(f"start_step must be >= 0, got {start_step}")
+    skip = start_step
+
     epoch_iter = range(epochs) if epochs is not None else itertools.count()
     for epoch in epoch_iter:
+        if skip >= steps_per_epoch:
+            skip -= steps_per_epoch
+            continue
         order = np.random.default_rng(seed + epoch).permutation(n)
-        for start in range(0, n - batch_size + 1, batch_size):
-            index = order[start:start + batch_size]
+        for step, start in enumerate(
+                range(0, n - batch_size + 1, batch_size)):
+            if step < skip:
+                continue
+            base = start + process_index * local
+            index = order[base:base + local]
             if labels is None:
                 yield data[index]
             else:
                 yield data[index], labels[index]
+        skip = 0
 
 
 def prefetch_to_device(iterator: Iterable, sharding=None, depth: int = 2):
