@@ -380,3 +380,52 @@ def test_sharded_restore_accepts_legacy_steps_without_manifest(tmp_path):
                               jax.tree.leaves(restored)):
         np.testing.assert_allclose(np.asarray(original), np.asarray(back),
                                    atol=0)
+
+
+def test_sp_train_step_matches_replicated_step():
+    """The sequence-parallel train step (zigzag ring attention + seq-sharded
+    activations over sp) produces the same loss and updated params as the
+    plain replicated step — sequence parallelism must be a layout choice,
+    not a numerics choice."""
+    from tpu_task.ml import train
+    from tpu_task.ml.models import transformer
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, d_head=8, d_ff=64,
+        dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0,
+                                cfg.vocab_size)
+
+    plain_state = train.init_state(jax.random.PRNGKey(0), cfg)
+    plain_step = train.make_train_step(cfg, donate=False)
+    plain_state, plain_metrics = plain_step(plain_state, tokens)
+
+    mesh = meshlib.make_mesh(4, axis_names=("sp",), axis_sizes=(4,))
+    sp_state = train.init_state(jax.random.PRNGKey(0), cfg)
+    sp_state, _ = train.shard_state(sp_state, cfg, mesh)
+    sp_step = train.make_sp_train_step(cfg, mesh, donate=False)(sp_state)
+    sp_state, sp_metrics = sp_step(sp_state, tokens)
+
+    assert abs(float(sp_metrics["loss"]) - float(plain_metrics["loss"])) < 1e-5
+    for a, b in zip(jax.tree.leaves(sp_state.params),
+                    jax.tree.leaves(plain_state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_sp_train_step_with_dp_axis():
+    """dp × sp combined mesh: batch shards over dp, seq over sp, one step
+    runs and the loss is finite (collective wiring check)."""
+    from tpu_task.ml import train
+    from tpu_task.ml.models import transformer
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=1, n_heads=2, d_head=8, d_ff=64,
+        dtype=jnp.float32)
+    mesh = meshlib.make_mesh(8, axis_names=("dp", "sp"), axis_sizes=(2, 4))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 17), 0,
+                                cfg.vocab_size)
+    state = train.init_state(jax.random.PRNGKey(0), cfg)
+    state, _ = train.shard_state(state, cfg, mesh)
+    step = train.make_sp_train_step(cfg, mesh, donate=False)(state)
+    state, metrics = step(state, tokens)
+    assert np.isfinite(float(metrics["loss"]))

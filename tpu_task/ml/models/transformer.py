@@ -220,12 +220,21 @@ def apply(params: Params, cfg: TransformerConfig, tokens, attn_fn=None):
 
 
 def apply_features(params: Params, cfg: TransformerConfig, tokens,
-                   attn_fn=None):
+                   attn_fn=None, activation_spec=None):
     """tokens (batch, seq) → final-layer features (batch, seq, d_model),
-    BEFORE the unembed projection (the fused loss consumes these)."""
+    BEFORE the unembed projection (the fused loss consumes these).
+
+    ``activation_spec``: optional sharding (e.g. a NamedSharding putting
+    seq on the ``sp`` axis) pinned onto the activations right after the
+    embedding — sequence-parallel training needs the residual stream
+    sharded over seq, which no parameter spec implies (params carry no seq
+    axis), so without the constraint XLA may replicate the activations and
+    forfeit the memory win."""
     if attn_fn is None:
         attn_fn = lambda q, k, v: dot_product_attention(q, k, v, True)
     x = embed_lookup(params["embed"].astype(cfg.dtype), tokens)
+    if activation_spec is not None:
+        x = jax.lax.with_sharding_constraint(x, activation_spec)
     for layer in params["layers"]:
         x = _block(x, layer, cfg, attn_fn)
     return _rmsnorm(x, params["final_norm"])
@@ -363,7 +372,7 @@ _fused_xent.defvjp(_fused_xent_fwd, _fused_xent_bwd)
 
 
 def loss_fn(params: Params, cfg: TransformerConfig, tokens, attn_fn=None,
-            fused: bool = True):
+            fused: bool = True, activation_spec=None):
     """Next-token cross-entropy; tokens (batch, seq).
 
     ``fused=True`` (default) streams the unembed+softmax over auto-sized
@@ -374,9 +383,15 @@ def loss_fn(params: Params, cfg: TransformerConfig, tokens, attn_fn=None,
     shrinks to bound logits memory (seq 32k × vocab 32k would be 8 GB f32
     unfused). ``fused=False`` keeps the monolithic reference path the
     hermetic tests compare against."""
+    if activation_spec is not None and not fused:
+        # apply() has no activation_spec path; silently dropping the
+        # constraint would replicate the residual stream over sp and OOM
+        # at exactly the lengths sequence parallelism exists to serve.
+        raise ValueError("activation_spec requires the fused loss path")
     targets = tokens[:, 1:]
     if fused:
-        features = apply_features(params, cfg, tokens[:, :-1], attn_fn=attn_fn)
+        features = apply_features(params, cfg, tokens[:, :-1], attn_fn=attn_fn,
+                                  activation_spec=activation_spec)
         b, s, d = features.shape
         return fused_xent(features.reshape(b * s, d),
                           params["unembed"].astype(cfg.dtype),

@@ -367,15 +367,21 @@ def zigzag_ring_attention_shard(q, k, v, axis_name: str = "sp",
 
 
 def zigzag_ring_attention(q, k, v, mesh, axis_name: str = "sp",
-                          impl: str | None = None, interpret: bool = False):
+                          impl: str | None = None, interpret: bool = False,
+                          batch_axes=None):
     """Global-view balanced causal ring attention (always causal).
 
     Permutes the sequence into zigzag stripe order, runs the balanced ring
     under shard_map, and un-permutes the output — exact causal attention at
     ~half the uniform ring's attention FLOPs.
+
+    ``batch_axes``: mesh axis (or tuple) the BATCH dim is sharded over —
+    on a dp × sp mesh, passing "dp" keeps each dp group computing only its
+    own batch slice instead of all-gathering and computing the global
+    batch redundantly on every replica.
     """
     devices = mesh.shape[axis_name]
-    spec = PartitionSpec(None, axis_name, None, None)
+    spec = PartitionSpec(batch_axes, axis_name, None, None)
     fn = jax.shard_map(
         functools.partial(zigzag_ring_attention_shard, axis_name=axis_name,
                           impl=impl, interpret=interpret),
@@ -403,12 +409,15 @@ def ring_attention_shard(q, k, v, axis_name: str = "sp", causal: bool = True,
 
 
 def ring_attention(q, k, v, mesh, axis_name: str = "sp", causal: bool = True,
-                   impl: str | None = None, interpret: bool = False):
+                   impl: str | None = None, interpret: bool = False,
+                   batch_axes=None):
     """Global-view ring attention: q/k/v (batch, seq, heads, head_dim).
 
-    Shards the sequence over ``axis_name`` with shard_map and runs the ring.
+    Shards the sequence over ``axis_name`` with shard_map and runs the
+    ring; ``batch_axes`` optionally shards the batch dim as well (see
+    zigzag_ring_attention).
     """
-    spec = PartitionSpec(None, axis_name, None, None)
+    spec = PartitionSpec(batch_axes, axis_name, None, None)
     fn = jax.shard_map(
         functools.partial(ring_attention_shard, axis_name=axis_name,
                           causal=causal, impl=impl, interpret=interpret),

@@ -82,17 +82,20 @@ def shard_state(state: TrainState, cfg, mesh) -> Tuple[TrainState, TrainState]:
 
 
 def make_train_step(cfg: transformer.TransformerConfig, optimizer=None, mesh=None,
-                    attn_fn=None, donate: bool = True):
+                    attn_fn=None, donate: bool = True, activation_spec=None):
     """Build the jitted (state, batch) → (state, metrics) step.
 
     With a mesh, in/out shardings pin the state layout and shard the batch
-    over the data axes; single-device otherwise.
+    over the data axes; single-device otherwise. ``activation_spec`` is
+    forwarded to the model so e.g. sequence-parallel steps can pin the
+    residual stream's seq axis onto the mesh (see make_sp_train_step).
     """
     optimizer = optimizer or make_optimizer()
 
     def step(state: TrainState, tokens):
         loss, grads = jax.value_and_grad(transformer.loss_fn)(
-            state.params, cfg, tokens, attn_fn=attn_fn
+            state.params, cfg, tokens, attn_fn=attn_fn,
+            activation_spec=activation_spec,
         )
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
@@ -119,3 +122,38 @@ def make_train_step(cfg: transformer.TransformerConfig, optimizer=None, mesh=Non
         )
 
     return jit_with_state
+
+
+def make_sp_train_step(cfg: transformer.TransformerConfig, mesh,
+                       optimizer=None, donate: bool = True,
+                       axis_name: str = "sp"):
+    """Sequence-parallel (long-context) training step.
+
+    One document's activations shard over the ``sp`` mesh axis; attention
+    runs the zigzag balanced causal ring (exact, ~half the uniform ring's
+    attention FLOPs — ml/parallel/ring_attention.py); the fused loss
+    reduces globally, and parameters/optimizer state replicate over sp
+    (they carry no seq axis) while following the usual logical rules on
+    any other mesh axes. 2 × sp (the zigzag stripe count) must divide the
+    sequence length. Combine with dp in the same mesh for batch
+    parallelism: ``make_mesh(n, axis_names=("dp", "sp"), axis_sizes=(a, b))``.
+    """
+    from tpu_task.ml.parallel.ring_attention import zigzag_ring_attention
+    from tpu_task.ml.parallel.sharding import logical_to_mesh_axes
+
+    # Resolve the batch placement from the logical rules (dp and/or fsdp,
+    # filtered to this mesh) so the activation constraint, the ring's
+    # shard_map batch spec, and make_train_step's token sharding all agree
+    # — a mismatch would all-gather the batch dim every layer and compute
+    # attention redundantly on every replica.
+    batch_axes = logical_to_mesh_axes(("batch",), mesh=mesh)[0]
+
+    def attn(q, k, v):
+        return zigzag_ring_attention(q, k, v, mesh, axis_name=axis_name,
+                                     batch_axes=batch_axes)
+
+    activation_spec = NamedSharding(
+        mesh, PartitionSpec(batch_axes, axis_name, None))
+    return make_train_step(cfg, optimizer=optimizer, mesh=mesh,
+                           attn_fn=attn, donate=donate,
+                           activation_spec=activation_spec)
