@@ -23,7 +23,7 @@ def cloud(tmp_path, monkeypatch):
     return Cloud(provider=Provider.LOCAL)
 
 
-def poll(task, predicate, timeout=30.0, period=0.2):
+def poll(task, predicate, timeout=60.0, period=0.2):
     deadline = time.time() + timeout
     while time.time() < deadline:
         task.read()
@@ -174,7 +174,7 @@ def test_parallelism_runs_n_workers(cloud):
         # Generous timeout: 3 agent subprocesses + sync loops under full-
         # suite load can take tens of seconds on a busy machine.
         poll(task, lambda t: t.status().get(StatusCode.SUCCEEDED, 0)
-             + t.status().get(StatusCode.FAILED, 0) >= 3, timeout=90)
+             + t.status().get(StatusCode.FAILED, 0) >= 3, timeout=180)
         logs = "".join(task.logs())
         for rank in range(3):
             assert f"worker-{rank}" in logs

@@ -429,3 +429,23 @@ def test_sp_train_step_with_dp_axis():
     step = train.make_sp_train_step(cfg, mesh, donate=False)(state)
     state, metrics = step(state, tokens)
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_sp_train_step_with_fsdp_axis():
+    """fsdp × sp mesh: the batch placement comes from the logical rules, so
+    fsdp (not just dp) shards the batch consistently across the activation
+    constraint, the ring's shard_map, and the token input sharding."""
+    from tpu_task.ml import train
+    from tpu_task.ml.models import transformer
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=1, n_heads=2, d_head=8, d_ff=64,
+        dtype=jnp.float32)
+    mesh = meshlib.make_mesh(8, axis_names=("fsdp", "sp"), axis_sizes=(2, 4))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 17), 0,
+                                cfg.vocab_size)
+    state = train.init_state(jax.random.PRNGKey(0), cfg)
+    state, _ = train.shard_state(state, cfg, mesh)
+    step = train.make_sp_train_step(cfg, mesh, donate=False)(state)
+    state, metrics = step(state, tokens)
+    assert np.isfinite(float(metrics["loss"]))

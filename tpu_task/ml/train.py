@@ -135,11 +135,11 @@ def make_sp_train_step(cfg: transformer.TransformerConfig, mesh,
     reduces globally, and parameters/optimizer state replicate over sp
     (they carry no seq axis) while following the usual logical rules on
     any other mesh axes. 2 × sp (the zigzag stripe count) must divide the
-    sequence length. Combine with dp in the same mesh for batch
+    MODEL sequence length — the loss drops the last token, so feed token
+    arrays of length (2·sp·k) + 1. Combine with dp in the same mesh for batch
     parallelism: ``make_mesh(n, axis_names=("dp", "sp"), axis_sizes=(a, b))``.
     """
     from tpu_task.ml.parallel.ring_attention import zigzag_ring_attention
-    from tpu_task.ml.parallel.sharding import logical_to_mesh_axes
 
     # Resolve the batch placement from the logical rules (dp and/or fsdp,
     # filtered to this mesh) so the activation constraint, the ring's
