@@ -240,3 +240,17 @@ def test_compiled_zigzag_ring_backward():
     _assert_bf16_close(loss_zz, loss_ref)
     for got, want in zip(g_zz, g_ref):
         _assert_bf16_close(got, want)
+
+
+@on_tpu
+def test_compiled_ulysses_degenerate():
+    """Ulysses all-to-all attention compiled on one chip (P=1): the
+    reshard collectives degenerate and the inner fused kernel runs."""
+    from tpu_task.ml.parallel import mesh as meshlib
+    from tpu_task.ml.parallel.ulysses import ulysses_attention
+
+    mesh = meshlib.make_mesh(1, axis_names=("sp",), axis_sizes=(1,))
+    q, k, v = _qkv_bf16(s=2048)
+    out = jax.jit(lambda q, k, v: ulysses_attention(q, k, v, mesh))(q, k, v)
+    ref = mha_reference(q, k, v, True)
+    _assert_bf16_close(out, ref)

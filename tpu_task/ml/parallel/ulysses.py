@@ -1,0 +1,91 @@
+"""All-to-all (Ulysses-style) context parallelism.
+
+The second context-parallel mode next to the ring
+(:mod:`tpu_task.ml.parallel.ring_attention`): instead of circulating k/v
+blocks around a ring, two ``all_to_all`` collectives reshard the activations
+from sequence-sharded to HEAD-sharded and back. In between, every device
+holds the FULL sequence for its head group, so attention itself is the
+plain fused kernel — the flash Pallas path on TPU — with exact causal
+masking and no schedule bookkeeping.
+
+Trade-offs vs the ring (why both exist):
+
+- Ulysses moves each activation twice per attention call (a2a in, a2a out)
+  regardless of sequence length; the ring moves k/v P-1 times but overlaps
+  transfers with block compute. On ICI-rich slices the a2a is cheap and the
+  kernel runs at full length (better MXU utilization than per-block calls).
+- Ulysses caps the parallel degree at the head count (heads % sp == 0);
+  the ring has no such cap — 32 devices on 8 heads needs the ring.
+- Memory: Ulysses holds (b, s, h/P, d) per device — full sequence, fewer
+  heads; the ring holds (b, s/P, h, d). Same totals, different shapes.
+
+Reference: DeepSpeed-Ulysses (public technique; no reference-code analog —
+the reference orchestrates machines, SURVEY.md §2.9).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax import lax
+from jax.sharding import PartitionSpec
+
+from tpu_task.ml.ops.attention import dot_product_attention
+
+
+def _seq_to_heads(x, axis_name: str):
+    """(b, s/P, h, d) local → (b, s, h/P, d) local: split heads, gather seq."""
+    return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)
+
+
+def _heads_to_seq(x, axis_name: str):
+    """(b, s, h/P, d) local → (b, s/P, h, d) local: the inverse reshard."""
+    return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def ulysses_attention_shard(q, k, v, axis_name: str = "sp",
+                            causal: bool = True):
+    """Per-shard body: local arrays are (batch, seq/P, heads, head_dim);
+    call inside ``shard_map`` with seq sharded on ``axis_name``.
+
+    Differentiable with plain autodiff: ``all_to_all``'s transpose is the
+    inverse all_to_all, and the inner attention is the fused custom-VJP op.
+    """
+    qh = _seq_to_heads(q, axis_name)
+    kh = _seq_to_heads(k, axis_name)
+    vh = _seq_to_heads(v, axis_name)
+    out = dot_product_attention(qh, kh, vh, causal)
+    return _heads_to_seq(out, axis_name)
+
+
+def ulysses_attention(q, k, v, mesh, axis_name: str = "sp",
+                      causal: bool = True, batch_axes=None):
+    """Global-view all-to-all context-parallel attention.
+
+    q/k/v: (batch, seq, heads, head_dim) with ``heads % sp == 0`` and
+    ``seq % sp == 0``. ``batch_axes`` as in
+    :func:`~tpu_task.ml.parallel.ring_attention.zigzag_ring_attention`:
+    mesh axis (or tuple) the batch dim is sharded over, so dp groups only
+    compute their own slice.
+    """
+    devices = mesh.shape[axis_name]
+    heads = q.shape[2]
+    if heads % devices:
+        raise ValueError(
+            f"ulysses needs heads ({heads}) divisible by {axis_name} "
+            f"({devices}); use the ring for higher parallel degrees")
+    if q.shape[1] % devices:
+        raise ValueError(f"sequence ({q.shape[1]}) not divisible by "
+                         f"{axis_name} ({devices})")
+    spec = PartitionSpec(batch_axes, axis_name, None, None)
+    fn = jax.shard_map(
+        functools.partial(ulysses_attention_shard, axis_name=axis_name,
+                          causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

@@ -126,31 +126,46 @@ def make_train_step(cfg: transformer.TransformerConfig, optimizer=None, mesh=Non
 
 def make_sp_train_step(cfg: transformer.TransformerConfig, mesh,
                        optimizer=None, donate: bool = True,
-                       axis_name: str = "sp"):
+                       axis_name: str = "sp", context_parallel: str = "zigzag"):
     """Sequence-parallel (long-context) training step.
 
-    One document's activations shard over the ``sp`` mesh axis; attention
-    runs the zigzag balanced causal ring (exact, ~half the uniform ring's
-    attention FLOPs — ml/parallel/ring_attention.py); the fused loss
-    reduces globally, and parameters/optimizer state replicate over sp
-    (they carry no seq axis) while following the usual logical rules on
-    any other mesh axes. 2 × sp (the zigzag stripe count) must divide the
-    MODEL sequence length — the loss drops the last token, so feed token
-    arrays of length (2·sp·k) + 1. Combine with dp in the same mesh for batch
+    One document's activations shard over the ``sp`` mesh axis; the fused
+    loss reduces globally, and parameters/optimizer state replicate over
+    sp (they carry no seq axis) while following the usual logical rules on
+    any other mesh axes. Combine with dp in the same mesh for batch
     parallelism: ``make_mesh(n, axis_names=("dp", "sp"), axis_sizes=(a, b))``.
+
+    ``context_parallel`` picks how attention crosses the shards:
+
+    - ``"zigzag"`` (default): balanced causal ring — k/v circulate, ~half
+      the uniform ring's attention FLOPs, parallel degree unbounded by the
+      head count. 2 × sp (the stripe count) must divide the MODEL sequence
+      length, i.e. feed token arrays of length (2·sp·k) + 1.
+    - ``"ulysses"``: two all_to_all reshards (seq↔heads) around one
+      full-length fused attention call (the flash kernel on TPU). Needs
+      ``heads % sp == 0``; sp must divide the model sequence length.
     """
     from tpu_task.ml.parallel.ring_attention import zigzag_ring_attention
+    from tpu_task.ml.parallel.ulysses import ulysses_attention
 
     # Resolve the batch placement from the logical rules (dp and/or fsdp,
-    # filtered to this mesh) so the activation constraint, the ring's
+    # filtered to this mesh) so the activation constraint, the attention
     # shard_map batch spec, and make_train_step's token sharding all agree
     # — a mismatch would all-gather the batch dim every layer and compute
     # attention redundantly on every replica.
     batch_axes = logical_to_mesh_axes(("batch",), mesh=mesh)[0]
 
-    def attn(q, k, v):
-        return zigzag_ring_attention(q, k, v, mesh, axis_name=axis_name,
+    if context_parallel == "zigzag":
+        def attn(q, k, v):
+            return zigzag_ring_attention(q, k, v, mesh, axis_name=axis_name,
+                                         batch_axes=batch_axes)
+    elif context_parallel == "ulysses":
+        def attn(q, k, v):
+            return ulysses_attention(q, k, v, mesh, axis_name=axis_name,
                                      batch_axes=batch_axes)
+    else:
+        raise ValueError(f"unknown context_parallel {context_parallel!r} "
+                         "(use 'zigzag' or 'ulysses')")
 
     activation_spec = NamedSharding(
         mesh, PartitionSpec(batch_axes, axis_name, None))
