@@ -26,8 +26,17 @@ import numpy as np
 _STEP_RE = re.compile(r"^ckpt-(\d+)\.npz$")
 
 
-def save_checkpoint(directory, step: int, tree: Any) -> Path:
-    """Write ``ckpt-{step}.npz`` atomically, then update LATEST."""
+def save_checkpoint(directory, step: int, tree: Any,
+                    keep: Optional[int] = None) -> Path:
+    """Write ``ckpt-{step}.npz`` atomically, then update LATEST.
+
+    ``keep``: retain the newest N checkpoints plus, always, the one just
+    written (an out-of-order re-save must never delete its own file and
+    leave LATEST dangling). The workdir sync mirrors deletions, so
+    retention bounds bucket usage too — long runs otherwise accumulate
+    every step's full state."""
+    if keep is not None and keep < 1:
+        raise ValueError(f"keep must be >= 1, got {keep}")
     directory = Path(directory)
     directory.mkdir(parents=True, exist_ok=True)
     leaves = [np.asarray(l) for l in jax.tree.leaves(tree)]
@@ -47,6 +56,14 @@ def save_checkpoint(directory, step: int, tree: Any) -> Path:
     pointer = directory / "LATEST.tmp"
     pointer.write_text(json.dumps({"step": step, "file": final.name}))
     os.replace(pointer, directory / "LATEST")
+    if keep is not None:
+        steps = sorted(
+            int(match.group(1)) for path in directory.iterdir()
+            if (match := _STEP_RE.match(path.name)))
+        retained = set(steps[-keep:]) | {step}
+        for old in steps:
+            if old not in retained:
+                (directory / f"ckpt-{old}.npz").unlink(missing_ok=True)
     return final
 
 
@@ -93,14 +110,30 @@ def _index_key(leaf_index: int, index, shape) -> str:
     return f"leaf_{leaf_index}|" + ",".join(parts)
 
 
-def save_checkpoint_sharded(directory, step: int, tree: Any) -> Path:
+def save_checkpoint_sharded(directory, step: int, tree: Any,
+                            keep: Optional[int] = None) -> Path:
     """Write this process's shards of a (possibly multi-host) pytree.
 
     Every process calls this; each writes only its addressable, replica-0
     shards. Process 0 also writes a LATEST_SHARDED pointer naming the step
     and the expected shard-file count — restore uses it to reject partial
     sets consistently across hosts (the plain-format LATEST is untouched).
-    """
+
+    ``keep``: retain the newest N steps (plus, always, the one just
+    written). Each process prunes its OWN old shard files (never a
+    sibling's — a slow process may still be writing an older step's shard
+    it owns); process 0 also prunes the old per-step manifests. Minimum 2:
+    with keep=1 a worker deletes its previous shard the moment it writes
+    the new one, and during the inter-worker sync-skew window NO step has
+    a complete shard set in the bucket — a preemption there would be
+    unrecoverable. More generally ``keep`` must exceed the worst-case
+    inter-worker save skew measured in save intervals; 2 covers loops
+    that save in lockstep, size it up for loosely-coupled savers."""
+    if keep is not None and keep < 2:
+        raise ValueError(
+            f"sharded keep must be >= 2 (got {keep}): with 1 retained "
+            "step, inter-worker sync skew leaves windows where no step "
+            "has a complete shard set")
     directory = Path(directory)
     directory.mkdir(parents=True, exist_ok=True)
     process = jax.process_index()
@@ -158,6 +191,19 @@ def save_checkpoint_sharded(directory, step: int, tree: Any) -> Path:
             "step": step, "file": final.name,
             "process_count": jax.process_count()}))
         os.replace(pointer, directory / "LATEST_SHARDED")
+    if keep is not None:
+        own = sorted(
+            int(match.group(1)) for path in directory.iterdir()
+            if (match := _SHARD_RE.match(path.name))
+            and int(match.group(2)) == process)
+        retained = set(own[-keep:]) | {step}
+        for old in own:
+            if old in retained:
+                continue
+            (directory /
+             f"ckpt-{old}.shard-{process}.npz").unlink(missing_ok=True)
+            if process == 0:
+                (directory / f"ckpt-{old}.meta").unlink(missing_ok=True)
     return final
 
 
