@@ -139,3 +139,23 @@ def test_compiled_moe_sharded_degenerate_matches_dense():
         lambda p, x: moe.apply_sharded(p, cfg, x, mesh))(params, x)
     _close(sharded_out, dense_out)
     _close(sharded_aux, dense_aux)
+
+
+@on_tpu
+def test_compiled_generate_on_chip():
+    """KV-cache generation (prefill + scan of cached single-token steps)
+    compiled at bf16: runs, stays in-vocab, and greedy is deterministic."""
+    from tpu_task.ml.models import decoding, transformer
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=1024, d_model=128, n_layers=2, n_heads=4, d_head=32,
+        d_ff=256, dtype=jnp.bfloat16)
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                cfg.vocab_size)
+    jitted = jax.jit(lambda p, t: decoding.generate(p, cfg, t, 32))
+    a = np.asarray(jitted(params, prompt))
+    b = np.asarray(jitted(params, prompt))
+    assert a.shape == (2, 32)
+    assert a.max() < cfg.vocab_size and a.min() >= 0
+    np.testing.assert_array_equal(a, b)

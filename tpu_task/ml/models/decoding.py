@@ -1,0 +1,138 @@
+"""KV-cache autoregressive decoding for the flagship transformer.
+
+Training recomputes attention over the full sequence every step; decoding
+must not — each new token attends cached k/v, so the per-token cost is
+O(seq) instead of O(seq²). TPU-first shape discipline: the cache is a
+fixed ``max_len`` ring of static shape, the decode loop is a ``lax.scan``
+(one compilation, no per-token retrace), and masking is positional
+arithmetic — no dynamic shapes anywhere, so XLA compiles one program for
+the whole generation.
+
+The reference ships no model/inference code at all (SURVEY.md §2.9);
+this completes the task library's train → eval → generate triangle.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpu_task.ml.models.transformer import (
+    Params,
+    TransformerConfig,
+    _rmsnorm,
+    _rope,
+    embed_lookup,
+)
+
+
+def init_cache(cfg: TransformerConfig, batch: int, max_len: int) -> List[dict]:
+    """Per-layer k/v caches of static shape (batch, max_len, heads, d_head)."""
+    shape = (batch, max_len, cfg.n_heads, cfg.d_head)
+    return [{"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+            for _ in range(cfg.n_layers)]
+
+
+def _cached_attention(q, k_cache, v_cache, q_positions):
+    """q: (b, s, h, d) at absolute ``q_positions``; caches: (b, L, h, d)
+    where every slot j holds the token at position j (zeros beyond the
+    filled region, masked off by the position test j <= q_pos)."""
+    d = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache) / (d ** 0.5)
+    slot = jnp.arange(k_cache.shape[1])
+    mask = slot[None, :] <= q_positions[:, None]           # (s, L)
+    scores = jnp.where(mask[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v_cache)
+
+
+def _cached_block(x, layer, cfg: TransformerConfig, cache: dict,
+                  positions) -> Tuple[Any, dict]:
+    b, s, _ = x.shape
+    h = _rmsnorm(x, layer["attn_norm"])
+    q = (h @ layer["wq"].astype(cfg.dtype)).reshape(b, s, cfg.n_heads, cfg.d_head)
+    k = (h @ layer["wk"].astype(cfg.dtype)).reshape(b, s, cfg.n_heads, cfg.d_head)
+    v = (h @ layer["wv"].astype(cfg.dtype)).reshape(b, s, cfg.n_heads, cfg.d_head)
+    # The TRAINING rope helper with absolute positions: one implementation,
+    # so the bit-exact train/decode parity the tests pin cannot drift.
+    q = _rope(q, cfg.rope_theta, positions)
+    k = _rope(k, cfg.rope_theta, positions)
+    k_cache = jax.lax.dynamic_update_slice(
+        cache["k"], k, (0, positions[0], 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(
+        cache["v"], v, (0, positions[0], 0, 0))
+    attn = _cached_attention(q, k_cache, v_cache, positions)
+    x = x + attn.reshape(b, s, cfg.d_attn) @ layer["wo"].astype(cfg.dtype)
+
+    h = _rmsnorm(x, layer["mlp_norm"])
+    gate = jax.nn.silu(h @ layer["w_gate"].astype(cfg.dtype))
+    up = h @ layer["w_up"].astype(cfg.dtype)
+    x = x + (gate * up) @ layer["w_down"].astype(cfg.dtype)
+    return x, {"k": k_cache, "v": v_cache}
+
+
+def forward_with_cache(params: Params, cfg: TransformerConfig, tokens,
+                       caches: List[dict], start: int):
+    """Run ``tokens`` (batch, s) occupying absolute positions
+    [start, start+s) through the model, filling the caches. Returns
+    (last-position logits (batch, vocab) float32, updated caches).
+    ``start`` may be a traced scalar — shapes stay static."""
+    s = tokens.shape[1]
+    positions = start + jnp.arange(s)
+    x = embed_lookup(params["embed"].astype(cfg.dtype), tokens)
+    new_caches = []
+    for layer, cache in zip(params["layers"], caches):
+        x, cache = _cached_block(x, layer, cfg, cache, positions)
+        new_caches.append(cache)
+    x = _rmsnorm(x, params["final_norm"])
+    logits = (x[:, -1] @ params["unembed"].astype(cfg.dtype))
+    return logits.astype(jnp.float32), new_caches
+
+
+def generate(params: Params, cfg: TransformerConfig, prompt,
+             max_new_tokens: int, *, temperature: float = 0.0,
+             rng: Optional[jax.Array] = None, max_len: Optional[int] = None):
+    """Autoregressive generation. prompt: (batch, prompt_len) int32 →
+    (batch, max_new_tokens) int32.
+
+    ``temperature == 0`` is greedy (argmax); otherwise softmax sampling at
+    the given temperature (``rng`` required). One prefill pass over the
+    prompt, then a ``lax.scan`` of single-token steps against the KV cache
+    — the whole generation is one compiled program."""
+    if temperature > 0 and rng is None:
+        raise ValueError("sampling (temperature > 0) needs an rng key")
+    batch, prompt_len = prompt.shape
+    total = (prompt_len + max_new_tokens) if max_len is None else max_len
+    if total < prompt_len + max_new_tokens:
+        raise ValueError(f"max_len {total} < prompt {prompt_len} + "
+                         f"new {max_new_tokens}")
+
+    caches = init_cache(cfg, batch, total)
+    logits, caches = forward_with_cache(params, cfg, prompt, caches, 0)
+
+    def pick(logits, key):
+        if temperature == 0:
+            return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+        return jax.random.categorical(
+            key, logits / temperature, axis=-1).astype(prompt.dtype)
+
+    keys = (jax.random.split(rng, max_new_tokens) if rng is not None
+            else jnp.zeros((max_new_tokens, 2), jnp.uint32))
+    first = pick(logits, keys[0])
+
+    def step(carry, key):
+        token, caches, position = carry
+        logits, caches = forward_with_cache(
+            params, cfg, token[:, None], caches, position)
+        nxt = pick(logits, key)
+        return (nxt, caches, position + 1), nxt
+
+    # The prefill already produced token 0; scan the remaining n-1 decode
+    # steps and emit each step's OWN token — an emit-the-carry shape would
+    # pay one whole discarded forward pass per call.
+    (_, _, _), rest = jax.lax.scan(
+        step, (first, caches, jnp.int32(prompt_len)), keys[1:])
+    return jnp.concatenate(
+        [first[:, None], jnp.moveaxis(rest, 0, 1)], axis=1)

@@ -181,12 +181,18 @@ def _rmsnorm(x, scale):
     return (x.astype(jnp.float32) * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale.astype(x.dtype)
 
 
-def _rope(x, theta: float):
-    """Rotary embedding over (batch, seq, heads, head_dim)."""
+def _rope(x, theta: float, positions=None):
+    """Rotary embedding over (batch, seq, heads, head_dim).
+
+    ``positions`` (seq,) overrides the default 0..seq-1 — KV-cache decoding
+    applies rope at absolute offsets through this SAME function, so the
+    train and decode paths cannot drift apart."""
     _, seq, _, d = x.shape
     half = d // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    angles = jnp.arange(seq, dtype=jnp.float32)[:, None] * freqs[None, :]
+    if positions is None:
+        positions = jnp.arange(seq)
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]
     cos = jnp.cos(angles)[None, :, None, :]
     sin = jnp.sin(angles)[None, :, None, :]
     x1, x2 = x[..., :half], x[..., half:]
