@@ -295,3 +295,31 @@ def test_generate_runs_under_jit():
     eager = decoding.generate(params, TINY, prompt, 3)
     np.testing.assert_array_equal(np.asarray(jitted(params, prompt)),
                                   np.asarray(eager))
+
+
+def test_gradient_accumulation_equals_full_batch_step():
+    """accum_steps=N must produce the same loss and updated params as the
+    single full-batch step: equal-sized microbatches of a token-mean loss
+    make mean-of-grads equal grad-of-mean."""
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 17), 0,
+                                TINY.vocab_size)
+    full_state = train.init_state(jax.random.PRNGKey(0), TINY)
+    full_step = train.make_train_step(TINY, donate=False)
+    full_state, full_metrics = full_step(full_state, tokens)
+
+    acc_state = train.init_state(jax.random.PRNGKey(0), TINY)
+    acc_step = train.make_train_step(TINY, donate=False, accum_steps=2)
+    acc_state, acc_metrics = acc_step(acc_state, tokens)
+
+    assert abs(float(acc_metrics["loss"]) - float(full_metrics["loss"])) < 1e-6
+    assert abs(float(acc_metrics["grad_norm"])
+               - float(full_metrics["grad_norm"])) < 1e-5
+    for a, b in zip(jax.tree.leaves(acc_state.params),
+                    jax.tree.leaves(full_state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+    with pytest.raises(ValueError, match="divisible"):
+        acc_3 = train.make_train_step(TINY, donate=False, accum_steps=3)
+        acc_3(train.init_state(jax.random.PRNGKey(0), TINY), tokens)
+    with pytest.raises(ValueError, match="accum_steps"):
+        train.make_train_step(TINY, accum_steps=0)

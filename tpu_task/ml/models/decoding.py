@@ -19,11 +19,12 @@ from typing import Any, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from tpu_task.ml.ops.attention import NEG_INF
 from tpu_task.ml.models.transformer import (
     Params,
     TransformerConfig,
+    _block,
     _rmsnorm,
-    _rope,
     embed_lookup,
 )
 
@@ -43,34 +44,28 @@ def _cached_attention(q, k_cache, v_cache, q_positions):
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache) / (d ** 0.5)
     slot = jnp.arange(k_cache.shape[1])
     mask = slot[None, :] <= q_positions[:, None]           # (s, L)
-    scores = jnp.where(mask[None, None], scores, -1e30)
+    scores = jnp.where(mask[None, None], scores, NEG_INF)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v_cache)
 
 
 def _cached_block(x, layer, cfg: TransformerConfig, cache: dict,
                   positions) -> Tuple[Any, dict]:
-    b, s, _ = x.shape
-    h = _rmsnorm(x, layer["attn_norm"])
-    q = (h @ layer["wq"].astype(cfg.dtype)).reshape(b, s, cfg.n_heads, cfg.d_head)
-    k = (h @ layer["wk"].astype(cfg.dtype)).reshape(b, s, cfg.n_heads, cfg.d_head)
-    v = (h @ layer["wv"].astype(cfg.dtype)).reshape(b, s, cfg.n_heads, cfg.d_head)
-    # The TRAINING rope helper with absolute positions: one implementation,
-    # so the bit-exact train/decode parity the tests pin cannot drift.
-    q = _rope(q, cfg.rope_theta, positions)
-    k = _rope(k, cfg.rope_theta, positions)
-    k_cache = jax.lax.dynamic_update_slice(
-        cache["k"], k, (0, positions[0], 0, 0))
-    v_cache = jax.lax.dynamic_update_slice(
-        cache["v"], v, (0, positions[0], 0, 0))
-    attn = _cached_attention(q, k_cache, v_cache, positions)
-    x = x + attn.reshape(b, s, cfg.d_attn) @ layer["wo"].astype(cfg.dtype)
+    """The TRAINING block with a cache-updating attention closure: every
+    projection, norm, rope application, and residual is transformer._block
+    itself, so the bit-exact train/decode parity the tests pin cannot
+    drift — only the attention (against cached k/v) differs."""
+    updated: dict = {}
 
-    h = _rmsnorm(x, layer["mlp_norm"])
-    gate = jax.nn.silu(h @ layer["w_gate"].astype(cfg.dtype))
-    up = h @ layer["w_up"].astype(cfg.dtype)
-    x = x + (gate * up) @ layer["w_down"].astype(cfg.dtype)
-    return x, {"k": k_cache, "v": v_cache}
+    def attn_fn(q, k, v):
+        updated["k"] = jax.lax.dynamic_update_slice(
+            cache["k"], k, (0, positions[0], 0, 0))
+        updated["v"] = jax.lax.dynamic_update_slice(
+            cache["v"], v, (0, positions[0], 0, 0))
+        return _cached_attention(q, updated["k"], updated["v"], positions)
+
+    x = _block(x, layer, cfg, attn_fn, positions=positions)
+    return x, updated
 
 
 def forward_with_cache(params: Params, cfg: TransformerConfig, tokens,
@@ -101,6 +96,8 @@ def generate(params: Params, cfg: TransformerConfig, prompt,
     the given temperature (``rng`` required). One prefill pass over the
     prompt, then a ``lax.scan`` of single-token steps against the KV cache
     — the whole generation is one compiled program."""
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
     if temperature > 0 and rng is None:
         raise ValueError("sampling (temperature > 0) needs an rng key")
     batch, prompt_len = prompt.shape

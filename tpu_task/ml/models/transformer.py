@@ -202,13 +202,18 @@ def _rope(x, theta: float, positions=None):
     return out.astype(x.dtype)
 
 
-def _block(x, layer, cfg: TransformerConfig, attn_fn):
+def _block(x, layer, cfg: TransformerConfig, attn_fn, positions=None):
+    """One transformer block; ``positions`` feeds rope absolute offsets —
+    the KV-cache decode path runs THIS function (with its own attn_fn
+    closing over the cache), so train and decode share every projection,
+    norm, and residual and cannot drift apart."""
     b, s, _ = x.shape
     h = _rmsnorm(x, layer["attn_norm"])
     q = (h @ layer["wq"].astype(cfg.dtype)).reshape(b, s, cfg.n_heads, cfg.d_head)
     k = (h @ layer["wk"].astype(cfg.dtype)).reshape(b, s, cfg.n_heads, cfg.d_head)
     v = (h @ layer["wv"].astype(cfg.dtype)).reshape(b, s, cfg.n_heads, cfg.d_head)
-    q, k = _rope(q, cfg.rope_theta), _rope(k, cfg.rope_theta)
+    q = _rope(q, cfg.rope_theta, positions)
+    k = _rope(k, cfg.rope_theta, positions)
     attn = attn_fn(q, k, v)
     x = x + attn.reshape(b, s, cfg.d_attn) @ layer["wo"].astype(cfg.dtype)
 

@@ -82,21 +82,56 @@ def shard_state(state: TrainState, cfg, mesh) -> Tuple[TrainState, TrainState]:
 
 
 def make_train_step(cfg: transformer.TransformerConfig, optimizer=None, mesh=None,
-                    attn_fn=None, donate: bool = True, activation_spec=None):
+                    attn_fn=None, donate: bool = True, activation_spec=None,
+                    accum_steps: int = 1):
     """Build the jitted (state, batch) → (state, metrics) step.
 
     With a mesh, in/out shardings pin the state layout and shard the batch
     over the data axes; single-device otherwise. ``activation_spec`` is
     forwarded to the model so e.g. sequence-parallel steps can pin the
     residual stream's seq axis onto the mesh (see make_sp_train_step).
+
+    ``accum_steps > 1`` splits the batch dim into that many equal
+    microbatches and accumulates gradients over a ``lax.scan`` before ONE
+    optimizer update — activation memory drops to one microbatch's worth
+    while the update equals the full-batch step exactly (the loss is a
+    token mean over equal-sized microbatches, so mean-of-grads =
+    grad-of-mean). The global batch must divide by accum_steps.
     """
     optimizer = optimizer or make_optimizer()
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+
+    def loss_and_grads(params, tokens):
+        if accum_steps == 1:
+            return jax.value_and_grad(transformer.loss_fn)(
+                params, cfg, tokens, attn_fn=attn_fn,
+                activation_spec=activation_spec)
+        batch = tokens.shape[0]
+        if batch % accum_steps:
+            raise ValueError(f"batch {batch} not divisible by "
+                             f"accum_steps {accum_steps}")
+        micro = tokens.reshape(accum_steps, batch // accum_steps,
+                               *tokens.shape[1:])
+
+        def body(carry, micro_tokens):
+            loss_sum, grad_sum = carry
+            loss, grads = jax.value_and_grad(transformer.loss_fn)(
+                params, cfg, micro_tokens, attn_fn=attn_fn,
+                activation_spec=activation_spec)
+            return (loss_sum + loss,
+                    jax.tree.map(jnp.add, grad_sum, grads)), None
+
+        zeros = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.result_type(p)), params)
+        (loss_sum, grad_sum), _ = jax.lax.scan(
+            body, (jnp.zeros((), jnp.float32), zeros), micro)
+        scale = 1.0 / accum_steps
+        return loss_sum * scale, jax.tree.map(
+            lambda g: g * scale, grad_sum)
 
     def step(state: TrainState, tokens):
-        loss, grads = jax.value_and_grad(transformer.loss_fn)(
-            state.params, cfg, tokens, attn_fn=attn_fn,
-            activation_spec=activation_spec,
-        )
+        loss, grads = loss_and_grads(state.params, tokens)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         gnorm = optax.global_norm(grads)
