@@ -30,23 +30,31 @@ from tpu_task.ml.models.transformer import (
 
 
 def init_cache(cfg: TransformerConfig, batch: int, max_len: int) -> List[dict]:
-    """Per-layer k/v caches of static shape (batch, max_len, heads, d_head)."""
-    shape = (batch, max_len, cfg.n_heads, cfg.d_head)
+    """Per-layer k/v caches of static shape (batch, max_len, KV heads,
+    d_head) — under grouped-query attention the cache shrinks by the group
+    factor, which is the point of GQA at decode time."""
+    shape = (batch, max_len, cfg.kv_heads, cfg.d_head)
     return [{"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
             for _ in range(cfg.n_layers)]
 
 
 def _cached_attention(q, k_cache, v_cache, q_positions):
-    """q: (b, s, h, d) at absolute ``q_positions``; caches: (b, L, h, d)
-    where every slot j holds the token at position j (zeros beyond the
-    filled region, masked off by the position test j <= q_pos)."""
-    d = q.shape[-1]
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache) / (d ** 0.5)
+    """q: (b, s, h, d) at absolute ``q_positions``; caches stay at KV-head
+    width (b, L, kv, d) and the einsums group q heads over them directly —
+    expanding the cache to h per step would stream group-factor times the
+    bytes through the memory-bound decode loop, forfeiting GQA's win.
+    Slot j holds the token at position j (zeros beyond the filled region,
+    masked off by the position test j <= q_pos)."""
+    b, s, h, d = q.shape
+    kv = k_cache.shape[2]
+    qg = q.reshape(b, s, kv, h // kv, d)
+    scores = jnp.einsum("bskgd,blkd->bkgsl", qg, k_cache) / (d ** 0.5)
     slot = jnp.arange(k_cache.shape[1])
     mask = slot[None, :] <= q_positions[:, None]           # (s, L)
-    scores = jnp.where(mask[None, None], scores, NEG_INF)
+    scores = jnp.where(mask[None, None, None], scores, NEG_INF)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v_cache)
+    out = jnp.einsum("bkgsl,blkd->bskgd", probs.astype(q.dtype), v_cache)
+    return out.reshape(b, s, h, d)
 
 
 def _cached_block(x, layer, cfg: TransformerConfig, cache: dict,
@@ -58,6 +66,8 @@ def _cached_block(x, layer, cfg: TransformerConfig, cache: dict,
     updated: dict = {}
 
     def attn_fn(q, k, v):
+        # k/v arrive at KV-head width and the cache STAYS narrow end to
+        # end — _cached_attention groups query heads over the kv heads.
         updated["k"] = jax.lax.dynamic_update_slice(
             cache["k"], k, (0, positions[0], 0, 0))
         updated["v"] = jax.lax.dynamic_update_slice(

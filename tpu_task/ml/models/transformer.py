@@ -36,10 +36,28 @@ class TransformerConfig:
     d_ff: int = 1408
     rope_theta: float = 10000.0
     dtype: Any = jnp.bfloat16
+    # Grouped-query attention: k/v projections carry this many heads, each
+    # shared by n_heads/n_kv_heads query heads (None = MHA). Shrinks the
+    # KV cache — decoding's real memory bound — by the group factor.
+    n_kv_heads: Any = None
 
     @property
     def d_attn(self) -> int:
         return self.n_heads * self.d_head
+
+    @property
+    def kv_heads(self) -> int:
+        kv = self.n_heads if self.n_kv_heads is None else self.n_kv_heads
+        if kv < 1:
+            raise ValueError(f"n_kv_heads must be >= 1, got {kv}")
+        if self.n_heads % kv:
+            raise ValueError(f"n_heads {self.n_heads} not divisible by "
+                             f"n_kv_heads {kv}")
+        return kv
+
+    @property
+    def d_kv(self) -> int:
+        return self.kv_heads * self.d_head
 
 
 # -- init --------------------------------------------------------------------
@@ -61,8 +79,8 @@ def init(rng, cfg: TransformerConfig) -> Params:
         params["layers"].append({
             "attn_norm": jnp.ones((cfg.d_model,), jnp.float32),
             "wq": _dense(next(keys), (cfg.d_model, cfg.d_attn), scale),
-            "wk": _dense(next(keys), (cfg.d_model, cfg.d_attn), scale),
-            "wv": _dense(next(keys), (cfg.d_model, cfg.d_attn), scale),
+            "wk": _dense(next(keys), (cfg.d_model, cfg.d_kv), scale),
+            "wv": _dense(next(keys), (cfg.d_model, cfg.d_kv), scale),
             "wo": _dense(next(keys), (cfg.d_attn, cfg.d_model), scale),
             "mlp_norm": jnp.ones((cfg.d_model,), jnp.float32),
             "w_gate": _dense(next(keys), (cfg.d_model, cfg.d_ff), scale),
@@ -202,6 +220,13 @@ def _rope(x, theta: float, positions=None):
     return out.astype(x.dtype)
 
 
+def expand_kv(kv, n_heads: int):
+    """(b, s, kv_heads, d) → (b, s, n_heads, d): repeat each kv head over
+    its query group (identity for MHA — XLA folds the no-op repeat)."""
+    group = n_heads // kv.shape[2]
+    return kv if group == 1 else jnp.repeat(kv, group, axis=2)
+
+
 def _block(x, layer, cfg: TransformerConfig, attn_fn, positions=None):
     """One transformer block; ``positions`` feeds rope absolute offsets —
     the KV-cache decode path runs THIS function (with its own attn_fn
@@ -210,10 +235,12 @@ def _block(x, layer, cfg: TransformerConfig, attn_fn, positions=None):
     b, s, _ = x.shape
     h = _rmsnorm(x, layer["attn_norm"])
     q = (h @ layer["wq"].astype(cfg.dtype)).reshape(b, s, cfg.n_heads, cfg.d_head)
-    k = (h @ layer["wk"].astype(cfg.dtype)).reshape(b, s, cfg.n_heads, cfg.d_head)
-    v = (h @ layer["wv"].astype(cfg.dtype)).reshape(b, s, cfg.n_heads, cfg.d_head)
+    k = (h @ layer["wk"].astype(cfg.dtype)).reshape(b, s, cfg.kv_heads, cfg.d_head)
+    v = (h @ layer["wv"].astype(cfg.dtype)).reshape(b, s, cfg.kv_heads, cfg.d_head)
     q = _rope(q, cfg.rope_theta, positions)
     k = _rope(k, cfg.rope_theta, positions)
+    # attn_fn receives k/v at KV-head width; grouped consumers (the KV
+    # cache) keep the narrow layout, everything else expands.
     attn = attn_fn(q, k, v)
     x = x + attn.reshape(b, s, cfg.d_attn) @ layer["wo"].astype(cfg.dtype)
 
@@ -242,7 +269,8 @@ def apply_features(params: Params, cfg: TransformerConfig, tokens,
     axis), so without the constraint XLA may replicate the activations and
     forfeit the memory win."""
     if attn_fn is None:
-        attn_fn = lambda q, k, v: dot_product_attention(q, k, v, True)
+        attn_fn = lambda q, k, v: dot_product_attention(
+            q, expand_kv(k, cfg.n_heads), expand_kv(v, cfg.n_heads), True)
     x = embed_lookup(params["embed"].astype(cfg.dtype), tokens)
     if activation_spec is not None:
         x = jax.lax.with_sharding_constraint(x, activation_spec)

@@ -190,12 +190,21 @@ def make_sp_train_step(cfg: transformer.TransformerConfig, mesh,
     # attention redundantly on every replica.
     batch_axes = logical_to_mesh_axes(("batch",), mesh=mesh)[0]
 
+    # GQA note: k/v widen to the query head count BEFORE crossing shards,
+    # so the ring/all_to_all traffic does not see GQA's narrow-kv saving;
+    # keeping the wire format narrow would need grouped-attention support
+    # inside the ring block primitives — a future optimization, traded
+    # here for exactness through the existing well-tested paths.
     if context_parallel == "zigzag":
         def attn(q, k, v):
+            k = transformer.expand_kv(k, cfg.n_heads)
+            v = transformer.expand_kv(v, cfg.n_heads)
             return zigzag_ring_attention(q, k, v, mesh, axis_name=axis_name,
                                          batch_axes=batch_axes)
     elif context_parallel == "ulysses":
         def attn(q, k, v):
+            k = transformer.expand_kv(k, cfg.n_heads)
+            v = transformer.expand_kv(v, cfg.n_heads)
             return ulysses_attention(q, k, v, mesh, axis_name=axis_name,
                                      batch_axes=batch_axes)
     else:
