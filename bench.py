@@ -136,7 +136,10 @@ def _train_flops_per_step(cfg, batch: int, seq: int) -> tuple:
     score entries, so the honest count scales attention by (s+1)/(2s); the
     PaLM-appendix-B convention credits the full s^2 for comparability with
     published MFU tables."""
-    n_mm_layer = 4 * cfg.d_model * cfg.d_attn + 3 * cfg.d_model * cfg.d_ff
+    # q+o at full head width, k+v at KV width (equal under MHA; narrower
+    # under grouped-query attention so GQA configs aren't over-credited).
+    n_mm_layer = (2 * cfg.d_model * cfg.d_attn + 2 * cfg.d_model * cfg.d_kv
+                  + 3 * cfg.d_model * cfg.d_ff)
     n_mm = cfg.n_layers * n_mm_layer + cfg.d_model * cfg.vocab_size  # + unembed
     tokens = batch * seq
     mm_fwd = 2.0 * tokens * n_mm

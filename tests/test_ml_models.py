@@ -406,3 +406,44 @@ def test_gqa_train_step_and_sp_step_run():
         transformer.TransformerConfig(n_heads=4, n_kv_heads=3).kv_heads
     with pytest.raises(ValueError, match="n_kv_heads"):
         transformer.TransformerConfig(n_heads=4, n_kv_heads=0).kv_heads
+
+
+def test_generate_eos_latches_per_row():
+    """Once a row emits eos_token, it keeps emitting it; other rows keep
+    generating — static shapes, per-row completion."""
+    from tpu_task.ml.models import decoding
+
+    params = transformer.init(jax.random.PRNGKey(0), TINY)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0,
+                                TINY.vocab_size)
+    plain = np.asarray(decoding.generate(params, TINY, prompt, 8))
+    # Use row 0's third greedy token as the EOS: everything after its first
+    # occurrence in row 0 must be EOS; row 1 (different tokens) unaffected
+    # until/unless it emits the same token.
+    eos = int(plain[0, 2])
+    out = np.asarray(decoding.generate(params, TINY, prompt, 8,
+                                       eos_token=eos))
+    first_hit = int(np.argmax(out[0] == eos))
+    assert out[0, first_hit] == eos
+    assert (out[0, first_hit:] == eos).all()
+    np.testing.assert_array_equal(out[0, :first_hit], plain[0, :first_hit])
+
+
+def test_generate_top_p_restricts_support():
+    """top_p sampling only ever emits tokens greedy-plausible under the
+    nucleus: with a tiny top_p it degenerates to greedy."""
+    from tpu_task.ml.models import decoding
+
+    params = transformer.init(jax.random.PRNGKey(0), TINY)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 5), 0,
+                                TINY.vocab_size)
+    greedy = np.asarray(decoding.generate(params, TINY, prompt, 6))
+    nucleus = np.asarray(decoding.generate(
+        params, TINY, prompt, 6, temperature=1.0, top_p=1e-6,
+        rng=jax.random.PRNGKey(3)))
+    np.testing.assert_array_equal(nucleus, greedy)  # nucleus of 1 = argmax
+    with pytest.raises(ValueError, match="top_p"):
+        decoding.generate(params, TINY, prompt, 2, temperature=1.0,
+                          top_p=1.5, rng=jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="top_p"):
+        decoding.generate(params, TINY, prompt, 2, top_p=0.5)

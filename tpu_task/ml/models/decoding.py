@@ -96,20 +96,44 @@ def forward_with_cache(params: Params, cfg: TransformerConfig, tokens,
     return logits.astype(jnp.float32), new_caches
 
 
+def _top_p_filter(logits, top_p: float):
+    """Nucleus filtering: keep the smallest probability mass >= top_p,
+    everything else to NEG_INF. Static shapes (sort + cumsum), jit-safe."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits.astype(jnp.float32), axis=-1)
+    cumulative = jnp.cumsum(probs, axis=-1)
+    # Keep every token whose PRECEDING mass is still under top_p — the
+    # first token crossing the threshold stays, and the argmax's preceding
+    # mass is 0, so at least one token always survives.
+    keep = (cumulative - probs) < top_p
+    threshold = jnp.min(
+        jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True)
+    return jnp.where(logits >= threshold, logits, NEG_INF)
+
+
 def generate(params: Params, cfg: TransformerConfig, prompt,
              max_new_tokens: int, *, temperature: float = 0.0,
+             top_p: Optional[float] = None,
+             eos_token: Optional[int] = None,
              rng: Optional[jax.Array] = None, max_len: Optional[int] = None):
     """Autoregressive generation. prompt: (batch, prompt_len) int32 →
     (batch, max_new_tokens) int32.
 
     ``temperature == 0`` is greedy (argmax); otherwise softmax sampling at
-    the given temperature (``rng`` required). One prefill pass over the
-    prompt, then a ``lax.scan`` of single-token steps against the KV cache
-    — the whole generation is one compiled program."""
+    the given temperature (``rng`` required), optionally nucleus-filtered
+    to the top ``top_p`` probability mass. ``eos_token``: once a row emits
+    it, the row keeps emitting it (static shapes — the scan always runs
+    max_new_tokens steps, finished rows just stop changing). One prefill
+    pass over the prompt, then a ``lax.scan`` of single-token steps against
+    the KV cache — the whole generation is one compiled program."""
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
     if temperature > 0 and rng is None:
         raise ValueError("sampling (temperature > 0) needs an rng key")
+    if top_p is not None and not 0 < top_p <= 1:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if top_p is not None and temperature == 0:
+        raise ValueError("top_p needs temperature > 0 (greedy ignores it)")
     batch, prompt_len = prompt.shape
     total = (prompt_len + max_new_tokens) if max_len is None else max_len
     if total < prompt_len + max_new_tokens:
@@ -122,24 +146,35 @@ def generate(params: Params, cfg: TransformerConfig, prompt,
     def pick(logits, key):
         if temperature == 0:
             return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
-        return jax.random.categorical(
-            key, logits / temperature, axis=-1).astype(prompt.dtype)
+        # Standard order: temper FIRST, then take the nucleus of the
+        # distribution actually being sampled — filtering untempered
+        # logits would truncate a flattened (t > 1) distribution far
+        # harder than top_p implies.
+        logits = logits / temperature
+        if top_p is not None:
+            logits = _top_p_filter(logits, top_p)
+        return jax.random.categorical(key, logits, axis=-1).astype(prompt.dtype)
 
     keys = (jax.random.split(rng, max_new_tokens) if rng is not None
             else jnp.zeros((max_new_tokens, 2), jnp.uint32))
     first = pick(logits, keys[0])
+    done0 = (jnp.zeros((batch,), bool) if eos_token is None
+             else first == eos_token)
 
     def step(carry, key):
-        token, caches, position = carry
+        token, caches, position, done = carry
         logits, caches = forward_with_cache(
             params, cfg, token[:, None], caches, position)
         nxt = pick(logits, key)
-        return (nxt, caches, position + 1), nxt
+        if eos_token is not None:
+            nxt = jnp.where(done, jnp.asarray(eos_token, nxt.dtype), nxt)
+            done = done | (nxt == eos_token)
+        return (nxt, caches, position + 1, done), nxt
 
     # The prefill already produced token 0; scan the remaining n-1 decode
     # steps and emit each step's OWN token — an emit-the-carry shape would
     # pay one whole discarded forward pass per call.
-    (_, _, _), rest = jax.lax.scan(
-        step, (first, caches, jnp.int32(prompt_len)), keys[1:])
+    (_, _, _, _), rest = jax.lax.scan(
+        step, (first, caches, jnp.int32(prompt_len), done0), keys[1:])
     return jnp.concatenate(
         [first[:, None], jnp.moveaxis(rest, 0, 1)], axis=1)
