@@ -31,3 +31,31 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # latency that poll-based tests cannot afford; probe every read in tests.
 os.environ.setdefault("TPU_TASK_SHUTDOWN_PROBE_PERIOD", "0")
 os.environ.setdefault("TPU_TASK_EVENTS_PROBE_PERIOD", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def agent_subprocess_serial():
+    """CROSS-PROCESS exclusive lock for agent-subprocess lifecycle tests.
+
+    These tests spawn real worker subprocesses with wall-clock sync loops;
+    two pytest processes running them concurrently starve each other until
+    poll ceilings trip (r4: test_tpu_multihost_workers_all_run exceeded
+    180 s under a concurrent double-suite, passes alone in 5 s). A flock on
+    a shared temp file serializes across PROCESSES — raising ceilings again
+    would just move the cliff.
+    """
+    import fcntl
+    import tempfile
+
+    path = os.path.join(tempfile.gettempdir(), "tpu-task-agent-tests.lock")
+    handle = open(path, "a+")
+    try:
+        fcntl.flock(handle, fcntl.LOCK_EX)
+        yield
+    finally:
+        try:
+            fcntl.flock(handle, fcntl.LOCK_UN)
+        finally:
+            handle.close()

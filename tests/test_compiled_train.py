@@ -142,6 +142,63 @@ def test_compiled_moe_sharded_degenerate_matches_dense():
 
 
 @on_tpu
+def test_compiled_moe_flagship_step_matches_dense_dispatch():
+    """The INTEGRATED MoE flagship train step (make_moe_train_step: shard_map
+    + all_to_all dispatch inside the real loss) compiled on the chip as a
+    degenerate dp=1×ep=1 mesh vs the dense-dispatch step — loss must agree;
+    multi-shard numerics are pinned on the virtual CPU mesh."""
+    from tpu_task.ml import train
+    from tpu_task.ml.models import transformer
+    from tpu_task.ml.parallel import mesh as meshlib
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=512, d_model=128, n_layers=2, n_heads=4, d_head=32,
+        d_ff=256, dtype=jnp.bfloat16, moe_every=2, n_experts=4,
+        moe_capacity_factor=8.0)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 129), 0,
+                                cfg.vocab_size)
+
+    ref_state = train.init_state(jax.random.PRNGKey(0), cfg)
+    ref_step = train.make_train_step(cfg, donate=False)
+    _, ref_metrics = ref_step(ref_state, tokens)
+
+    mesh = meshlib.make_mesh(1, axis_names=("dp", "ep"), axis_sizes=(1, 1))
+    state = train.init_state(jax.random.PRNGKey(0), cfg)
+    state, _ = train.shard_state(state, cfg, mesh)
+    step = train.make_moe_train_step(cfg, mesh, donate=False)(state)
+    _, metrics = step(state, tokens)
+    _close(float(metrics["loss"]), float(ref_metrics["loss"]), rel=0.01)
+
+
+@on_tpu
+def test_compiled_pp_flagship_step_matches_sequential():
+    """The INTEGRATED pipeline flagship train step (1F1B shard_map schedule
+    over the real layers, embed-gradient via dx, head loss per microbatch)
+    compiled on the chip as a degenerate pp=1 mesh vs the sequential step."""
+    from tpu_task.ml import train
+    from tpu_task.ml.models import transformer
+    from tpu_task.ml.parallel import mesh as meshlib
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=512, d_model=128, n_layers=2, n_heads=4, d_head=32,
+        d_ff=256, dtype=jnp.bfloat16)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 129), 0,
+                                cfg.vocab_size)
+
+    ref_state = train.init_state(jax.random.PRNGKey(0), cfg)
+    ref_step = train.make_train_step(cfg, donate=False)
+    _, ref_metrics = ref_step(ref_state, tokens)
+
+    mesh = meshlib.make_mesh(1, axis_names=("pp",), axis_sizes=(1,))
+    state = train.init_pp_state(jax.random.PRNGKey(0), cfg, 1)
+    state, _ = train.shard_pp_state(state, mesh)
+    step = train.make_pp_train_step(cfg, mesh, n_microbatches=2,
+                                    donate=False)(state)
+    _, metrics = step(state, tokens)
+    _close(float(metrics["loss"]), float(ref_metrics["loss"]), rel=0.01)
+
+
+@on_tpu
 def test_compiled_generate_on_chip():
     """KV-cache generation (prefill + scan of cached single-token steps)
     compiled at bf16: runs, stays in-vocab, and greedy is deterministic."""

@@ -479,3 +479,133 @@ def test_gce_bare_read_recovers_remote_over_http(gce_task):
     assert remote.startswith(":googlecloudstorage")
     assert "service_account_credentials" in remote  # re-injected locally
     assert remote.endswith(f":{task.identifier.long()}")
+
+
+# -- CLI end-to-end over the loopback control plane ----------------------------
+
+
+def test_cli_lifecycle_over_loopback_tpu(tmp_path, monkeypatch, capsys):
+    """The closest real-cloud rehearsal this environment permits: drive
+    `create → read --follow → delete` through cli/main.py AS A USER WOULD —
+    flag bridge → TaskSpec → TPUTask → RestTpuClient → real HTTP against
+    LoopbackTpu → bucket mailbox → status folding → follow exit code. The
+    worker's side (logs, status JSON, self-destruct `stop`) is simulated
+    exactly as machine-script semantics define it (tpl:51 status report,
+    tpl:14 self-stop). Data plane: local-directory bucket root (the role
+    rclone's local backend plays in the reference's tests)."""
+    from tpu_task.backends.tpu import api as tpu_api
+    from tpu_task.cli.main import main as cli_main
+
+    bucket_root = tmp_path / "buckets"
+    bucket_root.mkdir()
+    workdir = tmp_path / "work"
+    workdir.mkdir()
+    (workdir / "input.txt").write_text("payload")
+    monkeypatch.setenv("TPU_TASK_LOCAL_BUCKET_ROOT", str(bucket_root))
+    monkeypatch.setenv("GOOGLE_APPLICATION_CREDENTIALS_DATA",
+                       json.dumps({"project_id": "proj"}))
+    monkeypatch.delenv("TPU_TASK_FAKE_TPU_ROOT", raising=False)
+    # Wheel staging is covered by its own tests; a cold `pip wheel` build
+    # here would only slow the lifecycle under test.
+    monkeypatch.setattr("tpu_task.machine.wheel.ensure_wheel", lambda: None)
+    monkeypatch.setattr("time.sleep", lambda _s: None)  # LRO + follow pacing
+
+    with LoopbackTpu() as server:
+        original_init = tpu_api.RestTpuClient.__init__
+
+        def attached_init(self, *args, **kwargs):
+            original_init(self, *args, **kwargs)
+            server.attach(self)
+
+        monkeypatch.setattr(tpu_api.RestTpuClient, "__init__", attached_init)
+
+        # -- create -----------------------------------------------------------
+        rc = cli_main([
+            "--cloud", "tpu", "--region", "us-central2",
+            "create", "--name", "cli-e2e", "--machine", "v4-8",
+            "--workdir", str(workdir), "--output", "results",
+            "--script", "#!/bin/bash\necho hello-from-worker\n",
+        ])
+        assert rc == 0
+        identifier = capsys.readouterr().out.strip().splitlines()[-1]
+        assert identifier.startswith("tpi-cli-e2e-")
+
+        qr_name = f"{identifier}-0"
+        assert server.qrs[qr_name]["state"] == "ACTIVE"
+        bucket = bucket_root / identifier
+        assert (bucket / "data" / "input.txt").read_text() == "payload"
+
+        # -- the worker's side, per machine-script semantics ------------------
+        reports = bucket / "reports"
+        reports.mkdir(exist_ok=True)
+        (reports / "task-w0").write_text(
+            "2026-07-30T12:00:00+00:00 hello-from-worker\n")
+        (reports / "status-w0").write_text(
+            '{"result": "exit-code", "code": "0", "status": "0"}')
+        (bucket / "data" / "results").mkdir()
+        (bucket / "data" / "results" / "out.txt").write_text("answer")
+        # ExecStopPost self-destruct: the worker calls `stop` on itself.
+        rc = cli_main(["--cloud", "tpu", "--region", "us-central2",
+                       "stop", identifier])
+        assert rc == 0
+        assert qr_name not in server.qrs
+
+        # -- read --follow: logs stream, terminal status maps to exit 0 -------
+        rc = cli_main(["--cloud", "tpu", "--region", "us-central2",
+                       "read", "--follow", identifier])
+        assert rc == 0
+        assert "hello-from-worker" in capsys.readouterr().out
+
+        # -- delete: outputs pulled, bucket emptied ---------------------------
+        rc = cli_main(["--cloud", "tpu", "--region", "us-central2",
+                       "delete", "--workdir", str(workdir),
+                       "--output", "results", identifier])
+        assert rc == 0
+        assert (workdir / "results" / "out.txt").read_text() == "answer"
+        assert list(bucket.rglob("*")) in ([], [bucket / "data"]) or \
+            not any(p.is_file() for p in bucket.rglob("*"))
+
+
+def test_cli_follow_exit_1_on_failure_over_loopback(tmp_path, monkeypatch,
+                                                    capsys):
+    """A worker reporting a nonzero exit folds to `failed` and read --follow
+    exits 1 — the reference's read.go:105-124 exit-code contract."""
+    from tpu_task.backends.tpu import api as tpu_api
+    from tpu_task.cli.main import main as cli_main
+
+    bucket_root = tmp_path / "buckets"
+    bucket_root.mkdir()
+    monkeypatch.setenv("TPU_TASK_LOCAL_BUCKET_ROOT", str(bucket_root))
+    monkeypatch.setenv("GOOGLE_APPLICATION_CREDENTIALS_DATA",
+                       json.dumps({"project_id": "proj"}))
+    monkeypatch.delenv("TPU_TASK_FAKE_TPU_ROOT", raising=False)
+    monkeypatch.setattr("tpu_task.machine.wheel.ensure_wheel", lambda: None)
+    monkeypatch.setattr("time.sleep", lambda _s: None)
+
+    with LoopbackTpu() as server:
+        original_init = tpu_api.RestTpuClient.__init__
+
+        def attached_init(self, *args, **kwargs):
+            original_init(self, *args, **kwargs)
+            server.attach(self)
+
+        monkeypatch.setattr(tpu_api.RestTpuClient, "__init__", attached_init)
+
+        rc = cli_main(["--cloud", "tpu", "--region", "us-central2",
+                       "create", "--name", "cli-fail", "--machine", "v4-8",
+                       "--workdir", "", "--script", "#!/bin/bash\nexit 3\n"])
+        assert rc == 0
+        identifier = capsys.readouterr().out.strip().splitlines()[-1]
+
+        bucket = bucket_root / identifier
+        (bucket / "reports").mkdir(parents=True, exist_ok=True)
+        (bucket / "reports" / "status-w0").write_text(
+            '{"result": "exit-code", "code": "3", "status": "3"}')
+        cli_main(["--cloud", "tpu", "--region", "us-central2",
+                  "stop", identifier])
+
+        rc = cli_main(["--cloud", "tpu", "--region", "us-central2",
+                       "read", "--follow", identifier])
+        assert rc == 1
+        cli_main(["--cloud", "tpu", "--region", "us-central2",
+                  "delete", identifier])

@@ -158,6 +158,105 @@ def test_pipeline_gradients_flow():
         assert float(jnp.abs(leaf).sum()) > 0
 
 
+# --- MoE flagship integration ------------------------------------------------
+
+def _moe_flagship_cfg():
+    from tpu_task.ml.models import transformer
+
+    return transformer.TransformerConfig(
+        vocab_size=64, d_model=16, n_layers=2, n_heads=2, d_head=8,
+        d_ff=32, dtype=jnp.float32, moe_every=2, n_experts=4,
+        # Capacity == local tokens: nothing drops, so expert-parallel
+        # dispatch must equal the dense reference exactly.
+        moe_capacity_factor=float(4))
+
+
+def test_moe_config_layers_and_init():
+    from tpu_task.ml.models import transformer
+
+    cfg = _moe_flagship_cfg()
+    assert [cfg.is_moe_layer(i) for i in range(2)] == [False, True]
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    assert "w_gate" in params["layers"][0] and "router" in params["layers"][1]
+    assert params["layers"][1]["w_in"].shape == (4, 16, 32)
+    # Dense layers init bit-identically to the all-dense config.
+    dense_cfg = transformer.TransformerConfig(
+        vocab_size=64, d_model=16, n_layers=2, n_heads=2, d_head=8,
+        d_ff=32, dtype=jnp.float32)
+    dense_params = transformer.init(jax.random.PRNGKey(0), dense_cfg)
+    np.testing.assert_array_equal(np.asarray(params["layers"][0]["wq"]),
+                                  np.asarray(dense_params["layers"][0]["wq"]))
+
+
+def test_moe_flagship_loss_includes_aux():
+    from tpu_task.ml.models import transformer
+
+    cfg = _moe_flagship_cfg()
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 9), 0, 64)
+    loss = transformer.loss_fn(params, cfg, tokens)
+    _, aux = transformer.apply_features_with_aux(params, cfg, tokens[:, :-1])
+    assert float(aux) > 0
+    no_aux_cfg = type(cfg)(**{**cfg.__dict__, "moe_aux_weight": 0.0})
+    loss_no_aux = transformer.loss_fn(params, no_aux_cfg, tokens)
+    np.testing.assert_allclose(
+        float(loss), float(loss_no_aux) + cfg.moe_aux_weight * float(aux),
+        rtol=1e-6)
+
+
+def test_moe_flagship_train_step_matches_dense_dispatch():
+    """The REAL integration pin: make_moe_train_step (ep-sharded all_to_all
+    dispatch inside the flagship train step, dp×ep mesh) produces the same
+    loss and updated params as the single-device dense-dispatch step."""
+    from tpu_task.ml import train
+    from tpu_task.ml.parallel import mesh as meshlib
+
+    cfg = _moe_flagship_cfg()
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 9), 0, 64)
+
+    ref_state = train.init_state(jax.random.PRNGKey(0), cfg)
+    ref_step = train.make_train_step(cfg, donate=False)
+    ref_state, ref_metrics = ref_step(ref_state, tokens)
+
+    mesh = meshlib.make_mesh(8, axis_names=("dp", "ep"), axis_sizes=(2, 4))
+    state = train.init_state(jax.random.PRNGKey(0), cfg)
+    state, _ = train.shard_state(state, cfg, mesh)
+    step = train.make_moe_train_step(cfg, mesh, donate=False)(state)
+    state, metrics = step(state, tokens)
+
+    np.testing.assert_allclose(float(metrics["loss"]),
+                               float(ref_metrics["loss"]), atol=1e-5)
+    for a, b in zip(jax.tree.leaves(state.params),
+                    jax.tree.leaves(ref_state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_moe_flagship_router_receives_gradient():
+    """The aux loss + LM loss must reach the router through the sharded
+    dispatch — a stranded router would silently stop balancing."""
+    from tpu_task.ml import train
+    from tpu_task.ml.models import transformer
+
+    cfg = _moe_flagship_cfg()
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 9), 0, 64)
+    grads = jax.grad(transformer.loss_fn)(params, cfg, tokens)
+    router_grad = grads["layers"][1]["router"]
+    assert float(jnp.abs(router_grad).sum()) > 0
+    for name in ("w_in", "w_out"):
+        assert float(jnp.abs(grads["layers"][1][name]).sum()) > 0
+
+
+def test_moe_train_step_requires_ep_axis():
+    from tpu_task.ml import train
+    from tpu_task.ml.parallel import mesh as meshlib
+
+    cfg = _moe_flagship_cfg()
+    mesh = meshlib.make_mesh(8)  # dp × fsdp × tp, no ep
+    with pytest.raises(ValueError, match="ep"):
+        train.make_moe_train_step(cfg, mesh)
+
+
 # -- 1F1B training schedule ---------------------------------------------------
 
 
@@ -223,6 +322,66 @@ def test_1f1b_rejects_ragged_microbatches():
                        lambda o, t: jnp.mean((o - t) ** 2), mesh, 3)
 
 
+def test_pp_flagship_train_step_matches_sequential():
+    """The REAL integration pin: make_pp_train_step (1F1B over the actual
+    transformer layers, embed gradient via the pipeline dx, head = final
+    norm + unembed + fused xent) equals the plain single-device
+    make_train_step — same loss, same updated params after one step."""
+    from tpu_task.ml import train
+    from tpu_task.ml.models import transformer
+
+    n_stages, n_micro = 4, 4
+    cfg = transformer.TransformerConfig(
+        vocab_size=64, d_model=16, n_layers=4, n_heads=2, d_head=8,
+        d_ff=32, dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 9), 0, 64)
+
+    ref_state = train.init_state(jax.random.PRNGKey(0), cfg)
+    ref_step = train.make_train_step(cfg, donate=False)
+    ref_state, ref_metrics = ref_step(ref_state, tokens)
+
+    mesh = meshlib.make_mesh(n_stages, axis_names=("pp",),
+                             axis_sizes=(n_stages,))
+    state = train.init_pp_state(jax.random.PRNGKey(0), cfg, n_stages)
+    state, _ = train.shard_pp_state(state, mesh)
+    step = train.make_pp_train_step(cfg, mesh, n_micro, donate=False)(state)
+    state, metrics = step(state, tokens)
+
+    np.testing.assert_allclose(float(metrics["loss"]),
+                               float(ref_metrics["loss"]), atol=1e-5)
+    np.testing.assert_allclose(float(metrics["grad_norm"]),
+                               float(ref_metrics["grad_norm"]), atol=1e-4)
+    unstacked = train.pp_unstack_params(jax.device_get(state.params))
+    for a, b in zip(jax.tree.leaves(unstacked),
+                    jax.tree.leaves(ref_state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_pp_stack_unstack_roundtrip():
+    from tpu_task.ml import train
+    from tpu_task.ml.models import transformer
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=32, d_model=8, n_layers=4, n_heads=2, d_head=4,
+        d_ff=16, dtype=jnp.float32)
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    back = train.pp_unstack_params(train.pp_stack_params(params, 2))
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(back)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_pp_train_step_rejects_bad_split():
+    from tpu_task.ml import train
+    from tpu_task.ml.models import transformer
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=32, d_model=8, n_layers=3, n_heads=2, d_head=4,
+        d_ff=16, dtype=jnp.float32)
+    mesh = meshlib.make_mesh(4, axis_names=("pp",), axis_sizes=(4,))
+    with pytest.raises(ValueError, match="divisible"):
+        train.make_pp_train_step(cfg, mesh, 4)
+
+
 def test_moe_default_drop_policy_is_zero():
     """Default (external-residual wiring): dropped slots contribute exact
     zeros — switch semantics, no double-count under x + moe(x)."""
@@ -264,8 +423,9 @@ def test_1f1b_trains_transformer_stages():
     from tpu_task.ml.ops.attention import mha_reference
 
     def stage_fn(layer, h):
-        return transformer._block(h, layer, cfg,
-                                  lambda q, k, v: mha_reference(q, k, v, True))
+        out, _aux = transformer._block(
+            h, layer, cfg, lambda q, k, v: mha_reference(q, k, v, True))
+        return out
 
     def loss_fn(out, tgt):
         return jnp.mean((out.astype(jnp.float32) - tgt) ** 2)

@@ -526,3 +526,173 @@ def test_sp_train_step_ulysses_matches_replicated_step():
     for a, b in zip(jax.tree.leaves(sp_state.params),
                     jax.tree.leaves(plain_state.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+# -- GQA across the sequence-parallel boundary (narrow-KV wire format) --------
+
+
+def _gqa_cfg():
+    from tpu_task.ml.models import transformer
+
+    return transformer.TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, d_head=8, d_ff=64,
+        dtype=jnp.float32, n_kv_heads=2)
+
+
+def test_zigzag_ring_narrow_kv_matches_dense():
+    """Narrow k/v into the ring == dense attention on pre-expanded k/v:
+    the expansion moved inside the shard, the math did not."""
+    from tpu_task.ml.models.transformer import expand_kv
+    from tpu_task.ml.parallel.ring_attention import zigzag_ring_attention
+
+    mesh = meshlib.make_mesh(4, axis_names=("sp",), axis_sizes=(4,))
+    b, s, h, kv, d = 2, 32, 4, 2, 16
+    ks = jax.random.split(jax.random.PRNGKey(11), 3)
+    q = jax.random.normal(ks[0], (b, s, h, d))
+    k = jax.random.normal(ks[1], (b, s, kv, d))
+    v = jax.random.normal(ks[2], (b, s, kv, d))
+    out = zigzag_ring_attention(q, k, v, mesh)
+    ref = mha_reference(q, expand_kv(k, h), expand_kv(v, h), True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_zigzag_ring_narrow_kv_gradients_match_dense():
+    from tpu_task.ml.models.transformer import expand_kv
+    from tpu_task.ml.parallel.ring_attention import zigzag_ring_attention
+
+    mesh = meshlib.make_mesh(4, axis_names=("sp",), axis_sizes=(4,))
+    b, s, h, kv, d = 1, 16, 4, 2, 8
+    ks = jax.random.split(jax.random.PRNGKey(12), 3)
+    q = jax.random.normal(ks[0], (b, s, h, d))
+    k = jax.random.normal(ks[1], (b, s, kv, d))
+    v = jax.random.normal(ks[2], (b, s, kv, d))
+
+    def f_ref(q, k, v):
+        return (mha_reference(q, expand_kv(k, h), expand_kv(v, h),
+                              True) ** 2).sum()
+
+    def f_ring(q, k, v):
+        return (zigzag_ring_attention(q, k, v, mesh) ** 2).sum()
+
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    g_ring = jax.grad(f_ring, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_ring, g_ref):
+        assert a.shape == b_.shape  # dk/dv at NARROW width both sides
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=1e-4)
+
+
+def test_sp_gqa_zigzag_step_matches_replicated():
+    """sp-GQA pin: the zigzag sp train step with narrow-KV wire format
+    still equals the replicated GQA step exactly."""
+    from tpu_task.ml import train
+
+    cfg = _gqa_cfg()
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0,
+                                cfg.vocab_size)
+    plain_state = train.init_state(jax.random.PRNGKey(0), cfg)
+    plain_state, plain_metrics = train.make_train_step(
+        cfg, donate=False)(plain_state, tokens)
+
+    mesh = meshlib.make_mesh(4, axis_names=("sp",), axis_sizes=(4,))
+    sp_state = train.init_state(jax.random.PRNGKey(0), cfg)
+    sp_state, _ = train.shard_state(sp_state, cfg, mesh)
+    sp_step = train.make_sp_train_step(cfg, mesh, donate=False)(sp_state)
+    sp_state, sp_metrics = sp_step(sp_state, tokens)
+
+    assert abs(float(sp_metrics["loss"]) - float(plain_metrics["loss"])) < 1e-5
+    for a, b in zip(jax.tree.leaves(sp_state.params),
+                    jax.tree.leaves(plain_state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_sp_gqa_ulysses_step_matches_replicated():
+    """Ulysses with kv_heads % sp == 0: narrow a2a path, exact equality.
+    n_kv_heads=2 over sp=2."""
+    from tpu_task.ml import train
+    from tpu_task.ml.models import transformer
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, d_head=8, d_ff=64,
+        dtype=jnp.float32, n_kv_heads=2)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0,
+                                cfg.vocab_size)
+    plain_state = train.init_state(jax.random.PRNGKey(0), cfg)
+    plain_state, plain_metrics = train.make_train_step(
+        cfg, donate=False)(plain_state, tokens)
+
+    mesh = meshlib.make_mesh(2, axis_names=("sp",), axis_sizes=(2,))
+    sp_state = train.init_state(jax.random.PRNGKey(0), cfg)
+    sp_state, _ = train.shard_state(sp_state, cfg, mesh)
+    sp_step = train.make_sp_train_step(
+        cfg, mesh, donate=False, context_parallel="ulysses")(sp_state)
+    sp_state, sp_metrics = sp_step(sp_state, tokens)
+
+    assert abs(float(sp_metrics["loss"]) - float(plain_metrics["loss"])) < 1e-5
+    for a, b in zip(jax.tree.leaves(sp_state.params),
+                    jax.tree.leaves(plain_state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_ulysses_gqa_widen_fallback_exact():
+    """kv_heads % sp != 0 (2 kv heads over sp=4): Ulysses widens before the
+    shard — collective saving forfeited, exactness kept."""
+    from tpu_task.ml.models.transformer import expand_kv
+    from tpu_task.ml.parallel.ulysses import ulysses_attention
+
+    mesh = meshlib.make_mesh(4, axis_names=("sp",), axis_sizes=(4,))
+    b, s, h, kv, d = 2, 32, 4, 2, 16
+    ks = jax.random.split(jax.random.PRNGKey(13), 3)
+    q = jax.random.normal(ks[0], (b, s, h, d))
+    k = jax.random.normal(ks[1], (b, s, kv, d))
+    v = jax.random.normal(ks[2], (b, s, kv, d))
+    out = ulysses_attention(q, k, v, mesh)
+    ref = mha_reference(q, expand_kv(k, h), expand_kv(v, h), True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def _collective_permute_bytes(hlo_text: str) -> int:
+    """Total bytes moved by collective-permute ops in compiled HLO."""
+    import re
+
+    sizes = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4, "f64": 8}
+    total = 0
+    for match in re.finditer(
+            r"= \(?(\w+)\[([\d,]*)\][^)]*?\)? collective-permute", hlo_text):
+        dtype, dims = match.groups()
+        count = 1
+        for dim in filter(None, dims.split(",")):
+            count *= int(dim)
+        total += count * sizes.get(dtype, 4)
+    return total
+
+
+def test_sp_gqa_narrow_wire_reduces_collective_bytes():
+    """The measurable claim: with group factor 4 (n_kv_heads=1 vs MHA), the
+    compiled sp train step moves LESS collective-permute traffic — k/v and
+    dk/dv all circulate at KV width. Compares total collective-permute
+    bytes parsed from the compiled HLO of both steps."""
+    from tpu_task.ml import train
+    from tpu_task.ml.models import transformer
+
+    def step_bytes(n_kv_heads):
+        cfg = transformer.TransformerConfig(
+            vocab_size=64, d_model=32, n_layers=1, n_heads=4, d_head=8,
+            d_ff=64, dtype=jnp.float32, n_kv_heads=n_kv_heads)
+        mesh = meshlib.make_mesh(4, axis_names=("sp",), axis_sizes=(4,))
+        state = train.init_state(jax.random.PRNGKey(0), cfg)
+        state, _ = train.shard_state(state, cfg, mesh)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0,
+                                    cfg.vocab_size)
+        step = train.make_sp_train_step(cfg, mesh, donate=False)(state)
+        text = step.lower(state, tokens).compile().as_text()
+        return _collective_permute_bytes(text)
+
+    mha = step_bytes(None)
+    gqa = step_bytes(1)  # group factor 4
+    assert mha > 0 and gqa > 0
+    # k/v + dk/dv shrink 4x; other permuted tensors (dq handoffs in the
+    # 1F1B-style ring bookkeeping, activation reshards) don't, so the
+    # measured total lands near halved (observed 35864 vs 69656 bytes at
+    # this toy shape — 1.94x). Assert a solid reduction without pinning
+    # XLA fusion details.
+    assert gqa < 0.6 * mha, (gqa, mha)

@@ -136,6 +136,15 @@ class TPUTask(GcsRemoteMixin, Task):
             return recorded
         if fake_mode():
             return self._bucket_dir
+        local_root = os.environ.get("TPU_TASK_LOCAL_BUCKET_ROOT")
+        if local_root:
+            # Local-directory bucket root: the per-task "bucket" is a
+            # directory under it. The hermetic stand-in for the default
+            # per-task GCS bucket — lets the REAL control-plane path (REST
+            # client, loopback emulator, CLI) run end-to-end with a local
+            # data plane, the role rclone's local backend plays in the
+            # reference's tests (storage_test.go:54).
+            return os.path.join(local_root, self.identifier.long())
         config = {}
         if self.cloud.credentials.gcp and self.cloud.credentials.gcp.application_credentials:
             config["service_account_credentials"] = \
@@ -277,6 +286,10 @@ class TPUTask(GcsRemoteMixin, Task):
     def _create_bucket(self) -> None:
         if fake_mode():
             os.makedirs(self._bucket_dir, exist_ok=True)
+            return
+        remote = self._remote()
+        if not remote.startswith(":"):  # local-directory bucket root
+            os.makedirs(remote, exist_ok=True)
             return
         if self.spec.remote_storage is not None:
             # Pre-allocated container: verify access, create nothing

@@ -50,7 +50,10 @@ def build_cloud(args) -> Cloud:
             name, _, value = pair.partition("=")
             if name:
                 tags[name] = value
-    return Cloud(provider=Provider(args.cloud), region=args.region, tags=tags)
+    from tpu_task.common.cloud import Credentials
+
+    return Cloud(provider=Provider(args.cloud), region=args.region, tags=tags,
+                 credentials=Credentials.from_env())
 
 
 def build_spec(args, trailing) -> TaskSpec:

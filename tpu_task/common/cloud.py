@@ -106,6 +106,16 @@ class Credentials:
     az: Optional[AZCredentials] = None
     k8s: Optional[K8SCredentials] = None
 
+    @classmethod
+    def from_env(cls) -> "Credentials":
+        """Cloud credentials are env-vars only, by design — the front-ends
+        (CLI flag bridge, declarative apply) load them here; nothing ever
+        reads them from flags or config files
+        (/root/reference/task/common/cloud.go:38-57,
+        docs/guides/authentication.md:6-12)."""
+        return cls(aws=AWSCredentials.from_env(), gcp=GCPCredentials.from_env(),
+                   az=AZCredentials.from_env(), k8s=K8SCredentials.from_env())
+
 
 @dataclass
 class Cloud:

@@ -81,8 +81,11 @@ def build_cloud(defn: TaskDefinition) -> Cloud:
     cloud_name = defn.attrs.get("cloud")
     if not cloud_name:
         raise HclError(f"task {defn.name!r}: 'cloud' is required")
+    from tpu_task.common.cloud import Credentials
+
     return Cloud(provider=Provider(str(cloud_name)),
                  region=str(defn.attrs.get("region", "us-west")),
+                 credentials=Credentials.from_env(),
                  tags={str(k): str(v)
                        for k, v in (defn.attrs.get("tags") or {}).items()})
 

@@ -3,10 +3,11 @@
 Training recomputes attention over the full sequence every step; decoding
 must not — each new token attends cached k/v, so the per-token cost is
 O(seq) instead of O(seq²). TPU-first shape discipline: the cache is a
-fixed ``max_len`` ring of static shape, the decode loop is a ``lax.scan``
-(one compilation, no per-token retrace), and masking is positional
-arithmetic — no dynamic shapes anywhere, so XLA compiles one program for
-the whole generation.
+fixed-capacity ``max_len`` buffer of static shape (slot j = position j; NOT
+a ring — writes past capacity clamp, see :func:`forward_with_cache`), the
+decode loop is a ``lax.scan`` (one compilation, no per-token retrace), and
+masking is positional arithmetic — no dynamic shapes anywhere, so XLA
+compiles one program for the whole generation.
 
 The reference ships no model/inference code at all (SURVEY.md §2.9);
 this completes the task library's train → eval → generate triangle.
@@ -74,7 +75,9 @@ def _cached_block(x, layer, cfg: TransformerConfig, cache: dict,
             cache["v"], v, (0, positions[0], 0, 0))
         return _cached_attention(q, updated["k"], updated["v"], positions)
 
-    x = _block(x, layer, cfg, attn_fn, positions=positions)
+    # MoE layers decode through the dense dispatch (single-device exact
+    # path); the router aux loss is a training-only term — dropped here.
+    x, _aux = _block(x, layer, cfg, attn_fn, positions=positions)
     return x, updated
 
 
@@ -83,8 +86,21 @@ def forward_with_cache(params: Params, cfg: TransformerConfig, tokens,
     """Run ``tokens`` (batch, s) occupying absolute positions
     [start, start+s) through the model, filling the caches. Returns
     (last-position logits (batch, vocab) float32, updated caches).
-    ``start`` may be a traced scalar — shapes stay static."""
+    ``start`` may be a traced scalar — shapes stay static.
+
+    HARD CONTRACT: ``start + s`` must not exceed the cache's ``max_len``.
+    The buffer is positional, not a ring — ``dynamic_update_slice`` CLAMPS
+    writes at capacity, so streaming past it silently corrupts the tail
+    slots (rope positions keep advancing while writes stop moving).
+    :func:`generate` validates its own bounds; direct callers get a loud
+    error here when ``start`` is a concrete Python int, and must enforce
+    the bound themselves when it is traced."""
     s = tokens.shape[1]
+    max_len = caches[0]["k"].shape[1] if caches else 0
+    if isinstance(start, int) and start + s > max_len:
+        raise ValueError(
+            f"cache overflow: start {start} + tokens {s} > max_len "
+            f"{max_len} (the cache is a fixed buffer, not a ring)")
     positions = start + jnp.arange(s)
     x = embed_lookup(params["embed"].astype(cfg.dtype), tokens)
     new_caches = []

@@ -65,7 +65,12 @@ def param_logical_axes() -> Dict[str, Tuple]:
 
 
 def _route(x, router, cfg: MoEConfig, rng=None):
-    """Top-k routing: (expert_index, gate) of shape (tokens, k) + aux loss."""
+    """Top-k routing: (expert_index, gate) of shape (tokens, k) + the
+    per-expert load statistics (assigned fraction, mean router probability)
+    the aux loss is built from. The stats stay separate so the sharded path
+    can average them GLOBALLY before taking their product — the aux is
+    quadratic in the stats, and a mean of per-shard products would differ
+    from the dense reference."""
     logits = x @ router  # (tokens, n_experts)
     if cfg.router_noise > 0 and rng is not None:
         logits = logits + cfg.router_noise * jax.random.normal(
@@ -74,12 +79,16 @@ def _route(x, router, cfg: MoEConfig, rng=None):
     gate, expert_index = lax.top_k(probs, cfg.top_k)  # (tokens, k) each
     if cfg.top_k > 1:
         gate = gate / jnp.sum(gate, axis=-1, keepdims=True)
-    # Load-balancing aux loss over all k assignments (switch/GShard).
+    # Load-balancing statistics over all k assignments (switch/GShard).
     assigned = jnp.mean(
         jax.nn.one_hot(expert_index, cfg.n_experts).sum(axis=1), axis=0)
     density_proxy = jnp.mean(probs, axis=0)
-    aux_loss = cfg.n_experts * jnp.sum(assigned * density_proxy) / cfg.top_k
-    return expert_index, gate, aux_loss
+    return expert_index, gate, (assigned, density_proxy)
+
+
+def _aux_from_stats(stats, cfg: MoEConfig):
+    assigned, density_proxy = stats
+    return cfg.n_experts * jnp.sum(assigned * density_proxy) / cfg.top_k
 
 
 def apply_dense(params, cfg: MoEConfig, x, rng=None):
@@ -88,7 +97,8 @@ def apply_dense(params, cfg: MoEConfig, x, rng=None):
     capacity grows)."""
     b, s, d = x.shape
     tokens = x.reshape(b * s, d)
-    expert_index, gate, aux_loss = _route(tokens, params["router"], cfg, rng)
+    expert_index, gate, stats = _route(tokens, params["router"], cfg, rng)
+    aux_loss = _aux_from_stats(stats, cfg)
     # top_k experts per token are DISTINCT, so the k one-hots are disjoint:
     # one summed dispatch matrix feeds a single expert pass, and the
     # gate-weighted combine separates the slots again.
@@ -107,9 +117,16 @@ def apply_dense(params, cfg: MoEConfig, x, rng=None):
 
 
 def apply_sharded(params, cfg: MoEConfig, x, mesh, axis_name: str = "ep",
-                  rng=None):
+                  rng=None, batch_axes=None):
     """Expert-parallel forward: tokens sharded over ep, experts one group
-    each, all_to_all token exchange both ways."""
+    each, all_to_all token exchange both ways.
+
+    ``batch_axes``: mesh axes the token batch dim shards over (default:
+    just ``axis_name``). Pass e.g. ``("dp", "ep")`` to compose expert
+    parallelism with data parallelism in one mesh — the all_to_all stays
+    inside each dp group (experts replicate over dp, shard over ep)."""
+    if batch_axes is None:
+        batch_axes = (axis_name,)
     n_shards = mesh.shape[axis_name]
     if cfg.n_experts % n_shards:
         raise ValueError(f"n_experts {cfg.n_experts} not divisible by "
@@ -122,9 +139,13 @@ def apply_sharded(params, cfg: MoEConfig, x, mesh, axis_name: str = "ep",
         n_tokens = tokens.shape[0]
         # Decorrelate router jitter across shards: each shard's tokens are
         # distinct, so identical noise would defeat the jitter's purpose.
-        shard_rng = None if rng is None else jax.random.fold_in(
-            rng, lax.axis_index(axis_name))
-        expert_index, gate, aux_loss = _route(tokens, router, cfg, shard_rng)
+        # Fold in EVERY batch axis index — under dp×ep composition two
+        # shards with the same ep index still hold different tokens.
+        shard_rng = rng
+        if shard_rng is not None:
+            for ax in batch_axes:
+                shard_rng = jax.random.fold_in(shard_rng, lax.axis_index(ax))
+        expert_index, gate, stats = _route(tokens, router, cfg, shard_rng)
         capacity = max(1, int(cfg.capacity_factor * n_tokens * cfg.top_k
                               / cfg.n_experts))
 
@@ -170,10 +191,18 @@ def apply_sharded(params, cfg: MoEConfig, x, mesh, axis_name: str = "ep",
             (slot_out * flat_gate[:, None].astype(tokens.dtype)).reshape(
                 cfg.top_k, n_tokens, d),
             axis=0)
-        aux = lax.pmean(aux_loss, axis_name)
+        # Average the load STATISTICS over every token-sharding axis first,
+        # then take their product: equal-sized shards make the global means
+        # exact, so the aux equals the dense single-device one (a mean of
+        # per-shard aux products would not — the aux is quadratic in the
+        # stats). Also makes the scalar mesh-invariant (out_specs demands
+        # it).
+        for ax in dict.fromkeys((*batch_axes, axis_name)):
+            stats = jax.tree.map(lambda s: lax.pmean(s, ax), stats)
+        aux = _aux_from_stats(stats, cfg)
         return combined.reshape(b, s, d), aux
 
-    token_spec = PartitionSpec(axis_name, None, None)   # batch sharded on ep
+    token_spec = PartitionSpec(batch_axes, None, None)  # batch over dp×ep
     expert_spec = PartitionSpec(axis_name, None, None)  # experts sharded on ep
     fn = jax.shard_map(
         shard_fn,

@@ -40,10 +40,36 @@ class TransformerConfig:
     # shared by n_heads/n_kv_heads query heads (None = MHA). Shrinks the
     # KV cache — decoding's real memory bound — by the group factor.
     n_kv_heads: Any = None
+    # Mixture-of-experts: every ``moe_every``-th layer (layers moe_every-1,
+    # 2·moe_every-1, ...) replaces its dense FFN with an ``n_experts``-way
+    # MoE FFN (tpu_task.ml.models.moe), expert-sharded over an ``ep`` mesh
+    # axis when trained through make_moe_train_step. 0 = all-dense.
+    moe_every: int = 0
+    n_experts: int = 0
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
+    # Weight of the router load-balancing loss added to the LM loss.
+    moe_aux_weight: float = 0.01
 
     @property
     def d_attn(self) -> int:
         return self.n_heads * self.d_head
+
+    def is_moe_layer(self, index: int) -> bool:
+        if self.moe_every <= 0:
+            return False
+        if self.n_experts < 2:
+            raise ValueError(f"moe_every={self.moe_every} needs n_experts "
+                             f">= 2, got {self.n_experts}")
+        return (index + 1) % self.moe_every == 0
+
+    @property
+    def moe_cfg(self):
+        from tpu_task.ml.models.moe import MoEConfig
+
+        return MoEConfig(
+            d_model=self.d_model, d_ff=self.d_ff, n_experts=self.n_experts,
+            capacity_factor=self.moe_capacity_factor, top_k=self.moe_top_k)
 
     @property
     def kv_heads(self) -> int:
@@ -75,38 +101,61 @@ def init(rng, cfg: TransformerConfig) -> Params:
         "final_norm": jnp.ones((cfg.d_model,), jnp.float32),
         "layers": [],
     }
-    for _ in range(cfg.n_layers):
-        params["layers"].append({
+    for i in range(cfg.n_layers):
+        layer = {
             "attn_norm": jnp.ones((cfg.d_model,), jnp.float32),
             "wq": _dense(next(keys), (cfg.d_model, cfg.d_attn), scale),
             "wk": _dense(next(keys), (cfg.d_model, cfg.d_kv), scale),
             "wv": _dense(next(keys), (cfg.d_model, cfg.d_kv), scale),
             "wo": _dense(next(keys), (cfg.d_attn, cfg.d_model), scale),
             "mlp_norm": jnp.ones((cfg.d_model,), jnp.float32),
-            "w_gate": _dense(next(keys), (cfg.d_model, cfg.d_ff), scale),
-            "w_up": _dense(next(keys), (cfg.d_model, cfg.d_ff), scale),
-            "w_down": _dense(next(keys), (cfg.d_ff, cfg.d_model), cfg.d_ff ** -0.5),
-        })
+        }
+        if cfg.is_moe_layer(i):
+            # Same 3-key budget as the dense FFN, so dense layers init
+            # bit-identically whether or not other layers are MoE.
+            layer["router"] = _dense(
+                next(keys), (cfg.d_model, cfg.n_experts), scale)
+            layer["w_in"] = _dense(
+                next(keys), (cfg.n_experts, cfg.d_model, cfg.d_ff), scale)
+            layer["w_out"] = _dense(
+                next(keys), (cfg.n_experts, cfg.d_ff, cfg.d_model),
+                cfg.d_ff ** -0.5)
+        else:
+            layer["w_gate"] = _dense(next(keys), (cfg.d_model, cfg.d_ff), scale)
+            layer["w_up"] = _dense(next(keys), (cfg.d_model, cfg.d_ff), scale)
+            layer["w_down"] = _dense(
+                next(keys), (cfg.d_ff, cfg.d_model), cfg.d_ff ** -0.5)
+        params["layers"].append(layer)
     return params
 
 
 def param_logical_axes(cfg: TransformerConfig) -> Params:
-    layer = {
+    attn = {
         "attn_norm": ("norm",),
         "wq": ("embed", "heads"),
         "wk": ("embed", "heads"),
         "wv": ("embed", "heads"),
         "wo": ("heads", "embed"),
         "mlp_norm": ("norm",),
+    }
+    dense_ffn = {
         "w_gate": ("embed", "mlp"),
         "w_up": ("embed", "mlp"),
         "w_down": ("mlp", "embed"),
+    }
+    moe_ffn = {
+        "router": ("embed", None),
+        "w_in": ("expert", "embed", "mlp"),
+        "w_out": ("expert", "mlp", "embed"),
     }
     return {
         "embed": ("vocab", "embed"),
         "unembed": ("embed", "vocab"),
         "final_norm": ("norm",),
-        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+        "layers": [
+            {**attn, **(moe_ffn if cfg.is_moe_layer(i) else dense_ffn)}
+            for i in range(cfg.n_layers)
+        ],
     }
 
 
@@ -159,11 +208,21 @@ def _embed_bwd(res, g):
 
     def onehot_grad(toks, gs):
         onehot = jax.nn.one_hot(toks, vocab, dtype=gs.dtype)
-        # Accumulate in float32 at full precision — the scatter-add this
-        # replaces was exact, so the matmul must not truncate to bf16.
+        # The scatter-add this replaces was exact, so the matmul must not
+        # lose anything the scatter kept. With a bf16 cotangent DEFAULT
+        # precision already IS exact: one-hot entries are {0, 1}, so every
+        # product is the cotangent value itself, and accumulation runs in
+        # f32 via preferred_element_type — while HIGHEST would force the
+        # ~6x-slower f32 multi-pass path on a T×V×d-sized einsum (the
+        # single biggest avoidable cost of the long-context step). An f32
+        # cotangent (CPU tests, f32 configs) keeps HIGHEST: DEFAULT on f32
+        # inputs may use split-bf16 passes, which would truncate.
+        precision = (jax.lax.Precision.HIGHEST
+                     if gs.dtype == jnp.float32
+                     else jax.lax.Precision.DEFAULT)
         return jnp.einsum(
             "tv,td->vd", onehot, gs,
-            precision=jax.lax.Precision.HIGHEST,
+            precision=precision,
             preferred_element_type=jnp.float32,
         )
 
@@ -221,17 +280,36 @@ def _rope(x, theta: float, positions=None):
 
 
 def expand_kv(kv, n_heads: int):
-    """(b, s, kv_heads, d) → (b, s, n_heads, d): repeat each kv head over
-    its query group (identity for MHA — XLA folds the no-op repeat)."""
-    group = n_heads // kv.shape[2]
-    return kv if group == 1 else jnp.repeat(kv, group, axis=2)
+    """(b, s, kv_heads, d) → (b, s, n_heads, d); the shared GQA expansion
+    rule — see :func:`tpu_task.ml.ops.attention.expand_kv_heads`."""
+    from tpu_task.ml.ops.attention import expand_kv_heads
+
+    return expand_kv_heads(kv, n_heads)
 
 
-def _block(x, layer, cfg: TransformerConfig, attn_fn, positions=None):
-    """One transformer block; ``positions`` feeds rope absolute offsets —
-    the KV-cache decode path runs THIS function (with its own attn_fn
-    closing over the cache), so train and decode share every projection,
-    norm, and residual and cannot drift apart."""
+def default_moe_fn(cfg: TransformerConfig):
+    """Dense-dispatch MoE FFN (single-device exact reference): the layout
+    make_moe_train_step's expert-parallel all_to_all path is pinned against."""
+    from tpu_task.ml.models import moe
+
+    mcfg = cfg.moe_cfg
+
+    def fn(layer, h):
+        return moe.apply_dense(layer, mcfg, h)
+
+    return fn
+
+
+def _block(x, layer, cfg: TransformerConfig, attn_fn, positions=None,
+           moe_fn=None):
+    """One transformer block → (x, aux_loss); ``positions`` feeds rope
+    absolute offsets — the KV-cache decode path runs THIS function (with
+    its own attn_fn closing over the cache), so train and decode share
+    every projection, norm, and residual and cannot drift apart.
+
+    ``aux_loss`` is the router load-balancing loss for MoE layers (an f32
+    zero for dense layers); ``moe_fn(layer, h) -> (ffn_out, aux)`` lets the
+    train step swap the dense dispatch for the ep-sharded all_to_all one."""
     b, s, _ = x.shape
     h = _rmsnorm(x, layer["attn_norm"])
     q = (h @ layer["wq"].astype(cfg.dtype)).reshape(b, s, cfg.n_heads, cfg.d_head)
@@ -245,10 +323,15 @@ def _block(x, layer, cfg: TransformerConfig, attn_fn, positions=None):
     x = x + attn.reshape(b, s, cfg.d_attn) @ layer["wo"].astype(cfg.dtype)
 
     h = _rmsnorm(x, layer["mlp_norm"])
+    if "router" in layer:
+        if moe_fn is None:
+            moe_fn = default_moe_fn(cfg)
+        out, aux = moe_fn(layer, h)
+        return x + out.astype(x.dtype), aux.astype(jnp.float32)
     gate = jax.nn.silu(h @ layer["w_gate"].astype(cfg.dtype))
     up = h @ layer["w_up"].astype(cfg.dtype)
     x = x + (gate * up) @ layer["w_down"].astype(cfg.dtype)
-    return x
+    return x, jnp.zeros((), jnp.float32)
 
 
 def apply(params: Params, cfg: TransformerConfig, tokens, attn_fn=None):
@@ -258,9 +341,20 @@ def apply(params: Params, cfg: TransformerConfig, tokens, attn_fn=None):
 
 
 def apply_features(params: Params, cfg: TransformerConfig, tokens,
-                   attn_fn=None, activation_spec=None):
-    """tokens (batch, seq) → final-layer features (batch, seq, d_model),
-    BEFORE the unembed projection (the fused loss consumes these).
+                   attn_fn=None, activation_spec=None, moe_fn=None):
+    """tokens (batch, seq) → final-layer features; see
+    :func:`apply_features_with_aux` (this drops the MoE aux loss)."""
+    return apply_features_with_aux(
+        params, cfg, tokens, attn_fn=attn_fn,
+        activation_spec=activation_spec, moe_fn=moe_fn)[0]
+
+
+def apply_features_with_aux(params: Params, cfg: TransformerConfig, tokens,
+                            attn_fn=None, activation_spec=None, moe_fn=None):
+    """tokens (batch, seq) → (final-layer features (batch, seq, d_model),
+    mean MoE aux loss). Features are BEFORE the unembed projection (the
+    fused loss consumes them); the aux mean runs over MoE layers only
+    (an f32 zero for all-dense configs).
 
     ``activation_spec``: optional sharding (e.g. a NamedSharding putting
     seq on the ``sp`` axis) pinned onto the activations right after the
@@ -274,9 +368,14 @@ def apply_features(params: Params, cfg: TransformerConfig, tokens,
     x = embed_lookup(params["embed"].astype(cfg.dtype), tokens)
     if activation_spec is not None:
         x = jax.lax.with_sharding_constraint(x, activation_spec)
-    for layer in params["layers"]:
-        x = _block(x, layer, cfg, attn_fn)
-    return _rmsnorm(x, params["final_norm"])
+    aux_sum = jnp.zeros((), jnp.float32)
+    n_moe = 0
+    for i, layer in enumerate(params["layers"]):
+        x, aux = _block(x, layer, cfg, attn_fn, moe_fn=moe_fn)
+        if "router" in layer:
+            aux_sum = aux_sum + aux
+            n_moe += 1
+    return _rmsnorm(x, params["final_norm"]), aux_sum / max(1, n_moe)
 
 
 # Vocab-block floor for the fused cross-entropy: each scan step holds one
@@ -300,6 +399,21 @@ def _auto_xent_block(n_tokens: int, vocab: int) -> int:
     return max(XENT_VOCAB_BLOCK, min(block, vocab_ceil))
 
 
+def _match_vma(init, *refs):
+    """Mark ``init`` (a pytree of fresh zeros) as device-varying over every
+    mesh axis the reference arrays vary on — scan carries built from
+    ``jnp.zeros`` inside ``shard_map`` (the pipeline head runs the fused
+    loss there) must match the body outputs' varying axes."""
+    vma = frozenset()
+    for r in refs:
+        vma = vma | getattr(jax.typeof(r), "vma", frozenset())
+    if not vma:
+        return init
+    from tpu_task.ml.parallel.mesh import pvary
+
+    return jax.tree.map(lambda x: pvary(x, tuple(vma)), init)
+
+
 def _pad_vocab(unembed, block):
     """Pad the vocab axis up to a block multiple (pad columns masked to
     -inf in the scan, so they never contribute)."""
@@ -318,7 +432,8 @@ def _masked_logits(features, u_block, start, block, vocab):
     return jnp.where(col_valid[None, :], z, -jnp.inf)
 
 
-def fused_xent(features, unembed, targets, block: Optional[int] = None):
+def fused_xent(features, unembed, targets, block: Optional[int] = None,
+               token_shards: int = 1):
     """Mean next-token cross-entropy WITHOUT materializing (tokens, vocab)
     logits beyond one tile: the unembed matmul, log-sum-exp, and target
     gather stream over vocab blocks (online logsumexp), and the backward
@@ -327,9 +442,17 @@ def fused_xent(features, unembed, targets, block: Optional[int] = None):
     multiple with masked columns). features: (T, d); unembed: (d, V);
     targets: (T,). ``block=None`` auto-sizes to the XENT_TILE_BYTES
     budget — whole-vocab single step at short context (fastest), bounded
-    tiles at long context (the memory win)."""
+    tiles at long context (the memory win).
+
+    ``token_shards``: how many ways the token dim is sharded under SPMD
+    (dp×fsdp×sp shard product) — trace-time shapes are GLOBAL, so without
+    it the auto block sizes against shard-factor more tokens than any
+    device holds and over-shrinks the tile (extra scan steps, results
+    unchanged). The train-step builders thread it from the mesh."""
     if block is None:
-        block = _auto_xent_block(features.shape[0], unembed.shape[1])
+        block = _auto_xent_block(
+            max(1, features.shape[0] // max(1, token_shards)),
+            unembed.shape[1])
     return _fused_xent(features, unembed, targets, block)
 
 
@@ -358,10 +481,12 @@ def _xent_forward(features, unembed, targets, block):
             t_logit)
         return (m_new, l, t_logit, start + block), None
 
-    init = (jnp.full((n_tokens,), -jnp.inf, jnp.float32),
-            jnp.zeros((n_tokens,), jnp.float32),
-            jnp.zeros((n_tokens,), jnp.float32),
-            jnp.int32(0))
+    init = _match_vma(
+        (jnp.full((n_tokens,), -jnp.inf, jnp.float32),
+         jnp.zeros((n_tokens,), jnp.float32),
+         jnp.zeros((n_tokens,), jnp.float32),
+         jnp.int32(0)),
+        features, unembed, targets)
     (m, l, target_logit, _), _ = jax.lax.scan(body, init, blocks)
     lse = m + jnp.log(l)
     return lse, target_logit
@@ -381,6 +506,17 @@ def _fused_xent_bwd(block, res, g):
         padded.shape[0], padded.shape[1] // block, block), 1, 0)
     scale = g / n_tokens
 
+    # Matmul operand dtype for the two (T, block) x (block|T, d) gradient
+    # contractions: on the bf16 train path the OPERANDS go bf16 (one MXU
+    # pass instead of the ~4x-slower f32 path — at seq 8k x vocab 32k these
+    # two matmuls alone are ~1.1e12 FLOPs/step) while ACCUMULATION stays
+    # f32 via preferred_element_type and the f32 carry below. ds entries
+    # are softmax probabilities minus a one-hot — bf16's 2^-8 relative
+    # rounding on them is far below the gradient noise the monolithic bf16
+    # forward already carries. f32 features (CPU tests, f32 configs) keep
+    # full f32 operands, so the hermetic exactness pins are untouched.
+    operand_dtype = features.dtype
+
     def body(carry, u_block):
         d_features, start = carry
         z = _masked_logits(features, u_block, start, block, vocab)
@@ -389,17 +525,19 @@ def _fused_xent_bwd(block, res, g):
         local = jnp.clip(targets - start, 0, block - 1)
         onehot = (jax.nn.one_hot(local, block, dtype=jnp.float32)
                   * in_block[:, None])
-        ds = (p - onehot) * scale  # (T, block) f32
+        ds = ((p - onehot) * scale).astype(operand_dtype)  # (T, block)
         # f32 accumulation throughout: a bf16 carry would drift over the
         # vocab/block partial sums (the monolithic path reduces in f32).
         d_features = d_features + jnp.dot(
-            ds, u_block.T.astype(jnp.float32),
+            ds, u_block.T.astype(operand_dtype),
             preferred_element_type=jnp.float32)
-        d_u_block = jnp.dot(features.T.astype(jnp.float32), ds,
+        d_u_block = jnp.dot(features.T.astype(operand_dtype), ds,
                             preferred_element_type=jnp.float32)
         return (d_features, start + block), d_u_block
 
-    init = (jnp.zeros(features.shape, jnp.float32), jnp.int32(0))
+    init = _match_vma(
+        (jnp.zeros(features.shape, jnp.float32), jnp.int32(0)),
+        features, unembed, targets, g)
     (d_features, _), d_u_blocks = jax.lax.scan(body, init, blocks)
     d_unembed = jnp.moveaxis(d_u_blocks, 0, 1).reshape(
         padded.shape)[:, :unembed.shape[1]]
@@ -411,8 +549,10 @@ _fused_xent.defvjp(_fused_xent_fwd, _fused_xent_bwd)
 
 
 def loss_fn(params: Params, cfg: TransformerConfig, tokens, attn_fn=None,
-            fused: bool = True, activation_spec=None):
-    """Next-token cross-entropy; tokens (batch, seq).
+            fused: bool = True, activation_spec=None, moe_fn=None,
+            token_shards: int = 1):
+    """Next-token cross-entropy (+ weighted MoE router aux loss when the
+    config has MoE layers); tokens (batch, seq).
 
     ``fused=True`` (default) streams the unembed+softmax over auto-sized
     vocab blocks: at short context the block covers the whole vocab — a
@@ -423,19 +563,23 @@ def loss_fn(params: Params, cfg: TransformerConfig, tokens, attn_fn=None,
     unfused). ``fused=False`` keeps the monolithic reference path the
     hermetic tests compare against."""
     if activation_spec is not None and not fused:
-        # apply() has no activation_spec path; silently dropping the
-        # constraint would replicate the residual stream over sp and OOM
-        # at exactly the lengths sequence parallelism exists to serve.
+        # The monolithic path would silently drop the constraint, replicate
+        # the residual stream over sp, and OOM at exactly the lengths
+        # sequence parallelism exists to serve.
         raise ValueError("activation_spec requires the fused loss path")
     targets = tokens[:, 1:]
+    features, aux = apply_features_with_aux(
+        params, cfg, tokens[:, :-1], attn_fn=attn_fn,
+        activation_spec=activation_spec, moe_fn=moe_fn)
+    b, s, d = features.shape
     if fused:
-        features = apply_features(params, cfg, tokens[:, :-1], attn_fn=attn_fn,
-                                  activation_spec=activation_spec)
-        b, s, d = features.shape
-        return fused_xent(features.reshape(b * s, d),
+        xent = fused_xent(features.reshape(b * s, d),
                           params["unembed"].astype(cfg.dtype),
-                          targets.reshape(-1))
-    logits = apply(params, cfg, tokens[:, :-1], attn_fn=attn_fn)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return nll.mean()
+                          targets.reshape(-1), token_shards=token_shards)
+    else:
+        logits = (features @ params["unembed"].astype(cfg.dtype)).astype(
+            jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        xent = -jnp.take_along_axis(
+            logp, targets[..., None], axis=-1)[..., 0].mean()
+    return xent + cfg.moe_aux_weight * aux

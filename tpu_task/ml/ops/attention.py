@@ -57,6 +57,25 @@ def _vma(*arrays):
     return out
 
 
+def expand_kv_heads(kv, n_heads: int):
+    """(b, s, kv_heads, d) → (b, s, n_heads, d): repeat each kv head over
+    its (contiguous) query group — grouped-query attention's single
+    expansion rule, shared by the model block, the ring schedules, and the
+    Ulysses reshard so the grouping semantics cannot drift apart. Identity
+    for MHA (XLA folds the no-op repeat)."""
+    group = n_heads // kv.shape[2]
+    return kv if group == 1 else jnp.repeat(kv, group, axis=2)
+
+
+def reduce_kv_heads(d_expanded, kv_heads: int):
+    """Transpose of :func:`expand_kv_heads`: sum the expanded-width
+    gradient over each query group back to kv_heads width."""
+    b, s, h, d = d_expanded.shape
+    if h == kv_heads:
+        return d_expanded
+    return d_expanded.reshape(b, s, kv_heads, h // kv_heads, d).sum(axis=3)
+
+
 def mha_reference(q, k, v, causal: bool = True):
     """Plain XLA attention — the numerical ground truth for the kernels."""
     *_, d = q.shape
@@ -531,20 +550,30 @@ def _pick_block(s: int, cap: int = 1024) -> int:
 
 
 def _pick_block_fwd_q(s: int) -> int:
-    """Pure-forward q-block: 2048 beats 1024 on v5e (1.73x vs 1.11x over
-    XLA at seq 2048, 2.19x vs 2.18x at 8192 — the no-lse forward holds few
-    enough VMEM tiles that the larger tile fits and amortizes the softmax
-    rescale passes)."""
+    """Pure-forward q-block: 2048 over 1024 on v5e — the no-lse forward
+    holds few enough VMEM tiles that the larger tile fits and amortizes
+    the softmax rescale passes. Block sweep at the bench shape (b=2 h=8
+    d=128 seq=2048, interleaved min-of-8 vs XLA, 2026-07-30): bq/bk
+    2048/whole-kv 2.55 ms, 1024/1024 3.65 ms, 2048/1024 3.48 ms,
+    1024/2048 2.53 ms — big tiles win even though whole-kv computes the
+    full causal rectangle."""
     return _pick_block(s, cap=2048)
 
 
 def _pick_block_fwd_k(sk: int, causal: bool) -> int:
     """Pure-forward k-block: single block when the whole kv sequence fits
-    one (<=2048: with bq=2048 that is 1.79x over XLA at seq 2048 — no grid
-    streaming, no rescale passes). Causal only: the non-causal kernel with
-    a 2048 k-tile exceeds the 16M scoped-vmem limit on v5e (Mosaic keeps
-    the full rectangle live without the diagonal gating), so it stays on
-    the 1024 cap, as does any longer kv sequence."""
+    one (<=2048) — no grid streaming, no rescale passes; the fastest
+    measured config at seq 2048 (see _pick_block_fwd_q's sweep table).
+    NOTE on magnitude: at seq 2048 the win over XLA is modest and
+    load-sensitive — driver captures across rounds r02-r05 put it at
+    1.03-1.14x (both paths sit near the same dispatch/DMA floor on v5e);
+    the flash advantage grows with sequence length (~2x at 8k, larger at
+    32k where XLA's S^2 materialization thrashes HBM). bench.py logs the
+    block picks it compiles so claim and capture stay auditable against
+    each other. Causal only: the non-causal kernel with a 2048 k-tile
+    exceeds the 16M scoped-vmem limit on v5e (Mosaic keeps the full
+    rectangle live without the diagonal gating), so it stays on the 1024
+    cap, as does any longer kv sequence."""
     if causal and sk <= 2048:
         return sk
     return _pick_block(sk)

@@ -100,12 +100,13 @@ def pipeline_train(
     stage_params: Any,
     x: jnp.ndarray,
     targets: jnp.ndarray,
-    loss_fn: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray],
+    loss_fn: Callable[..., jnp.ndarray],
     mesh,
     n_microbatches: int,
     axis_name: str = "pp",
+    head_params: Any = None,
 ):
-    """1F1B pipelined training step: returns ``(mean_loss, grads)``.
+    """1F1B pipelined training step.
 
     Schedule: stage s runs the forward of microbatch m at tick ``m + s`` and
     its backward at tick ``m + 2(P-1) - s`` — the last stage's backward for
@@ -115,10 +116,18 @@ def pipeline_train(
     the saved input with ``jax.vjp`` and accumulates parameter gradients.
     Total ticks: M + 2P - 2.
 
-    ``loss_fn(out_mb, target_mb) -> scalar`` is evaluated by the LAST stage
-    only; the returned loss is the mean over microbatches. ``grads`` has the
-    same stage-stacked structure (leading axis P, sharded over ``pp``) as
-    ``stage_params``.
+    Without ``head_params``: ``loss_fn(out_mb, target_mb) -> scalar`` is
+    evaluated by the LAST stage only; returns ``(mean_loss, grads)`` where
+    ``grads`` has the same stage-stacked structure (leading axis P, sharded
+    over ``pp``) as ``stage_params``.
+
+    With ``head_params`` (a model head living after the last stage — final
+    norm + unembed for an LM): ``loss_fn(head_params, out_mb, target_mb) ->
+    scalar``, and the return grows to ``(mean_loss, grads, head_grads,
+    dx)`` — ``head_grads`` matches ``head_params`` (replicated), ``dx`` is
+    the loss gradient w.r.t. ``x`` (for backpropagating into an embedding
+    that runs BEFORE the pipeline). Both are scaled to the microbatch-mean
+    loss, like ``grads``.
     """
     n_stages = mesh.shape[axis_name]
     batch = x.shape[0]
@@ -129,10 +138,21 @@ def pipeline_train(
     micro = x.reshape(n_microbatches, mb, *x.shape[1:])
     micro_targets = targets.reshape(n_microbatches, mb, *targets.shape[1:])
     buffer_slots = 2 * n_stages  # ≥ max in-flight (2P-1), power-of-2-ish
+    with_head = head_params is not None
 
-    def shard_fn(params_slice, micro_local, targets_local):
+    def shard_fn(params_slice, micro_local, targets_local, head_local):
         params_stage = jax.tree.map(lambda p: p[0], params_slice)
         stage = lax.axis_index(axis_name)
+        if with_head:
+            from tpu_task.ml.parallel.mesh import pvary as _pvary
+
+            # Differentiating w.r.t. a pp-UNVARYING input inside shard_map
+            # makes its cotangent psum over pp — every stage's (garbage)
+            # head gradient would silently pollute the last stage's real
+            # one. Mark the head varying first; the masked accumulation +
+            # final psum below then select exactly the last stage's.
+            head_local = jax.tree.map(
+                lambda p: _pvary(p, (axis_name,)), head_local)
         ticks = n_microbatches + 2 * (n_stages - 1)
         fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
         bwd_perm = [(i + 1, i) for i in range(n_stages - 1)]
@@ -148,10 +168,17 @@ def pipeline_train(
             jax.tree.map(lambda p: pvary(jnp.zeros_like(p), (axis_name,)),
                          params_stage),                   # grad accumulators
             pvary(jnp.zeros((), jnp.float32), (axis_name,)),  # loss sum
+            # Head-grad accumulators + banked per-microbatch dx (only
+            # materialized when a head is attached).
+            jax.tree.map(lambda p: pvary(jnp.zeros_like(p), (axis_name,)),
+                         head_local) if with_head else (),
+            pvary(jnp.zeros_like(micro_local), (axis_name,))
+            if with_head else (),
         )
 
         def tick(t, state):
-            fwd_carry, bwd_carry, ring, grads, loss_sum = state
+            (fwd_carry, bwd_carry, ring, grads, loss_sum,
+             head_grads, dx_bank) = state
 
             # ---- forward half: microbatch f = t - stage ----
             f = t - stage
@@ -171,8 +198,36 @@ def pipeline_train(
             out_b, vjp_fn = jax.vjp(stage_fn, params_stage, saved_inp)
             # Last stage: cotangent from the loss on its own (recomputed)
             # output; other stages: cotangent arriving from stage s+1.
-            loss_b, dloss = jax.value_and_grad(loss_fn)(
-                out_b, targets_local[b_index])
+            if with_head:
+                # lax.cond, not compute-and-mask: with a model head the
+                # loss fwd+bwd is a whole-vocab matmul pair comparable to a
+                # stage's own compute — running it on every stage and
+                # masking would waste ~P-fold head FLOPs. The predicate is
+                # device-varying inside shard_map, so only the last stage
+                # executes the head branch.
+                def head_branch(operands):
+                    out_v, target_v = operands
+                    loss_v, (dhead_v, dloss_v) = jax.value_and_grad(
+                        loss_fn, argnums=(0, 1))(head_local, out_v, target_v)
+                    return loss_v, dhead_v, dloss_v.astype(out_v.dtype)
+
+                def skip_branch(operands):
+                    out_v, _target_v = operands
+                    # pvary: fresh zeros are pp-unvarying, but the head
+                    # branch's outputs vary over pp — cond demands equal
+                    # types from both branches.
+                    return (pvary(jnp.zeros((), jnp.float32), (axis_name,)),
+                            jax.tree.map(
+                                lambda p: pvary(jnp.zeros_like(p),
+                                                (axis_name,)), head_local),
+                            pvary(jnp.zeros_like(out_v), (axis_name,)))
+
+                loss_b, dhead, dloss = lax.cond(
+                    stage == n_stages - 1, head_branch, skip_branch,
+                    (out_b, targets_local[b_index]))
+            else:
+                loss_b, dloss = jax.value_and_grad(loss_fn)(
+                    out_b, targets_local[b_index])
             cot = jnp.where(stage == n_stages - 1,
                             dloss.astype(out_b.dtype), bwd_carry)
             dparams, dx = vjp_fn(cot)
@@ -184,20 +239,51 @@ def pipeline_train(
                 grads, dparams)
             loss_sum = loss_sum + jnp.where(
                 do_bwd & (stage == n_stages - 1), loss_b, 0.0)
+            if with_head:
+                # Every stage computes a dhead from ITS out_b; only the
+                # last stage's is the real head gradient — masked here so
+                # the final psum replicates exactly it.
+                head_live = do_bwd & (stage == n_stages - 1)
+                head_grads = jax.tree.map(
+                    lambda g, d: g + jnp.where(
+                        head_live, d, jnp.zeros_like(d)),
+                    head_grads, dhead)
+                # Stage 0's dx w.r.t. its saved input IS dL/d(embedding)
+                # for this microbatch; bank it (masked to stage 0 by the
+                # final psum).
+                dx_bank = jnp.where(
+                    do_bwd & (stage == 0),
+                    dx_bank.at[b_index].set(dx.astype(dx_bank.dtype)),
+                    dx_bank)
 
             # ---- hand-offs (issued together so transfers overlap) ----
             fwd_carry = lax.ppermute(out, axis_name, fwd_perm)
             bwd_carry = lax.ppermute(dx, axis_name, bwd_perm)
-            return fwd_carry, bwd_carry, ring, grads, loss_sum
+            return (fwd_carry, bwd_carry, ring, grads, loss_sum,
+                    head_grads, dx_bank)
 
-        _, _, _, grads, loss_sum = lax.fori_loop(0, ticks, tick, state)
+        (_, _, _, grads, loss_sum, head_grads, dx_bank) = lax.fori_loop(
+            0, ticks, tick, state)
         # Loss lives on the last stage only; replicate. Grads stay per-stage,
         # scaled to match the MEAN loss (each tick accumulated one
         # microbatch's unscaled gradient).
         loss = lax.psum(loss_sum, axis_name) / n_microbatches
         grads = jax.tree.map(lambda g: g / n_microbatches, grads)
-        return loss, jax.tree.map(lambda g: g[None], grads)
+        stacked = jax.tree.map(lambda g: g[None], grads)
+        if not with_head:
+            return loss, stacked
+        # Head grads live (masked) on the last stage, banked dx on stage 0:
+        # one psum each replicates them from their owning stage.
+        head_grads = jax.tree.map(
+            lambda g: lax.psum(g, axis_name) / n_microbatches, head_grads)
+        dx = lax.psum(
+            jnp.where(stage == 0, dx_bank, jnp.zeros_like(dx_bank)),
+            axis_name) / n_microbatches
+        return loss, stacked, head_grads, dx
 
+    out_specs = (PartitionSpec(), PartitionSpec(axis_name))
+    if with_head:
+        out_specs = out_specs + (PartitionSpec(), PartitionSpec())
     fn = jax.shard_map(
         shard_fn,
         mesh=mesh,
@@ -205,7 +291,13 @@ def pipeline_train(
             PartitionSpec(axis_name),   # stage-sharded params
             PartitionSpec(),            # microbatches replicated
             PartitionSpec(),            # targets replicated
+            PartitionSpec(),            # head params replicated
         ),
-        out_specs=(PartitionSpec(), PartitionSpec(axis_name)),
+        out_specs=out_specs,
     )
-    return fn(stage_params, micro, micro_targets)
+    results = fn(stage_params, micro, micro_targets,
+                 head_params if with_head else ())
+    if not with_head:
+        return results
+    loss, grads, head_grads, dx = results
+    return loss, grads, head_grads, dx.reshape(batch, *x.shape[1:])

@@ -15,7 +15,11 @@ from jax.sharding import NamedSharding, PartitionSpec
 # logical axis -> mesh axis (or None = replicate).
 # fsdp shards the "long" parameter axis; tp shards heads/mlp.
 DEFAULT_RULES: Dict[str, Optional[object]] = {
-    "batch": ("dp", "fsdp"),   # activation batch spans both data axes
+    # Activation batch spans every data axis present in the mesh; "ep"
+    # counts as one (expert-parallel meshes shard tokens over ep so the
+    # dense compute between MoE layers parallelizes too — only the expert
+    # weights and the all_to_all dispatch treat ep specially).
+    "batch": ("dp", "fsdp", "ep"),
     "seq": None,               # sequence replicated (ring attention uses "sp")
     "vocab": "tp",
     "embed": "fsdp",

@@ -51,12 +51,23 @@ def ulysses_attention_shard(q, k, v, axis_name: str = "sp",
     """Per-shard body: local arrays are (batch, seq/P, heads, head_dim);
     call inside ``shard_map`` with seq sharded on ``axis_name``.
 
+    k/v may arrive at KV-head width (GQA): the all_to_all then moves
+    kv_heads-width bytes and the expansion to query width happens HERE,
+    after the reshard — head-group alignment makes this exact: q head j
+    uses kv head j // group, and with heads = (kv/P)·group·P per-device
+    contiguous q-head range [dev·h/P, (dev+1)·h/P) maps exactly onto kv
+    range [dev·kv/P, (dev+1)·kv/P). Requires kv_heads % P == 0 (the caller
+    widens before the shard otherwise).
+
     Differentiable with plain autodiff: ``all_to_all``'s transpose is the
-    inverse all_to_all, and the inner attention is the fused custom-VJP op.
+    inverse all_to_all, the expansion's transpose is the query-group sum,
+    and the inner attention is the fused custom-VJP op.
     """
+    from tpu_task.ml.ops.attention import expand_kv_heads
+
     qh = _seq_to_heads(q, axis_name)
-    kh = _seq_to_heads(k, axis_name)
-    vh = _seq_to_heads(v, axis_name)
+    kh = expand_kv_heads(_seq_to_heads(k, axis_name), qh.shape[2])
+    vh = expand_kv_heads(_seq_to_heads(v, axis_name), qh.shape[2])
     out = dot_product_attention(qh, kh, vh, causal)
     return _heads_to_seq(out, axis_name)
 
@@ -66,11 +77,16 @@ def ulysses_attention(q, k, v, mesh, axis_name: str = "sp",
     """Global-view all-to-all context-parallel attention.
 
     q/k/v: (batch, seq, heads, head_dim) with ``heads % sp == 0`` and
-    ``seq % sp == 0``. ``batch_axes`` as in
+    ``seq % sp == 0``; k/v may be KV-head-narrow (GQA) — they cross the
+    all_to_all narrow when ``kv_heads % sp == 0`` (group alignment, see
+    :func:`ulysses_attention_shard`), else they widen before the shard.
+    ``batch_axes`` as in
     :func:`~tpu_task.ml.parallel.ring_attention.zigzag_ring_attention`:
     mesh axis (or tuple) the batch dim is sharded over, so dp groups only
     compute their own slice.
     """
+    from tpu_task.ml.ops.attention import expand_kv_heads
+
     devices = mesh.shape[axis_name]
     heads = q.shape[2]
     if heads % devices:
@@ -80,6 +96,12 @@ def ulysses_attention(q, k, v, mesh, axis_name: str = "sp",
     if q.shape[1] % devices:
         raise ValueError(f"sequence ({q.shape[1]}) not divisible by "
                          f"{axis_name} ({devices})")
+    kv_heads = k.shape[2]
+    if kv_heads != heads and kv_heads % devices:
+        # Narrow heads can't split P ways: widen before the shard — the
+        # collective saving is forfeited but the math stays exact.
+        k = expand_kv_heads(k, heads)
+        v = expand_kv_heads(v, heads)
     spec = PartitionSpec(batch_axes, axis_name, None, None)
     fn = jax.shard_map(
         functools.partial(ulysses_attention_shard, axis_name=axis_name,

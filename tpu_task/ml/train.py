@@ -39,15 +39,14 @@ def init_state(rng, cfg: transformer.TransformerConfig, optimizer=None) -> Train
     return TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state)
 
 
-def state_pspecs(state: TrainState, cfg: transformer.TransformerConfig, mesh) -> TrainState:
-    """PartitionSpecs for a TrainState: optimizer moments follow the params."""
-    p_specs = transformer.param_pspecs(cfg, mesh=mesh)
+def _opt_specs_like(p_specs, opt_state):
+    """Map optimizer-state leaves to the param spec whose tree path is a
+    suffix of the leaf's path.
 
-    # optax state embeds copies of the param pytree (ScaleByAdamState.mu/.nu,
-    # trace terms, ...). Map each optimizer leaf to the param spec whose tree
-    # path is a suffix of the leaf's path — structural, so two same-shaped
-    # params with different layouts can't collide. Scalars (counts,
-    # schedules) fall through to replicated.
+    optax state embeds copies of the param pytree (ScaleByAdamState.mu/.nu,
+    trace terms, ...); suffix matching is structural, so two same-shaped
+    params with different layouts can't collide. Scalars (counts,
+    schedules) fall through to replicated."""
     param_paths = {}
     for path, spec in jax.tree_util.tree_flatten_with_path(
         p_specs, is_leaf=lambda x: isinstance(x, PartitionSpec)
@@ -58,15 +57,20 @@ def state_pspecs(state: TrainState, cfg: transformer.TransformerConfig, mesh) ->
         keys = tuple(str(k) for k in path)
         for start in range(len(keys)):  # longest suffix first
             spec = param_paths.get(keys[start:])
-            if spec is not None and jnp.ndim(leaf) == len(spec):
+            if spec is not None and jnp.ndim(leaf) >= len(spec):
                 return spec
         return PartitionSpec()
 
-    opt_specs = jax.tree_util.tree_map_with_path(spec_for, state.opt_state)
+    return jax.tree_util.tree_map_with_path(spec_for, opt_state)
+
+
+def state_pspecs(state: TrainState, cfg: transformer.TransformerConfig, mesh) -> TrainState:
+    """PartitionSpecs for a TrainState: optimizer moments follow the params."""
+    p_specs = transformer.param_pspecs(cfg, mesh=mesh)
     return TrainState(
         step=PartitionSpec(),
         params=p_specs,
-        opt_state=opt_specs,
+        opt_state=_opt_specs_like(p_specs, state.opt_state),
     )
 
 
@@ -81,9 +85,30 @@ def shard_state(state: TrainState, cfg, mesh) -> Tuple[TrainState, TrainState]:
     return sharded, specs
 
 
+def _token_shard_factor(mesh, activation_spec) -> int:
+    """How many ways the (batch, seq) token grid shards on this mesh —
+    trace-time shapes are global, so per-device tile sizing (the fused
+    xent auto block) must divide by this. Derived from the activation
+    sharding when pinned (it names the batch AND seq axes, e.g. sp), else
+    from the logical batch rule."""
+    if mesh is None:
+        return 1
+    if activation_spec is not None:
+        spec = getattr(activation_spec, "spec", activation_spec)
+    else:
+        spec = logical_to_mesh_axes(("batch", "seq"), mesh=mesh)
+    factor = 1
+    for entry in tuple(spec)[:2]:
+        if entry is None:
+            continue
+        for axis in (entry if isinstance(entry, tuple) else (entry,)):
+            factor *= mesh.shape[axis]
+    return factor
+
+
 def make_train_step(cfg: transformer.TransformerConfig, optimizer=None, mesh=None,
                     attn_fn=None, donate: bool = True, activation_spec=None,
-                    accum_steps: int = 1):
+                    accum_steps: int = 1, moe_fn=None):
     """Build the jitted (state, batch) → (state, metrics) step.
 
     With a mesh, in/out shardings pin the state layout and shard the batch
@@ -101,12 +126,14 @@ def make_train_step(cfg: transformer.TransformerConfig, optimizer=None, mesh=Non
     optimizer = optimizer or make_optimizer()
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    token_shards = _token_shard_factor(mesh, activation_spec)
 
     def loss_and_grads(params, tokens):
         if accum_steps == 1:
             return jax.value_and_grad(transformer.loss_fn)(
                 params, cfg, tokens, attn_fn=attn_fn,
-                activation_spec=activation_spec)
+                activation_spec=activation_spec, moe_fn=moe_fn,
+                token_shards=token_shards)
         batch = tokens.shape[0]
         if batch % accum_steps:
             raise ValueError(f"batch {batch} not divisible by "
@@ -118,7 +145,8 @@ def make_train_step(cfg: transformer.TransformerConfig, optimizer=None, mesh=Non
             loss_sum, grad_sum = carry
             loss, grads = jax.value_and_grad(transformer.loss_fn)(
                 params, cfg, micro_tokens, attn_fn=attn_fn,
-                activation_spec=activation_spec)
+                activation_spec=activation_spec, moe_fn=moe_fn,
+                token_shards=token_shards)
             return (loss_sum + loss,
                     jax.tree.map(jnp.add, grad_sum, grads)), None
 
@@ -159,6 +187,227 @@ def make_train_step(cfg: transformer.TransformerConfig, optimizer=None, mesh=Non
     return jit_with_state
 
 
+def pp_stack_params(params, n_stages: int):
+    """Regular flagship params → pipeline-parallel layout.
+
+    ``{"embed", "final_norm", "unembed", "stages"}`` where ``stages`` leaves
+    carry a leading (n_stages, layers_per_stage) prefix — stage-sharded over
+    ``pp``, each stage owning a contiguous slice of layers."""
+    n_layers = len(params["layers"])
+    if n_layers % n_stages:
+        raise ValueError(f"n_layers {n_layers} not divisible by "
+                         f"{n_stages} pipeline stages")
+    lps = n_layers // n_stages
+    grouped = [
+        jax.tree.map(lambda *leaves: jnp.stack(leaves),
+                     *params["layers"][s * lps:(s + 1) * lps])
+        for s in range(n_stages)
+    ]
+    return {
+        "embed": params["embed"],
+        "final_norm": params["final_norm"],
+        "unembed": params["unembed"],
+        "stages": jax.tree.map(lambda *leaves: jnp.stack(leaves), *grouped),
+    }
+
+
+def pp_unstack_params(pp_params):
+    """Inverse of :func:`pp_stack_params` (for checkpoint interchange and
+    the equivalence tests)."""
+    stages = pp_params["stages"]
+    leaves = jax.tree.leaves(stages)
+    n_stages, lps = leaves[0].shape[0], leaves[0].shape[1]
+    layers = [
+        jax.tree.map(lambda p: p[s, j], stages)
+        for s in range(n_stages) for j in range(lps)
+    ]
+    return {
+        "embed": pp_params["embed"],
+        "final_norm": pp_params["final_norm"],
+        "unembed": pp_params["unembed"],
+        "layers": layers,
+    }
+
+
+def init_pp_state(rng, cfg: transformer.TransformerConfig, n_stages: int,
+                  optimizer=None) -> TrainState:
+    """TrainState in pipeline layout — init equals the sequential init
+    exactly (pp_stack_params of the same transformer.init)."""
+    optimizer = optimizer or make_optimizer()
+    params = pp_stack_params(transformer.init(rng, cfg), n_stages)
+    return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                      opt_state=optimizer.init(params))
+
+
+def pp_state_pspecs(state: TrainState, mesh, axis_name: str = "pp") -> TrainState:
+    """PartitionSpecs for a pipeline TrainState: stage-stacked leaves shard
+    their leading stage axis over ``pp``; embed/head replicate."""
+    p_specs = {
+        "embed": PartitionSpec(),
+        "final_norm": PartitionSpec(),
+        "unembed": PartitionSpec(),
+        "stages": jax.tree.map(lambda _: PartitionSpec(axis_name),
+                               state.params["stages"]),
+    }
+    return TrainState(
+        step=PartitionSpec(),
+        params=p_specs,
+        opt_state=_opt_specs_like(p_specs, state.opt_state),
+    )
+
+
+def shard_pp_state(state: TrainState, mesh,
+                   axis_name: str = "pp") -> Tuple[TrainState, TrainState]:
+    specs = pp_state_pspecs(state, mesh, axis_name)
+    sharded = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        state, specs,
+        is_leaf=lambda x: isinstance(x, PartitionSpec),
+    )
+    return sharded, specs
+
+
+def make_pp_train_step(cfg: transformer.TransformerConfig, mesh,
+                       n_microbatches: int, optimizer=None,
+                       donate: bool = True, axis_name: str = "pp"):
+    """Pipeline-parallel flagship training step (1F1B schedule).
+
+    The REAL transformer layers split into ``pp`` contiguous stages (not
+    toy stage fns): embedding runs before the pipeline (its gradient comes
+    back through the 1F1B ``dx`` output), final norm + unembed + fused
+    cross-entropy are the pipeline head evaluated per microbatch by the
+    last stage, and each stage's blocks recompute their forward in the
+    backward (activation recomputation). One optimizer update per step —
+    equals the sequential full-batch step exactly (microbatch token counts
+    are equal, so mean-of-microbatch-means = full mean; pinned in
+    tests/test_ml_moe_pipeline.py).
+
+    Takes/returns TrainStates in the :func:`pp_stack_params` layout.
+    """
+    from tpu_task.ml.parallel.pipeline import pipeline_train
+
+    optimizer = optimizer or make_optimizer()
+    n_stages = mesh.shape[axis_name]
+    if cfg.n_layers % n_stages:
+        raise ValueError(f"n_layers {cfg.n_layers} not divisible by "
+                         f"{n_stages} pipeline stages")
+    if any(cfg.is_moe_layer(i) for i in range(cfg.n_layers)):
+        raise ValueError("pipeline step supports dense layers only "
+                         "(MoE layers go through make_moe_train_step)")
+    lps = cfg.n_layers // n_stages
+
+    def attn(q, k, v):
+        from tpu_task.ml.ops.attention import dot_product_attention
+
+        return dot_product_attention(
+            q, transformer.expand_kv(k, cfg.n_heads),
+            transformer.expand_kv(v, cfg.n_heads), True)
+
+    def stage_fn(stage_layers, h):
+        # stage_layers leaves: (layers_per_stage, ...) — static unroll.
+        for j in range(lps):
+            layer = jax.tree.map(lambda p: p[j], stage_layers)
+            h, _aux = transformer._block(h, layer, cfg, attn)
+        return h
+
+    def head_loss(head, out_mb, tgt_mb):
+        h = transformer._rmsnorm(out_mb, head["final_norm"])
+        b, s, d = h.shape
+        return transformer.fused_xent(
+            h.reshape(b * s, d), head["unembed"].astype(cfg.dtype),
+            tgt_mb.reshape(-1))
+
+    def step(state: TrainState, tokens):
+        params = state.params
+        inp, tgt = tokens[:, :-1], tokens[:, 1:]
+        x, embed_vjp = jax.vjp(
+            lambda table: transformer.embed_lookup(
+                table.astype(cfg.dtype), inp),
+            params["embed"])
+        head = {"final_norm": params["final_norm"],
+                "unembed": params["unembed"]}
+        loss, stage_grads, head_grads, dx = pipeline_train(
+            stage_fn, params["stages"], x, tgt, head_loss, mesh,
+            n_microbatches, axis_name=axis_name, head_params=head)
+        (d_embed,) = embed_vjp(dx.astype(x.dtype))
+        grads = {"embed": d_embed,
+                 "final_norm": head_grads["final_norm"],
+                 "unembed": head_grads["unembed"],
+                 "stages": stage_grads}
+        updates, opt_state = optimizer.update(grads, state.opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        gnorm = optax.global_norm(grads)
+        new_state = TrainState(step=state.step + 1, params=new_params,
+                               opt_state=opt_state)
+        return new_state, {"loss": loss, "grad_norm": gnorm}
+
+    def jit_with_state(state: TrainState):
+        specs = pp_state_pspecs(state, mesh, axis_name)
+        state_shardings = jax.tree.map(
+            lambda s: NamedSharding(mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, PartitionSpec),
+        )
+        return jax.jit(
+            step,
+            in_shardings=(state_shardings,
+                          NamedSharding(mesh, PartitionSpec())),
+            out_shardings=(state_shardings,
+                           NamedSharding(mesh, PartitionSpec())),
+            donate_argnums=(0,) if donate else (),
+        )
+
+    return jit_with_state
+
+
+def make_moe_train_step(cfg: transformer.TransformerConfig, mesh,
+                        optimizer=None, donate: bool = True,
+                        axis_name: str = "ep", accum_steps: int = 1):
+    """Expert-parallel training step for a MoE flagship config.
+
+    The config's MoE layers (``moe_every``/``n_experts``) dispatch through
+    the all_to_all expert exchange over the mesh's ``ep`` axis instead of
+    the dense one-hot reference path: experts shard one group per ep slot
+    (logical axis "expert" → ep), tokens shard over every data axis in the
+    mesh PLUS ep, and each MoE layer's two ``lax.all_to_all``s stay inside
+    the ep groups. With ample capacity the step equals the dense-dispatch
+    step exactly (pinned in tests/test_ml_moe_pipeline.py).
+
+    The reference analog is TPI's parallelism knob driving the real task,
+    not a demo (/root/reference/task/k8s/resources/resource_job.go:135-140)
+    — here the ep axis drives the real flagship train step.
+    """
+    from tpu_task.ml.models import moe
+
+    if mesh.shape.get(axis_name) is None:
+        raise ValueError(f"mesh has no {axis_name!r} axis: {mesh.axis_names}")
+    if not any(cfg.is_moe_layer(i) for i in range(cfg.n_layers)):
+        raise ValueError("config has no MoE layers (set moe_every/n_experts)")
+    mcfg = cfg.moe_cfg
+
+    # Tokens shard over the usual data axes INCLUDING ep (the "batch" rule
+    # lists ep as a data axis): each ep slot routes its own token shard, so
+    # the all_to_all moves capacity buffers, not the whole batch, and the
+    # dense compute between MoE layers parallelizes over ep too. Resolving
+    # from the same rules table keeps the shard_map spec, the activation
+    # constraint, and make_train_step's token sharding in agreement.
+    data_axes = logical_to_mesh_axes(("batch",), mesh=mesh)[0]
+    batch_axes = (data_axes if isinstance(data_axes, tuple)
+                  else (data_axes,) if data_axes else ())
+    if axis_name not in batch_axes:
+        batch_axes = (*batch_axes, axis_name)
+
+    def moe_fn(layer, h):
+        return moe.apply_sharded(layer, mcfg, h, mesh, axis_name=axis_name,
+                                 batch_axes=batch_axes)
+
+    activation_spec = NamedSharding(
+        mesh, PartitionSpec(batch_axes, None, None))
+    return make_train_step(cfg, optimizer=optimizer, mesh=mesh,
+                           donate=donate, moe_fn=moe_fn,
+                           activation_spec=activation_spec,
+                           accum_steps=accum_steps)
+
+
 def make_sp_train_step(cfg: transformer.TransformerConfig, mesh,
                        optimizer=None, donate: bool = True,
                        axis_name: str = "sp", context_parallel: str = "zigzag"):
@@ -190,21 +439,19 @@ def make_sp_train_step(cfg: transformer.TransformerConfig, mesh,
     # attention redundantly on every replica.
     batch_axes = logical_to_mesh_axes(("batch",), mesh=mesh)[0]
 
-    # GQA note: k/v widen to the query head count BEFORE crossing shards,
-    # so the ring/all_to_all traffic does not see GQA's narrow-kv saving;
-    # keeping the wire format narrow would need grouped-attention support
-    # inside the ring block primitives — a future optimization, traded
-    # here for exactness through the existing well-tested paths.
+    # GQA: k/v cross the shard boundary at KV-head width — the ring's
+    # ppermutes and the Ulysses all_to_all move narrow bytes, and the
+    # expansion to query width happens inside each shard right before the
+    # block kernel (ring_attention._expand_kv / ulysses_attention_shard).
+    # sp-GQA stays exactly equal to the replicated step: expansion commutes
+    # with the seq sharding (pinned in tests/test_ml_parallel.py, which
+    # also asserts the narrow wire format from the compiled HLO).
     if context_parallel == "zigzag":
         def attn(q, k, v):
-            k = transformer.expand_kv(k, cfg.n_heads)
-            v = transformer.expand_kv(v, cfg.n_heads)
             return zigzag_ring_attention(q, k, v, mesh, axis_name=axis_name,
                                          batch_axes=batch_axes)
     elif context_parallel == "ulysses":
         def attn(q, k, v):
-            k = transformer.expand_kv(k, cfg.n_heads)
-            v = transformer.expand_kv(v, cfg.n_heads)
             return ulysses_attention(q, k, v, mesh, axis_name=axis_name,
                                      batch_axes=batch_axes)
     else:

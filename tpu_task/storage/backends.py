@@ -154,6 +154,13 @@ class Backend:
         size+modtime check); None falls back to copying everything."""
         return None
 
+    def list_hidden(self) -> List[str]:
+        """Internal housekeeping keys excluded from :meth:`list` (e.g.
+        in-flight composite-upload parts). ``delete_storage`` purges these
+        too — a crash-orphaned part must not make bucket deletion fail
+        (non-empty) or leak invisibly forever."""
+        return []
+
 
 def parallel_map(fns, workers: int) -> list:
     """Run zero-arg callables concurrently; on the FIRST failure cancel all
@@ -390,6 +397,12 @@ class LocalBackend(Backend):
         return self.root
 
 
+# Temp namespace for in-flight composite-upload parts: excluded from
+# list()/list_meta() so a concurrent sync pull never mirrors (or races the
+# deletion of) transient part objects.
+GCS_TMP_PREFIX = ".gcs-tmp/"
+
+
 class GCSBackend(Backend):
     """Google Cloud Storage via the JSON API (no SDK dependency).
 
@@ -468,6 +481,31 @@ class GCSBackend(Backend):
                 name = item["name"]
                 if self.prefix:
                     name = name[len(self.prefix):].lstrip("/")
+                if name.startswith(GCS_TMP_PREFIX):
+                    continue  # in-flight parts; see list_hidden()
+                keys.append(name)
+            page_token = payload.get("nextPageToken", "")
+            if not page_token:
+                return sorted(keys)
+
+    def list_hidden(self) -> List[str]:
+        """Crash-orphaned composite parts under the temp prefix (normally
+        none — the uploader deletes its parts in a finally block)."""
+        import urllib.parse
+
+        full_prefix = self._key(GCS_TMP_PREFIX)
+        keys: List[str] = []
+        page_token = ""
+        while True:
+            url = (f"https://storage.googleapis.com/storage/v1/b/{self.container}/o"
+                   f"?prefix={urllib.parse.quote(full_prefix, safe='')}")
+            if page_token:
+                url += f"&pageToken={page_token}"
+            payload = json.loads(self._request("GET", url))
+            for item in payload.get("items", []):
+                name = item["name"]
+                if self.prefix:
+                    name = name[len(self.prefix):].lstrip("/")
                 keys.append(name)
             page_token = payload.get("nextPageToken", "")
             if not page_token:
@@ -491,6 +529,8 @@ class GCSBackend(Backend):
                 name = item["name"]
                 if self.prefix:
                     name = name[len(self.prefix):].lstrip("/")
+                if name.startswith(GCS_TMP_PREFIX):
+                    continue  # in-flight composite parts are not objects
                 updated = 0.0
                 try:
                     updated = datetime.fromisoformat(
@@ -583,7 +623,11 @@ class GCSBackend(Backend):
         part_size = -(-part_size // (256 * 1024)) * (256 * 1024)
         token = _uuid.uuid4().hex[:8]
         starts = list(range(0, size, part_size))
-        part_keys = [f"{key}.gcs-part-{token}-{index:02d}"
+        # Parts live under a dedicated temp prefix that list()/list_meta()
+        # exclude — in the destination namespace a concurrent sync pull
+        # could observe and mirror transient multi-MB part objects (or
+        # race the finally-block delete mid-download).
+        part_keys = [f"{GCS_TMP_PREFIX}{token}/{key}.part-{index:02d}"
                      for index in range(len(starts))]
 
         fd = os.open(path, os.O_RDONLY)

@@ -171,17 +171,37 @@ class S3Backend(Backend):
     def write_if_absent(self, key: str, data: bytes) -> bool:
         """Atomic first-writer-wins via S3 conditional writes: PutObject
         with ``If-None-Match: *`` answers 412 when the object exists and
-        409 ConditionalRequestConflict when racing an in-flight write —
-        both mean this caller didn't win. ``_resolve_conditional_loss``
-        disambiguates the retry-after-lost-response case."""
-        try:
-            self._request("PUT", self._key(key), {}, body=data,
-                          extra_headers={"If-None-Match": "*"})
-            return True
-        except urllib.error.HTTPError as error:
-            if error.code in (409, 412):
-                return _resolve_conditional_loss(self, key, data)
-            raise
+        409 ConditionalRequestConflict when racing an in-flight write.
+
+        412 means an object exists — ``_resolve_conditional_loss``
+        disambiguates the retry-after-lost-response case. 409 means the
+        COMPETING write was still in flight: it may yet fail, leaving
+        nothing stored — a read-back there would 404 and report False with
+        no object persisted, so the caller (the event mailbox) would
+        believe a record exists when none does. Retry the conditional PUT
+        with backoff until the race settles into created / 412."""
+        for delay in (0.05, 0.2, 0.8, None):
+            try:
+                self._request("PUT", self._key(key), {}, body=data,
+                              extra_headers={"If-None-Match": "*"})
+                return True
+            except urllib.error.HTTPError as error:
+                if error.code == 412:
+                    return _resolve_conditional_loss(self, key, data)
+                if error.code == 409 and delay is not None:
+                    time.sleep(delay)
+                    continue
+                if error.code == 409:
+                    # Conflict never settled: fall back to the read-back —
+                    # a 404 there means nothing persisted, which must
+                    # surface as an error, not a quiet False.
+                    try:
+                        return self.read(key) == data
+                    except ResourceNotFoundError:
+                        raise RuntimeError(
+                            f"conditional write of {key!r} kept returning "
+                            "409 with no object persisted") from error
+                raise
 
     def write_from_file(self, key: str, path: str) -> None:
         """Streaming upload: multipart with parallel parts above the

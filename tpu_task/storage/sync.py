@@ -203,11 +203,12 @@ def status(remote: str, initial_status: Optional[Status] = None) -> Status:
 
 
 def delete_storage(remote: str) -> None:
-    """Empty the remote (all objects, then empty dirs)."""
+    """Empty the remote (all objects — including crash-orphaned internal
+    housekeeping keys hidden from list() — then empty dirs)."""
     backend, _ = open_backend(remote)
     if not backend.exists():
         raise ResourceNotFoundError(remote)
-    keys = backend.list()
+    keys = backend.list() + backend.list_hidden()
     _for_each(backend.delete, keys, parallel=backend.local_root() is None)
     if isinstance(backend, LocalBackend):
         backend.remove_empty_dirs()
