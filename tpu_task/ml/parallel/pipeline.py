@@ -105,6 +105,7 @@ def pipeline_train(
     n_microbatches: int,
     axis_name: str = "pp",
     head_params: Any = None,
+    batch_axes: tuple = (),
 ):
     """1F1B pipelined training step.
 
@@ -128,6 +129,16 @@ def pipeline_train(
     the loss gradient w.r.t. ``x`` (for backpropagating into an embedding
     that runs BEFORE the pipeline). Both are scaled to the microbatch-mean
     loss, like ``grads``.
+
+    ``batch_axes``: mesh axes the BATCH dim shards over (dp×pp
+    composition): each dp group pipelines its own batch slice through the
+    same stages — the microbatch split happens PER SHARD (shard-local rows
+    regroup into ``n_microbatches`` equal chunks: movement-free, and exact
+    because an equal-size regrouping changes neither the full-batch mean
+    loss, any parameter gradient, nor any row's dx). loss/grads/head_grads
+    dp-average (equal shard sizes make the mean exact) while ``dx`` stays
+    batch-sharded like ``x``. Requires ``loss_fn`` to be a mean over its
+    microbatch tokens.
     """
     n_stages = mesh.shape[axis_name]
     batch = x.shape[0]
@@ -135,44 +146,63 @@ def pipeline_train(
         raise ValueError(f"batch {batch} not divisible by microbatches "
                          f"{n_microbatches}")
     mb = batch // n_microbatches
-    micro = x.reshape(n_microbatches, mb, *x.shape[1:])
-    micro_targets = targets.reshape(n_microbatches, mb, *targets.shape[1:])
     buffer_slots = 2 * n_stages  # ≥ max in-flight (2P-1), power-of-2-ish
     with_head = head_params is not None
+    batch_axes = tuple(batch_axes)
+    batch_shards = 1
+    for ax in batch_axes:
+        batch_shards *= mesh.shape[ax]
+    if mb % batch_shards:
+        raise ValueError(
+            f"microbatch size {mb} (batch {batch} / {n_microbatches}) not "
+            f"divisible by the {batch_shards}-way batch sharding "
+            f"({batch_axes})")
+    mb_local = mb // batch_shards
 
-    def shard_fn(params_slice, micro_local, targets_local, head_local):
-        params_stage = jax.tree.map(lambda p: p[0], params_slice)
+    def shard_fn(params_slice, x_local, targets_local, head_local):
+        from tpu_task.ml.parallel.mesh import pvary
+
+        # Shard-local microbatch split: x arrives batch-sharded on dim 0
+        # and regroups locally — a dim-1-of-(M, mb) spec would own
+        # different rows than the dim-0 batch sharding and force a
+        # whole-activation reshard collective every step.
+        micro_local = x_local.reshape(
+            n_microbatches, mb_local, *x_local.shape[1:])
+        targets_micro = targets_local.reshape(
+            n_microbatches, mb_local, *targets_local.shape[1:])
+
+        # Mark per-stage params (and the head) varying over EVERY axis this
+        # body computes across: differentiating w.r.t. an UNVARYING input
+        # inside shard_map makes its cotangent psum over the unvaried axes
+        # — over pp that would pollute the last stage's real head gradient
+        # with every other stage's garbage one, and over dp it would turn
+        # the per-shard mean-loss gradients into a sum (dp× too large).
+        # With everything varying, reductions below are explicit.
+        all_axes = (axis_name, *batch_axes)
+        params_stage = jax.tree.map(
+            lambda p: pvary(p[0], all_axes), params_slice)
         stage = lax.axis_index(axis_name)
         if with_head:
-            from tpu_task.ml.parallel.mesh import pvary as _pvary
-
-            # Differentiating w.r.t. a pp-UNVARYING input inside shard_map
-            # makes its cotangent psum over pp — every stage's (garbage)
-            # head gradient would silently pollute the last stage's real
-            # one. Mark the head varying first; the masked accumulation +
-            # final psum below then select exactly the last stage's.
             head_local = jax.tree.map(
-                lambda p: _pvary(p, (axis_name,)), head_local)
+                lambda p: pvary(p, all_axes), head_local)
         ticks = n_microbatches + 2 * (n_stages - 1)
         fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
         bwd_perm = [(i + 1, i) for i in range(n_stages - 1)]
 
-        from tpu_task.ml.parallel.mesh import pvary
-
-        zero_mb = pvary(jnp.zeros_like(micro_local[0]), (axis_name,))
+        zero_mb = pvary(jnp.zeros_like(micro_local[0]), all_axes)
         state = (
             zero_mb,                                      # forward carry
             zero_mb,                                      # backward carry (dx)
             pvary(jnp.zeros((buffer_slots,) + micro_local.shape[1:],
-                            micro_local.dtype), (axis_name,)),  # input ring
-            jax.tree.map(lambda p: pvary(jnp.zeros_like(p), (axis_name,)),
+                            micro_local.dtype), all_axes),  # input ring
+            jax.tree.map(lambda p: pvary(jnp.zeros_like(p), all_axes),
                          params_stage),                   # grad accumulators
-            pvary(jnp.zeros((), jnp.float32), (axis_name,)),  # loss sum
+            pvary(jnp.zeros((), jnp.float32), all_axes),  # loss sum
             # Head-grad accumulators + banked per-microbatch dx (only
             # materialized when a head is attached).
-            jax.tree.map(lambda p: pvary(jnp.zeros_like(p), (axis_name,)),
+            jax.tree.map(lambda p: pvary(jnp.zeros_like(p), all_axes),
                          head_local) if with_head else (),
-            pvary(jnp.zeros_like(micro_local), (axis_name,))
+            pvary(jnp.zeros_like(micro_local), all_axes)
             if with_head else (),
         )
 
@@ -213,21 +243,21 @@ def pipeline_train(
 
                 def skip_branch(operands):
                     out_v, _target_v = operands
-                    # pvary: fresh zeros are pp-unvarying, but the head
-                    # branch's outputs vary over pp — cond demands equal
-                    # types from both branches.
-                    return (pvary(jnp.zeros((), jnp.float32), (axis_name,)),
+                    # pvary: fresh zeros are unvarying, but the head
+                    # branch's outputs vary over the mesh axes — cond
+                    # demands equal types from both branches.
+                    return (pvary(jnp.zeros((), jnp.float32), all_axes),
                             jax.tree.map(
                                 lambda p: pvary(jnp.zeros_like(p),
-                                                (axis_name,)), head_local),
-                            pvary(jnp.zeros_like(out_v), (axis_name,)))
+                                                all_axes), head_local),
+                            pvary(jnp.zeros_like(out_v), all_axes))
 
                 loss_b, dhead, dloss = lax.cond(
                     stage == n_stages - 1, head_branch, skip_branch,
-                    (out_b, targets_local[b_index]))
+                    (out_b, targets_micro[b_index]))
             else:
                 loss_b, dloss = jax.value_and_grad(loss_fn)(
-                    out_b, targets_local[b_index])
+                    out_b, targets_micro[b_index])
             cot = jnp.where(stage == n_stages - 1,
                             dloss.astype(out_b.dtype), bwd_carry)
             dparams, dx = vjp_fn(cot)
@@ -264,40 +294,54 @@ def pipeline_train(
 
         (_, _, _, grads, loss_sum, head_grads, dx_bank) = lax.fori_loop(
             0, ticks, tick, state)
-        # Loss lives on the last stage only; replicate. Grads stay per-stage,
-        # scaled to match the MEAN loss (each tick accumulated one
-        # microbatch's unscaled gradient).
-        loss = lax.psum(loss_sum, axis_name) / n_microbatches
-        grads = jax.tree.map(lambda g: g / n_microbatches, grads)
+
+        def batch_mean(value):
+            for ax in batch_axes:
+                value = lax.pmean(value, ax)
+            return value
+
+        # Loss lives on the last stage only; replicate over pp, average the
+        # per-dp-shard means (equal shard sizes → exact). Grads stay
+        # per-stage, scaled to match the MEAN loss (each tick accumulated
+        # one microbatch's unscaled gradient), dp-averaged.
+        loss = batch_mean(lax.psum(loss_sum, axis_name) / n_microbatches)
+        grads = jax.tree.map(
+            lambda g: batch_mean(g / n_microbatches), grads)
         stacked = jax.tree.map(lambda g: g[None], grads)
         if not with_head:
             return loss, stacked
         # Head grads live (masked) on the last stage, banked dx on stage 0:
-        # one psum each replicates them from their owning stage.
+        # one psum each replicates them from their owning stage. dx stays
+        # batch-sharded (it backs the embedding's batch-sharded cotangent)
+        # and carries the SAME 1/(M·dp_shards) scaling a global-mean loss
+        # implies per token — the dp mean that batch_mean applies to the
+        # parameter grads shows up here as a plain divide.
         head_grads = jax.tree.map(
-            lambda g: lax.psum(g, axis_name) / n_microbatches, head_grads)
+            lambda g: batch_mean(lax.psum(g, axis_name) / n_microbatches),
+            head_grads)
         dx = lax.psum(
             jnp.where(stage == 0, dx_bank, jnp.zeros_like(dx_bank)),
-            axis_name) / n_microbatches
-        return loss, stacked, head_grads, dx
+            axis_name) / (n_microbatches * batch_shards)
+        # Undo the local microbatch regrouping so dx rows line up with this
+        # shard's slice of x.
+        return loss, stacked, head_grads, dx.reshape(
+            n_microbatches * mb_local, *dx.shape[2:])
 
+    batch_spec = (PartitionSpec(batch_axes) if batch_axes
+                  else PartitionSpec())
     out_specs = (PartitionSpec(), PartitionSpec(axis_name))
     if with_head:
-        out_specs = out_specs + (PartitionSpec(), PartitionSpec())
+        out_specs = out_specs + (PartitionSpec(), batch_spec)
     fn = jax.shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(
             PartitionSpec(axis_name),   # stage-sharded params
-            PartitionSpec(),            # microbatches replicated
-            PartitionSpec(),            # targets replicated
+            batch_spec,                 # batch dim over batch_axes
+            batch_spec,                 # targets likewise
             PartitionSpec(),            # head params replicated
         ),
         out_specs=out_specs,
     )
-    results = fn(stage_params, micro, micro_targets,
-                 head_params if with_head else ())
-    if not with_head:
-        return results
-    loss, grads, head_grads, dx = results
-    return loss, grads, head_grads, dx.reshape(batch, *x.shape[1:])
+    return fn(stage_params, x, targets,
+              head_params if with_head else ())

@@ -62,6 +62,19 @@ def logical_to_mesh_axes(
     return PartitionSpec(*(resolve(a) for a in logical_axes))
 
 
+def mesh_batch_axes(mesh) -> Tuple[str, ...]:
+    """The mesh axes the logical "batch" dim shards over, normalized to a
+    (possibly empty) tuple — the one resolution every train-step builder
+    shares so token sharding, activation constraints, and shard_map specs
+    cannot disagree."""
+    resolved = logical_to_mesh_axes(("batch",), mesh=mesh)[0]
+    if resolved is None:
+        return ()
+    if isinstance(resolved, tuple):
+        return resolved
+    return (resolved,)
+
+
 def named_sharding(mesh, *spec) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec(*spec))
 

@@ -16,7 +16,7 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec
 
 from tpu_task.ml.models import transformer
-from tpu_task.ml.parallel.sharding import logical_to_mesh_axes
+from tpu_task.ml.parallel.sharding import logical_to_mesh_axes, mesh_batch_axes
 
 
 class TrainState(NamedTuple):
@@ -295,6 +295,11 @@ def make_pp_train_step(cfg: transformer.TransformerConfig, mesh,
         raise ValueError("pipeline step supports dense layers only "
                          "(MoE layers go through make_moe_train_step)")
     lps = cfg.n_layers // n_stages
+    # dp×pp composition: any data axes in the mesh shard the batch dim
+    # (each dp group pipelines its own slice; grads/loss dp-average inside
+    # pipeline_train). Resolved from the shared helper like every other
+    # step builder so token sharding and the shard_map specs agree.
+    batch_axes = mesh_batch_axes(mesh)
 
     def attn(q, k, v):
         from tpu_task.ml.ops.attention import dot_product_attention
@@ -328,7 +333,8 @@ def make_pp_train_step(cfg: transformer.TransformerConfig, mesh,
                 "unembed": params["unembed"]}
         loss, stage_grads, head_grads, dx = pipeline_train(
             stage_fn, params["stages"], x, tgt, head_loss, mesh,
-            n_microbatches, axis_name=axis_name, head_params=head)
+            n_microbatches, axis_name=axis_name, head_params=head,
+            batch_axes=batch_axes)
         (d_embed,) = embed_vjp(dx.astype(x.dtype))
         grads = {"embed": d_embed,
                  "final_norm": head_grads["final_norm"],
@@ -347,10 +353,12 @@ def make_pp_train_step(cfg: transformer.TransformerConfig, mesh,
             lambda s: NamedSharding(mesh, s), specs,
             is_leaf=lambda x: isinstance(x, PartitionSpec),
         )
+        token_spec = (PartitionSpec(batch_axes, None) if batch_axes
+                      else PartitionSpec())
         return jax.jit(
             step,
             in_shardings=(state_shardings,
-                          NamedSharding(mesh, PartitionSpec())),
+                          NamedSharding(mesh, token_spec)),
             out_shardings=(state_shardings,
                            NamedSharding(mesh, PartitionSpec())),
             donate_argnums=(0,) if donate else (),
@@ -388,11 +396,9 @@ def make_moe_train_step(cfg: transformer.TransformerConfig, mesh,
     # lists ep as a data axis): each ep slot routes its own token shard, so
     # the all_to_all moves capacity buffers, not the whole batch, and the
     # dense compute between MoE layers parallelizes over ep too. Resolving
-    # from the same rules table keeps the shard_map spec, the activation
+    # from the shared helper keeps the shard_map spec, the activation
     # constraint, and make_train_step's token sharding in agreement.
-    data_axes = logical_to_mesh_axes(("batch",), mesh=mesh)[0]
-    batch_axes = (data_axes if isinstance(data_axes, tuple)
-                  else (data_axes,) if data_axes else ())
+    batch_axes = mesh_batch_axes(mesh)
     if axis_name not in batch_axes:
         batch_axes = (*batch_axes, axis_name)
 
