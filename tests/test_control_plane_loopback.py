@@ -520,15 +520,26 @@ def test_cli_lifecycle_over_loopback_tpu(tmp_path, monkeypatch, capsys):
         monkeypatch.setattr(tpu_api.RestTpuClient, "__init__", attached_init)
 
         # -- create -----------------------------------------------------------
-        rc = cli_main([
+        create_args = [
             "--cloud", "tpu", "--region", "us-central2",
             "create", "--name", "cli-e2e", "--machine", "v4-8",
             "--workdir", str(workdir), "--output", "results",
             "--script", "#!/bin/bash\necho hello-from-worker\n",
-        ])
+        ]
+        rc = cli_main(create_args)
         assert rc == 0
         identifier = capsys.readouterr().out.strip().splitlines()[-1]
         assert identifier.startswith("tpi-cli-e2e-")
+        # Reference smoke-test discipline: every operation runs twice
+        # (task_smoke_test.go:180-181). A bare name salts a fresh random
+        # identifier, so true idempotency is re-creating by the FULL
+        # identifier: same task, create tolerates the existing resources.
+        recreate_args = list(create_args)
+        recreate_args[recreate_args.index("cli-e2e")] = identifier
+        assert cli_main(recreate_args) == 0
+        assert capsys.readouterr().out.strip().splitlines()[-1] == identifier
+        assert len([name for name in server.qrs
+                    if name.startswith("tpi-cli-e2e-")]) == 1
 
         qr_name = f"{identifier}-0"
         assert server.qrs[qr_name]["state"] == "ACTIVE"
@@ -561,6 +572,9 @@ def test_cli_lifecycle_over_loopback_tpu(tmp_path, monkeypatch, capsys):
                        "delete", "--workdir", str(workdir),
                        "--output", "results", identifier])
         assert rc == 0
+        # Double delete tolerated (same smoke discipline).
+        assert cli_main(["--cloud", "tpu", "--region", "us-central2",
+                         "delete", identifier]) == 0
         assert (workdir / "results" / "out.txt").read_text() == "answer"
         assert list(bucket.rglob("*")) in ([], [bucket / "data"]) or \
             not any(p.is_file() for p in bucket.rglob("*"))

@@ -438,12 +438,13 @@ def make_sp_train_step(cfg: transformer.TransformerConfig, mesh,
     from tpu_task.ml.parallel.ring_attention import zigzag_ring_attention
     from tpu_task.ml.parallel.ulysses import ulysses_attention
 
-    # Resolve the batch placement from the logical rules (dp and/or fsdp,
-    # filtered to this mesh) so the activation constraint, the attention
-    # shard_map batch spec, and make_train_step's token sharding all agree
-    # — a mismatch would all-gather the batch dim every layer and compute
-    # attention redundantly on every replica.
-    batch_axes = logical_to_mesh_axes(("batch",), mesh=mesh)[0]
+    # Resolve the batch placement from the shared helper so the activation
+    # constraint, the attention shard_map batch spec, and
+    # make_train_step's token sharding all agree — a mismatch would
+    # all-gather the batch dim every layer and compute attention
+    # redundantly on every replica. PartitionSpec entries want None (not an
+    # empty tuple) for "replicated", hence the `or None`.
+    batch_axes = mesh_batch_axes(mesh) or None
 
     # GQA: k/v cross the shard boundary at KV-head width — the ring's
     # ppermutes and the Ulysses all_to_all move narrow bytes, and the
