@@ -34,18 +34,27 @@ os.environ.setdefault("TPU_TASK_EVENTS_PROBE_PERIOD", "0")
 
 import pytest  # noqa: E402
 
+# Modules whose tests spawn real agent subprocesses with wall-clock sync
+# loops: serialized below behind a CROSS-PROCESS flock. Two pytest
+# processes running them concurrently starve each other until poll
+# ceilings trip (r4: test_tpu_multihost_workers_all_run exceeded 180 s
+# under a concurrent double-suite, passes alone in 5 s; r5: a CLI
+# lifecycle test timed out the same way) — raising ceilings again would
+# just move the cliff. One allowlist here, not a pasted shim per module.
+AGENT_SUBPROCESS_MODULES = {
+    "test_cli",
+    "test_frontend",
+    "test_lifecycle_local",
+    "test_tpu_backend",
+}
 
-@pytest.fixture(scope="module")
-def agent_subprocess_serial():
-    """CROSS-PROCESS exclusive lock for agent-subprocess lifecycle tests.
 
-    These tests spawn real worker subprocesses with wall-clock sync loops;
-    two pytest processes running them concurrently starve each other until
-    poll ceilings trip (r4: test_tpu_multihost_workers_all_run exceeded
-    180 s under a concurrent double-suite, passes alone in 5 s). A flock on
-    a shared temp file serializes across PROCESSES — raising ceilings again
-    would just move the cliff.
-    """
+@pytest.fixture(autouse=True, scope="module")
+def agent_subprocess_serial(request):
+    module = getattr(request.module, "__name__", "").rsplit(".", 1)[-1]
+    if module not in AGENT_SUBPROCESS_MODULES:
+        yield
+        return
     import fcntl
     import tempfile
 

@@ -9,13 +9,6 @@ import uuid
 
 import pytest
 
-# Serialize the whole module's agent-subprocess lifecycles across pytest
-# PROCESSES (see conftest.agent_subprocess_serial): concurrent suites starve
-# the wall-clock sync loops these tests poll on.
-@pytest.fixture(autouse=True, scope="module")
-def _agent_serial(agent_subprocess_serial):
-    yield
-
 from tpu_task.common.cloud import Cloud, Provider
 from tpu_task.common.identifier import Identifier
 from tpu_task.common.values import Environment, StatusCode, Task as TaskSpec, Variables

@@ -7,13 +7,6 @@ import time
 
 import pytest
 
-# Serialize the whole module's agent-subprocess lifecycles across pytest
-# PROCESSES (see conftest.agent_subprocess_serial): concurrent suites starve
-# the wall-clock sync loops these tests poll on.
-@pytest.fixture(autouse=True, scope="module")
-def _agent_serial(agent_subprocess_serial):
-    yield
-
 from tpu_task.backends.tpu import (
     FakeTpuControlPlane,
     InvalidAcceleratorError,
