@@ -1,0 +1,325 @@
+"""Async overlapped checkpointing: failure semantics, bit-identical parity
+with the sync path, pruning under in-flight saves, direct bucket streaming."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from tpu_task.ml import checkpoint as ckpt  # noqa: E402
+
+
+def small_tree(offset: float = 0.0):
+    return {
+        "w": jnp.arange(16.0).reshape(4, 4) + offset,
+        "b": jnp.arange(4.0) + offset,
+        "step_count": np.int64(7),
+    }
+
+
+def tree_equal(a, b) -> bool:
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        and np.asarray(x).dtype == np.asarray(y).dtype
+        for x, y in zip(la, lb))
+
+
+def test_async_save_returns_before_background_write(tmp_path, monkeypatch):
+    """The tier-1 overlap contract: save() returns while the shard file is
+    still unwritten; wait() completes the publish."""
+    gate = threading.Event()
+    real_write = ckpt._write_npz_atomic
+
+    def gated_write(directory, final_name, arrays):
+        assert gate.wait(timeout=30), "test gate never opened"
+        return real_write(directory, final_name, arrays)
+
+    monkeypatch.setattr(ckpt, "_write_npz_atomic", gated_write)
+    tree = small_tree()
+    with ckpt.AsyncCheckpointer(tmp_path) as cp:
+        final = cp.save(0, tree)
+        # save() already returned; the write is parked on the gate.
+        assert not final.exists()
+        assert not (tmp_path / "LATEST_SHARDED").exists()
+        gate.set()
+        cp.wait()
+        assert final.exists()
+    restored = ckpt.restore_checkpoint_sharded(tmp_path, small_tree(99.0))
+    assert tree_equal(restored, tree)
+
+
+def test_async_snapshot_decouples_from_source_mutation(tmp_path):
+    """The snapshot is a host copy: mutating (donating) the source arrays
+    after save() must not change what lands on disk."""
+    host = np.arange(8.0)
+    tree = {"w": host}
+    with ckpt.AsyncCheckpointer(tmp_path) as cp:
+        cp.save(0, tree)
+        host += 1000.0  # simulates the train loop reusing donated buffers
+        cp.wait()
+    restored = ckpt.restore_checkpoint_sharded(tmp_path, {"w": np.zeros(8)})
+    assert np.array_equal(restored["w"], np.arange(8.0))
+
+
+def test_background_failure_surfaces_on_next_save_and_wait(tmp_path, monkeypatch):
+    calls = {"n": 0}
+    real_write = ckpt._write_npz_atomic
+
+    def failing_once(directory, final_name, arrays):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError("disk full")
+        return real_write(directory, final_name, arrays)
+
+    monkeypatch.setattr(ckpt, "_write_npz_atomic", failing_once)
+    cp = ckpt.AsyncCheckpointer(tmp_path)
+    cp.save(0, small_tree())  # background write will fail
+    with pytest.raises(ckpt.AsyncCheckpointError, match="disk full"):
+        cp.wait()
+    # The error was consumed: the pipeline keeps working afterwards.
+    cp.save(1, small_tree(1.0))
+    cp.wait()
+    cp.close()
+    restored = ckpt.restore_checkpoint_sharded(tmp_path, small_tree())
+    assert tree_equal(restored, small_tree(1.0))
+
+
+def test_background_failure_surfaces_on_next_save_call(tmp_path, monkeypatch):
+    monkeypatch.setattr(
+        ckpt, "_write_npz_atomic",
+        lambda *a, **k: (_ for _ in ()).throw(OSError("boom")))
+    cp = ckpt.AsyncCheckpointer(tmp_path)
+    cp.save(0, small_tree())
+    # Deterministic ordering: let the failure land before the next save.
+    cp._queue.join()
+    with pytest.raises(ckpt.AsyncCheckpointError, match="boom"):
+        cp.save(1, small_tree())
+
+
+def test_close_surfaces_pending_failure(tmp_path, monkeypatch):
+    monkeypatch.setattr(
+        ckpt, "_write_npz_atomic",
+        lambda *a, **k: (_ for _ in ()).throw(OSError("late")))
+    cp = ckpt.AsyncCheckpointer(tmp_path)
+    cp.save(0, small_tree())
+    with pytest.raises(ckpt.AsyncCheckpointError, match="late"):
+        cp.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        cp.save(1, small_tree())
+
+
+def test_interrupted_async_save_preserves_previous_step(tmp_path):
+    """A crash mid-async-save must leave the previous complete step
+    restorable — restore's partial-set rejection is the safety net."""
+    good = small_tree()
+    with ckpt.AsyncCheckpointer(tmp_path) as cp:
+        cp.save(1, good)
+    # Crash shape A: step 2's shard set is incomplete for its save-time
+    # topology (manifest says 2 processes, only shard-0 landed).
+    np.savez(tmp_path / "ckpt-2.shard-0.npz", **{"leaf_0|0:4,0:4": np.ones((4, 4))})
+    (tmp_path / "ckpt-2.meta").write_text(
+        json.dumps({"step": 2, "process_count": 2}))
+    # Crash shape B: step 3's shard file is torn (partial upload bytes).
+    (tmp_path / "ckpt-3.shard-0.npz").write_bytes(b"torn-zip-garbage")
+    restored = ckpt.restore_checkpoint_sharded(tmp_path, small_tree(50.0))
+    assert tree_equal(restored, good)
+
+
+def test_async_and_sync_saves_restore_bit_identically(tmp_path):
+    tree = small_tree(3.0)
+    sync_dir, async_dir = tmp_path / "sync", tmp_path / "async"
+    ckpt.save_checkpoint_sharded(sync_dir, 5, tree)
+    with ckpt.AsyncCheckpointer(async_dir) as cp:
+        cp.save(5, tree)
+
+    sync_names = sorted(p.name for p in sync_dir.iterdir())
+    async_names = sorted(p.name for p in async_dir.iterdir())
+    assert sync_names == async_names  # same shard filenames + meta + pointer
+    assert (json.loads((sync_dir / "LATEST_SHARDED").read_text())
+            == json.loads((async_dir / "LATEST_SHARDED").read_text()))
+
+    template = small_tree(77.0)
+    from_sync = ckpt.restore_checkpoint_sharded(sync_dir, template)
+    from_async = ckpt.restore_checkpoint_sharded(async_dir, template)
+    assert tree_equal(from_sync, from_async)
+    assert tree_equal(from_sync, tree)
+
+
+def test_async_keep_pruning_with_in_flight_saves(tmp_path, monkeypatch):
+    """keep= retention stays correct when saves queue up: after the queue
+    drains, exactly the newest `keep` steps (and their manifests) remain,
+    and no queued step was ever pruned."""
+    release = threading.Semaphore(0)
+    real_write = ckpt._write_npz_atomic
+
+    def slow_write(directory, final_name, arrays):
+        assert release.acquire(timeout=30)
+        return real_write(directory, final_name, arrays)
+
+    monkeypatch.setattr(ckpt, "_write_npz_atomic", slow_write)
+    # max_pending=8: all four saves must queue up behind the gate (the
+    # default backpressure bound would block the later save() calls).
+    with ckpt.AsyncCheckpointer(tmp_path, keep=2, max_pending=8) as cp:
+        for step in range(4):
+            cp.save(step, small_tree(float(step)))
+        for _ in range(4):
+            release.release()
+        cp.wait()
+    steps = sorted(int(m.group(1)) for p in tmp_path.iterdir()
+                   if (m := ckpt._SHARD_RE.match(p.name)))
+    assert steps == [2, 3]
+    metas = sorted(p.name for p in tmp_path.glob("ckpt-*.meta"))
+    assert metas == ["ckpt-2.meta", "ckpt-3.meta"]
+    restored = ckpt.restore_checkpoint_sharded(tmp_path, small_tree())
+    assert tree_equal(restored, small_tree(3.0))
+
+
+def test_async_keep_validation_matches_sync(tmp_path):
+    with pytest.raises(ValueError, match="keep must be >= 2"):
+        ckpt.AsyncCheckpointer(tmp_path, keep=1)
+
+
+def test_direct_upload_streams_to_bucket(tmp_path):
+    """With upload_remote set, published steps land in the bucket prefix
+    without any agent sync tick: shard + manifest + pointer (pointer
+    content equal to the local one), pruned steps deleted remotely."""
+    bucket = tmp_path / "bucket" / "data" / "checkpoints"
+    local = tmp_path / "checkpoints"
+    with ckpt.AsyncCheckpointer(local, keep=2,
+                                upload_remote=str(bucket)) as cp:
+        for step in range(3):
+            cp.save(step, small_tree(float(step)))
+        cp.wait()
+        uploaded = sorted(p.name for p in bucket.iterdir())
+        assert uploaded == ["LATEST_SHARDED", "ckpt-1.meta", "ckpt-1.shard-0.npz",
+                            "ckpt-2.meta", "ckpt-2.shard-0.npz"]
+        assert ((bucket / "LATEST_SHARDED").read_text()
+                == (local / "LATEST_SHARDED").read_text())
+    # The bucket copy alone is restorable (what a respawned worker pulls).
+    restored = ckpt.restore_checkpoint_sharded(bucket, small_tree())
+    assert tree_equal(restored, small_tree(2.0))
+
+
+def test_direct_upload_preserves_mtimes_so_sync_diff_skips(tmp_path):
+    """The agent's incremental sync must not re-upload what the pipeline
+    already pushed: uploaded copies carry the source mtime, so the
+    size+modtime diff reports zero changed keys."""
+    from tpu_task.storage.backends import LocalBackend
+    from tpu_task.storage.sync import _changed_keys
+
+    bucket = tmp_path / "bucket"
+    local = tmp_path / "checkpoints"
+    with ckpt.AsyncCheckpointer(local, upload_remote=str(bucket)) as cp:
+        cp.save(0, small_tree())
+    src_meta = LocalBackend(str(local)).list_meta()
+    dst_meta = LocalBackend(str(bucket)).list_meta()
+    assert sorted(src_meta) == sorted(dst_meta)
+    assert _changed_keys(sorted(src_meta), src_meta, dst_meta,
+                         mtimes_preserved=True) == []
+
+
+def test_upload_failure_surfaces_like_write_failure(tmp_path):
+    # A file path that can't be a directory root forces the backend write
+    # to fail — durability failures must propagate, not vanish.
+    blocker = tmp_path / "not-a-dir"
+    blocker.write_text("file in the way")
+    cp = ckpt.AsyncCheckpointer(
+        tmp_path / "ckpts", upload_remote=str(blocker / "sub"))
+    cp.save(0, small_tree())
+    with pytest.raises(ckpt.AsyncCheckpointError):
+        cp.wait()
+    cp.close()
+
+
+def test_resolve_upload_remote_from_agent_env(tmp_path, monkeypatch):
+    monkeypatch.delenv("TPU_TASK_DATA_REMOTE", raising=False)
+    assert ckpt.resolve_upload_remote("checkpoints") is None
+    monkeypatch.setenv("TPU_TASK_DATA_REMOTE", "/bucket/data")
+    monkeypatch.chdir(tmp_path)  # the agent runs the task with cwd=workdir
+    assert (ckpt.resolve_upload_remote("checkpoints")
+            == "/bucket/data/checkpoints")
+    # The prefix is the WORKDIR-RELATIVE path (what the agent's mirror
+    # uses), never a bare basename beside it.
+    assert (ckpt.resolve_upload_remote("out/ckpts")
+            == "/bucket/data/out/ckpts")
+    assert (ckpt.resolve_upload_remote(tmp_path / "out" / "ckpts")
+            == "/bucket/data/out/ckpts")
+    # Outside the workdir the mirror never ships the directory — a direct
+    # upload would just be reaped as extraneous, so there is no remote.
+    assert ckpt.resolve_upload_remote("/somewhere/else/ckpts") is None
+    # Connection strings concatenate, not os.path.join.
+    monkeypatch.setenv("TPU_TASK_DATA_REMOTE", ":s3:bucket/task/data")
+    assert (ckpt.resolve_upload_remote("checkpoints")
+            == ":s3:bucket/task/data/checkpoints")
+
+
+def test_save_backpressure_bounds_pending_snapshots(tmp_path, monkeypatch):
+    """Saves beyond max_pending block instead of queueing unbounded host
+    copies: with the writer gated, the (max_pending+2)th save waits, then
+    completes once the writer drains."""
+    release = threading.Semaphore(0)
+    real_write = ckpt._write_npz_atomic
+
+    def gated_write(directory, final_name, arrays):
+        assert release.acquire(timeout=30)
+        return real_write(directory, final_name, arrays)
+
+    monkeypatch.setattr(ckpt, "_write_npz_atomic", gated_write)
+    cp = ckpt.AsyncCheckpointer(tmp_path, max_pending=1)
+    cp.save(0, small_tree())   # taken by the writer, parked on the gate
+    cp.save(1, small_tree())   # fills the queue (max_pending=1)
+    third_returned = threading.Event()
+
+    def third_save():
+        cp.save(2, small_tree(2.0))
+        third_returned.set()
+
+    thread = threading.Thread(target=third_save, daemon=True)
+    thread.start()
+    assert not third_returned.wait(timeout=0.3)  # blocked on backpressure
+    for _ in range(3):
+        release.release()
+    assert third_returned.wait(timeout=30)
+    cp.wait()
+    cp.close()
+    restored = ckpt.restore_checkpoint_sharded(tmp_path, small_tree())
+    assert tree_equal(restored, small_tree(2.0))
+
+
+def test_mirror_sync_delete_pass_spares_concurrently_published_files(tmp_path):
+    """The agent's mirror sync must not delete a checkpoint the async
+    pipeline published+uploaded between the tick's source listing and its
+    delete pass: the delete re-checks the live local source."""
+    import importlib
+
+    sync_mod = importlib.import_module("tpu_task.storage.sync")
+    src, dst = tmp_path / "src", tmp_path / "dst"
+    src.mkdir()
+    (src / "old.txt").write_text("payload")
+    real_list_meta = sync_mod.LocalBackend.list_meta
+    published = {"done": False}
+
+    def racing_list_meta(self, prefix=""):
+        meta = real_list_meta(self, prefix)
+        if not published["done"] and self.root == str(src):
+            published["done"] = True
+            # After the listing, the pipeline lands the step on BOTH sides
+            # (local publish, then direct upload).
+            (src / "ckpt-9.shard-0.npz").write_bytes(b"step9")
+            (dst / "ckpt-9.shard-0.npz").write_bytes(b"step9")
+        return meta
+
+    dst.mkdir()
+    import unittest.mock as mock
+    with mock.patch.object(sync_mod.LocalBackend, "list_meta",
+                           racing_list_meta):
+        sync_mod.sync(str(src), str(dst))
+    # Without the live-source re-check, the delete pass would have reaped
+    # the newest durable checkpoint from the bucket.
+    assert (dst / "ckpt-9.shard-0.npz").read_bytes() == b"step9"
+    assert (dst / "old.txt").read_text() == "payload"

@@ -96,3 +96,22 @@ def test_exec_on_workers_and_distributed_env(tpu_cloud, tmp_path):
         poll(task, all_ranks_logged)
     finally:
         task.delete()
+
+
+def test_ssh_transport_materializes_key_once(tmp_path):
+    from tpu_task.machine.fanout import SSHTransport
+
+    transport = SSHTransport("-----FAKE KEY-----\n")
+    first = transport._ensure_key()
+    assert open(first).read() == "-----FAKE KEY-----\n"
+    import os
+    assert os.stat(first).st_mode & 0o777 == 0o600
+    # A 32-worker fan-out reuses the same file: no per-exec rewrite.
+    assert all(transport._ensure_key() == first for _ in range(32))
+    transport.close()
+    assert not os.path.exists(first)
+    # close() is idempotent and a later use re-materializes.
+    transport.close()
+    again = transport._ensure_key()
+    assert os.path.exists(again)
+    transport.close()

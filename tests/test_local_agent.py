@@ -94,3 +94,76 @@ def test_env_variables_visible_to_task(tmp_path):
     logs = (remote / "reports" / "task-m1").read_text()
     assert "rank=0" in logs
     assert "id=m1" in logs
+
+
+def test_data_remote_env_visible_to_task(tmp_path):
+    """The agent exports the bucket data prefix so user scripts can stream
+    checkpoints straight into the bucket (AsyncCheckpointer upload)."""
+    remote, _workdir, process = run_agent(
+        tmp_path, 'echo "data_remote=$TPU_TASK_DATA_REMOTE"\n')
+    assert process.returncode == 0, process.stderr
+    logs = (remote / "reports" / "task-m1").read_text()
+    assert f"data_remote={remote / 'data'}" in logs
+
+
+def test_nonzero_worker_syncs_only_own_checkpoint_shards(tmp_path):
+    """Workers N≠0 ship their OWN sharded checkpoint files to the bucket
+    (the multi-host contract from tpu-worker-script.sh.tpl:143-150) and
+    nothing else."""
+    remote, _workdir, process = run_agent(
+        tmp_path,
+        "mkdir -p checkpoints\n"
+        "echo shard > checkpoints/ckpt-3.shard-1.npz\n"
+        "echo private > notes.txt\n"
+        "sleep 0.5\n",
+        machine_id="m2", worker_id=1)
+    assert process.returncode == 0, process.stderr
+    assert (remote / "data" / "checkpoints" / "ckpt-3.shard-1.npz").exists()
+    # Only its shards: no plain workdir payload, no other shard indices.
+    assert not (remote / "data" / "notes.txt").exists()
+
+
+def test_worker0_sync_spares_other_workers_shards(tmp_path):
+    """Worker 0's mirror sync must not delete shard files only workers N≠0
+    uploaded — and still mirrors its own shards and plain payload."""
+    remote = tmp_path / "bucket"
+    (remote / "data" / "checkpoints").mkdir(parents=True)
+    (remote / "data" / "checkpoints" / "ckpt-3.shard-1.npz").write_bytes(b"w1")
+    remote2, _workdir, process = run_agent(
+        tmp_path,
+        "mkdir -p checkpoints\n"
+        "echo shard > checkpoints/ckpt-3.shard-0.npz\n"
+        "echo payload > out.txt\n"
+        "sleep 0.5\n")
+    assert process.returncode == 0, process.stderr
+    assert remote2 == remote
+    assert (remote / "data" / "checkpoints" / "ckpt-3.shard-1.npz").read_bytes() == b"w1"
+    assert (remote / "data" / "checkpoints" / "ckpt-3.shard-0.npz").exists()
+    assert (remote / "data" / "out.txt").read_text() == "payload\n"
+
+
+def test_agent_async_checkpoint_direct_upload_end_to_end(tmp_path):
+    """Full overlap path under the real agent: a task script saves through
+    AsyncCheckpointer(upload_remote="auto") and the checkpoint lands in the
+    bucket via the pipeline (mtime-preserved, so the agent's own sync tick
+    has nothing left to re-upload)."""
+    script = (
+        "export JAX_PLATFORMS=cpu\n"
+        f"export PYTHONPATH={REPO}\n"
+        "python3 - <<'PY'\n"
+        "import numpy as np\n"
+        "from tpu_task.ml import AsyncCheckpointer\n"
+        "with AsyncCheckpointer('checkpoints', upload_remote='auto') as cp:\n"
+        "    cp.save(2, {'w': np.arange(6.0)})\n"
+        "PY\n"
+    )
+    remote, workdir, process = run_agent(tmp_path, script)
+    assert process.returncode == 0, process.stderr
+    bucket_ckpts = remote / "data" / "checkpoints"
+    assert (bucket_ckpts / "ckpt-2.shard-0.npz").exists()
+    assert (bucket_ckpts / "LATEST_SHARDED").exists()
+    # Uploaded copies carry the source mtime (the re-upload-skip contract).
+    local = workdir / "checkpoints" / "ckpt-2.shard-0.npz"
+    import os as _os
+    assert abs(_os.path.getmtime(local)
+               - _os.path.getmtime(bucket_ckpts / "ckpt-2.shard-0.npz")) < 0.002

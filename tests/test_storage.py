@@ -319,3 +319,37 @@ def test_local_write_if_absent_race_single_winner(tmp_path):
     assert len(winners) == 1
     content = backend.read("events/e.json")
     assert content == f"writer-{winners[0]}".encode() * 64
+
+
+def test_for_each_fails_fast_and_cancels_queued_work():
+    """The sync engine's parallel fan-out rides parallel_map's fail-fast
+    drain: the first worker exception re-raises and still-queued sibling
+    transfers are cancelled instead of streaming to completion."""
+    import importlib
+    import threading
+    import time as _time
+
+    # tpu_task.storage exports sync the FUNCTION; fetch the module.
+    sync_mod = importlib.import_module("tpu_task.storage.sync")
+
+    done = []
+    done_lock = threading.Lock()
+
+    def work(key):
+        if key == "k-fail":
+            raise OSError("simulated transfer failure")
+        _time.sleep(0.3)
+        with done_lock:
+            done.append(key)
+
+    keys = ["k-fail"] + [f"k{i}" for i in range(8)]
+    orig = sync_mod.CLOUD_COPY_WORKERS
+    sync_mod.CLOUD_COPY_WORKERS = 2
+    try:
+        with pytest.raises(OSError, match="simulated transfer failure"):
+            sync_mod._for_each(work, keys, parallel=True)
+    finally:
+        sync_mod.CLOUD_COPY_WORKERS = orig
+    # 2 workers: the failure + at most one in-flight sibling ran; the other
+    # 7 queued transfers were cancelled by the fail-fast drain.
+    assert len(done) <= 2

@@ -631,7 +631,13 @@ class TPUTask(GcsRemoteMixin, Task):
             return fan_out(directories, command, LocalTransport(), timeout=timeout)
         key_pair = self.get_key_pair()
         transport = SSHTransport(key_pair.private_string() if key_pair else "")
-        return fan_out(self.worker_addresses(), command, transport, timeout=timeout)
+        try:
+            # One key materialization serves the whole fan-out; close()
+            # removes it as soon as the last worker returns.
+            return fan_out(self.worker_addresses(), command, transport,
+                           timeout=timeout)
+        finally:
+            transport.close()
 
     def get_key_pair(self) -> Optional[DeterministicSSHKeyPair]:
         """Deterministic keypair from the cloud secret (client.go:92 parity)."""

@@ -20,6 +20,8 @@ from __future__ import annotations
 import os
 import subprocess
 import tempfile
+import threading
+import weakref
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import List, Optional, Protocol, Sequence
@@ -42,37 +44,66 @@ class Transport(Protocol):
     def run(self, address: str, command: str, timeout: float) -> tuple: ...
 
 
+def _unlink_quietly(path: str) -> None:
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
 class SSHTransport:
-    """Remote exec over the system ssh binary with an in-memory private key."""
+    """Remote exec over the system ssh binary with an in-memory private key.
+
+    The key is materialized to a 0600 temp file ONCE per transport instance
+    and reused across the whole fan-out (a 32-worker slice does 1 key write,
+    not 32), removed on :meth:`close` or garbage collection."""
 
     def __init__(self, private_key_pem: str, username: str = "ubuntu",
                  connect_timeout: int = 10):
         self.private_key_pem = private_key_pem
         self.username = username
         self.connect_timeout = connect_timeout
+        self._key_path: Optional[str] = None
+        self._key_lock = threading.Lock()
+        self._finalizer = None
+
+    def _ensure_key(self) -> str:
+        """Write the key file on first use; thread-safe — fan_out calls
+        ``run`` from a pool, and all workers must share one file."""
+        with self._key_lock:
+            if self._key_path is None or not os.path.exists(self._key_path):
+                fd, key_path = tempfile.mkstemp(prefix="tpu-task-key-")
+                with os.fdopen(fd, "w") as handle:  # mkstemp opens 0600
+                    handle.write(self.private_key_pem)
+                self._key_path = key_path
+                self._finalizer = weakref.finalize(
+                    self, _unlink_quietly, key_path)
+            return self._key_path
+
+    def close(self) -> None:
+        """Remove the materialized key file (idempotent; a later ``run``
+        re-materializes it)."""
+        with self._key_lock:
+            if self._finalizer is not None:
+                self._finalizer()
+                self._finalizer = None
+            self._key_path = None
 
     def run(self, address: str, command: str, timeout: float) -> tuple:
-        fd, key_path = tempfile.mkstemp(prefix="tpu-task-key-")
-        try:
-            with os.fdopen(fd, "w") as handle:
-                handle.write(self.private_key_pem)
-            os.chmod(key_path, 0o600)
-            proc = subprocess.run(
-                [
-                    "ssh",
-                    "-i", key_path,
-                    "-o", "StrictHostKeyChecking=no",
-                    "-o", "UserKnownHostsFile=/dev/null",
-                    "-o", f"ConnectTimeout={self.connect_timeout}",
-                    "-o", "BatchMode=yes",
-                    f"{self.username}@{address}",
-                    command,
-                ],
-                capture_output=True, text=True, timeout=timeout,
-            )
-            return proc.returncode, proc.stdout, proc.stderr
-        finally:
-            os.unlink(key_path)
+        proc = subprocess.run(
+            [
+                "ssh",
+                "-i", self._ensure_key(),
+                "-o", "StrictHostKeyChecking=no",
+                "-o", "UserKnownHostsFile=/dev/null",
+                "-o", f"ConnectTimeout={self.connect_timeout}",
+                "-o", "BatchMode=yes",
+                f"{self.username}@{address}",
+                command,
+            ],
+            capture_output=True, text=True, timeout=timeout,
+        )
+        return proc.returncode, proc.stdout, proc.stderr
 
 
 class LocalTransport:

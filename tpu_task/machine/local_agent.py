@@ -40,10 +40,21 @@ def _iso_now() -> str:
     return datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
 
 
+def _shard_sync_rules(worker_id: int) -> list:
+    """Per-worker shard filter rules, same as tpu-worker-script.sh.tpl:
+    worker 0 mirrors everything but other workers' checkpoint shard files
+    (its sync must never delete shards only worker N uploaded); worker N
+    mirrors ONLY its own shard files."""
+    if worker_id == 0:
+        return ["+ **ckpt-*.shard-0.*", "- **ckpt-*.shard-*"]
+    return [f"+ **ckpt-*.shard-{worker_id}.*", "- **"]
+
+
 class Agent:
     def __init__(self, remote: str, directory: str, script_path: str,
                  machine_id: str, timeout_epoch: float,
-                 log_period: float, data_period: float, worker_id: int = 0):
+                 log_period: float, data_period: float, worker_id: int = 0,
+                 checkpoint_dir: str = "checkpoints"):
         self.remote = remote
         self.directory = directory
         self.script_path = script_path
@@ -52,6 +63,7 @@ class Agent:
         self.log_period = log_period
         self.data_period = data_period
         self.worker_id = worker_id
+        self.checkpoint_dir = checkpoint_dir
         self.log_lines: list[str] = []
         self._log_lock = threading.Lock()
         self._done = threading.Event()
@@ -81,22 +93,67 @@ class Agent:
                 self._sync_logs()
 
     def _data_loop(self) -> None:
-        if self.worker_id != 0:
-            return
         last_epoch = None
         while not self._done.wait(self.data_period):
             epoch = self._data_epoch()
             if epoch != last_epoch:
                 last_epoch = epoch
                 try:
-                    storage_sync(self.directory, os.path.join(self.remote, "data"))
+                    self._sync_data(epoch)
                 except Exception as error:  # keep looping like the shell loop
                     self._append_log(f"data sync error: {error}\n")
 
+    def _sync_data(self, epoch: float = None) -> None:
+        """One data tick. Worker 0 mirrors the whole workdir; workers N≠0
+        mirror only their own checkpoint shard files (the multi-host sharded
+        contract — tpu-worker-script.sh.tpl:143-150). Checkpoint-priority:
+        worker 0 syncs the checkpoint directory FIRST, so checkpoints become
+        durable before the rest of the workdir streams, and the size+mtime
+        diff skips files an AsyncCheckpointer direct-upload already pushed
+        (it preserves source mtimes) instead of re-uploading them."""
+        data_remote = os.path.join(self.remote, "data")
+        rules = _shard_sync_rules(self.worker_id)
+        if self.worker_id != 0:
+            # ``epoch`` is the loop's already-computed shard mtime scan —
+            # don't re-walk the workdir for the same answer; only the
+            # final-sync call path (no epoch) scans here.
+            if epoch is None:
+                epoch = self._shard_epoch()
+            if epoch > 0.0:
+                storage_sync(self.directory, data_remote, exclude=rules)
+            return
+        ckpt_local = os.path.join(self.directory, self.checkpoint_dir)
+        if os.path.isdir(ckpt_local):
+            storage_sync(
+                ckpt_local,
+                os.path.join(data_remote, self.checkpoint_dir),
+                exclude=rules)
+        storage_sync(self.directory, data_remote, exclude=rules)
+
     def _data_epoch(self) -> float:
+        if self.worker_id != 0:
+            return self._shard_epoch()
         newest = 0.0
         for dirpath, _dirnames, filenames in os.walk(self.directory):
             for name in filenames:
+                try:
+                    newest = max(newest, os.path.getmtime(os.path.join(dirpath, name)))
+                except OSError:
+                    pass
+        return newest
+
+    def _shard_epoch(self) -> float:
+        """Newest mtime among THIS worker's checkpoint shard files (0.0 when
+        none exist — workers N≠0 sync nothing else, so no shards means no
+        sync and no spurious ``data/`` creation in the bucket)."""
+        import fnmatch
+
+        pattern = f"ckpt-*.shard-{self.worker_id}.*"
+        newest = 0.0
+        for dirpath, _dirnames, filenames in os.walk(self.directory):
+            for name in filenames:
+                if not fnmatch.fnmatch(name, pattern):
+                    continue
                 try:
                     newest = max(newest, os.path.getmtime(os.path.join(dirpath, name)))
                 except OSError:
@@ -122,6 +179,11 @@ class Agent:
         restore_accelerator_env(env)
         env["TPU_WORKER_ID"] = str(self.worker_id)
         env["TPU_TASK_MACHINE_IDENTITY"] = self.machine_id
+        # The bucket prefix the workdir mirrors to: lets user scripts stream
+        # checkpoints straight into the bucket off the sync tick
+        # (AsyncCheckpointer(upload_remote="auto")) instead of waiting for
+        # the next data-period sweep.
+        env["TPU_TASK_DATA_REMOTE"] = data_remote
         if env.get("TPU_TASK_CLOUD_PROVIDER") == "k8s":
             # Mirror the rank under the k8s-native name so scripts written
             # for real indexed Jobs (resource_job.go:135-140) run unchanged
@@ -184,11 +246,12 @@ class Agent:
         # Final data sync BEFORE the status report: the report is what makes
         # clients observe a terminal status, and delete→pull may follow it
         # immediately — data uploaded after it would be lost to the pull.
-        if self.worker_id == 0:
-            try:
-                storage_sync(self.directory, data_remote)
-            except Exception as error:
-                self._append_log(f"final data sync error: {error}\n")
+        # All workers run it: worker 0 mirrors the workdir, workers N≠0 ship
+        # their own checkpoint shards (no-op when they wrote none).
+        try:
+            self._sync_data()
+        except Exception as error:
+            self._append_log(f"final data sync error: {error}\n")
         self._sync_logs()
         self._write_report("status", json.dumps(report))
         if self.worker_id == 0:
@@ -214,6 +277,9 @@ def main(argv=None) -> int:
     parser.add_argument("--log-period", type=float, default=5.0)
     parser.add_argument("--data-period", type=float, default=10.0)
     parser.add_argument("--worker-id", type=int, default=0)
+    parser.add_argument("--checkpoint-dir", default="checkpoints",
+                        help="workdir-relative checkpoint directory that gets"
+                             " priority (first) in each data sync tick")
     args = parser.parse_args(argv)
 
     machine_id = args.machine_id or f"{uuid.uuid4()}-worker{args.worker_id}"
@@ -221,7 +287,7 @@ def main(argv=None) -> int:
         remote=args.remote, directory=args.directory, script_path=args.script,
         machine_id=machine_id, timeout_epoch=args.timeout,
         log_period=args.log_period, data_period=args.data_period,
-        worker_id=args.worker_id,
+        worker_id=args.worker_id, checkpoint_dir=args.checkpoint_dir,
     )
     return agent.run()
 

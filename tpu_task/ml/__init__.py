@@ -11,6 +11,8 @@ semantics) meaningful for training jobs.
 """
 
 from tpu_task.ml.checkpoint import (
+    AsyncCheckpointer,
+    AsyncCheckpointError,
     latest_step,
     restore_checkpoint,
     restore_checkpoint_sharded,
@@ -25,6 +27,8 @@ from tpu_task.ml.parallel.mesh import (
 from tpu_task.ml import profiling
 
 __all__ = [
+    "AsyncCheckpointer",
+    "AsyncCheckpointError",
     "balanced_mesh_shape",
     "profiling",
     "distributed_init_from_env",

@@ -8,22 +8,44 @@ user-script half of that contract for JAX pytrees:
 
 * atomic writes (temp file + rename) so the sync loop never ships a torn file;
 * monotonically numbered steps + a LATEST pointer written last;
-* restore returns the template pytree's structure/dtypes/shardings.
+* restore returns the template pytree's structure/dtypes/shardings;
+* :class:`AsyncCheckpointer` — overlapped saves: device→host snapshot on the
+  caller, serialization + publish (+ optional direct bucket streaming) on a
+  background writer, so frequent preemption-recovery checkpoints cost the
+  train loop only the snapshot.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import queue
 import re
 import tempfile
+import threading
 from pathlib import Path
-from typing import Any, Optional
+from typing import Any, Iterable, Optional
 
 import jax
 import numpy as np
 
 _STEP_RE = re.compile(r"^ckpt-(\d+)\.npz$")
+
+
+def _write_npz_atomic(directory: Path, final_name: str, arrays: dict) -> Path:
+    """Serialize ``arrays`` to ``directory/final_name`` via temp file +
+    rename, so the sync loop (and a crash) never observes a torn file."""
+    final = directory / final_name
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as handle:
+            np.savez(handle, **arrays)
+        os.replace(tmp, final)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    return final
 
 
 def save_checkpoint(directory, step: int, tree: Any,
@@ -42,16 +64,7 @@ def save_checkpoint(directory, step: int, tree: Any,
     leaves = [np.asarray(l) for l in jax.tree.leaves(tree)]
     arrays = {f"leaf_{i}": a for i, a in enumerate(leaves)}
 
-    final = directory / f"ckpt-{step}.npz"
-    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
-    try:
-        with os.fdopen(fd, "wb") as f:
-            np.savez(f, **arrays)
-        os.replace(tmp, final)
-    except BaseException:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-        raise
+    final = _write_npz_atomic(directory, f"ckpt-{step}.npz", arrays)
 
     pointer = directory / "LATEST.tmp"
     pointer.write_text(json.dumps({"step": step, "file": final.name}))
@@ -129,15 +142,43 @@ def save_checkpoint_sharded(directory, step: int, tree: Any,
     unrecoverable. More generally ``keep`` must exceed the worst-case
     inter-worker save skew measured in save intervals; 2 covers loops
     that save in lockstep, size it up for loosely-coupled savers."""
+    _validate_sharded_keep(keep)
+    directory = Path(directory)
+    process = jax.process_index()
+    arrays = _snapshot_sharded(tree, process)
+    final, _pruned = _publish_sharded(
+        directory, step, arrays, process, jax.process_count(), keep)
+    return final
+
+
+def _validate_sharded_keep(keep: Optional[int]) -> None:
     if keep is not None and keep < 2:
         raise ValueError(
             f"sharded keep must be >= 2 (got {keep}): with 1 retained "
             "step, inter-worker sync skew leaves windows where no step "
             "has a complete shard set")
-    directory = Path(directory)
-    directory.mkdir(parents=True, exist_ok=True)
-    process = jax.process_index()
 
+
+def _decoupled(array: np.ndarray) -> np.ndarray:
+    """A host array safe to serialize after control returns to the caller.
+
+    ``np.asarray`` of a device shard is a fresh owning buffer when a real
+    transfer happened (TPU/GPU) but a zero-copy VIEW of the runtime's
+    buffer on the CPU backend — where the train loop's next donated step
+    would overwrite it under the background writer. Copy only the views;
+    a second memcpy of an already-owning transfer would double the one
+    cost the async path is built to minimize."""
+    if array.base is None and array.flags.owndata:
+        return array
+    return np.array(array, copy=True)
+
+
+def _snapshot_sharded(tree: Any, process: int, copy: bool = False) -> dict:
+    """Device→host snapshot of this process's replica-0 addressable shards.
+
+    ``copy=True`` decouples every leaf from caller-owned memory: the async
+    pipeline serializes AFTER returning control to the train loop, whose
+    next step may donate/overwrite the buffers a zero-copy view aliases."""
     arrays = {}
     for leaf_index, leaf in enumerate(jax.tree.leaves(tree)):
         if isinstance(leaf, jax.Array) and hasattr(leaf, "addressable_shards"):
@@ -145,24 +186,37 @@ def save_checkpoint_sharded(directory, step: int, tree: Any,
             for shard in leaf.addressable_shards:
                 if shard.replica_id != 0:
                     continue  # one copy of replicated shards is enough
+                data = np.asarray(shard.data)
                 arrays[_index_key(leaf_index, shard.index, shape)] = \
-                    np.asarray(shard.data)
+                    _decoupled(data) if copy else data
         else:
             array = np.asarray(leaf)
             if process == 0:  # plain host values: process 0's copy wins
+                if copy:
+                    # Always copy plain host leaves: np.asarray of a numpy
+                    # input IS the caller's array (owning or not), and the
+                    # caller may mutate it after save() returns.
+                    array = np.array(array, copy=True)
                 index = tuple(slice(0, dim) for dim in array.shape)
                 arrays[_index_key(leaf_index, index, array.shape)] = array
+    return arrays
 
-    final = directory / f"ckpt-{step}.shard-{process}.npz"
-    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
-    try:
-        with os.fdopen(fd, "wb") as handle:
-            np.savez(handle, **arrays)
-        os.replace(tmp, final)
-    except BaseException:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-        raise
+
+def _publish_sharded(directory: Path, step: int, arrays: dict, process: int,
+                     process_count: int, keep: Optional[int],
+                     protect: Iterable[int] = ()) -> tuple:
+    """Serialize + atomically publish one process's shard of ``step``.
+
+    Shared by the sync and async paths, so both produce byte-identical
+    layouts (same shard filenames, same meta/LATEST_SHARDED contract).
+    ``protect``: steps that must survive pruning regardless of age — the
+    async writer passes its in-flight set so retention can never delete a
+    step another queued save still depends on. Returns ``(final_path,
+    pruned_paths)``; the pruned list lets the direct-upload pipeline mirror
+    deletions into the bucket."""
+    directory.mkdir(parents=True, exist_ok=True)
+    final = _write_npz_atomic(
+        directory, f"ckpt-{step}.shard-{process}.npz", arrays)
 
     if process == 0:
         # Reap shard files beyond this topology: a re-save of the same step
@@ -171,7 +225,7 @@ def save_checkpoint_sharded(directory, step: int, tree: Any,
         # reject the step forever.
         for stale in directory.glob(f"ckpt-{step}.shard-*.npz"):
             match = _SHARD_RE.match(stale.name)
-            if match and int(match.group(2)) >= jax.process_count():
+            if match and int(match.group(2)) >= process_count:
                 try:
                     stale.unlink()
                 except OSError:
@@ -181,7 +235,7 @@ def save_checkpoint_sharded(directory, step: int, tree: Any,
         # step's completeness by the count it was saved with.
         meta = directory / f"ckpt-{step}.meta.tmp"
         meta.write_text(json.dumps({
-            "step": step, "process_count": jax.process_count()}))
+            "step": step, "process_count": process_count}))
         os.replace(meta, directory / f"ckpt-{step}.meta")
         # A SEPARATE pointer file: repointing the plain LATEST at a shard
         # file would make latest_step()/restore_checkpoint() chase a
@@ -189,22 +243,241 @@ def save_checkpoint_sharded(directory, step: int, tree: Any,
         pointer = directory / "LATEST_SHARDED.tmp"
         pointer.write_text(json.dumps({
             "step": step, "file": final.name,
-            "process_count": jax.process_count()}))
+            "process_count": process_count}))
         os.replace(pointer, directory / "LATEST_SHARDED")
+    pruned = []
     if keep is not None:
         own = sorted(
             int(match.group(1)) for path in directory.iterdir()
             if (match := _SHARD_RE.match(path.name))
             and int(match.group(2)) == process)
-        retained = set(own[-keep:]) | {step}
+        retained = set(own[-keep:]) | {step} | set(protect)
         for old in own:
             if old in retained:
                 continue
-            (directory /
-             f"ckpt-{old}.shard-{process}.npz").unlink(missing_ok=True)
+            shard_path = directory / f"ckpt-{old}.shard-{process}.npz"
+            shard_path.unlink(missing_ok=True)
+            pruned.append(shard_path)
             if process == 0:
-                (directory / f"ckpt-{old}.meta").unlink(missing_ok=True)
-    return final
+                meta_path = directory / f"ckpt-{old}.meta"
+                meta_path.unlink(missing_ok=True)
+                pruned.append(meta_path)
+    return final, pruned
+
+
+# -- async overlapped checkpointing -------------------------------------------
+#
+# Every sync save stalls the train loop on device→host transfer + npz
+# serialization + rename, and frequent checkpoints are exactly what spot/
+# preemptible recovery needs (Check-N-Run NSDI '22; Orbax/T5X async). The
+# async pipeline splits the save: the caller pays ONLY the device→host
+# snapshot; one background writer thread serializes, atomically publishes,
+# and (optionally) streams the shard files straight into the task bucket —
+# the next training steps overlap all of it.
+
+
+class AsyncCheckpointError(RuntimeError):
+    """A background save (write or bucket upload) failed. Raised on the next
+    ``save()``/``wait()``/``close()`` after the failure — async errors are
+    deferred, never dropped."""
+
+
+class AsyncCheckpointer:
+    """Overlapped sharded checkpointing: snapshot → background write →
+    optional streaming bucket upload.
+
+    ``save(step, tree)`` snapshots this process's replica-0 addressable
+    shards to host memory (a copy — the train loop may donate the device
+    buffers to its next step) and returns immediately; a single background
+    writer thread then serializes and atomically publishes the same files
+    ``save_checkpoint_sharded`` would have written (same shard names, same
+    meta/LATEST_SHARDED contract — restore via
+    :func:`restore_checkpoint_sharded`). The single writer is the barrier:
+    overlapping saves queue FIFO and can never interleave their writes.
+
+    ``upload_remote``: a storage connection string (or plain path) naming
+    the bucket prefix for this checkpoint directory (e.g.
+    ``f"{os.environ['TPU_TASK_DATA_REMOTE']}/checkpoints"`` under the worker
+    agent — or pass ``upload_remote="auto"`` to derive exactly that). When
+    set, each published step streams straight into the bucket through the
+    storage backends (chunked resumable / multipart for large shards) instead
+    of waiting for the agent's next whole-directory sync tick; source mtimes
+    are preserved so the agent's size+mtime diff skips what was already
+    pushed. The remote pointer uploads LAST, so a remote reader never sees
+    LATEST_SHARDED name a step whose files haven't landed.
+
+    Failure semantics: a background failure is stored and raised (wrapped in
+    :class:`AsyncCheckpointError`) on the next ``save()``/``wait()``/
+    ``close()``. A crash mid-save never corrupts the previous step: shard
+    files publish via temp-file + rename and restore rejects partial sets.
+
+    Retention: ``keep`` prunes exactly like the sync path, and the writer
+    protects every queued/in-flight step from pruning, so a save can never
+    delete a step still being written. Multi-host: every process runs its
+    own ``AsyncCheckpointer`` over the same directory, like every process
+    calls ``save_checkpoint_sharded``.
+    """
+
+    def __init__(self, directory, keep: Optional[int] = None,
+                 upload_remote: Optional[str] = None,
+                 upload_workers: int = 4, max_pending: int = 2):
+        _validate_sharded_keep(keep)
+        if max_pending < 1:
+            raise ValueError(f"max_pending must be >= 1, got {max_pending}")
+        if upload_remote == "auto":
+            upload_remote = resolve_upload_remote(directory)
+        self.directory = Path(directory)
+        self.keep = keep
+        self.upload_remote = upload_remote
+        self.upload_workers = upload_workers
+        # Bounded: each queued save holds a FULL host copy of the tree, so
+        # an unbounded queue is an OOM under saves that outpace the writer.
+        # When full, save() blocks until the writer drains — backpressure,
+        # never unbounded memory (worst case max_pending+1 copies live).
+        self._queue: queue.Queue = queue.Queue(maxsize=max_pending)
+        self._lock = threading.Lock()
+        self._inflight: set = set()
+        self._error: Optional[BaseException] = None
+        self._backend = None
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+
+    # -- train-loop side -----------------------------------------------------
+    def save(self, step: int, tree: Any) -> Path:
+        """Snapshot ``tree`` and schedule the write; returns the path the
+        background writer will publish. Blocked time is the device→host
+        snapshot — plus, when ``max_pending`` saves are already queued, the
+        wait for the writer to drain one (bounded memory over latency)."""
+        if self._closed:
+            raise RuntimeError("AsyncCheckpointer is closed")
+        self._raise_pending()
+        process = jax.process_index()
+        arrays = _snapshot_sharded(tree, process, copy=True)
+        with self._lock:
+            self._inflight.add(step)
+        self._ensure_writer()
+        self._queue.put((step, arrays, process, jax.process_count()))
+        return self.directory / f"ckpt-{step}.shard-{process}.npz"
+
+    def wait(self) -> None:
+        """Block until every queued save is published (and uploaded, when
+        direct upload is on); re-raise any background failure."""
+        self._queue.join()
+        self._raise_pending()
+
+    def close(self) -> None:
+        """Drain the queue, stop the writer, surface any pending failure."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._thread is not None:
+            self._queue.put(None)
+            self._thread.join()
+        self._raise_pending()
+
+    def __enter__(self) -> "AsyncCheckpointer":
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        self.close()
+
+    def _raise_pending(self) -> None:
+        with self._lock:
+            error, self._error = self._error, None
+        if error is not None:
+            raise AsyncCheckpointError(
+                f"background checkpoint save failed: {error}") from error
+
+    # -- writer side ---------------------------------------------------------
+    def _ensure_writer(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._writer, name="async-checkpoint-writer",
+                daemon=True)
+            self._thread.start()
+
+    def _writer(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                self._queue.task_done()
+                return
+            step, arrays, process, process_count = item
+            try:
+                with self._lock:
+                    protect = frozenset(self._inflight - {step})
+                final, pruned = _publish_sharded(
+                    self.directory, step, arrays, process, process_count,
+                    self.keep, protect=protect)
+                if self.upload_remote:
+                    self._upload_step(step, final, process, pruned)
+            except BaseException as error:
+                with self._lock:
+                    if self._error is None:  # first failure wins
+                        self._error = error
+            finally:
+                with self._lock:
+                    self._inflight.discard(step)
+                self._queue.task_done()
+
+    def _upload_step(self, step: int, final: Path, process: int,
+                     pruned: list) -> None:
+        """Stream this step's artifacts into the bucket prefix: shard file
+        (+ manifest) first, pointer strictly LAST — the remote durability
+        order must match the local publish order. Pruned steps are deleted
+        remotely best-effort (the agent's mirror sync also reaps them)."""
+        from tpu_task.storage.backends import parallel_map
+
+        backend = self._open_backend()
+
+        def push(name: str) -> None:
+            path = self.directory / name
+            backend.write_from_file(name, str(path))
+            if hasattr(backend, "set_mtime"):
+                # Preserved mtimes are what lets the agent's incremental
+                # size+mtime diff skip files this pipeline already pushed.
+                backend.set_mtime(name, os.path.getmtime(path))
+
+        names = [final.name]
+        meta = self.directory / f"ckpt-{step}.meta"
+        if process == 0 and meta.exists():
+            names.append(meta.name)
+        parallel_map([lambda name=name: push(name) for name in names],
+                     min(self.upload_workers, len(names)))
+        if process == 0 and (self.directory / "LATEST_SHARDED").exists():
+            push("LATEST_SHARDED")
+        for path in pruned:
+            try:
+                backend.delete(path.name)
+            except Exception:
+                pass  # mirror sync reaps leftovers; never fail a save on this
+
+    def _open_backend(self):
+        if self._backend is None:
+            from tpu_task.storage.backends import open_backend
+
+            self._backend, _ = open_backend(self.upload_remote)
+        return self._backend
+
+
+def resolve_upload_remote(directory) -> Optional[str]:
+    """Bucket prefix for direct checkpoint upload under the worker agent:
+    ``$TPU_TASK_DATA_REMOTE/<directory relative to the workdir>`` — the
+    agent exports that variable, runs the task with cwd=workdir, and
+    mirrors the workdir to ``<remote>/data``, so the upload prefix must be
+    the same RELATIVE path the mirror uses (``out/ckpts`` → ``data/out/
+    ckpts``; a bare basename would upload beside the mirror's copy and the
+    next delete pass would reap it as extraneous). None outside an agent,
+    and None for directories that escape the workdir (the mirror never
+    ships those, so a direct upload would be deleted the same way) —
+    AsyncCheckpointer then skips direct upload gracefully."""
+    root = os.environ.get("TPU_TASK_DATA_REMOTE", "")
+    if not root:
+        return None
+    relative = os.path.relpath(os.path.abspath(directory), os.getcwd())
+    if relative.split(os.sep, 1)[0] == os.pardir:
+        return None
+    return f"{root.rstrip('/')}/{relative.replace(os.sep, '/')}"
 
 
 def restore_checkpoint_sharded(directory, template: Any,

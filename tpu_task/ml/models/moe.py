@@ -21,6 +21,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec
 
+from tpu_task.ml.parallel.mesh import shard_map as _shard_map
+
 
 @dataclass(frozen=True)
 class MoEConfig:
@@ -204,7 +206,7 @@ def apply_sharded(params, cfg: MoEConfig, x, mesh, axis_name: str = "ep",
 
     token_spec = PartitionSpec(batch_axes, None, None)  # batch over dp×ep
     expert_spec = PartitionSpec(axis_name, None, None)  # experts sharded on ep
-    fn = jax.shard_map(
+    fn = _shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(PartitionSpec(None, None), expert_spec, expert_spec,

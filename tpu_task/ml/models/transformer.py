@@ -404,12 +404,13 @@ def _match_vma(init, *refs):
     mesh axis the reference arrays vary on — scan carries built from
     ``jnp.zeros`` inside ``shard_map`` (the pipeline head runs the fused
     loss there) must match the body outputs' varying axes."""
+    from tpu_task.ml.parallel.mesh import pvary, value_vma
+
     vma = frozenset()
     for r in refs:
-        vma = vma | getattr(jax.typeof(r), "vma", frozenset())
+        vma = vma | value_vma(r)
     if not vma:
         return init
-    from tpu_task.ml.parallel.mesh import pvary
 
     return jax.tree.map(lambda x: pvary(x, tuple(vma)), init)
 

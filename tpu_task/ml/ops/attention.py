@@ -51,10 +51,22 @@ def _dot_tn(a, b):  # a.T @ b with f32 accumulation
 
 def _vma(*arrays):
     """Union of the inputs' varying-mesh-axes (for pallas under shard_map)."""
+    from tpu_task.ml.parallel.mesh import value_vma
+
     out = frozenset()
     for a in arrays:
-        out = out | getattr(jax.typeof(a), "vma", frozenset())
+        out = out | value_vma(a)
     return out
+
+
+def _out_struct(shape, dtype, vma):
+    """``jax.ShapeDtypeStruct`` carrying ``vma`` where the jax version
+    supports the kwarg; plain struct otherwise (pre-vma jax tracks no
+    varying axes, so there is nothing to declare)."""
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except TypeError:
+        return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def expand_kv_heads(kv, n_heads: int):
@@ -217,12 +229,12 @@ def flash_attention(
     grid = (b * h, sq // block_q, num_k_blocks)
     out_specs = [
         pl.BlockSpec((None, block_q, d), lambda bh, qb, kb: (bh, qb, 0))]
-    out_shape = [jax.ShapeDtypeStruct((b * h, sq, d), q.dtype, vma=vma)]
+    out_shape = [_out_struct((b * h, sq, d), q.dtype, vma)]
     if return_lse:
         out_specs.append(
             pl.BlockSpec((None, block_q, LANES), lambda bh, qb, kb: (bh, qb, 0)))
         out_shape.append(
-            jax.ShapeDtypeStruct((b * h, sq, LANES), jnp.float32, vma=vma))
+            _out_struct((b * h, sq, LANES), jnp.float32, vma))
     results = pl.pallas_call(
         kernel,
         grid=grid,
@@ -493,7 +505,7 @@ def _flash_bwd_with_stats(q, k, v, do, lse, delta, causal, *, q_offset,
             pl.BlockSpec((None, block_q, LANES), lambda bh, qb, kb: (bh, qb, 0)),
         ],
         out_specs=pl.BlockSpec((None, block_q, d), lambda bh, qb, kb: (bh, qb, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype, vma=vma),
+        out_shape=_out_struct((b * h, sq, d), q.dtype, vma),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(qf, kf, vf, dof, lsef, deltaf)
@@ -517,8 +529,8 @@ def _flash_bwd_with_stats(q, k, v, do, lse, delta, causal, *, q_offset,
             pl.BlockSpec((None, block_k, d), lambda bh, kb, qb: (bh, kb, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype, vma=vma),
-            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype, vma=vma),
+            _out_struct((b * h, sk, d), k.dtype, vma),
+            _out_struct((b * h, sk, d), v.dtype, vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),

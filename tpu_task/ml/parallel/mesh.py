@@ -77,18 +77,64 @@ def make_mesh(
     return jax.sharding.Mesh(dev_array, tuple(axis_names))
 
 
+def value_vma(x) -> frozenset:
+    """``jax.typeof(x).vma`` — the mesh axes ``x`` varies over under
+    shard_map — or ``frozenset()`` on jax versions predating the vma
+    system (no ``jax.typeof``/``lax.pcast``/``lax.pvary``: those versions
+    track no varying axes, so the degenerate answer is exact, not a lie).
+    The single version gate every vma consumer shares."""
+    import jax
+
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return frozenset()
+    return getattr(typeof(x), "vma", frozenset())
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=None):
+    """``jax.shard_map`` with a fallback for jax versions predating the
+    top-level API: ``jax.experimental.shard_map.shard_map``, whose
+    equivalent of ``check_vma`` is spelled ``check_rep``. The one place
+    that knows both spellings — every shard_map call in the tree routes
+    through here."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        kwargs = {} if check_vma is None else {"check_vma": check_vma}
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kwargs)
+    from jax.experimental.shard_map import shard_map as legacy_shard_map
+
+    kwargs = {} if check_vma is None else {"check_rep": check_vma}
+    return legacy_shard_map(f, mesh=mesh, in_specs=in_specs,
+                            out_specs=out_specs, **kwargs)
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a mesh axis inside shard_map: ``lax.axis_size`` on
+    jax versions that have it, the axis-env lookup (private module — the
+    pre-axis_size spelling of the same table) on older ones."""
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    from jax._src.core import get_axis_env
+
+    return get_axis_env().axis_size(axis_name)
+
+
 def pvary(x, axis_names):
     """Mark ``x`` as device-varying over ``axis_names`` inside shard_map.
 
     Idempotent: an input already varying over the axes passes through (the
     raw primitive rejects varying→varying). Wraps ``lax.pcast(...,
     to='varying')`` (new name) with a fallback to the deprecated
-    ``lax.pvary`` on older jax.
+    ``lax.pvary`` on older jax; on jax predating the vma system entirely
+    it is the identity (there is no varying-axis bookkeeping to satisfy).
     """
-    import jax
     from jax import lax
 
-    vma = getattr(jax.typeof(x), "vma", frozenset())
+    vma = value_vma(x)
     missing = tuple(axis for axis in axis_names if axis not in vma)
     if not missing:
         return x
@@ -97,7 +143,9 @@ def pvary(x, axis_names):
             return lax.pcast(x, missing, to="varying")
         except TypeError:
             pass
-    return lax.pvary(x, missing)
+    if hasattr(lax, "pvary"):
+        return lax.pvary(x, missing)
+    return x
 
 
 def worker_env(worker_id: int, num_workers: int, coordinator: str) -> dict:

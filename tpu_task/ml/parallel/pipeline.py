@@ -25,6 +25,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec
 
+from tpu_task.ml.parallel.mesh import shard_map as _shard_map
+
 
 def pipeline_apply(
     stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
@@ -83,7 +85,7 @@ def pipeline_apply(
         mask = (stage == n_stages - 1).astype(outputs.dtype)
         return lax.psum(outputs * mask, axis_name)
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(
@@ -332,7 +334,7 @@ def pipeline_train(
     out_specs = (PartitionSpec(), PartitionSpec(axis_name))
     if with_head:
         out_specs = out_specs + (PartitionSpec(), batch_spec)
-    fn = jax.shard_map(
+    fn = _shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec
 
+from tpu_task.ml.parallel.mesh import axis_size as _axis_size, shard_map as _shard_map
 from tpu_task.ml.ops.attention import (
     NEG_INF,
     block_attention_bwd,
@@ -68,7 +69,7 @@ def _fold(o, lse, o_b, lse_b):
 
 
 def _ring_fwd_impl(q, k, v, axis_name, causal, impl, interpret):
-    axis_size = lax.axis_size(axis_name)
+    axis_size = _axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     n_heads = q.shape[2]  # k/v may be narrower (GQA): expand per block
 
@@ -113,7 +114,7 @@ def _ring_bwd_impl(q, k, v, o, lse, do, axis_name, causal, impl, interpret):
     dk/dv is summed over the query group (the exact transpose of the local
     expansion) before joining the ring, so backward collective bytes shrink
     by the group factor too."""
-    axis_size = lax.axis_size(axis_name)
+    axis_size = _axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     n_heads = q.shape[2]
     kv_heads = k.shape[2]
@@ -249,7 +250,7 @@ def _pad_rows(o_half, lse_half, c):
 
 
 def _zigzag_fwd_impl(q, k, v, axis_name, impl, interpret):
-    axis_size = lax.axis_size(axis_name)
+    axis_size = _axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     c = q.shape[1] // 2
     n_heads = q.shape[2]  # k/v may be narrower (GQA): expand per block
@@ -297,7 +298,7 @@ def _zigzag_fwd_impl(q, k, v, axis_name, impl, interpret):
 
 
 def _zigzag_bwd_impl(q, k, v, o, lse, do, axis_name, impl, interpret):
-    axis_size = lax.axis_size(axis_name)
+    axis_size = _axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     c = q.shape[1] // 2
     n_heads = q.shape[2]
@@ -424,7 +425,7 @@ def zigzag_ring_attention(q, k, v, mesh, axis_name: str = "sp",
     """
     devices = mesh.shape[axis_name]
     spec = PartitionSpec(batch_axes, axis_name, None, None)
-    fn = jax.shard_map(
+    fn = _shard_map(
         functools.partial(zigzag_ring_attention_shard, axis_name=axis_name,
                           impl=impl, interpret=interpret),
         mesh=mesh,
@@ -460,7 +461,7 @@ def ring_attention(q, k, v, mesh, axis_name: str = "sp", causal: bool = True,
     zigzag_ring_attention).
     """
     spec = PartitionSpec(batch_axes, axis_name, None, None)
-    fn = jax.shard_map(
+    fn = _shard_map(
         functools.partial(ring_attention_shard, axis_name=axis_name,
                           causal=causal, impl=impl, interpret=interpret),
         mesh=mesh,

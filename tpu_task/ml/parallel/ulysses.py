@@ -31,6 +31,7 @@ import jax
 from jax import lax
 from jax.sharding import PartitionSpec
 
+from tpu_task.ml.parallel.mesh import shard_map as _shard_map
 from tpu_task.ml.ops.attention import dot_product_attention
 
 
@@ -103,7 +104,7 @@ def ulysses_attention(q, k, v, mesh, axis_name: str = "sp",
         k = expand_kv_heads(k, heads)
         v = expand_kv_heads(v, heads)
     spec = PartitionSpec(batch_axes, axis_name, None, None)
-    fn = jax.shard_map(
+    fn = _shard_map(
         functools.partial(ulysses_attention_shard, axis_name=axis_name,
                           causal=causal),
         mesh=mesh,

@@ -24,6 +24,7 @@ from tpu_task.common.values import Status, StatusCode
 from tpu_task.storage import native
 from tpu_task.storage.backends import (
     Backend, Connection, LocalBackend, contained_path, open_backend,
+    parallel_map,
 )
 from tpu_task.storage.filters import FilterSet, compile_exclude_list, limit_transfer
 
@@ -43,16 +44,14 @@ CLOUD_COPY_WORKERS = int(os.environ.get("TPU_TASK_TRANSFERS", "16"))
 def _for_each(fn, keys: Sequence[str], parallel: bool) -> None:
     """Apply ``fn`` to every key, on a thread pool for network-bound work.
 
-    The pool drain re-raises the first worker exception, mirroring rclone's
-    multiplexed transfers (SURVEY.md §2.9 item 1)."""
+    Rides :func:`parallel_map`'s fail-fast drain: the first worker exception
+    cancels all still-queued transfers and re-raises after in-flight siblings
+    settle — ``pool.map`` would let doomed multi-GB siblings keep streaming
+    to completion after the failure (the hazard backends.py already fixed
+    for part uploads)."""
     if parallel and len(keys) > 1:
-        from concurrent.futures import ThreadPoolExecutor
-
-        with ThreadPoolExecutor(
-            max_workers=min(CLOUD_COPY_WORKERS, len(keys))
-        ) as pool:
-            for _result in pool.map(fn, keys):
-                pass
+        parallel_map([lambda key=key: fn(key) for key in keys],
+                     min(CLOUD_COPY_WORKERS, len(keys)))
     else:
         for key in keys:
             fn(key)
@@ -145,9 +144,20 @@ def _transfer(source_remote: str, destination_remote: str, filters: FilterSet,
 
     if delete_extraneous:
         wanted = set(keys)
+        src_root = source.local_root()
         for key in destination.list():
-            if key not in wanted and filters.includes_file(key):
-                destination.delete(key)
+            if key in wanted or not filters.includes_file(key):
+                continue
+            # The wanted set comes from the listing at the START of the
+            # tick; a concurrent producer (AsyncCheckpointer publishing a
+            # step and direct-uploading it) may have created the key on
+            # BOTH sides since. Deleting from the stale set would remove
+            # the newest durable checkpoint from the bucket — re-check the
+            # live source when it is a local directory (the agent's case).
+            if src_root is not None and os.path.isfile(
+                    contained_path(src_root, key)):
+                continue
+            destination.delete(key)
         if isinstance(destination, LocalBackend):
             destination.remove_empty_dirs()
 
