@@ -451,6 +451,68 @@ def bench_generation() -> dict:
     }
 
 
+def bench_transport(n_objects: int = 200, rounds: int = 3) -> dict:
+    """Small-object PUT/GET/DELETE ops/s against the loopback GCS emulator,
+    plus the emulator-side count of TCP connections that served them: the
+    pooled keep-alive transport must serve all requests over ≤ pool-size
+    connections, where the pre-pool client opened one TCP connection PER
+    REQUEST (N ops ⇒ N connections). ``batch_delete`` rides the JSON-API
+    batch endpoint (≤100 sub-deletes per round-trip). Same min-of-rounds
+    discipline as ``data_plane``; the client is serial, so the expected
+    connection count is exactly 1 (+~2 for the parallel batch calls)."""
+    from tpu_task.storage.backends import GCSBackend
+    from tpu_task.storage.gcs_emulator import LoopbackGCS
+    from tpu_task.storage.http_util import default_pool
+
+    payload = b"x" * 1024
+    keys = [f"small/{i:04d}" for i in range(n_objects)]
+    best = {"put": float("inf"), "get": float("inf"),
+            "delete": float("inf"), "batch_delete": float("inf")}
+    with LoopbackGCS() as server:
+        backend = GCSBackend("bench")
+        server.attach(backend)
+        for _round in range(rounds):
+            t0 = time.perf_counter()
+            for key in keys:
+                backend.write(key, payload)
+            best["put"] = min(best["put"], time.perf_counter() - t0)
+
+            t0 = time.perf_counter()
+            for key in keys:
+                backend.read(key)
+            best["get"] = min(best["get"], time.perf_counter() - t0)
+
+            t0 = time.perf_counter()
+            for key in keys:
+                backend.delete(key)
+            best["delete"] = min(best["delete"], time.perf_counter() - t0)
+
+            for key in keys:
+                backend.write(key, payload)
+            t0 = time.perf_counter()
+            backend.delete_batch(keys)
+            best["batch_delete"] = min(best["batch_delete"],
+                                       time.perf_counter() - t0)
+        connections = server.connections
+        batch_calls = server.batch_calls
+    requests = rounds * (4 * n_objects + batch_calls // rounds)
+    return {
+        "object_bytes": len(payload),
+        "n_objects": n_objects,
+        "rounds": rounds,
+        "put_ops_per_s": round(n_objects / best["put"], 1),
+        "get_ops_per_s": round(n_objects / best["get"], 1),
+        "delete_ops_per_s": round(n_objects / best["delete"], 1),
+        "batch_delete_ops_per_s": round(n_objects / best["batch_delete"], 1),
+        "requests_sent": requests,
+        "connections_opened": connections,
+        "pool_size": default_pool().max_idle_per_host,
+        "note": ("pooled keep-alive: connections_opened stays O(pool size) "
+                 "regardless of request count; the unpooled client opened "
+                 "one connection per request"),
+    }
+
+
 def bench_data_plane() -> dict:
     """1 GiB synthetic-checkpoint push/pull through each streaming cloud
     client against an in-process loopback server: GCS (chunked resumable
@@ -539,6 +601,16 @@ def bench_data_plane() -> dict:
                     "pull_MBps": round(size / 1e6 / pull_s, 1),
                     "verified_size": verified,
                 }
+            # Pin pooling in the headline data-plane numbers: a future PR
+            # that silently drops keep-alive shows up here as a connection
+            # count exploding back toward the request count.
+            results["connections_opened"] = {
+                "gcs": gcs_server.connections,
+                "s3": s3_server.connections,
+                "azureblob": az_server.connections,
+                "note": ("gcs counter includes the gcs_single_stream "
+                         "variant (same server)"),
+            }
         return {
             "object_gib": 1.0,
             "method": ("interleaved min-of-3 rounds (shared-host "
@@ -655,6 +727,7 @@ def main() -> int:
     flash = bench_flash_kernel()
     ring = bench_ring_schedule()
     generation = bench_generation()
+    transport = bench_transport()
     data_plane = bench_data_plane()
     checkpoint = bench_checkpoint()
     lifecycle_s = bench_lifecycle()
@@ -665,6 +738,7 @@ def main() -> int:
         "flash_attention": flash,
         "ring_schedule": ring,
         "generation": generation,
+        "transport": transport,
         "data_plane": data_plane,
         "checkpoint": checkpoint,
         "lifecycle_wallclock_s": round(lifecycle_s, 2),

@@ -190,6 +190,47 @@ def test_status_malformed_report_raises(mailbox):
         status(str(mailbox))
 
 
+def test_reports_fans_out_cloud_reads_in_parallel(monkeypatch):
+    """A cloud-backed status poll of an N-worker pod must not be N serial
+    round-trips: reads fan out over the transfer pool, and the result keeps
+    the listing's deterministic order regardless of completion order."""
+    import importlib
+    import threading
+
+    sync_module = importlib.import_module("tpu_task.storage.sync")
+
+    class SlowCloudBackend:
+        def __init__(self, blobs):
+            self.blobs = blobs
+            self.in_flight = 0
+            self.max_in_flight = 0
+            self._lock = threading.Lock()
+
+        def list(self, prefix=""):
+            return sorted(k for k in self.blobs if k.startswith(prefix))
+
+        def read(self, key):
+            with self._lock:
+                self.in_flight += 1
+                self.max_in_flight = max(self.max_in_flight, self.in_flight)
+            time.sleep(0.02)
+            with self._lock:
+                self.in_flight -= 1
+            return self.blobs[key]
+
+        def local_root(self):
+            return None  # cloud store → parallel path
+
+    backend = SlowCloudBackend(
+        {f"reports/status-m{i:02d}": f"report {i}".encode()
+         for i in range(8)})
+    monkeypatch.setattr(sync_module, "open_backend",
+                        lambda remote: (backend, None))
+    out = sync_module.reports(":googlecloudstorage:bkt", "status")
+    assert out == [f"report {i}" for i in range(8)]  # sorted-key order
+    assert backend.max_in_flight > 1  # genuinely concurrent
+
+
 def test_delete_storage(mailbox):
     (mailbox / "reports" / "task-m1").write_text("x")
     (mailbox / "data").mkdir()

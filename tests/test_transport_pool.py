@@ -1,0 +1,390 @@
+"""Pooled keep-alive HTTP transport: connection reuse, stale-socket
+handling, bounded idle set, and the GCS batch-delete path.
+
+The emulator-side connection counters make reuse falsifiable: N requests
+over ≤ pool-size TCP connections (the pre-pool client opened one per
+request). The pool's stale-socket single-retry and idle bounds are unit
+tested through the injectable connection-factory seam, and ``send``'s
+retry/``ok_statuses``/``with_headers`` contract is regression-tested
+through the REAL pooled path against a scripted loopback server — the
+fault-injection ``urlopen`` seam itself is covered by
+test_http_resilience.py, which must keep passing unchanged.
+"""
+
+import http.client
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from tpu_task.storage.backends import GCSBackend, parallel_map
+from tpu_task.storage.gcs_emulator import LoopbackGCS
+from tpu_task.storage.http_util import HTTPPool, send
+
+
+@pytest.fixture()
+def loopback():
+    with LoopbackGCS() as server:
+        yield server
+
+
+def _backend(server, prefix=""):
+    backend = GCSBackend("bkt", prefix)
+    server.attach(backend)
+    return backend
+
+
+# -- connection reuse over real sockets ---------------------------------------
+
+
+def test_serial_requests_share_one_connection(loopback):
+    backend = _backend(loopback)
+    for i in range(25):
+        backend.write(f"small/{i}", b"x")
+    for i in range(25):
+        assert backend.read(f"small/{i}") == b"x"
+    for i in range(25):
+        backend.delete(f"small/{i}")
+    assert backend.list() == []
+    # 76 requests from one client thread: the pooled transport must ride ONE
+    # persistent connection; the per-request client opened 76.
+    assert loopback.connections == 1
+
+
+def test_concurrent_requests_bounded_by_pool(loopback):
+    backend = _backend(loopback)
+    parallel_map([lambda i=i: backend.write(f"obj/{i}", b"y")
+                  for i in range(64)], 8)
+    assert len(loopback.objects) == 64
+    assert loopback.connections <= 8  # one per concurrent worker at most
+    before = loopback.connections
+    for i in range(64):
+        assert backend.read(f"obj/{i}") == b"y"
+    # The burst's connections were parked in the pool; the serial sweep
+    # reuses them instead of opening more.
+    assert loopback.connections == before
+
+
+def test_concurrent_checkout_with_fault_injection(loopback):
+    """Concurrent checkout under failures: workers racing the pool while the
+    server 404s half the requests must neither wedge nor leak — every
+    response (success or HTTPError) returns its connection for reuse."""
+    backend = _backend(loopback)
+    for i in range(0, 32, 2):
+        backend.write(f"k/{i}", b"v")
+
+    from tpu_task.common.errors import ResourceNotFoundError
+
+    outcomes = []
+
+    def fetch(i):
+        try:
+            backend.read(f"k/{i}")
+            outcomes.append("hit")
+        except ResourceNotFoundError:
+            outcomes.append("miss")
+
+    parallel_map([lambda i=i: fetch(i) for i in range(32)], 8)
+    assert sorted(set(outcomes)) == ["hit", "miss"]
+    assert outcomes.count("hit") == 16
+    assert loopback.connections <= 8
+
+
+def test_control_plane_polls_reuse_connection():
+    from tpu_task.backends.tpu.api import RestTpuClient
+    from tpu_task.backends.tpu.emulator import LoopbackTpu
+
+    with LoopbackTpu() as plane:
+        client = RestTpuClient("proj", "us-central2-b")
+        plane.attach(client)
+        for _ in range(10):
+            client.list_nodes()
+        assert plane.connections == 1
+
+
+# -- pool unit behavior through the connection-factory seam -------------------
+
+
+class _FakeRawResponse:
+    def __init__(self, body, will_close):
+        self.status, self.reason = 200, "OK"
+        self.headers = {}
+        self.will_close = will_close
+        self._body = body
+
+    def read(self):
+        return self._body
+
+
+class _FakeConn:
+    """Scripted http.client connection double. Script entries:
+    ("ok", body[, will_close]) | ("stale",)."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.closed = False
+        self.sock = None
+        self.timeout = None
+        self._pending = None
+
+    def request(self, method, path, body=None, headers=None):
+        entry = self.script.pop(0)
+        if entry[0] == "stale":
+            raise http.client.RemoteDisconnected("server closed idle socket")
+        self._pending = entry
+
+    def getresponse(self):
+        _kind, body, *rest = self._pending
+        return _FakeRawResponse(body, rest[0] if rest else False)
+
+    def close(self):
+        self.closed = True
+
+
+def _request(url="http://svc.example/a", method="GET"):
+    return urllib.request.Request(url, method=method)
+
+
+def test_stale_pooled_socket_retries_once_on_fresh_connection():
+    made = []
+
+    def connect(scheme, host, port, timeout):
+        # First connection: one good response, then stale on reuse.
+        script = ([("ok", b"first"), ("stale",)] if not made
+                  else [("ok", b"second")])
+        conn = _FakeConn(script)
+        made.append(conn)
+        return conn
+
+    pool = HTTPPool(connect=connect)
+    sleeps = []
+    assert send("GET", "http://svc.example/a",
+                urlopen=pool.urlopen, sleep=sleeps.append) == b"first"
+    # Reused socket dies with zero bytes read → ONE fresh-connection retry
+    # inside the pool, before (and without consuming) the backoff ladder.
+    assert send("GET", "http://svc.example/b",
+                urlopen=pool.urlopen, sleep=sleeps.append) == b"second"
+    assert len(made) == 2
+    assert pool.stale_retries == 1
+    assert made[0].closed
+    assert sleeps == []  # the backoff ladder never fired
+
+
+def test_all_stale_parked_sockets_drain_without_consuming_backoff():
+    """After a long pause the WHOLE idle set may be dead: one request must
+    drain every stale socket and land on a fresh connection without burning
+    any of send()'s backoff ladder."""
+    made = []
+
+    def connect(scheme, host, port, timeout):
+        conn = _FakeConn([("ok", b"fresh")])
+        made.append(conn)
+        return conn
+
+    pool = HTTPPool(connect=connect)
+    key = ("http", "svc.example", 80)
+    stale = [_FakeConn([("stale",)]) for _ in range(3)]
+    for conn in stale:
+        pool._checkin(key, conn)
+    sleeps = []
+    assert send("GET", "http://svc.example/a",
+                urlopen=pool.urlopen, sleep=sleeps.append) == b"fresh"
+    assert all(conn.closed for conn in stale)  # every dead socket discarded
+    assert len(made) == 1                      # exactly one fresh connection
+    assert pool.stale_retries == 3
+    assert sleeps == []                        # backoff ladder untouched
+
+
+def test_fresh_connection_failure_is_not_stale_retried():
+    made = []
+
+    def connect(scheme, host, port, timeout):
+        conn = _FakeConn([("stale",)])
+        made.append(conn)
+        return conn
+
+    pool = HTTPPool(connect=connect)
+    with pytest.raises(urllib.error.URLError):
+        pool.urlopen(_request())
+    # A FRESH connection dying is a real transport error: surface it to the
+    # caller's backoff ladder instead of looping inside the pool.
+    assert len(made) == 1
+
+
+def test_connection_close_response_is_not_pooled():
+    made = []
+
+    def connect(scheme, host, port, timeout):
+        conn = _FakeConn([("ok", b"one", True)] if not made
+                         else [("ok", b"two")])
+        made.append(conn)
+        return conn
+
+    pool = HTTPPool(connect=connect)
+    assert pool.urlopen(_request()).read() == b"one"
+    assert made[0].closed  # server said Connection: close (will_close)
+    assert pool.urlopen(_request()).read() == b"two"
+    assert len(made) == 2
+
+
+def test_idle_set_is_bounded():
+    pool = HTTPPool(max_idle_per_host=2)
+    key = ("http", "svc.example", 80)
+    conns = [_FakeConn([]) for _ in range(3)]
+    for conn in conns:
+        pool._checkin(key, conn)
+    assert [conn.closed for conn in conns] == [False, False, True]
+    pool.purge()
+    assert all(conn.closed for conn in conns)
+
+
+# -- send() contract through the REAL pooled path -----------------------------
+
+
+class _ScriptedHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    disable_nagle_algorithm = True
+
+    def _serve(self):
+        length = int(self.headers.get("Content-Length", "0"))
+        if length:
+            self.rfile.read(length)
+        code, headers, body = self.server.script.pop(0)
+        self.send_response(code)
+        for name, value in headers.items():
+            self.send_header(name, value)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    do_GET = do_PUT = do_POST = _serve
+
+    def log_message(self, *args):
+        pass
+
+
+@pytest.fixture()
+def scripted_server():
+    server = ThreadingHTTPServer(("127.0.0.1", 0), _ScriptedHandler)
+    server.script = []
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        yield server
+    finally:
+        server.shutdown()
+        server.server_close()
+        from tpu_task.storage.http_util import default_pool
+
+        default_pool().purge(port=server.server_address[1])
+
+
+def test_send_default_transport_honors_retry_after_ok_statuses_with_headers(
+        scripted_server):
+    """Regression: the full send() contract — Retry-After pacing,
+    ok_statuses error-as-success, with_headers — through the DEFAULT pooled
+    transport over a real socket, not an injected fake."""
+    scripted_server.script[:] = [
+        (429, {"Retry-After": "2"}, b""),
+        (308, {"Range": "bytes=0-41"}, b"partial"),
+    ]
+    port = scripted_server.server_address[1]
+    sleeps = []
+    body, headers = send(
+        "PUT", f"http://127.0.0.1:{port}/chunk", data=b"x",
+        ok_statuses=(308,), with_headers=True, sleep=sleeps.append)
+    assert body == b"partial"
+    assert {k.lower(): v for k, v in headers.items()}["range"] == "bytes=0-41"
+    assert sleeps == [2.0]
+
+
+def test_send_default_transport_retries_5xx_then_succeeds(scripted_server):
+    scripted_server.script[:] = [
+        (503, {}, b""),
+        (200, {}, b"recovered"),
+    ]
+    port = scripted_server.server_address[1]
+    sleeps = []
+    assert send("GET", f"http://127.0.0.1:{port}/x",
+                sleep=sleeps.append) == b"recovered"
+    assert sleeps == [0.5]
+
+
+# -- GCS batch deletes --------------------------------------------------------
+
+
+def test_batch_delete_many_objects_few_round_trips(loopback):
+    backend = _backend(loopback, prefix="task-3")
+    keys = [f"d/{i:03d}" for i in range(250)]
+    for key in keys:
+        backend.write(key, b"z")
+    backend.delete_batch(keys + ["never-existed"])  # 404 subop is success
+    assert backend.list() == []
+    assert loopback.batch_calls == 3  # ceil(251/100), not 251 DELETEs
+
+
+def test_batch_delete_retries_failed_subops_individually(loopback):
+    backend = _backend(loopback, prefix="t")
+    keys = ["k/0", "k/1", "k/2"]
+    for key in keys:
+        backend.write(key, b"v")
+
+    original_request = backend._request
+
+    def fake_batch_request(method, url, data=None, headers=None,
+                           ok_statuses=()):
+        if not url.endswith("/batch/storage/v1"):
+            # The single-delete fallback uses the real transport.
+            return original_request(method, url, data=data, headers=headers,
+                                    ok_statuses=ok_statuses)
+        return (b"--b\r\n"
+                b"Content-Type: application/http\r\n"
+                b"Content-ID: <response-1>\r\n\r\n"
+                b"HTTP/1.1 204 No Content\r\n\r\n\r\n"
+                b"--b\r\n"
+                b"Content-Type: application/http\r\n"
+                b"Content-ID: <response-2>\r\n\r\n"
+                b"HTTP/1.1 500 Backend Error\r\n\r\n\r\n"
+                b"--b\r\n"
+                b"Content-Type: application/http\r\n"
+                b"Content-ID: <response-3>\r\n\r\n"
+                b"HTTP/1.1 204 No Content\r\n\r\n\r\n"
+                b"--b--")
+
+    deleted = []
+    original_delete = backend.delete
+    backend._request = fake_batch_request
+    backend.delete = lambda key: (deleted.append(key), original_delete(key))
+    backend.delete_batch(keys)
+    # Only the 500'd suboperation goes through the single-delete ladder.
+    assert deleted == ["k/1"]
+    assert "t/k/1" not in loopback.objects
+
+
+def test_batch_delete_unparseable_response_falls_back_to_singles(loopback):
+    backend = _backend(loopback, prefix="t2")
+    keys = ["a", "b", "c"]
+    for key in keys:
+        backend.write(key, b"v")
+
+    backend._request = lambda *args, **kwargs: b"not multipart at all"
+    deleted = []
+    backend.delete = deleted.append
+    backend.delete_batch(keys)
+    assert sorted(deleted) == keys  # nothing silently assumed deleted
+
+
+def test_delete_storage_uses_batch_endpoint(loopback, monkeypatch):
+    import importlib
+
+    sync_module = importlib.import_module("tpu_task.storage.sync")
+    backend = _backend(loopback, prefix="task-7")
+    for i in range(120):
+        backend.write(f"out/{i:03d}", b"x")
+    monkeypatch.setattr(sync_module, "open_backend",
+                        lambda remote: (backend, None))
+    sync_module.delete_storage(":googlecloudstorage:bkt/task-7")
+    assert [k for k in loopback.objects if k.startswith("task-7/")] == []
+    assert loopback.batch_calls == 2  # 120 keys → 2 batch round-trips

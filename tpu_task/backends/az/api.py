@@ -43,7 +43,8 @@ class ArmClient:
 
     def _fetch_token(self):
         import urllib.parse
-        import urllib.request
+
+        from tpu_task.storage.http_util import send
 
         body = urllib.parse.urlencode({
             "grant_type": "client_credentials",
@@ -53,10 +54,11 @@ class ArmClient:
         }).encode()
         url = (f"https://login.microsoftonline.com/{self.tenant_id}"
                "/oauth2/v2.0/token")
-        opener = self._urlopen or urllib.request.urlopen
-        request = urllib.request.Request(url, data=body, method="POST")
-        with opener(request, timeout=30) as response:
-            payload = json.loads(response.read())
+        payload = json.loads(send(
+            "POST", url, data=body,
+            headers={"Content-Type": "application/x-www-form-urlencoded"},
+            timeout=30, urlopen=self._urlopen,
+            sleep=self._sleep or time.sleep))
         return payload["access_token"], float(payload.get("expires_in", 3600))
 
     def request(self, method: str, path: str, api_version: str,

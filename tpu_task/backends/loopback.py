@@ -19,6 +19,17 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 class LoopbackHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
+    # Headers and body leave as separate segments (unbuffered wfile); Nagle
+    # would hold the body for the client's delayed ACK (~40 ms) on every
+    # kept-alive request.
+    disable_nagle_algorithm = True
+
+    def setup(self) -> None:
+        super().setup()
+        # One handler per TCP connection — counts connections, so tests can
+        # assert the pooled client transport actually reuses sockets across
+        # control-plane polls.
+        self.emulator.count_connection()
 
     @property
     def emulator(self):
@@ -89,6 +100,7 @@ class LoopbackControlPlane:
     handler_class = LoopbackHandler
 
     def __init__(self):
+        self.connections = 0  # TCP connections accepted (keep-alive asserts)
         self._server = ThreadingHTTPServer(("127.0.0.1", 0),
                                            self.handler_class)
         self._server.emulator = self  # type: ignore[attr-defined]
@@ -96,13 +108,24 @@ class LoopbackControlPlane:
             target=self._server.serve_forever, daemon=True)
         self._lock = threading.Lock()
 
+    def count_connection(self) -> None:
+        with self._lock:
+            self.connections += 1
+
     def __enter__(self):
         self._thread.start()
         return self
 
     def __exit__(self, *exc):
+        from tpu_task.storage.http_util import default_pool
+
+        port = self.port
         self._server.shutdown()
         self._server.server_close()
+        # Idle keep-alive sockets in the shared pool point at this dead
+        # server; drop them so a later server on a reused ephemeral port
+        # never inherits one.
+        default_pool().purge(port=port)
 
     @property
     def port(self) -> int:

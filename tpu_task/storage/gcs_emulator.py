@@ -28,6 +28,18 @@ from typing import Dict, Optional, Tuple
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     server_version = "LoopbackGCS/1"
+    # The unbuffered wfile sends headers and body as separate segments;
+    # with Nagle on, the body segment waits out the client's delayed ACK
+    # (~40 ms) on every KEPT-ALIVE request — the pooled client would look
+    # slower than the reconnect-per-request one it replaced.
+    disable_nagle_algorithm = True
+
+    def setup(self) -> None:
+        super().setup()
+        # One handler per TCP connection: counts connections, not requests —
+        # the keep-alive reuse assertions and the bench transport section
+        # read this.
+        self._store().count_connection()
 
     # -- helpers -------------------------------------------------------------
     def _store(self) -> "LoopbackGCS":
@@ -49,8 +61,57 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *args) -> None:  # quiet
         pass
 
+    # -- batch ---------------------------------------------------------------
+    def _handle_batch(self) -> None:
+        """JSON-API batch endpoint: a multipart/mixed body of
+        ``application/http`` sub-requests, answered part-for-part with
+        per-suboperation statuses. Only DELETE sub-requests are understood —
+        the only kind this build sends (storage.objects.delete batching)."""
+        import http.client as _http_client
+
+        body = self._read_body()
+        match = re.search(r'boundary="?([^";]+)"?',
+                          self.headers.get("Content-Type", ""))
+        if not match:
+            self._reply(400, b"missing multipart boundary")
+            return
+        store = self._store()
+        with store._lock:  # parallel batch POSTs race this counter
+            store.batch_calls += 1
+        results = []
+        for part in body.split(b"--" + match.group(1).encode())[1:]:
+            if part.strip() in (b"", b"--"):
+                continue  # preamble / closing delimiter
+            sub = re.search(rb"([A-Z]+) (\S+) HTTP/1\.1", part)
+            cid = re.search(rb"Content-ID:\s*<([^>]+)>", part)
+            status = 400
+            if sub and sub.group(1) == b"DELETE":
+                obj = re.match(rb"/storage/v1/b/[^/]+/o/([^?\s]+)",
+                               sub.group(2))
+                if obj:
+                    key = urllib.parse.unquote(obj.group(1).decode())
+                    status = (404 if store.objects.pop(key, None) is None
+                              else 204)
+            results.append((cid.group(1).decode() if cid else "", status))
+        boundary = "batch_loopback_response"
+        pieces = []
+        for cid, status in results:
+            reason = _http_client.responses.get(status, "Unknown")
+            content_id = f"Content-ID: <response-{cid}>\r\n" if cid else ""
+            pieces.append(
+                (f"--{boundary}\r\nContent-Type: application/http\r\n"
+                 f"{content_id}\r\n"
+                 f"HTTP/1.1 {status} {reason}\r\n"
+                 f"Content-Length: 0\r\n\r\n\r\n").encode())
+        pieces.append(f"--{boundary}--".encode())
+        self._reply(200, b"".join(pieces), {
+            "Content-Type": f"multipart/mixed; boundary={boundary}"})
+
     # -- upload --------------------------------------------------------------
     def do_POST(self) -> None:
+        if self.path == "/batch/storage/v1":
+            self._handle_batch()
+            return
         parsed = urllib.parse.urlparse(self.path)
         query = urllib.parse.parse_qs(parsed.query)
         compose = re.match(r"^/storage/v1/b/([^/]+)/o/(.+)/compose$",
@@ -194,6 +255,8 @@ class LoopbackGCS:
     def __init__(self):
         self.objects: Dict[str, bytes] = {}
         self.buckets: set = set()
+        self.connections = 0  # TCP connections accepted (keep-alive asserts)
+        self.batch_calls = 0  # batch-endpoint POSTs served
         self._sessions: Dict[int, Tuple[str, bytearray, int]] = {}
         self._next_session = 1
         self._lock = threading.Lock()
@@ -202,14 +265,25 @@ class LoopbackGCS:
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True)
 
+    def count_connection(self) -> None:
+        with self._lock:
+            self.connections += 1
+
     # -- lifecycle -----------------------------------------------------------
     def __enter__(self) -> "LoopbackGCS":
         self._thread.start()
         return self
 
     def __exit__(self, *exc) -> None:
+        from tpu_task.storage.http_util import default_pool
+
+        port = self.port
         self._server.shutdown()
         self._server.server_close()
+        # Idle keep-alive sockets in the shared pool point at this dead
+        # server; drop them so a later server on a reused ephemeral port
+        # never inherits one.
+        default_pool().purge(port=port)
 
     @property
     def port(self) -> int:

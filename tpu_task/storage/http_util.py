@@ -1,9 +1,15 @@
-"""HTTP resilience shared by every REST client (GCS, Cloud TPU, GCE).
+"""HTTP transport + resilience shared by every REST client (GCS, S3, Azure
+Blob, Cloud TPU, GCE, EC2, ARM).
 
-The reference gets retry/backoff, token refresh, and request pacing for free
-from the cloud SDKs (aws-sdk-go-v2, google.golang.org/api — SURVEY.md §2.2-2.3
-clients); this build speaks raw REST, so the resilience layer lives here:
+The reference gets retry/backoff, token refresh, request pacing, AND pooled
+keep-alive connections for free from the cloud SDKs and rclone (aws-sdk-go-v2,
+google.golang.org/api — SURVEY.md §2.2-2.3 clients); this build speaks raw
+REST, so both layers live here:
 
+* :class:`HTTPPool` — thread-safe keep-alive connection pool on stdlib
+  ``http.client``: per-``(scheme, host, port)`` checkout/checkin, bounded
+  idle set, one shared ``ssl.SSLContext`` (TLS session reuse), and stale
+  parked sockets discarded in favor of one fresh-connection attempt.
 * :func:`send` — one request with bounded exponential backoff on 429/5xx and
   transient transport errors, honoring ``Retry-After``.
 * :class:`OAuthToken` — cached bearer token with expiry-aware refresh.
@@ -11,13 +17,21 @@ clients); this build speaks raw REST, so the resilience layer lives here:
   on 401 with a force-refreshed token (expired/revoked server-side).
 
 Everything is injectable (``urlopen``, ``sleep``, ``now``) so fault-injection
-tests can script 500s, 429s, and expired tokens hermetically.
+tests can script 500s, 429s, and expired tokens hermetically — the pool sits
+*behind* the ``urlopen`` seam (it IS the default ``urlopen``), so an injected
+transport bypasses it entirely and scripted tests never touch a socket.
 """
 
 from __future__ import annotations
 
+import http.client
+import io
+import os
+import ssl
 import threading
 import time as _time
+import urllib.error
+import urllib.parse
 from typing import Callable, Dict, Optional, Tuple
 
 RETRY_STATUSES = (408, 429, 500, 502, 503, 504)
@@ -26,11 +40,260 @@ BACKOFF_BASE = 0.5
 BACKOFF_CAP = 8.0
 RETRY_AFTER_CAP = 60.0
 
+# Max idle keep-alive connections kept per (scheme, host, port). Matches the
+# widest per-operation fan-out in the stack (8 ranged-download / part-upload
+# workers, TPU_TASK_TRANSFERS=16 cross-object streams), so a burst parks its
+# connections instead of reopening them next tick. The TPU_TASK_HTTP_POOL_SIZE
+# override is read when a pool is constructed, not at import, so exporting it
+# after the package loads (the agent's case) still takes effect.
+DEFAULT_POOL_SIZE = 16
+
+# Failure shapes of a pooled socket the server quietly closed between our
+# requests: nothing of a response was received, so retrying on another
+# connection is safe (every request in this stack is idempotent — PUT chunks
+# carry Content-Range, deletes tolerate 404) and costs no backoff.
+# RemoteDisconnected subclasses both BadStatusLine and ConnectionResetError.
+_STALE_ERRORS = (
+    http.client.BadStatusLine,
+    ConnectionResetError,
+    BrokenPipeError,
+    ConnectionAbortedError,
+    ssl.SSLEOFError,
+)
+
+_REDIRECT_STATUSES = (301, 302, 303, 307)
+
+
+class _PooledResponse:
+    """Fully-buffered response with the urllib surface callers use
+    (context manager, ``read()``, ``headers``, ``status``). Buffering the
+    body eagerly is what frees the connection for reuse — every caller in
+    this stack reads to EOF anyway."""
+
+    def __init__(self, status: int, reason: str, headers, body: bytes):
+        self.status = self.code = status
+        self.reason = reason
+        self.headers = headers
+        self._body = body
+
+    def read(self) -> bytes:
+        body, self._body = self._body, b""
+        return body
+
+    def getcode(self) -> int:
+        return self.status
+
+    def __enter__(self) -> "_PooledResponse":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+class HTTPPool:
+    """Thread-safe keep-alive connection pool over stdlib ``http.client``.
+
+    Persistent connections are checked out per ``(scheme, host, port)`` and
+    checked back into a bounded idle set (LIFO, so the warmest socket is
+    reused first) once the response is fully read. All HTTPS connections
+    share one ``ssl.SSLContext``, so TLS sessions resume across connections
+    to the same host instead of paying a full handshake each time. A
+    connection is NOT pooled when the server asked to close it
+    (``Connection: close``) or spoke a pre-keep-alive protocol (HTTP/1.0
+    downgrade) — ``http.client`` surfaces both as ``will_close``.
+
+    Failures surface as ``urllib.error`` exceptions so :func:`send`'s
+    retry/backoff ladder is transport-agnostic, with one addition: a request
+    that dies on a REUSED connection before any response bytes arrive
+    discards that socket and moves on (draining further dead parked sockets
+    if the whole idle set expired during a pause) until it runs on a fresh
+    connection — which gets exactly one attempt — all *inside* the pool,
+    before (and without consuming) the caller's backoff budget. The server
+    idling out pooled sockets is routine, not an error.
+
+    ``connect`` is an injection seam for tests: a callable
+    ``(scheme, host, port, timeout) -> connection``.
+    """
+
+    def __init__(self, max_idle_per_host: int = 0, connect=None):
+        self.max_idle_per_host = max_idle_per_host or int(os.environ.get(
+            "TPU_TASK_HTTP_POOL_SIZE", str(DEFAULT_POOL_SIZE)))
+        self._lock = threading.Lock()
+        self._idle: Dict[tuple, list] = {}
+        self._ssl_context: Optional[ssl.SSLContext] = None
+        self._connect = connect or self._new_connection
+        self.connections_opened = 0
+        self.stale_retries = 0
+
+    # -- connection lifecycle -------------------------------------------------
+    def _context(self) -> ssl.SSLContext:
+        with self._lock:
+            if self._ssl_context is None:
+                self._ssl_context = ssl.create_default_context()
+            return self._ssl_context
+
+    def _new_connection(self, scheme: str, host: str, port: int,
+                        timeout: float):
+        if scheme == "https":
+            return http.client.HTTPSConnection(
+                host, port, timeout=timeout, context=self._context())
+        return http.client.HTTPConnection(host, port, timeout=timeout)
+
+    def _checkout(self, key: tuple, timeout: float):
+        """An idle pooled connection if one exists (reused=True), else a
+        fresh one. Reused sockets get the caller's timeout re-applied."""
+        with self._lock:
+            idle = self._idle.get(key)
+            if idle:
+                conn = idle.pop()
+                if not idle:
+                    del self._idle[key]
+                conn.timeout = timeout
+                sock = getattr(conn, "sock", None)
+                if sock is not None:
+                    try:
+                        sock.settimeout(timeout)
+                    except OSError:
+                        pass
+                return conn, True
+        conn = self._connect(key[0], key[1], key[2], timeout)
+        with self._lock:
+            self.connections_opened += 1
+        return conn, False
+
+    def _checkin(self, key: tuple, conn) -> None:
+        with self._lock:
+            idle = self._idle.setdefault(key, [])
+            if len(idle) < self.max_idle_per_host:
+                idle.append(conn)
+                return
+        conn.close()
+
+    def purge(self, port: Optional[int] = None) -> None:
+        """Close idle connections — all of them, or only those to ``port``
+        (loopback emulators purge their port on teardown so a later server
+        on a reused ephemeral port never inherits a stale socket)."""
+        with self._lock:
+            if port is None:
+                victims, self._idle = self._idle, {}
+            else:
+                victims = {key: conns for key, conns in self._idle.items()
+                           if key[2] == port}
+                for key in victims:
+                    del self._idle[key]
+        for conns in victims.values():
+            for conn in conns:
+                conn.close()
+
+    # -- request path ---------------------------------------------------------
+    def urlopen(self, request, timeout: float = 60.0):
+        """Drop-in for ``urllib.request.urlopen(request, timeout=...)`` over
+        pooled connections: same ``HTTPError``/``URLError`` surface (the
+        retry layer cannot tell the transports apart), same bounded redirect
+        following, same implicit form Content-Type on bodied requests."""
+        method = request.get_method()
+        url = request.full_url
+        data = request.data
+        headers = dict(request.header_items())
+        if data is not None and not any(
+                name.lower() == "content-type" for name in headers):
+            # urllib parity (AbstractHTTPHandler.do_request_).
+            headers["Content-Type"] = "application/x-www-form-urlencoded"
+        for _hop in range(5):
+            response = self._one_request(method, url, data, headers, timeout)
+            location = response.headers.get("Location") if response.headers else None
+            if response.status in _REDIRECT_STATUSES and location:
+                url = urllib.parse.urljoin(url, location)
+                if response.status == 303 or (
+                        response.status in (301, 302)
+                        and method not in ("GET", "HEAD")):
+                    # urllib parity: redirected POSTs re-issue as bodyless
+                    # GETs (303 always; 307 preserves method + body).
+                    method, data = "GET", None
+                    headers = {name: value for name, value in headers.items()
+                               if name.lower() not in ("content-length",
+                                                       "content-type")}
+                continue
+            if 200 <= response.status < 300:
+                return response
+            raise urllib.error.HTTPError(
+                url, response.status, response.reason, response.headers,
+                io.BytesIO(response.read()))
+        raise urllib.error.URLError(f"too many redirects for {url!r}")
+
+    def _one_request(self, method: str, url: str, data, headers,
+                     timeout: float) -> _PooledResponse:
+        split = urllib.parse.urlsplit(url)
+        scheme = split.scheme or "http"
+        host = split.hostname
+        if host is None:
+            raise urllib.error.URLError(f"no host in url: {url!r}")
+        port = split.port or (443 if scheme == "https" else 80)
+        path = split.path or "/"
+        if split.query:
+            path += "?" + split.query
+        key = (scheme, host, port)
+        while True:
+            conn, reused = self._checkout(key, timeout)
+            try:
+                conn.request(method, path, body=data, headers=headers)
+                raw = conn.getresponse()
+                body = raw.read()
+            except _STALE_ERRORS as error:
+                conn.close()
+                if reused:
+                    # A parked socket the server idled out: discard it and
+                    # try the next one (every stale iteration pops the idle
+                    # set, so this terminates at a fresh connection — the
+                    # common case after a long pause is ALL parked sockets
+                    # dead, which must not burn the caller's backoff).
+                    with self._lock:
+                        self.stale_retries += 1
+                    continue
+                raise urllib.error.URLError(error) from error
+            except (OSError, http.client.HTTPException) as error:
+                conn.close()
+                raise urllib.error.URLError(error) from error
+            if raw.will_close:
+                conn.close()
+            else:
+                self._checkin(key, conn)
+            return _PooledResponse(raw.status, raw.reason, raw.headers, body)
+
+
+_default_pool: Optional[HTTPPool] = None
+_default_pool_lock = threading.Lock()
+
+
+def default_pool() -> HTTPPool:
+    """The process-wide pool behind :func:`send`'s default transport."""
+    global _default_pool
+    with _default_pool_lock:
+        if _default_pool is None:
+            _default_pool = HTTPPool()
+        return _default_pool
+
+
+_proxies: Optional[Dict[str, str]] = None
+
 
 def _default_urlopen(request, timeout):
-    import urllib.request
+    global _proxies
+    if _proxies is None:
+        import urllib.request
 
-    return urllib.request.urlopen(request, timeout=timeout)
+        # One environment scan, not one per request: proxy config does not
+        # change mid-process for any supported flow.
+        _proxies = urllib.request.getproxies()
+    if _proxies:
+        scheme = urllib.parse.urlsplit(request.full_url).scheme
+        if _proxies.get(scheme):
+            import urllib.request
+
+            # A proxy is configured: urllib knows how to speak it; the pool
+            # intentionally does not.
+            return urllib.request.urlopen(request, timeout=timeout)
+    return default_pool().urlopen(request, timeout=timeout)
 
 
 def send(

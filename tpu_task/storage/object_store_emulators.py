@@ -27,23 +27,39 @@ PAGE_SIZE = 2  # force pagination in list operations
 def loopback_transport(origin: str, port: int):
     """``urlopen`` replacement rewriting ``origin`` URLs to the local
     server — the one host-rewrite proxy shared by every loopback emulator
-    (this module and ``gcs_emulator``)."""
+    (this module, ``gcs_emulator``, and the control-plane emulators).
+    Rewritten requests ride the shared keep-alive pool
+    (:func:`tpu_task.storage.http_util.default_pool`), so emulator traffic
+    exercises the exact pooled transport production requests use."""
 
     def opener(request, timeout=None):
         import urllib.request
+
+        from tpu_task.storage.http_util import default_pool
 
         url = request.full_url.replace(origin, f"http://127.0.0.1:{port}")
         patched = urllib.request.Request(
             url, data=request.data, method=request.get_method())
         for key, value in request.header_items():
             patched.add_header(key, value)
-        return urllib.request.urlopen(patched, timeout=timeout)
+        return default_pool().urlopen(patched, timeout=timeout or 60.0)
 
     return opener
 
 
 class _BaseHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
+    # Headers and body leave as separate segments (unbuffered wfile); Nagle
+    # would hold the body for the client's delayed ACK (~40 ms) on every
+    # kept-alive request.
+    disable_nagle_algorithm = True
+
+    def setup(self) -> None:
+        super().setup()
+        # One handler instance per TCP connection (requests then loop
+        # through handle_one_request): counting here counts connections,
+        # which is what the keep-alive reuse assertions need.
+        self._store().count_connection()
 
     def _store(self):
         return self.server.emulator  # type: ignore[attr-defined]
@@ -70,18 +86,31 @@ class _LoopbackStore:
         self.uploads: Dict[str, dict] = {}  # S3 multipart uploads in flight
         self.blocks: Dict[str, Dict[str, bytes]] = {}  # Azure uncommitted
         self.auth_headers: list = []  # recorded for assertions
+        self.connections = 0  # TCP connections accepted (keep-alive asserts)
+        self._counter_lock = threading.Lock()
         self._server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
         self._server.emulator = self  # type: ignore[attr-defined]
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True)
+
+    def count_connection(self) -> None:
+        with self._counter_lock:
+            self.connections += 1
 
     def __enter__(self):
         self._thread.start()
         return self
 
     def __exit__(self, *exc):
+        from tpu_task.storage.http_util import default_pool
+
+        port = self.port
         self._server.shutdown()
         self._server.server_close()
+        # Idle keep-alive sockets in the shared pool point at this dead
+        # server; drop them so a later server on a reused ephemeral port
+        # never inherits one.
+        default_pool().purge(port=port)
 
     @property
     def port(self) -> int:

@@ -23,8 +23,8 @@ from tpu_task.common.errors import ResourceNotFoundError
 from tpu_task.common.values import Status, StatusCode
 from tpu_task.storage import native
 from tpu_task.storage.backends import (
-    Backend, Connection, LocalBackend, contained_path, open_backend,
-    parallel_map,
+    CLOUD_COPY_WORKERS, Backend, Connection, LocalBackend, contained_path,
+    open_backend, parallel_map,
 )
 from tpu_task.storage.filters import FilterSet, compile_exclude_list, limit_transfer
 
@@ -36,9 +36,8 @@ __all__ = [
 ]
 
 
-# Concurrent object-store streams (rclone's --transfers knob defaults to 4;
-# checkpoint-class objects benefit from more on fat NICs).
-CLOUD_COPY_WORKERS = int(os.environ.get("TPU_TASK_TRANSFERS", "16"))
+# CLOUD_COPY_WORKERS (rclone's --transfers role) lives in backends.py — one
+# parse site for the knob — and is re-exported here for monkeypatching tests.
 
 
 def _for_each(fn, keys: Sequence[str], parallel: bool) -> None:
@@ -145,6 +144,7 @@ def _transfer(source_remote: str, destination_remote: str, filters: FilterSet,
     if delete_extraneous:
         wanted = set(keys)
         src_root = source.local_root()
+        extraneous = []
         for key in destination.list():
             if key in wanted or not filters.includes_file(key):
                 continue
@@ -157,7 +157,11 @@ def _transfer(source_remote: str, destination_remote: str, filters: FilterSet,
             if src_root is not None and os.path.isfile(
                     contained_path(src_root, key)):
                 continue
-            destination.delete(key)
+            extraneous.append(key)
+        # Batched where the store supports it (GCS: ≤100 per round-trip),
+        # parallel singles elsewhere — a mirror tick that prunes hundreds
+        # of stale keys must not serialize hundreds of round-trips.
+        destination.delete_batch(extraneous)
         if isinstance(destination, LocalBackend):
             destination.remove_empty_dirs()
 
@@ -173,14 +177,22 @@ def sync(source: str, destination: str, exclude: Sequence[str] = ()) -> None:
 
 
 def reports(remote: str, prefix: str) -> List[str]:
-    """Read every ``reports/{prefix}-*`` blob (one per machine)."""
+    """Read every ``reports/{prefix}-*`` blob (one per machine).
+
+    Cloud reads fan out over the transfer pool: a status/log poll against a
+    32-worker pod is 32 blobs, and serial GETs would make every poll tick
+    32 sequential round-trips. Results keep the listing's deterministic
+    (sorted-key) order regardless of fetch completion order."""
     backend, _ = open_backend(remote)
-    out: List[str] = []
-    for key in backend.list("reports"):
-        base = key.rsplit("/", 1)[-1]
-        if base.startswith(prefix + "-"):
-            out.append(backend.read(key).decode(errors="replace"))
-    return out
+    keys = [key for key in backend.list("reports")
+            if key.rsplit("/", 1)[-1].startswith(prefix + "-")]
+    blobs: Dict[str, str] = {}
+
+    def fetch(key: str) -> None:
+        blobs[key] = backend.read(key).decode(errors="replace")
+
+    _for_each(fetch, keys, parallel=backend.local_root() is None)
+    return [blobs[key] for key in keys]
 
 
 def logs(remote: str) -> List[str]:
@@ -214,12 +226,13 @@ def status(remote: str, initial_status: Optional[Status] = None) -> Status:
 
 def delete_storage(remote: str) -> None:
     """Empty the remote (all objects — including crash-orphaned internal
-    housekeeping keys hidden from list() — then empty dirs)."""
+    housekeeping keys hidden from list() — then empty dirs). Rides the
+    backend's batch-delete path: GCS folds ≤100 deletes into one
+    round-trip; other cloud stores fan singles out on the transfer pool."""
     backend, _ = open_backend(remote)
     if not backend.exists():
         raise ResourceNotFoundError(remote)
-    keys = backend.list() + backend.list_hidden()
-    _for_each(backend.delete, keys, parallel=backend.local_root() is None)
+    backend.delete_batch(backend.list() + backend.list_hidden())
     if isinstance(backend, LocalBackend):
         backend.remove_empty_dirs()
 
