@@ -5,7 +5,10 @@
 
 PYTHON ?= python3
 
-.PHONY: test smoke sweep bench wheel multichip kernels-tpu clean
+# Seed for the chaos soak: any run is replayable by pinning this.
+TPU_TASK_CHAOS_SEED ?= 20260804
+
+.PHONY: test smoke sweep bench chaos wheel multichip kernels-tpu clean
 
 # Hermetic suite (the reference's `make test`, 30 s budget there; ours spans
 # the fake control planes, sharded-compute CPU checks, and the loopback GCS
@@ -26,6 +29,12 @@ sweep:
 # Headline benchmark: one JSON line (driver contract).
 bench:
 	$(PYTHON) bench.py
+
+# Seeded fault-injection soak: preemptions + a hung worker + flaky storage
+# against the hermetic TPU control plane, replayable from the seed.
+chaos:
+	TPU_TASK_CHAOS_SEED=$(TPU_TASK_CHAOS_SEED) \
+		$(PYTHON) -m pytest tests/ -m chaos -q
 
 # Build the agent wheel the worker bootstrap installs.
 wheel:

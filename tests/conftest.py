@@ -27,10 +27,11 @@ if not os.environ.get("TPU_TASK_TEST_REAL_TPU"):
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# Bucket-probe caches (shutdown marker, durable events) add observation
-# latency that poll-based tests cannot afford; probe every read in tests.
+# Bucket-probe caches (shutdown marker, durable events, heartbeats) add
+# observation latency that poll-based tests cannot afford; probe every read.
 os.environ.setdefault("TPU_TASK_SHUTDOWN_PROBE_PERIOD", "0")
 os.environ.setdefault("TPU_TASK_EVENTS_PROBE_PERIOD", "0")
+os.environ.setdefault("TPU_TASK_HEARTBEAT_PROBE_PERIOD", "0")
 
 import pytest  # noqa: E402
 
@@ -42,6 +43,8 @@ import pytest  # noqa: E402
 # lifecycle test timed out the same way) — raising ceilings again would
 # just move the cliff. One allowlist here, not a pasted shim per module.
 AGENT_SUBPROCESS_MODULES = {
+    "test_chaos",
+    "test_chaos_soak",
     "test_cli",
     "test_frontend",
     "test_lifecycle_local",

@@ -1,0 +1,582 @@
+"""Chaos plane + heartbeat liveness, unit level.
+
+Seeded fault injection (replayable per seed), the chaos transport against
+``send``'s retry ladder, agent heartbeats / SIGTERM preemption handling, and
+the TPU reconciler's liveness-requeue, requeue-backoff, and recovery-budget
+paths — all hermetic. The end-to-end soak lives in ``test_chaos_soak.py``
+(``make chaos``)."""
+
+import io
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+
+import pytest
+
+from tpu_task.backends.tpu import api as tpu_api
+from tpu_task.common.cloud import Cloud, Provider
+from tpu_task.common.identifier import Identifier
+from tpu_task.common.values import (
+    SPOT_ENABLED,
+    Environment,
+    Size,
+    StatusCode,
+    Task as TaskSpec,
+)
+from tpu_task.storage.http_util import send
+from tpu_task.testing.chaos import (
+    ChaosSchedule,
+    ChaosTpuClient,
+    ChaosTransport,
+    flaky_storage,
+)
+from tpu_task import task as task_factory
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- seeded schedule / replayability ------------------------------------------
+
+
+def test_derived_streams_are_deterministic_and_independent():
+    a, b = ChaosSchedule(seed=42), ChaosSchedule(seed=42)
+    assert [a.derive("transport").random() for _ in range(5)] == \
+        [b.derive("transport").random() for _ in range(5)]
+    # Draw count at one seam never perturbs another seam's stream.
+    noisy = ChaosSchedule(seed=42)
+    for _ in range(100):
+        noisy.derive("tpu-client").random()
+    assert noisy.derive("transport").random() == \
+        ChaosSchedule(seed=42).derive("transport").random()
+    assert ChaosSchedule(seed=43).derive("transport").random() != \
+        a.derive("transport").random()
+
+
+def test_schedule_fires_timed_actions_and_retries_preconditions():
+    clock = [0.0]
+    schedule = ChaosSchedule(seed=1, now=lambda: clock[0])
+    fired = []
+    attempts = []
+
+    def flaky_action():
+        attempts.append(1)
+        if len(attempts) < 2:
+            return False  # precondition not met yet
+        fired.append("done")
+        return True
+
+    schedule.at(1.0, flaky_action, label="x")
+    schedule.tick()
+    assert not attempts          # not due yet
+    clock[0] = 1.2
+    schedule.tick()
+    assert attempts and not fired  # first try failed → retried later
+    clock[0] = 2.0
+    schedule.tick()
+    assert fired == ["done"]
+    clock[0] = 3.0
+    schedule.tick()
+    assert fired == ["done"]     # fires exactly once
+    assert schedule.pending() == []
+
+
+# -- control-plane seam --------------------------------------------------------
+
+
+class _StubPlane:
+    def __init__(self):
+        self.calls = []
+
+    def get_node(self, name):
+        self.calls.append(("get_node", name))
+        return tpu_api.NodeInfo(name=name, state="READY",
+                                accelerator_type="v4-8")
+
+    def preempt_node(self, name, graceful=False):
+        self.calls.append(("preempt", name, graceful))
+
+
+def test_chaos_tpu_client_injects_replayable_transient_errors():
+    def run(seed):
+        plane = _StubPlane()
+        client = ChaosTpuClient(plane, ChaosSchedule(seed=seed),
+                                error_rate=0.4)
+        outcomes = []
+        for _ in range(20):
+            try:
+                client.get_node("n")
+                outcomes.append("ok")
+            except urllib.error.HTTPError as error:
+                outcomes.append(error.code)
+        return outcomes
+
+    first, second = run(9), run(9)
+    assert first == second                       # replayable from the seed
+    assert any(code in (429, 503) for code in first)
+    assert "ok" in first
+    assert run(10) != first
+
+
+def test_chaos_tpu_client_scheduled_preempt_fires_through_inner_plane():
+    clock = [0.0]
+    plane = _StubPlane()
+    schedule = ChaosSchedule(seed=3, now=lambda: clock[0])
+    client = ChaosTpuClient(plane, schedule)
+    client.preempt_at(2.0, "node-x")
+    client.get_node("poll")      # tick at t=0: nothing due
+    assert ("preempt", "node-x", False) not in plane.calls
+    clock[0] = 2.5
+    client.get_node("poll")      # tick fires the reclaim
+    assert ("preempt", "node-x", False) in plane.calls
+    assert [fault.kind for fault in schedule.injected] == ["preempt"]
+
+
+# -- urlopen seam --------------------------------------------------------------
+
+
+class _OkTransport:
+    """Always answers 200 with a fixed body (the inner seam under chaos)."""
+
+    def __init__(self, body=b"0123456789abcdef" * 8):
+        self.body = body
+        self.requests = []
+
+    def __call__(self, request, timeout=None):
+        self.requests.append(request)
+        body = self.body
+
+        class Response:
+            headers = {}
+            status = 200
+
+            def read(self):
+                return body
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *exc):
+                return False
+
+        return Response()
+
+
+def test_chaos_transport_resets_and_timeouts_ride_the_retry_ladder():
+    schedule = ChaosSchedule(seed=5)
+    transport = ChaosTransport(schedule, inner=_OkTransport(),
+                               reset_rate=0.3, timeout_rate=0.2)
+    sleeps = []
+    import random
+
+    ok = 0
+    for _ in range(10):
+        body = send("GET", "http://x/y", urlopen=transport,
+                    sleep=sleeps.append, rng=random.Random(0))
+        ok += body is not None
+    assert ok == 10                              # every request recovered
+    kinds = {fault.kind for fault in schedule.injected}
+    assert kinds & {"reset", "timeout"}          # chaos actually fired
+    assert sleeps                                # ladder engaged
+
+
+def test_chaos_transport_truncates_reads_and_fails_uploads():
+    schedule = ChaosSchedule(seed=11)
+    inner = _OkTransport()
+    transport = ChaosTransport(schedule, inner=inner, truncate_rate=1.0)
+    with transport(_request("GET", "http://x/y")) as response:
+        assert len(response.read()) < len(inner.body)  # mid-stream drop
+
+    schedule = ChaosSchedule(seed=11)
+    transport = ChaosTransport(schedule, inner=_OkTransport(),
+                               upload_fail_rate=1.0)
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        transport(_request("PUT", "http://x/y", data=b"chunk"))
+    assert exc.value.code == 503
+    # Bodyless requests never draw the upload fault.
+    transport(_request("GET", "http://x/y"))
+
+
+def _request(method, url, data=None):
+    import urllib.request
+
+    return urllib.request.Request(url, data=data, method=method)
+
+
+def test_flaky_storage_wraps_open_backend(tmp_path):
+    from tpu_task.storage.backends import open_backend
+
+    (tmp_path / "blob").write_bytes(b"x")
+    schedule = ChaosSchedule(seed=2)
+    with flaky_storage(schedule, fail_rate=1.0):
+        from tpu_task.storage import backends as backends_module
+
+        backend, _ = backends_module.open_backend(str(tmp_path))
+        with pytest.raises(OSError, match="chaos"):
+            backend.read("blob")
+    backend, _ = open_backend(str(tmp_path))   # unpatched again
+    assert backend.read("blob") == b"x"
+
+
+# -- agent: heartbeats, SIGTERM preemption, log-loop resilience ----------------
+
+
+def _agent_command(tmp_path, script_text, machine_id="m1", extra=()):
+    remote = tmp_path / "bucket"
+    workdir = tmp_path / "workdir"
+    remote.mkdir(exist_ok=True)
+    workdir.mkdir(exist_ok=True)
+    script = tmp_path / "task.sh"
+    script.write_text(script_text)
+    command = [
+        sys.executable, "-m", "tpu_task.machine.local_agent",
+        "--remote", str(remote), "--directory", str(workdir),
+        "--script", str(script), "--machine-id", machine_id,
+        "--log-period", "0.1", "--data-period", "0.1",
+        "--heartbeat-period", "0.1", *extra,
+    ]
+    return remote, workdir, command
+
+
+def test_agent_writes_heartbeats_with_node_identity(tmp_path):
+    remote, _workdir, command = _agent_command(
+        tmp_path, "sleep 0.5\n", extra=("--node-name", "tpi-x-0"))
+    process = subprocess.run(command, capture_output=True, text=True,
+                             timeout=60, env={**os.environ, "PYTHONPATH": REPO})
+    assert process.returncode == 0, process.stderr
+    payload = json.loads((remote / "reports" / "heartbeat-m1").read_text())
+    assert payload["machine"] == "m1"
+    assert payload["node"] == "tpi-x-0"
+    assert payload["worker"] == 0
+    assert payload["final"] is True          # clean exit → final heartbeat
+
+
+def test_agent_exports_node_identity_to_task(tmp_path):
+    remote, _workdir, command = _agent_command(
+        tmp_path, 'echo "node=$TPU_TASK_NODE"\n',
+        extra=("--node-name", "tpi-x-3"))
+    process = subprocess.run(command, capture_output=True, text=True,
+                             timeout=60, env={**os.environ, "PYTHONPATH": REPO})
+    assert process.returncode == 0, process.stderr
+    assert "node=tpi-x-3" in (remote / "reports" / "task-m1").read_text()
+
+
+def test_agent_sigterm_is_a_preemption_notice(tmp_path):
+    """SIGTERM → child stopped, final data/log sync runs, terminal status
+    report result "preempted" lands, NO self-destruct marker (the slice must
+    be requeued, not torn down)."""
+    remote, _workdir, command = _agent_command(
+        tmp_path,
+        "echo started\n"
+        "echo progress > state.txt\n"
+        "sleep 300\n")
+    process = subprocess.Popen(command, env={**os.environ, "PYTHONPATH": REPO},
+                               stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if (remote / "reports" / "task-m1").exists() and \
+                    "started" in (remote / "reports" / "task-m1").read_text():
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("agent never started the task")
+        process.send_signal(signal.SIGTERM)
+        process.wait(timeout=30)
+    finally:
+        if process.poll() is None:
+            process.kill()
+            process.wait()
+    status = json.loads((remote / "reports" / "status-m1").read_text())
+    assert status["result"] == "preempted"
+    assert status["code"] == ""
+    # Status folding counts a preempted report as neither success nor failure.
+    from tpu_task.storage.sync import status as fold_status
+
+    folded = fold_status(str(remote))
+    assert folded.get(StatusCode.SUCCEEDED, 0) == 0
+    assert folded.get(StatusCode.FAILED, 0) == 0
+    # The preempted worker's last state still landed in the bucket.
+    assert (remote / "data" / "state.txt").read_text() == "progress\n"
+    assert not (remote / "shutdown").exists()
+    # Graceful exit: final heartbeat, so liveness never flags this machine.
+    assert json.loads(
+        (remote / "reports" / "heartbeat-m1").read_text())["final"] is True
+
+
+def test_log_loop_survives_transient_sync_errors(tmp_path):
+    """One failed log sync must not kill log streaming for the rest of the
+    run (the _data_loop contract, now shared)."""
+    from tpu_task.machine.local_agent import Agent
+
+    agent = Agent(remote=str(tmp_path / "bucket"),
+                  directory=str(tmp_path / "work"), script_path="unused",
+                  machine_id="m9", timeout_epoch=0,
+                  log_period=0.02, data_period=999)
+    failures = [2]  # fail the first two sync attempts
+    real_sync = agent._sync_logs
+
+    def flaky_sync():
+        if failures[0] > 0:
+            failures[0] -= 1
+            raise OSError("chaos: bucket unavailable")
+        real_sync()
+
+    agent._sync_logs = flaky_sync
+    agent._append_log("line-1\n")
+    import threading
+
+    thread = threading.Thread(target=agent._log_loop, daemon=True)
+    thread.start()
+    deadline = time.time() + 10
+    report = tmp_path / "bucket" / "reports" / "task-m9"
+    while time.time() < deadline and not report.exists():
+        time.sleep(0.02)
+    agent._done.set()
+    thread.join(timeout=5)
+    content = report.read_text()
+    assert "line-1" in content
+    assert "log sync error" in content   # the failures were recorded, not fatal
+
+
+def test_sigterm_after_child_exit_keeps_real_result(tmp_path):
+    """A teardown SIGTERM that lands AFTER the task finished must not
+    relabel the run "preempted" — the terminal path reports the child's
+    real result (the self-destruct scale-in race)."""
+    from tpu_task.machine.local_agent import Agent
+
+    agent = Agent(remote=str(tmp_path / "bucket"),
+                  directory=str(tmp_path / "work"), script_path="unused",
+                  machine_id="m1", timeout_epoch=0,
+                  log_period=1, data_period=1)
+
+    class FinishedChild:
+        pid = 2 ** 22  # never a live pid in the test sandbox
+
+        def poll(self):
+            return 0
+
+    old = signal.getsignal(signal.SIGTERM)
+    try:
+        agent._install_preemption_handler(FinishedChild())
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.time() + 2
+        while time.time() < deadline and \
+                signal.getsignal(signal.SIGTERM) is old:
+            time.sleep(0.01)  # let the signal deliver
+        assert not agent._preempted.is_set()
+    finally:
+        signal.signal(signal.SIGTERM, old)
+
+
+def test_self_destruct_scale_in_is_graceful(tmp_path):
+    """Self-destruct scale-in SIGTERMs surviving siblings: a still-running
+    worker final-syncs and leaves a terminal status report instead of being
+    SIGKILLed report-less (its last state would otherwise vanish)."""
+    from tpu_task.backends.local.control_plane import MachineGroup
+
+    group = MachineGroup("graceful-test", root=str(tmp_path / "cp"))
+    script = (
+        "#!/bin/bash\n"
+        'if test "$TPU_WORKER_ID" = "0"; then echo lead done; exit 0; fi\n'
+        "echo follower waiting\nsleep 300\n"
+    )
+    group.create(script, parallelism=2, timeout_epoch=0, environment={},
+                 log_period=0.1, data_period=0.1)
+    group.scale(2)
+    try:
+        # Worker 0 exits fast and writes the shutdown marker; reconcile then
+        # scales to 0, gracefully terminating worker 1.
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            state = group.reconcile()
+            if state.desired == 0 and not group.live_workers():
+                break
+            time.sleep(0.2)
+        reports_dir = os.path.join(group.bucket, "reports")
+
+        def statuses():
+            return {name: json.loads(open(os.path.join(reports_dir, name)).read())
+                    for name in os.listdir(reports_dir)
+                    if name.startswith("status-")}
+
+        deadline = time.time() + 15
+        while time.time() < deadline and len(statuses()) < 2:
+            time.sleep(0.2)  # the TERMed follower is still final-syncing
+        reports = statuses()
+        assert len(reports) == 2, f"a worker died report-less: {reports}"
+        results = sorted(r["result"] for r in reports.values())
+        assert results == ["preempted", "success"], results
+    finally:
+        group.delete()
+
+
+# -- reconciler: liveness requeue, backoff, recovery budget --------------------
+
+
+@pytest.fixture
+def tpu_cloud(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPU_TASK_FAKE_TPU_ROOT", str(tmp_path / "fake-tpu"))
+    monkeypatch.setenv("TPU_TASK_LOCAL_LOG_PERIOD", "0.1")
+    monkeypatch.setenv("TPU_TASK_LOCAL_DATA_PERIOD", "0.1")
+    monkeypatch.setenv("TPU_TASK_LOCAL_HEARTBEAT_PERIOD", "0.1")
+    return Cloud(provider=Provider.TPU, region="us-central2")
+
+
+def poll(condition, timeout=30.0, period=0.1, message="condition not reached"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if condition():
+            return
+        time.sleep(period)
+    raise AssertionError(message)
+
+
+def _make_task(tpu_cloud, name, script="#!/bin/bash\nsleep 300\n",
+               run_workers=True):
+    spec = TaskSpec(size=Size(machine="v4-8"),
+                    environment=Environment(script=script), spot=SPOT_ENABLED)
+    task = task_factory.new(tpu_cloud, Identifier.deterministic(name), spec)
+    task.client.run_workers = run_workers
+    return task
+
+
+def _wait_active(task, qr_name, timeout=30.0):
+    poll(lambda: task.client.get_queued_resource(qr_name).state
+         == tpu_api.QR_ACTIVE, timeout=timeout,
+         message=f"{qr_name} never went ACTIVE")
+
+
+def test_liveness_requeues_hung_but_active_slice(tpu_cloud, monkeypatch):
+    """Agent killed without the control plane noticing (node stays READY,
+    QR stays ACTIVE): the stale heartbeat alone must get the slice requeued,
+    with a durable liveness-requeue event for the MTTR record."""
+    monkeypatch.setenv("TPU_TASK_HEARTBEAT_STALE_AFTER", "0.8")
+    monkeypatch.setenv("TPU_TASK_LIVENESS_BOOT_GRACE", "60")
+    monkeypatch.setenv("TPU_TASK_REQUEUE_BACKOFF_BASE", "0")
+    task = _make_task(tpu_cloud, "liveness-hang")
+    task.create()
+    qr = task._qr_name(0)
+    try:
+        _wait_active(task, qr)
+        heartbeat_dir = os.path.join(task._bucket_dir, "reports")
+        poll(lambda: any(name.startswith("heartbeat-")
+                         for name in os.listdir(heartbeat_dir))
+             if os.path.isdir(heartbeat_dir) else False,
+             message="no heartbeat ever reached the bucket")
+
+        # Hang the worker: kill the agent directly; the node record still
+        # says READY, so only the liveness layer can see this failure.
+        node = json.loads(open(task.client._node_path(qr)).read())
+        for worker in node["workers"]:
+            os.killpg(worker["pid"], signal.SIGKILL)
+
+        dead_blobs = {name for name in os.listdir(heartbeat_dir)
+                      if name.startswith("heartbeat-")}
+
+        def requeued():
+            task.read()
+            return "liveness-requeue" in [e.code for e in task.events()]
+
+        poll(requeued, timeout=30, message="hung slice never requeued")
+        # The requeue went through the control plane: the QR is alive again.
+        assert task.client.get_queued_resource(qr).state in (
+            tpu_api.QR_WAITING, tpu_api.QR_PROVISIONING, tpu_api.QR_ACTIVE)
+        # The dead incarnation's heartbeat blobs were pruned: a FRESH
+        # observer must read "no heartbeat yet" (boot grace), not a stale
+        # blob it would spuriously requeue the booting replacement over.
+        left = {name for name in os.listdir(heartbeat_dir)
+                if name.startswith("heartbeat-")}
+        assert not (dead_blobs & left), f"stale heartbeats survived: {left}"
+        # Durable: a fresh observer sees the liveness decision from the
+        # bucket mailbox with an MTTR-computable stamp.
+        observer = task_factory.new(tpu_cloud,
+                                    Identifier.deterministic("liveness-hang"),
+                                    TaskSpec())
+        events = [e for e in observer.events() if e.code == "liveness-requeue"]
+        assert events and events[0].time.tzinfo is not None
+    finally:
+        task.delete()
+
+
+def test_requeue_backoff_delays_consecutive_recoveries(tpu_cloud, monkeypatch):
+    monkeypatch.setenv("TPU_TASK_HEARTBEAT_STALE_AFTER", "0")  # liveness off
+    monkeypatch.setenv("TPU_TASK_REQUEUE_BACKOFF_BASE", "60")
+    task = _make_task(tpu_cloud, "backoff", run_workers=False)
+    task.create()
+    qr = task._qr_name(0)
+    try:
+        _wait_active(task, qr)
+        task.client.preempt_node(qr)
+        task.read()              # first recovery: immediate
+        assert task._requeue_state[qr]["attempts"] == 1
+        _wait_active(task, qr)
+        task.client.preempt_node(qr)
+        for _ in range(3):
+            task.read()          # inside the 60 s backoff window
+        # Still SUSPENDED: the governor refused to thrash.
+        assert task.client.get_queued_resource(qr).state == tpu_api.QR_SUSPENDED
+        assert task._requeue_state[qr]["attempts"] == 1
+    finally:
+        task.delete()
+
+
+def test_recovery_budget_exhaustion_converges_to_failed(tpu_cloud, monkeypatch):
+    """A poisoned spec that re-suspends immediately N times must surface
+    FAILED with the budget-exhausted event — and release the queued
+    resource — instead of requeueing forever."""
+    monkeypatch.setenv("TPU_TASK_HEARTBEAT_STALE_AFTER", "0")
+    monkeypatch.setenv("TPU_TASK_REQUEUE_BACKOFF_BASE", "0")
+    monkeypatch.setenv("TPU_TASK_RECOVERY_BUDGET", "2")
+    monkeypatch.setenv("TPU_TASK_RECOVERY_HEALTHY_AFTER", "999")
+    task = _make_task(tpu_cloud, "budget", run_workers=False)
+    task.create()
+    qr = task._qr_name(0)
+    try:
+        for _ in range(2):       # burn the whole budget
+            _wait_active(task, qr)
+            task.client.preempt_node(qr)
+            task.read()
+        _wait_active(task, qr)
+        task.client.preempt_node(qr)
+        task.read()              # budget exhausted → FAILED
+        codes = [event.code for event in task.events()]
+        assert "recovery-budget-exhausted" in codes
+        assert task.status().get(StatusCode.FAILED, 0) >= 1
+        assert qr not in task.client.list_queued_resources()
+        # Latch: further reads don't try to recover a slice that is gone.
+        task.read()
+    finally:
+        task.delete()
+
+
+def test_healthy_requeue_resets_recovery_budget(tpu_cloud, monkeypatch):
+    monkeypatch.setenv("TPU_TASK_HEARTBEAT_STALE_AFTER", "0")
+    monkeypatch.setenv("TPU_TASK_REQUEUE_BACKOFF_BASE", "0")
+    monkeypatch.setenv("TPU_TASK_RECOVERY_BUDGET", "2")
+    monkeypatch.setenv("TPU_TASK_RECOVERY_HEALTHY_AFTER", "0.2")
+    task = _make_task(tpu_cloud, "budget-reset", run_workers=False)
+    task.create()
+    qr = task._qr_name(0)
+    try:
+        _wait_active(task, qr)
+        task.client.preempt_node(qr)
+        task.read()
+        assert task._requeue_state[qr]["attempts"] == 1
+        _wait_active(task, qr)
+        time.sleep(0.3)          # healthy uptime beyond HEALTHY_AFTER
+        task.read()              # reset fires on the healthy observation
+        assert task._requeue_state[qr]["attempts"] == 0
+        # The budget now bounds CONSECUTIVE failures only: two more
+        # recoveries fit without tripping FAILED.
+        for _ in range(2):
+            _wait_active(task, qr)
+            task.client.preempt_node(qr)
+            task.read()
+        codes = [event.code for event in task.events()]
+        assert "recovery-budget-exhausted" not in codes
+    finally:
+        task.delete()

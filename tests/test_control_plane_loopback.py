@@ -84,6 +84,23 @@ def test_tpu_lifecycle_over_http(tpu_client, monkeypatch):
     assert all(a.startswith("Bearer ") for a in server.auth_headers)
 
 
+def test_tpu_client_rides_out_emulated_brownout(tpu_client, monkeypatch):
+    """Chaos over real sockets: the emulator's ``fail_next`` brownout hook
+    serves 503s/429s and the real client's retry ladder (pooled transport,
+    full-jitter backoff) absorbs them — no injected transports anywhere."""
+    server, client = tpu_client
+    monkeypatch.setattr("time.sleep", lambda _s: None)
+    client._sleep = lambda _s: None  # backoff pacing out of the wall-clock
+
+    client.create_queued_resource("qr-b", _qr_spec(node_id="node-b"))
+    server.fail_next(count=2, status=503)
+    info = client.get_queued_resource("qr-b")   # 503, 503, then 200
+    assert info.state == "ACTIVE"
+    server.fail_next(count=1, status=429)
+    assert client.list_queued_resources() == ["qr-b"]
+    client.delete_queued_resource("qr-b")
+
+
 def test_tpu_preemption_recovery_over_http(tpu_client, tmp_path, monkeypatch):
     """The flagship reconciler over real sockets: a bare-read TPUTask sees
     SUSPENDED, re-queues from the spec echoed by the API, and persists the

@@ -76,13 +76,42 @@ class FakeSleep:
         self.calls.append(seconds)
 
 
+class TopRng:
+    """Jitter stub that always draws the ladder's upper bound, so tests can
+    assert the exact exponential envelope."""
+
+    def uniform(self, _low, high):
+        return high
+
+
 def test_send_retries_5xx_then_succeeds():
     transport = FakeTransport([("http", 500), ("http", 503), ("ok", b"done")])
     sleep = FakeSleep()
-    body = send("GET", "https://x/y", urlopen=transport, sleep=sleep)
+    body = send("GET", "https://x/y", urlopen=transport, sleep=sleep,
+                rng=TopRng())
     assert body == b"done"
     assert len(transport.requests) == 3
-    assert sleep.calls == [0.5, 1.0]  # exponential backoff
+    assert sleep.calls == [0.5, 1.0]  # exponential backoff envelope
+
+
+def test_send_backoff_is_jittered_and_seed_deterministic():
+    """Full jitter: waits are uniform in (0, ladder], and an injected seeded
+    rng replays the identical sequence — multi-worker retries spread out
+    instead of synchronizing into a thundering herd."""
+    import random
+
+    waits = {}
+    for run in range(2):
+        transport = FakeTransport([("http", 500)] * 3 + [("ok", b"ok")])
+        sleep = FakeSleep()
+        send("GET", "https://x/y", urlopen=transport, sleep=sleep,
+             rng=random.Random(7))
+        waits[run] = list(sleep.calls)
+    assert waits[0] == waits[1]  # replayable from one seed
+    for wait, ladder in zip(waits[0], (0.5, 1.0, 2.0)):
+        assert 0 <= wait <= ladder
+    # Astronomically unlikely to sit exactly on the envelope at every rung.
+    assert waits[0] != [0.5, 1.0, 2.0]
 
 
 def test_send_honors_retry_after():

@@ -307,8 +307,13 @@ def test_send_default_transport_retries_5xx_then_succeeds(scripted_server):
     ]
     port = scripted_server.server_address[1]
     sleeps = []
+
+    class TopRng:  # pin the jitter to the ladder's envelope
+        def uniform(self, _low, high):
+            return high
+
     assert send("GET", f"http://127.0.0.1:{port}/x",
-                sleep=sleeps.append) == b"recovered"
+                sleep=sleeps.append, rng=TopRng()) == b"recovered"
     assert sleeps == [0.5]
 
 

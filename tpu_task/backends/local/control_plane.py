@@ -192,9 +192,11 @@ class MachineGroup:
         state = self._load()
 
         # Self-destruct marker written by worker 0 at task exit.
+        self_destruct = False
         if os.path.exists(os.path.join(self.bucket, "shutdown")) and state.desired > 0:
             self._log_event("self-destruct", "shutdown marker observed; scaling to 0")
             state.desired = 0
+            self_destruct = True
 
         alive: List[Worker] = []
         for worker in state.workers:
@@ -206,7 +208,12 @@ class MachineGroup:
 
         while len(state.workers) > state.desired:
             worker = state.workers.pop()
-            self._kill(worker)
+            # Self-destruct scale-in is GRACEFUL (SIGTERM): a sibling still
+            # finishing gets to final-sync and write its terminal report —
+            # a SIGKILL here could swallow another worker's last state (and
+            # with parallelism>1 leave the task short of its success count
+            # forever). Explicit stop()/preempt stay hard kills.
+            self._kill(worker, graceful=self_destruct)
             self._log_event("scale-in", f"killed worker {worker.index} (pid {worker.pid})")
 
         used_indices = {worker.index for worker in state.workers}
@@ -253,7 +260,17 @@ class MachineGroup:
         return Worker(index=index, pid=process.pid, machine_id=machine_id,
                       started_at=time.time())
 
-    def _kill(self, worker: Worker) -> None:
+    def _kill(self, worker: Worker, graceful: bool = False) -> None:
+        if graceful:
+            # Preemption notice: the agent's SIGTERM handler stops the task
+            # child, final-syncs, and writes the terminal status report
+            # before exiting (reports the child's REAL result when it had
+            # already finished).
+            try:
+                os.kill(worker.pid, signal.SIGTERM)
+                return
+            except (ProcessLookupError, PermissionError):
+                return
         try:
             os.killpg(worker.pid, signal.SIGKILL)
         except (ProcessLookupError, PermissionError):

@@ -337,6 +337,9 @@ class FakeTpuControlPlane:
                      "--timeout", metadata.get("tpu-task-timeout", "0"),
                      "--log-period", metadata.get("tpu-task-log-period", "5"),
                      "--data-period", metadata.get("tpu-task-data-period", "10"),
+                     "--heartbeat-period",
+                     metadata.get("tpu-task-heartbeat-period", "30"),
+                     "--node-name", node["name"],
                      "--worker-id", str(worker["index"])],
                     env=env, start_new_session=True,
                     stdout=agent_log, stderr=agent_log,
@@ -386,13 +389,34 @@ class FakeTpuControlPlane:
                         pass
 
     # -- fault injection ------------------------------------------------------
-    def preempt_node(self, name: str) -> None:
-        """Spot reclaim: kill the node's workers, mark PREEMPTED."""
+    def preempt_node(self, name: str, graceful: bool = False) -> None:
+        """Spot reclaim: stop the node's workers, mark PREEMPTED.
+
+        ``graceful`` delivers SIGTERM to each agent (the reclaim-warning
+        shape real clouds give) so it can final-sync and report before
+        exiting. The pids are then FORGOTTEN: the reconciler's very next
+        read requeues the SUSPENDED resource, and delete_node reaping the
+        recorded pids would SIGKILL the agents mid-final-sync — revoking
+        exactly the grace this mode grants (the agent's own TERM→grace→KILL
+        ladder bounds a stuck child). Default is a hard kill — capacity
+        yanked mid-write."""
+        import signal as signal_module
+
         payload = self._load(self._node_path(name))
-        self._kill_workers(payload)
-        payload["state"] = NODE_PREEMPTED
+        if graceful:
+            for worker in payload.get("workers", []):
+                pid = worker.get("pid") or 0
+                if not pid:
+                    continue
+                try:
+                    os.kill(pid, signal_module.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        else:
+            self._kill_workers(payload)
         for worker in payload["workers"]:
             worker["pid"] = 0
+        payload["state"] = NODE_PREEMPTED
         self._store(self._node_path(name), payload)
 
     def requeue(self, qr_name: str) -> None:

@@ -41,6 +41,7 @@ class LoopbackTpu(LoopbackControlPlane):
         self.operations: Dict[str, int] = {}  # op name -> remaining polls
         self.auth_headers: List[str] = []
         self._op_counter = 0
+        self._fail_queue: List[int] = []      # chaos: statuses to serve next
 
     # -- client wiring ---------------------------------------------------------
     def attach(self, client) -> None:
@@ -55,6 +56,14 @@ class LoopbackTpu(LoopbackControlPlane):
         """Spot reclaim: node gone, queued resource SUSPENDED."""
         self.qrs[name]["state"] = "SUSPENDED"
 
+    def fail_next(self, count: int = 1, status: int = 503) -> None:
+        """Chaos hook: answer the next ``count`` requests with ``status``
+        (control-plane brownout) — the real client's retry ladder and the
+        reconciler's fault tolerance run over actual sockets, the
+        socket-level counterpart of ``testing.chaos.ChaosTpuClient``."""
+        with self._lock:
+            self._fail_queue.extend([status] * count)
+
     # -- request handling ------------------------------------------------------
     def _operation(self, parent: str, pending_polls: int) -> dict:
         with self._lock:
@@ -64,6 +73,11 @@ class LoopbackTpu(LoopbackControlPlane):
         return {"name": name, "done": pending_polls == 0}
 
     def handle(self, method: str, path: str, query: dict, body: dict):
+        with self._lock:
+            if self._fail_queue:
+                status = self._fail_queue.pop(0)
+                return status, {"error": {
+                    "code": status, "message": "chaos: injected brownout"}}
         op = _OP_PATH.match(path)
         if op:
             name = path[len("/v2/"):]

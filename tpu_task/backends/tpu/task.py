@@ -101,6 +101,17 @@ class TPUTask(GcsRemoteMixin, Task):
         self._bucket_event_records: Dict[str, Event] = {}
         self._bucket_events_at = float("-inf")
         self._warned: Dict[str, bool] = {}  # one warning per failure kind
+        # Durable-event writes that failed (flaky bucket): retried on later
+        # reads so the MTTR record survives transient storage faults.
+        self._pending_event_writes: List[tuple] = []
+        # Liveness + recovery-governor state (per queued-resource name).
+        # _heartbeat_records: blob key → (mtime, node, final) body cache —
+        # heartbeat bodies are re-read only when the blob's mtime moved.
+        self._heartbeat_records: Dict[str, tuple] = {}
+        self._heartbeats_cache: Optional[Dict[str, dict]] = None
+        self._heartbeats_at = float("-inf")
+        self._first_active: Dict[str, float] = {}   # qr → first ACTIVE (wall)
+        self._requeue_state: Dict[str, dict] = {}   # qr → governor record
 
         if fake_mode():
             self.client = FakeTpuControlPlane()
@@ -226,6 +237,8 @@ class TPUTask(GcsRemoteMixin, Task):
                                     if self._timeout_epoch() else 0),
             "tpu-task-log-period": os.environ.get("TPU_TASK_LOCAL_LOG_PERIOD", "5"),
             "tpu-task-data-period": os.environ.get("TPU_TASK_LOCAL_DATA_PERIOD", "10"),
+            "tpu-task-heartbeat-period": os.environ.get(
+                "TPU_TASK_LOCAL_HEARTBEAT_PERIOD", "30"),
         }
         for name, value in {**self._credentials_env(),
                             **variables.enrich()}.items():
@@ -317,9 +330,13 @@ class TPUTask(GcsRemoteMixin, Task):
     def start(self) -> None:
         spec = self._qr_spec()
         for index in range(self.spec.parallelism):
-            qr_spec = QueuedResourceSpec(**{**spec.__dict__,
-                                            "node_id": self._qr_name(index)})
-            self.client.create_queued_resource(self._qr_name(index), qr_spec)
+            name = self._qr_name(index)
+            qr_spec = QueuedResourceSpec(**{
+                **spec.__dict__, "node_id": name,
+                # Per-slice identity: workers stamp it into heartbeats
+                # (liveness correlation) and read it as TPU_TASK_NODE.
+                "metadata": {**spec.metadata, "tpu-task-node": name}})
+            self.client.create_queued_resource(name, qr_spec)
 
     def stop(self) -> None:
         # Iterate actual surviving QR names, unioned with the spec's index
@@ -357,6 +374,11 @@ class TPUTask(GcsRemoteMixin, Task):
                 key_hint="self-destruct")
             self.stop()
 
+        self._drain_pending_event_writes()
+        stale_after = float(os.environ.get("TPU_TASK_HEARTBEAT_STALE_AFTER",
+                                           "120"))
+        heartbeats = self._heartbeat_index() if stale_after > 0 else None
+
         addresses: List[str] = []
         running = 0
         self._events = []
@@ -377,7 +399,7 @@ class TPUTask(GcsRemoteMixin, Task):
             # created before the API echoed schedulingConfig.
             if info.state == QR_SUSPENDED and (info.spec.spot
                                                or self.spec.spot >= 0):
-                self._recover(info)
+                self._maybe_recover(info, code="recover")
                 continue
             if info.state == QR_ACTIVE and info.node_name:
                 try:
@@ -385,6 +407,19 @@ class TPUTask(GcsRemoteMixin, Task):
                 except ResourceNotFoundError:
                     continue
                 if node.state == "READY":
+                    # Liveness: a slice the control plane calls ACTIVE whose
+                    # heartbeats went stale is dead capacity — treat it as
+                    # preemption-equivalent and requeue (same governor:
+                    # backoff + bounded recovery budget).
+                    if stale_after > 0 and self._liveness_stale(
+                            info, heartbeats, stale_after,
+                            worker_count=node.worker_count):
+                        self._maybe_recover(
+                            info, code="liveness-requeue",
+                            occurrence=self._liveness_occurrence(
+                                info, heartbeats))
+                        continue
+                    self._maybe_reset_budget(info, heartbeats)
                     running += 1
                     addresses.extend(node.endpoints)
         self.spec.addresses = addresses
@@ -441,10 +476,22 @@ class TPUTask(GcsRemoteMixin, Task):
         writes collapse into one record instead of inflating the MTTR
         history forever."""
         self._recovery_events.append(event)
-        from tpu_task.storage.backends import open_backend
-
         hint = key_hint or f"{event.code}-{uuid.uuid4().hex[:8]}"
         key = f"reports/events-{hint}.json"
+        payload = json.dumps({
+            "time": event.time.isoformat(),
+            "code": event.code,
+            "description": list(event.description),
+        }).encode()
+        if not self._persist_event(key, payload):
+            # Flaky bucket: queue the record and retry on later reads — a
+            # transient storage fault must not erase the MTTR history.
+            if len(self._pending_event_writes) < 64:
+                self._pending_event_writes.append((key, payload))
+
+    def _persist_event(self, key: str, payload: bytes) -> bool:
+        from tpu_task.storage.backends import open_backend
+
         try:
             backend, _ = open_backend(self._remote())
             # First writer wins: concurrent observers of one occurrence
@@ -453,16 +500,20 @@ class TPUTask(GcsRemoteMixin, Task):
             # cached under the immutability contract (_bucket_events).
             # write_if_absent is atomic on local (O_EXCL) and GCS
             # (ifGenerationMatch=0) — the deployed mailbox backends.
-            wrote = backend.write_if_absent(key, json.dumps({
-                "time": event.time.isoformat(),
-                "code": event.code,
-                "description": list(event.description),
-            }).encode())
+            wrote = backend.write_if_absent(key, payload)
             if wrote:
                 self._bucket_events_at = float("-inf")  # cache now stale
+            return True
         except Exception as error:
             self._warn_once("event-persist",
                             f"could not persist recovery event: {error}")
+            return False
+
+    def _drain_pending_event_writes(self) -> None:
+        pending, self._pending_event_writes = self._pending_event_writes, []
+        for key, payload in pending:
+            if not self._persist_event(key, payload):
+                self._pending_event_writes.append((key, payload))
 
     def _bucket_events(self) -> List[Event]:
         """Durable events from the bucket mailbox, cached for
@@ -504,18 +555,224 @@ class TPUTask(GcsRemoteMixin, Task):
         self._bucket_events_at = now
         return self._bucket_events_cache
 
+    # -- liveness (heartbeat staleness) ---------------------------------------
+    def _heartbeat_index(self) -> Optional[Dict[str, Dict[int, dict]]]:
+        """Newest heartbeat per slice worker from ``reports/heartbeat-*``
+        blobs: ``{node: {worker: {"mtime": epoch_s, "final": bool}}}``.
+        ``None`` when this probe failed (or the backend lists no mtimes) —
+        a flaky bucket must yield *no decision*, never a spurious requeue,
+        and never a stale snapshot that ages into one. Bodies (machine→node/worker
+        mapping) are cached per (key, mtime): a poll re-reads only blobs
+        that moved. Cached for TPU_TASK_HEARTBEAT_PROBE_PERIOD seconds
+        (default 20)."""
+        period = float(os.environ.get("TPU_TASK_HEARTBEAT_PROBE_PERIOD", "20"))
+        now = time.monotonic()
+        if now - self._heartbeats_at < period:
+            return self._heartbeats_cache
+        from tpu_task.storage.backends import open_backend
+
+        try:
+            backend, _ = open_backend(self._remote())
+            meta = backend.list_meta("reports/")
+            if meta is None:
+                # A backend that lists no mtimes can't age heartbeats —
+                # liveness makes NO decision rather than misreading every
+                # blob as never-written (all deployed backends do report
+                # mtimes; this is the contract for future ones).
+                self._warn_once("heartbeat-meta",
+                                "storage backend lists no mtimes; "
+                                "heartbeat liveness disabled")
+                self._heartbeats_cache = None
+                self._heartbeats_at = now
+                return None
+            index: Dict[str, Dict[int, dict]] = {}
+            for key in sorted(meta):
+                name = key.rsplit("/", 1)[-1]
+                if not name.startswith("heartbeat-"):
+                    continue
+                mtime = meta[key][1]
+                cached = self._heartbeat_records.get(key)
+                if cached is None or cached[0] != mtime:
+                    payload = json.loads(backend.read(key))
+                    cached = (mtime, payload.get("node", ""),
+                              int(payload.get("worker", 0)),
+                              bool(payload.get("final")))
+                    self._heartbeat_records[key] = cached
+                _, node, worker, final = cached
+                workers = index.setdefault(node, {})
+                entry = workers.get(worker)
+                if entry is None or mtime > entry["mtime"]:
+                    workers[worker] = {"mtime": mtime, "final": final}
+            # Drop cache entries for blobs that left the listing (pruned on
+            # requeue / task teardown) so the cache stays bounded.
+            for key in [k for k in self._heartbeat_records if k not in meta]:
+                del self._heartbeat_records[key]
+        except Exception as error:
+            # Probe failed → NO decision (never a stale last-known-good: a
+            # sustained observer-side outage would otherwise age the frozen
+            # cache past the staleness bound and requeue a healthy slice).
+            self._warn_once("heartbeat-probe",
+                            f"heartbeat probe failed: {error}")
+            return None
+        self._heartbeats_cache = index
+        self._heartbeats_at = now
+        return index
+
+    def _liveness_stale(self, info: QueuedResourceInfo,
+                        heartbeats: Optional[Dict[str, Dict[int, dict]]],
+                        stale_after: float,
+                        worker_count: int = 1) -> bool:
+        """Is this ACTIVE slice hung? True when ANY of its workers' newest
+        heartbeats is older than ``stale_after`` seconds (one hung worker
+        wedges the whole jax.distributed job) — or when a worker never
+        heartbeat at all within TPU_TASK_LIVENESS_BOOT_GRACE of the slice
+        first being seen ACTIVE (a VM that hung before the agent started).
+        Heartbeats older than the slice's last requeue belong to the
+        previous incarnation and count as "none yet"; a worker whose last
+        heartbeat is ``final`` exited cleanly and is the status mailbox's
+        business, not liveness's."""
+        now = time.time()
+        first = self._first_active.setdefault(info.name, now)
+        if heartbeats is None:
+            return False  # probe failed: no data, no decision
+        last_requeue = self._requeue_state.get(info.name, {}).get("at_wall", 0.0)
+        anchor = max(first, last_requeue)
+        grace = float(os.environ.get("TPU_TASK_LIVENESS_BOOT_GRACE", "600"))
+        entries = heartbeats.get(info.node_name) or {}
+        for worker in range(worker_count):
+            entry = entries.get(worker)
+            if entry is None or entry["mtime"] <= last_requeue:
+                if now - anchor > grace:
+                    return True
+                continue
+            if entry["final"]:
+                continue
+            if now - entry["mtime"] > stale_after:
+                return True
+        return False
+
+    def _liveness_occurrence(self, info: QueuedResourceInfo,
+                             heartbeats) -> str:
+        """Idempotency suffix for one liveness occurrence, derived from the
+        HUNG worker's last heartbeat (the oldest non-final one): every
+        observer of the same hang sees the same frozen mtime — a healthy
+        sibling's advancing heartbeats must not vary the key — while a
+        later hang of the requeued incarnation freezes at a fresher mtime,
+        so concurrent observers dedupe but successive requeues each get
+        their own durable MTTR record."""
+        entries = (heartbeats or {}).get(info.node_name) or {}
+        stale = [e["mtime"] for e in entries.values() if not e["final"]]
+        if stale:
+            return str(int(min(stale)))
+        anchor = max(self._first_active.get(info.name, 0.0),
+                     self._requeue_state.get(info.name, {}).get("at_wall", 0.0))
+        return f"boot{int(anchor)}"
+
+    # -- requeue governor: backoff + bounded recovery budget ------------------
+    def _maybe_recover(self, info: QueuedResourceInfo, code: str,
+                       occurrence: str = "") -> None:
+        """Gate every requeue through per-slice exponential backoff and a
+        bounded recovery budget, so a poisoned spec converges to FAILED
+        instead of thrashing forever. Every decision lands in the durable
+        event mailbox — MTTR stays measurable from any observer."""
+        state = self._requeue_state.setdefault(info.name, {
+            "attempts": 0, "next_at": float("-inf"), "at_wall": 0.0,
+            "exhausted": False})
+        if state["exhausted"]:
+            return
+        budget = int(os.environ.get("TPU_TASK_RECOVERY_BUDGET", "5"))
+        if state["attempts"] >= budget:
+            state["exhausted"] = True
+            self._fail_unrecoverable(info)
+            return
+        now = time.monotonic()
+        if now < state["next_at"]:
+            return  # backing off; reconsidered on a later read
+        base = float(os.environ.get("TPU_TASK_REQUEUE_BACKOFF_BASE", "2"))
+        cap = float(os.environ.get("TPU_TASK_REQUEUE_BACKOFF_CAP", "60"))
+        state["attempts"] += 1
+        state["next_at"] = now + min(base * (2 ** (state["attempts"] - 1)), cap)
+        state["at_wall"] = time.time()
+        self._first_active.pop(info.name, None)
+        stamp = datetime.now(timezone.utc)
+        reason = ("stale heartbeat on ACTIVE slice" if code == "liveness-requeue"
+                  else "preempted")
+        self._record_recovery(
+            Event(time=stamp, code=code,
+                  description=[f"re-queueing {reason} {info.name} "
+                               f"(attempt {state['attempts']}/{budget})"]),
+            key_hint=f"{code}-{info.name}-"
+                     f"{occurrence or self._occurrence_stamp(info, stamp)}")
+        self._recover(info)
+
+    def _occurrence_stamp(self, info: QueuedResourceInfo, stamp) -> str:
+        """Idempotency suffix for one recovery occurrence: concurrent
+        observers of the SAME suspension compute the same key (the control
+        plane's SUSPEND event time identifies it), while successive
+        suspensions of one slice get distinct durable records. Falls back
+        to the observation minute when the API exposed no SUSPEND event."""
+        for event in reversed(info.events):
+            if event.get("code") == "SUSPEND":
+                return "".join(ch for ch in event["time"] if ch.isalnum())
+        return stamp.strftime("%Y%m%dT%H%M")
+
+    def _maybe_reset_budget(self, info: QueuedResourceInfo,
+                            heartbeats: Optional[Dict[str, dict]]) -> None:
+        """A healthy re-queue resets the budget: the slice came back ACTIVE
+        and either produced a fresh heartbeat since its last requeue or ran
+        for TPU_TASK_RECOVERY_HEALTHY_AFTER seconds — so the budget bounds
+        *consecutive* failing recoveries, not lifetime preemptions."""
+        state = self._requeue_state.get(info.name)
+        if not state or not state["attempts"] or state["exhausted"]:
+            return
+        healthy_after = float(os.environ.get(
+            "TPU_TASK_RECOVERY_HEALTHY_AFTER", "120"))
+        entries = (heartbeats or {}).get(info.node_name) or {}
+        heartbeat_fresh = any(entry["mtime"] > state["at_wall"]
+                              for entry in entries.values())
+        uptime_ok = time.time() - state["at_wall"] > healthy_after
+        if heartbeat_fresh or uptime_ok:
+            state["attempts"] = 0
+            state["next_at"] = float("-inf")
+
+    def _fail_unrecoverable(self, info: QueuedResourceInfo) -> None:
+        """Recovery budget exhausted: surface FAILED and release capacity.
+
+        A terminal status report (non-zero code) lands in the mailbox so
+        EVERY observer's status fold sees the slice as failed; the durable
+        budget-exhausted event is the forensic record; the queued resource
+        is deleted so a poisoned spec stops consuming quota."""
+        stamp = datetime.now(timezone.utc)
+        budget = int(os.environ.get("TPU_TASK_RECOVERY_BUDGET", "5"))
+        self._record_recovery(
+            Event(time=stamp, code="recovery-budget-exhausted",
+                  description=[f"{info.name}: {budget} consecutive recoveries "
+                               "failed; giving up (FAILED)"]),
+            key_hint=f"budget-{info.name}")
+        from tpu_task.storage.backends import open_backend
+
+        try:
+            backend, _ = open_backend(self._remote())
+            backend.write(f"reports/status-{info.name}", json.dumps({
+                "result": "recovery-budget-exhausted",
+                "code": "recovery-budget-exhausted", "status": ""}).encode())
+        except Exception as error:
+            self._warn_once("budget-status",
+                            f"could not persist budget-exhausted status: {error}")
+        try:
+            self.client.delete_queued_resource(info.name, force=True)
+        except ResourceNotFoundError:
+            pass
+
     def _recover(self, info: QueuedResourceInfo) -> None:
         """The preemption-recovery reconciler: SUSPENDED → delete → re-queue.
 
         Workers of the re-granted node restore their workdir from the bucket
         (render_script / local agent restore path), so user scripts resume
         from the last synced checkpoint — ASG-respawn semantics made explicit.
+        (Mechanical requeue only; event recording and backoff/budget gating
+        live in :meth:`_maybe_recover`.)
         """
-        stamp = datetime.now(timezone.utc)
-        self._record_recovery(
-            Event(time=stamp, code="recover",
-                  description=[f"re-queueing preempted {info.name}"]),
-            key_hint=f"recover-{info.name}-{stamp.strftime('%Y%m%dT%H%M')}")
         # Recover the staged agent-wheel URL from the QR's own metadata —
         # a bare-read process never staged one itself, and a re-rendered
         # bootstrap without it would fall back to the package index.
@@ -532,7 +789,38 @@ class TPUTask(GcsRemoteMixin, Task):
             self.client.delete_queued_resource(info.name, force=True)
         except ResourceNotFoundError:
             pass
+        # Prune the dead incarnation's heartbeat blobs BEFORE the respawn:
+        # they are exactly what a FRESH observer (empty in-memory requeue
+        # state) would otherwise read as "stale heartbeat on an ACTIVE
+        # slice" while the re-granted VM is still booting — a spurious
+        # requeue storm — and they grow without bound across requeues.
+        # After the prune the new incarnation reads as "no heartbeat yet"
+        # to every observer, which is what boot grace is for. (A graceful
+        # agent's final=True heartbeat written after this is harmless —
+        # final entries never count as stale.)
+        self._prune_heartbeats(info.name)
         self.client.create_queued_resource(info.name, spec)
+
+    def _prune_heartbeats(self, node_name: str) -> None:
+        from tpu_task.storage.backends import open_backend
+
+        try:
+            backend, _ = open_backend(self._remote())
+            for key in backend.list("reports/"):
+                name = key.rsplit("/", 1)[-1]
+                if not name.startswith("heartbeat-"):
+                    continue
+                cached = self._heartbeat_records.get(key)
+                node = cached[1] if cached else \
+                    json.loads(backend.read(key)).get("node", "")
+                if node == node_name:
+                    backend.delete(key)
+                    self._heartbeat_records.pop(key, None)
+        except Exception as error:
+            # Best effort: a failed prune leaves the (bounded) stale-blob
+            # hazard, never breaks the requeue itself.
+            self._warn_once("heartbeat-prune",
+                            f"could not prune heartbeats: {error}")
 
     def delete(self) -> None:
         # Resolve (and cache) the remote BEFORE stop() deletes the queued

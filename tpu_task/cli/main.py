@@ -171,7 +171,10 @@ def cmd_read(args) -> int:
             seen_events.add(key)
             # Recovery/self-destruct events are the preemption-MTTR record —
             # surface them in the follow loop, not just at debug level.
-            if event.code in ("recover", "REQUEUE", "SUSPEND", "self-destruct"):
+            # liveness-requeue/budget-exhausted are the heartbeat liveness
+            # layer's decisions (hung-but-ACTIVE slices, poisoned specs).
+            if event.code in ("recover", "REQUEUE", "SUSPEND", "self-destruct",
+                              "liveness-requeue", "recovery-budget-exhausted"):
                 if waiting:
                     print(file=sys.stderr)
                     waiting = False

@@ -54,7 +54,8 @@ class Agent:
     def __init__(self, remote: str, directory: str, script_path: str,
                  machine_id: str, timeout_epoch: float,
                  log_period: float, data_period: float, worker_id: int = 0,
-                 checkpoint_dir: str = "checkpoints"):
+                 checkpoint_dir: str = "checkpoints",
+                 heartbeat_period: float = 30.0, node_name: str = ""):
         self.remote = remote
         self.directory = directory
         self.script_path = script_path
@@ -64,9 +65,13 @@ class Agent:
         self.data_period = data_period
         self.worker_id = worker_id
         self.checkpoint_dir = checkpoint_dir
+        self.heartbeat_period = heartbeat_period
+        self.node_name = node_name
         self.log_lines: list[str] = []
         self._log_lock = threading.Lock()
         self._done = threading.Event()
+        # SIGTERM = preemption notice: stop the child, final-sync, report.
+        self._preempted = threading.Event()
 
     # -- sync loops ----------------------------------------------------------
     def _reports_dir(self) -> str:
@@ -89,8 +94,34 @@ class Agent:
             with self._log_lock:
                 current = len(self.log_lines)
             if current != last:
+                try:
+                    self._sync_logs()
+                except Exception as error:  # transient like _data_loop: one
+                    # failed tick must not kill log streaming for the run
+                    self._append_log(f"log sync error: {error}\n")
+                    continue  # `last` unchanged → retried next tick
                 last = current
-                self._sync_logs()
+
+    # -- liveness heartbeats ---------------------------------------------------
+    def _write_heartbeat(self, final: bool = False) -> None:
+        """``reports/heartbeat-{machine}``: the liveness contract. The
+        orchestrator's reconciler treats a stale heartbeat on an ACTIVE
+        slice as preemption-equivalent; ``final`` marks a clean agent exit
+        so a finished worker is never mistaken for a hung one."""
+        self._write_report("heartbeat", json.dumps({
+            "time": _iso_now(),
+            "machine": self.machine_id,
+            "worker": self.worker_id,
+            "node": self.node_name,
+            "final": final,
+        }))
+
+    def _heartbeat_loop(self) -> None:
+        while not self._done.wait(self.heartbeat_period):
+            try:
+                self._write_heartbeat()
+            except Exception as error:  # flaky bucket ≠ dead worker
+                self._append_log(f"heartbeat error: {error}\n")
 
     def _data_loop(self) -> None:
         last_epoch = None
@@ -184,6 +215,10 @@ class Agent:
         # (AsyncCheckpointer(upload_remote="auto")) instead of waiting for
         # the next data-period sweep.
         env["TPU_TASK_DATA_REMOTE"] = data_remote
+        if self.node_name:
+            # Stable per-slice identity (survives requeues): scripts key
+            # per-slice state (checkpoints) on it in multi-slice tasks.
+            env["TPU_TASK_NODE"] = self.node_name
         if env.get("TPU_TASK_CLOUD_PROVIDER") == "k8s":
             # Mirror the rank under the k8s-native name so scripts written
             # for real indexed Jobs (resource_job.go:135-140) run unchanged
@@ -203,9 +238,16 @@ class Agent:
             start_new_session=True,
         )
 
+        self._install_preemption_handler(process)
+        try:
+            self._write_heartbeat()  # liveness baseline before the first tick
+        except Exception as error:
+            self._append_log(f"heartbeat error: {error}\n")
+
         threads = [
             threading.Thread(target=self._log_loop, daemon=True),
             threading.Thread(target=self._data_loop, daemon=True),
+            threading.Thread(target=self._heartbeat_loop, daemon=True),
         ]
         for thread in threads:
             thread.start()
@@ -234,7 +276,12 @@ class Agent:
             thread.join(timeout=5)
 
         # Status report (tpl:51): timeout has result "timeout" and no code.
-        if timed_out:
+        # A preempted worker reports result "preempted" (no code): status
+        # folding counts neither success nor failure — the reconciler owns
+        # the slice's fate, and the report preserves the last state.
+        if self._preempted.is_set():
+            report = {"result": "preempted", "code": "", "status": ""}
+        elif timed_out:
             report = {"result": "timeout", "code": "", "status": ""}
         else:
             code = process.returncode
@@ -254,12 +301,59 @@ class Agent:
             self._append_log(f"final data sync error: {error}\n")
         self._sync_logs()
         self._write_report("status", json.dumps(report))
-        if self.worker_id == 0:
+        try:
+            # Final heartbeat: a cleanly-exited (or preempted-with-grace)
+            # worker must never read as hung to the liveness reconciler.
+            self._write_heartbeat(final=True)
+        except Exception as error:
+            self._append_log(f"heartbeat error: {error}\n")
+        if self.worker_id == 0 and not self._preempted.is_set():
             # Self-destruct signal: the control plane scales the group to zero
             # when it sees this marker (the hermetic `leo stop` equivalent).
+            # NOT on preemption — a preempted slice must be requeued, not
+            # torn down.
             with open(os.path.join(self.remote, "shutdown"), "w") as handle:
                 handle.write(self.machine_id)
         return process.returncode or 0
+
+    def _install_preemption_handler(self, process: subprocess.Popen) -> None:
+        """SIGTERM = preemption notice (the shape every cloud's reclaim
+        warning takes): stop the child with the same TERM→grace→KILL ladder
+        the timeout path uses, then let the normal terminal path run its
+        final data/log sync and status report, so a preempted worker's last
+        state still lands in the bucket."""
+
+        def on_sigterm(_signum, _frame):
+            if process.poll() is not None:
+                # The task already finished — the terminal path is running
+                # and must report the child's REAL result; a teardown
+                # notice arriving now is not a preemption of the task.
+                return
+            if self._preempted.is_set():
+                return
+            self._preempted.set()
+            self._append_log("preemption notice (SIGTERM): stopping task\n")
+            try:
+                os.killpg(process.pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+            grace = float(os.environ.get("TPU_TASK_PREEMPT_GRACE", "10"))
+
+            def escalate():
+                if process.poll() is None:
+                    try:
+                        os.killpg(process.pid, signal.SIGKILL)
+                    except ProcessLookupError:
+                        pass
+
+            timer = threading.Timer(grace, escalate)
+            timer.daemon = True
+            timer.start()
+
+        try:
+            signal.signal(signal.SIGTERM, on_sigterm)
+        except ValueError:
+            pass  # not the main thread (in-process test harness)
 
     def _read_output(self, process: subprocess.Popen) -> None:
         assert process.stdout is not None
@@ -280,6 +374,12 @@ def main(argv=None) -> int:
     parser.add_argument("--checkpoint-dir", default="checkpoints",
                         help="workdir-relative checkpoint directory that gets"
                              " priority (first) in each data sync tick")
+    parser.add_argument("--heartbeat-period", type=float, default=30.0,
+                        help="liveness heartbeat write period (seconds)")
+    parser.add_argument("--node-name", default="",
+                        help="stable slice identity (queued-resource name); "
+                             "exported to the task as TPU_TASK_NODE and "
+                             "stamped into heartbeats")
     args = parser.parse_args(argv)
 
     machine_id = args.machine_id or f"{uuid.uuid4()}-worker{args.worker_id}"
@@ -288,6 +388,7 @@ def main(argv=None) -> int:
         machine_id=machine_id, timeout_epoch=args.timeout,
         log_period=args.log_period, data_period=args.data_period,
         worker_id=args.worker_id, checkpoint_dir=args.checkpoint_dir,
+        heartbeat_period=args.heartbeat_period, node_name=args.node_name,
     )
     return agent.run()
 

@@ -43,6 +43,9 @@ source /opt/task/credentials
 TPU_METADATA="http://metadata.google.internal/computeMetadata/v1/instance/attributes"
 export TPU_WORKER_ID="$(curl --silent --header 'Metadata-Flavor: Google' $TPU_METADATA/agent-worker-number || echo 0)"
 export TPU_WORKER_HOSTNAMES="$(curl --silent --header 'Metadata-Flavor: Google' $TPU_METADATA/worker-network-endpoints | tr ',' '\n' | cut -d: -f3 | paste -sd, - || true)"
+# Stable slice identity (the queued-resource name; survives requeues):
+# stamped into liveness heartbeats and exported to the task script.
+export TPU_TASK_NODE="$(curl --silent --header 'Metadata-Flavor: Google' $TPU_METADATA/tpu-task-node || echo unknown)"
 export TPU_TASK_MACHINE_IDENTITY="$(uuidgen)-worker$TPU_WORKER_ID"
 # jax.distributed contract (tpu_task.ml.parallel.mesh.distributed_init_from_env):
 # rank, world size, and coordinator = worker 0's endpoint.
@@ -54,6 +57,7 @@ export TPU_TASK_COORDINATOR="$(echo "$TPU_WORKER_HOSTNAMES" | cut -d, -f1):8476"
 {
   echo "export TPU_WORKER_ID=$TPU_WORKER_ID"
   echo "export TPU_WORKER_HOSTNAMES=$TPU_WORKER_HOSTNAMES"
+  echo "export TPU_TASK_NODE=$TPU_TASK_NODE"
   echo "export TPU_TASK_MACHINE_IDENTITY=$TPU_TASK_MACHINE_IDENTITY"
   echo "export TPU_TASK_WORKER_ID=$TPU_TASK_WORKER_ID"
   echo "export TPU_TASK_NUM_WORKERS=$TPU_TASK_NUM_WORKERS"
@@ -112,7 +116,15 @@ sudo systemctl enable tpu-task.service --now
 sudo systemctl disable --now apt-daily.timer 2> /dev/null || true
 
 # Log stream: journald task unit → reports/task-{machine}, every 5 s on change.
+# The liveness heartbeat rides the same loop: its payload changes every tick,
+# so the hash check below guarantees a sync (and thus a fresh
+# reports/heartbeat-{machine} in the bucket) each period — the staleness
+# contract the orchestrator's reconciler watches (TPU_TASK_HEARTBEAT_STALE_AFTER).
 while sleep 5; do
+  printf '{"time": "%s", "machine": "%s", "worker": %s, "node": "%s", "final": false}' \
+    "$(date --utc +%Y-%m-%dT%H:%M:%SZ)" "$TPU_TASK_MACHINE_IDENTITY" \
+    "${TPU_WORKER_ID:-0}" "$TPU_TASK_NODE" \
+    > "$TPU_TASK_LOG_DIRECTORY/heartbeat-$TPU_TASK_MACHINE_IDENTITY"
   test -n "$TPU_TASK_MACHINE_LOGS" && journalctl > "$TPU_TASK_LOG_DIRECTORY/machine-$TPU_TASK_MACHINE_IDENTITY"
   journalctl --all --no-hostname --output=short-iso --quiet --unit=tpu-task --utc | sed 's/^\([0-9-]*\)T\([0-9:]*\)+0000 \S*: \(.*\)/\1T\2Z \3/g' > "$TPU_TASK_LOG_DIRECTORY/task-$TPU_TASK_MACHINE_IDENTITY"
   NEW_TPU_TASK_LOG_HASH="$(md5sum "$TPU_TASK_LOG_DIRECTORY"/*)"

@@ -27,6 +27,7 @@ from __future__ import annotations
 import http.client
 import io
 import os
+import random as _random
 import ssl
 import threading
 import time as _time
@@ -274,6 +275,22 @@ def default_pool() -> HTTPPool:
         return _default_pool
 
 
+_jitter_rng: Optional[_random.Random] = None
+_jitter_lock = threading.Lock()
+
+
+def _default_jitter_rng() -> _random.Random:
+    """Process-wide backoff-jitter RNG, seeded off ``os.urandom`` so every
+    worker process jitters independently — N workers retrying a shared
+    endpoint must not re-synchronize into the thundering herd the backoff
+    was supposed to break up."""
+    global _jitter_rng
+    with _jitter_lock:
+        if _jitter_rng is None:
+            _jitter_rng = _random.Random(int.from_bytes(os.urandom(8), "big"))
+        return _jitter_rng
+
+
 _proxies: Optional[Dict[str, str]] = None
 
 
@@ -308,20 +325,28 @@ def send(
     with_headers: bool = False,
     urlopen=None,
     sleep=_time.sleep,
+    rng=None,
 ):
     """One HTTP request with retry/backoff on transient failures.
 
-    Retries 408/429/5xx and transport-level errors with exponential backoff
-    (0.5 s → 8 s), honoring ``Retry-After`` when the server sends one.
-    ``ok_statuses`` treats additional HTTP error codes as success and returns
-    their body (GCS resumable uploads answer 308 for intermediate chunks).
-    Non-retryable errors (4xx) raise immediately. With ``with_headers`` the
-    return value is ``(body, headers_dict)`` instead of just the body.
+    Retries 408/429/5xx and transport-level errors with *full-jitter*
+    exponential backoff — each wait is uniform in ``(0, ladder]`` where the
+    ladder doubles 0.5 s → 8 s — so a multi-worker fan-out whose retries
+    were synchronized by one shared failure doesn't re-converge into a
+    thundering herd. A server-sent ``Retry-After`` takes precedence over
+    the jittered ladder, capped at 60 s. ``rng`` injects the jitter source
+    (``random.Random``-shaped; default process-wide, seeded off
+    ``os.urandom``). ``ok_statuses`` treats additional HTTP error codes as
+    success and returns their body (GCS resumable uploads answer 308 for
+    intermediate chunks). Non-retryable errors (4xx) raise immediately.
+    With ``with_headers`` the return value is ``(body, headers_dict)``
+    instead of just the body.
     """
     import urllib.error
     import urllib.request
 
     urlopen = urlopen or _default_urlopen
+    rng = rng or _default_jitter_rng()
     delay = BACKOFF_BASE
     last_error: Optional[Exception] = None
     for attempt in range(retries + 1):
@@ -344,9 +369,11 @@ def send(
                 raise
             last_error = error
             retry_after = error.headers.get("Retry-After") if error.headers else None
-            wait = delay
+            wait = rng.uniform(0, delay)
             if retry_after:
                 try:
+                    # Retry-After precedence: the server's pacing request is
+                    # explicit — obey it as-is, no jitter.
                     wait = min(float(retry_after), RETRY_AFTER_CAP)
                 except ValueError:
                     pass
@@ -355,7 +382,7 @@ def send(
             if attempt == retries:
                 raise
             last_error = error
-            sleep(delay)
+            sleep(rng.uniform(0, delay))
         delay = min(delay * 2, BACKOFF_CAP)
     raise RuntimeError(f"unreachable retry loop exit: {last_error}")
 
@@ -404,6 +431,7 @@ def authorized_send(
     with_headers: bool = False,
     urlopen=None,
     sleep=_time.sleep,
+    rng=None,
 ):
     """:func:`send` with Bearer auth; one forced token refresh on 401."""
     import urllib.error
@@ -413,7 +441,8 @@ def authorized_send(
     try:
         return send(method, url, data=data, headers=request_headers,
                     timeout=timeout, retries=retries, ok_statuses=ok_statuses,
-                    with_headers=with_headers, urlopen=urlopen, sleep=sleep)
+                    with_headers=with_headers, urlopen=urlopen, sleep=sleep,
+                    rng=rng)
     except urllib.error.HTTPError as error:
         if error.code != 401:
             raise
@@ -421,4 +450,5 @@ def authorized_send(
         request_headers["Authorization"] = "Bearer " + token.get()
         return send(method, url, data=data, headers=request_headers,
                     timeout=timeout, retries=retries, ok_statuses=ok_statuses,
-                    with_headers=with_headers, urlopen=urlopen, sleep=sleep)
+                    with_headers=with_headers, urlopen=urlopen, sleep=sleep,
+                    rng=rng)

@@ -1,0 +1,21 @@
+"""Deterministic fault-injection tooling (the chaos plane).
+
+Test/soak infrastructure that ships with the package so the CLI, the bench
+driver, and external users can all rehearse failure handling against the
+hermetic control planes with zero cloud credentials."""
+
+from tpu_task.testing.chaos import (
+    ChaosBackend,
+    ChaosSchedule,
+    ChaosTpuClient,
+    ChaosTransport,
+    flaky_storage,
+)
+
+__all__ = [
+    "ChaosBackend",
+    "ChaosSchedule",
+    "ChaosTpuClient",
+    "ChaosTransport",
+    "flaky_storage",
+]

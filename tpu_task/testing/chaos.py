@@ -1,0 +1,415 @@
+"""Seeded, schedulable fault injector for the hermetic lifecycle (chaos plane).
+
+Jepsen-style seeded fault injection is the standard way to prove a recovery
+reconciler without cloud credentials (Check-N-Run's frequent-checkpoint story
+only pays off when the orchestrator reliably detects death and requeues).
+This module wraps the two seams the stack already injects through:
+
+* :class:`ChaosTpuClient` — a ``TpuClient`` wrapper: transient 429/503
+  bursts, injected latency, and *scheduled* preemptions / worker hangs
+  driven through :meth:`FakeTpuControlPlane.preempt_node` and direct agent
+  kills (a hung VM the control plane still reports ACTIVE).
+* :class:`ChaosTransport` — conforms to the ``urlopen`` seam of
+  ``storage/http_util.py``: connection resets, timeouts, slow responses,
+  truncated reads, and failed uploads, all upstream of the retry ladder.
+* :class:`ChaosBackend` / :func:`flaky_storage` — transient faults at the
+  storage ``Backend`` surface (the orchestrator's bucket probes: shutdown
+  marker, heartbeat index, durable event mailbox).
+
+Replayability: every seam draws from its OWN deterministic stream derived
+from one seed (:meth:`ChaosSchedule.derive`), so the decision sequence at
+each seam is identical run to run regardless of how the other seams
+interleave. ``ChaosSchedule.injected`` is the flight record — each injected
+fault with its wall-clock stamp, which is what MTTR is measured against.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal
+import threading
+import time
+import urllib.error
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+__all__ = [
+    "ChaosBackend",
+    "ChaosFault",
+    "ChaosSchedule",
+    "ChaosTpuClient",
+    "ChaosTransport",
+    "flaky_storage",
+    "transient_http_error",
+]
+
+
+def transient_http_error(url: str, code: int,
+                         retry_after: Optional[float] = None):
+    """A retryable HTTPError shaped like the live services' 429/503s."""
+    import email.message
+    import io
+
+    headers = email.message.Message()
+    if retry_after is not None:
+        headers["Retry-After"] = str(retry_after)
+    return urllib.error.HTTPError(
+        url, code, "chaos: injected transient error", headers,
+        io.BytesIO(b"chaos"))
+
+
+@dataclass
+class ChaosFault:
+    """One injected fault, stamped for MTTR accounting."""
+
+    time: float          # wall-clock (time.time()) at injection
+    kind: str            # "preempt" | "hang" | "error" | "reset" | ...
+    target: str = ""     # node/url/backend the fault hit
+    detail: str = ""
+
+
+@dataclass(eq=False)
+class _TimedAction:
+    at: float            # seconds after schedule start
+    label: str
+    fn: Callable[[], bool]   # returns True when done; False → retried
+    retry_every: float = 0.5
+    deadline: float = 60.0   # give up (seconds after `at`)
+    fired: bool = field(default=False, compare=False)
+    retry_at: float = field(default=0.0, compare=False)
+
+
+class ChaosSchedule:
+    """One seed → a replayable plan of faults across every chaos seam.
+
+    Timed actions (``at(seconds, fn)``) fire on :meth:`tick`, which every
+    wrapper calls on each operation — so the schedule advances with the
+    system under test and needs no extra thread. An action whose
+    precondition isn't met yet (e.g. "preempt node X" before X exists)
+    returns False and is retried until its deadline.
+    """
+
+    def __init__(self, seed: int, *, now: Callable[[], float] = time.monotonic):
+        self.seed = seed
+        self._now = now
+        self._start = now()
+        self._lock = threading.Lock()
+        self._actions: List[_TimedAction] = []
+        self.injected: List[ChaosFault] = []
+
+    def derive(self, seam: str) -> random.Random:
+        """An independent deterministic stream for one seam: the draw count
+        at one seam never perturbs another's decisions."""
+        return random.Random(f"{self.seed}:{seam}")
+
+    def elapsed(self) -> float:
+        return self._now() - self._start
+
+    def at(self, seconds: float, fn: Callable[[], bool], label: str = "",
+           deadline: float = 60.0) -> None:
+        with self._lock:
+            self._actions.append(_TimedAction(
+                at=seconds, label=label, fn=fn, deadline=deadline))
+            self._actions.sort(key=lambda action: action.at)
+
+    def record(self, kind: str, target: str = "", detail: str = "") -> ChaosFault:
+        fault = ChaosFault(time=time.time(), kind=kind, target=target,
+                           detail=detail)
+        with self._lock:
+            self.injected.append(fault)
+        return fault
+
+    def tick(self) -> None:
+        """Fire every due action (once each; failed preconditions retry).
+
+        Due actions are CLAIMED (marked fired) under the lock before their
+        callbacks run, so concurrent tickers — the soak driver loop plus a
+        chaos-wrapped client on another thread — can never double-inject
+        one fault; a callback that reports "not yet" releases its claim
+        with a retry delay."""
+        elapsed = self.elapsed()
+        with self._lock:
+            due = [action for action in self._actions
+                   if not action.fired and action.at <= elapsed
+                   and action.retry_at <= elapsed
+                   and elapsed <= action.at + action.deadline]
+            for action in due:
+                action.fired = True  # claim
+        for action in due:
+            done = False
+            try:
+                done = bool(action.fn())
+            except Exception:
+                done = False  # precondition not met yet; retry
+            if not done:
+                with self._lock:
+                    action.fired = False
+                    action.retry_at = self.elapsed() + action.retry_every
+
+    def pending(self) -> List[str]:
+        with self._lock:
+            return [action.label for action in self._actions if not action.fired]
+
+
+# -- control-plane seam --------------------------------------------------------
+
+class ChaosTpuClient:
+    """``TpuClient`` wrapper: seeded transient faults + scheduled reclaims.
+
+    Pass-through for every control-plane call, with three chaos behaviors:
+
+    * ``error_rate`` — fraction of calls that raise a retryable 429/503
+      (what a real control plane does under load; the fake plane never
+      does, so the reconciler's tolerance is otherwise untested);
+    * ``delay_rate``/``max_delay`` — injected latency via ``sleep``;
+    * :meth:`preempt_at` / :meth:`hang_at` — wall-clock-scheduled spot
+      reclaims (through the inner plane's ``preempt_node``) and worker
+      hangs (agent processes killed while the node record stays READY —
+      the failure only the heartbeat liveness layer can see).
+    """
+
+    #: methods eligible for probabilistic faults (mutations stay reliable so
+    #: a scheduled preemption is not itself lost to chaos)
+    FAULT_METHODS = ("get_queued_resource", "list_queued_resources", "get_node")
+
+    def __init__(self, inner, schedule: ChaosSchedule, *,
+                 error_rate: float = 0.0, delay_rate: float = 0.0,
+                 max_delay: float = 0.02, sleep: Callable[[float], None] = time.sleep):
+        self._inner = inner
+        self._schedule = schedule
+        self._rng = schedule.derive("tpu-client")
+        self._error_rate = error_rate
+        self._delay_rate = delay_rate
+        self._max_delay = max_delay
+        self._sleep = sleep
+
+    def __getattr__(self, name: str):
+        attr = getattr(self._inner, name)
+        if not callable(attr) or name.startswith("_"):
+            return attr
+        if name not in self.FAULT_METHODS:
+            return attr
+
+        def chaotic(*args, **kwargs):
+            self._schedule.tick()
+            draw = self._rng.random()
+            if draw < self._error_rate:
+                code = 429 if self._rng.random() < 0.5 else 503
+                self._schedule.record("error", target=name,
+                                      detail=f"http {code}")
+                raise transient_http_error(f"chaos://tpu/{name}", code)
+            if draw < self._error_rate + self._delay_rate:
+                self._sleep(self._rng.uniform(0, self._max_delay))
+            return attr(*args, **kwargs)
+
+        return chaotic
+
+    # -- scheduled reclaims ----------------------------------------------------
+    def preempt_at(self, seconds: float, node_name: str,
+                   graceful: bool = False, deadline: float = 60.0) -> None:
+        """Spot-reclaim ``node_name`` once it is alive, ``seconds`` after the
+        schedule started (retries until the node exists and is READY)."""
+
+        def fire() -> bool:
+            node = self._inner.get_node(node_name)  # raises until it exists
+            if node.state != "READY":
+                return False
+            self._inner.preempt_node(node_name, graceful=graceful)
+            self._schedule.record(
+                "preempt", target=node_name,
+                detail="graceful" if graceful else "hard")
+            return True
+
+        self._schedule.at(seconds, fire, label=f"preempt {node_name}",
+                          deadline=deadline)
+
+    def hang_at(self, seconds: float, node_name: str,
+                deadline: float = 60.0) -> None:
+        """Kill ``node_name``'s agent processes WITHOUT telling the control
+        plane — the node record stays READY/ACTIVE while heartbeats stop,
+        i.e. a hung VM. Fake-plane only (reads the node record's pids)."""
+
+        def fire() -> bool:
+            path = self._inner._node_path(node_name)
+            if not os.path.exists(path):
+                return False
+            with open(path) as handle:
+                payload = json.load(handle)
+            if payload.get("state") != "READY":
+                return False
+            pids = [worker.get("pid") or 0
+                    for worker in payload.get("workers", [])]
+            if not any(pids):
+                return False
+            for pid in pids:
+                if not pid:
+                    continue
+                try:
+                    os.killpg(pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    try:
+                        os.kill(pid, signal.SIGKILL)
+                    except (ProcessLookupError, PermissionError):
+                        pass
+            self._schedule.record("hang", target=node_name,
+                                  detail=f"killed agents {pids}")
+            return True
+
+        self._schedule.at(seconds, fire, label=f"hang {node_name}",
+                          deadline=deadline)
+
+
+# -- HTTP transport seam -------------------------------------------------------
+
+class _TruncatedResponse:
+    """Response wrapper whose body stops short — a mid-stream connection
+    drop the status line already promised more bytes for."""
+
+    def __init__(self, inner, keep: int):
+        self._inner = inner
+        self._keep = keep
+        self.headers = getattr(inner, "headers", {})
+        self.status = getattr(inner, "status", 200)
+
+    def read(self) -> bytes:
+        return self._inner.read()[: self._keep]
+
+    def getcode(self) -> int:
+        return getattr(self._inner, "getcode", lambda: self.status)()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        close = getattr(self._inner, "__exit__", None)
+        if close:
+            close(*exc)
+        return False
+
+
+class ChaosTransport:
+    """Chaos at the ``urlopen`` seam of :mod:`tpu_task.storage.http_util`.
+
+    Wraps any transport with the same contract (the pooled default, a
+    loopback emulator transport, or a scripted fake) and injects, per
+    request and per its seeded stream: connection resets, timeouts, slow
+    responses, truncated reads, and failed uploads (503 on bodied
+    requests — the part/chunk upload failure shape). Sits *upstream* of
+    ``send``'s retry ladder, which is exactly what it exercises.
+    """
+
+    def __init__(self, schedule: ChaosSchedule, inner=None, *,
+                 reset_rate: float = 0.0, timeout_rate: float = 0.0,
+                 slow_rate: float = 0.0, slow_seconds: float = 0.02,
+                 truncate_rate: float = 0.0, upload_fail_rate: float = 0.0,
+                 sleep: Callable[[float], None] = time.sleep):
+        if inner is None:
+            from tpu_task.storage.http_util import _default_urlopen
+
+            inner = _default_urlopen
+        self._inner = inner
+        self._schedule = schedule
+        self._rng = schedule.derive("transport")
+        self._reset_rate = reset_rate
+        self._timeout_rate = timeout_rate
+        self._slow_rate = slow_rate
+        self._slow_seconds = slow_seconds
+        self._truncate_rate = truncate_rate
+        self._upload_fail_rate = upload_fail_rate
+        self._sleep = sleep
+
+    def __call__(self, request, timeout: float = 60.0):
+        self._schedule.tick()
+        url = getattr(request, "full_url", "")
+        draw = self._rng.random()
+        gate = self._reset_rate
+        if draw < gate:
+            self._schedule.record("reset", target=url)
+            raise urllib.error.URLError(
+                ConnectionResetError("chaos: connection reset by peer"))
+        gate += self._timeout_rate
+        if draw < gate:
+            self._schedule.record("timeout", target=url)
+            raise urllib.error.URLError(
+                TimeoutError("chaos: request timed out"))
+        if request.data is not None:
+            gate += self._upload_fail_rate
+            if draw < gate:
+                self._schedule.record("upload-fail", target=url)
+                raise transient_http_error(url, 503)
+        if self._rng.random() < self._slow_rate:
+            self._sleep(self._slow_seconds)
+        response = self._inner(request, timeout=timeout)
+        if self._truncate_rate and self._rng.random() < self._truncate_rate:
+            self._schedule.record("truncate", target=url)
+            return _TruncatedResponse(response, keep=max(
+                0, self._rng.randrange(0, 64)))
+        return response
+
+
+# -- storage Backend seam ------------------------------------------------------
+
+class ChaosBackend:
+    """Transient-fault wrapper over a storage ``Backend``.
+
+    Read-side operations (``read``, ``list``, ``list_meta``) and the
+    mailbox write (``write_if_absent``, ``write``) raise a transient
+    ``OSError`` per the seeded stream — the orchestrator's observation
+    paths must degrade to "no decision", never crash or decide wrong.
+    Everything else passes through untouched.
+    """
+
+    FAULT_METHODS = ("read", "list", "list_meta", "write", "write_if_absent")
+
+    def __init__(self, inner, schedule: ChaosSchedule, *,
+                 fail_rate: float = 0.1, rng: Optional[random.Random] = None):
+        self._inner = inner
+        self._schedule = schedule
+        # ``rng`` lets many wrappers share ONE advancing stream
+        # (:func:`flaky_storage` opens a fresh backend per orchestrator
+        # operation — re-deriving per wrapper would replay the stream's
+        # FIRST draw against every operation instead of walking it).
+        self._rng = rng if rng is not None else schedule.derive("storage")
+        self._fail_rate = fail_rate
+
+    def __getattr__(self, name: str):
+        attr = getattr(self._inner, name)
+        if name not in self.FAULT_METHODS or not callable(attr):
+            return attr
+
+        def chaotic(*args, **kwargs):
+            if self._rng.random() < self._fail_rate:
+                self._schedule.record("storage-error", target=name)
+                raise OSError(f"chaos: transient storage fault in {name}")
+            return attr(*args, **kwargs)
+
+        return chaotic
+
+
+@contextmanager
+def flaky_storage(schedule: ChaosSchedule, fail_rate: float = 0.1):
+    """Patch ``open_backend`` so every backend the orchestrator opens is
+    chaos-wrapped. Module-local references (``storage.sync`` imported the
+    symbol at load) are patched too. Agent *subprocesses* are unaffected —
+    this is the observer/reconciler storage path."""
+    from tpu_task.storage import backends as backends_module
+    from tpu_task.storage import sync as sync_module
+
+    original = backends_module.open_backend
+    rng = schedule.derive("storage")  # ONE stream across all opened backends
+
+    def chaotic_open(remote: str):
+        backend, connection = original(remote)
+        return (ChaosBackend(backend, schedule, fail_rate=fail_rate, rng=rng),
+                connection)
+
+    backends_module.open_backend = chaotic_open
+    sync_module.open_backend = chaotic_open
+    try:
+        yield schedule
+    finally:
+        backends_module.open_backend = original
+        sync_module.open_backend = original
