@@ -8,7 +8,7 @@ PYTHON ?= python3
 # Seed for the chaos soak: any run is replayable by pinning this.
 TPU_TASK_CHAOS_SEED ?= 20260804
 
-.PHONY: test smoke sweep bench chaos wheel multichip kernels-tpu clean
+.PHONY: test smoke sweep bench bench-steady chaos wheel multichip kernels-tpu clean
 
 # Hermetic suite (the reference's `make test`, 30 s budget there; ours spans
 # the fake control planes, sharded-compute CPU checks, and the loopback GCS
@@ -29,6 +29,12 @@ sweep:
 # Headline benchmark: one JSON line (driver contract).
 bench:
 	$(PYTHON) bench.py
+
+# Steady-state cost model only: requests/tick + bytes/tick for a no-change
+# sync tick and an unchanged 32-machine poll, before/after the manifest
+# planner + conditional poll cache (loopback GCS emulator counters).
+bench-steady:
+	$(PYTHON) bench.py steady_state
 
 # Seeded fault-injection soak: preemptions + a hung worker + flaky storage
 # against the hermetic TPU control plane, replayable from the seed.

@@ -625,6 +625,128 @@ def bench_data_plane() -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_steady_state(n_files: int = 1000, n_machines: int = 32) -> dict:
+    """Requests/tick and bytes/tick for the no-change steady state, before
+    vs after the O(changes) layers: a 1k-file workdir `sync` tick and a
+    32-machine status+log poll against the loopback GCS emulator's
+    request/byte counters.
+
+    "Before" measures the pre-manifest paths via their kill switches
+    (TPU_TASK_SYNC_PLANNER=0 re-lists both sides every tick;
+    TPU_TASK_POLL_CACHE=0 re-reads every blob); "after" is the default:
+    the sync planner diffs a local scandir sweep against its persisted
+    manifest (zero round-trips when nothing changed) and polls ride the
+    conditional (ETag/304 + ranged-tail) cache."""
+    import importlib
+    import shutil
+
+    from tpu_task.storage.backends import GCSBackend
+    from tpu_task.storage.gcs_emulator import LoopbackGCS
+
+    sync_mod = importlib.import_module("tpu_task.storage.sync")
+    tmp = Path(tempfile.mkdtemp(prefix="tpu-task-steady-"))
+    work = tmp / "work"
+    for index in range(n_files):
+        path = work / f"d{index % 20:02d}" / f"f{index:04d}.txt"
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_bytes(b"x" * 200)
+    remote = ":googlecloudstorage:steady-bench"
+    knobs = ("TPU_TASK_SYNC_PLANNER", "TPU_TASK_POLL_CACHE",
+             "TPU_TASK_SYNC_RECONCILE_EVERY")
+    saved = {key: os.environ.get(key) for key in knobs}
+
+    def measure(server, fn) -> dict:
+        server.reset_counters()
+        t0 = time.perf_counter()
+        fn()
+        return {
+            "requests": server.request_total(),
+            "by_kind": dict(server.requests),
+            "bytes": server.bytes_in + server.bytes_out,
+            "wall_ms": round((time.perf_counter() - t0) * 1e3, 2),
+        }
+
+    try:
+        with LoopbackGCS() as server:
+            backend = GCSBackend("steady-bench")
+            server.attach(backend)
+            real_open = sync_mod.open_backend
+            sync_mod.open_backend = (
+                lambda r: (backend, None) if r == remote else real_open(r))
+            try:
+                sync_mod.reset_sync_planners()
+                sync_mod.reset_poll_caches()
+                # Long horizon: measure pure planned ticks, not a reconcile.
+                os.environ["TPU_TASK_SYNC_RECONCILE_EVERY"] = "1000000"
+
+                def tick():
+                    sync_mod.sync(str(work), remote)
+
+                initial = measure(server, tick)
+                os.environ["TPU_TASK_SYNC_PLANNER"] = "0"
+                data_before = measure(server, tick)  # pre-PR full re-list
+                os.environ.pop("TPU_TASK_SYNC_PLANNER")
+                # The manifest seeded by the initial tick survived the
+                # kill-switch tick untouched, so this is a planned tick.
+                data_after = measure(server, tick)  # planned no-change tick
+                (work / "d00" / "f0000.txt").write_bytes(b"y" * 200)
+                data_changed = measure(server, tick)
+
+                for index in range(n_machines):
+                    backend.write(f"reports/status-m{index:02d}",
+                                  json.dumps({"code": ""}).encode())
+                    backend.write(f"reports/task-m{index:02d}",
+                                  (f"machine {index}: " + "log " * 200
+                                   + "\n").encode())
+
+                def poll():
+                    sync_mod.status(remote)
+                    sync_mod.logs(remote)
+
+                os.environ["TPU_TASK_POLL_CACHE"] = "0"
+                poll_before = measure(server, poll)  # pre-PR full re-reads
+                os.environ.pop("TPU_TASK_POLL_CACHE")
+                measure(server, poll)  # warm the poll cache
+                poll_after = measure(server, poll)  # unchanged poll
+                backend.write("reports/task-m00",
+                              (f"machine 0: " + "log " * 200
+                               + "\nnew line\n").encode())
+                poll_tail = measure(server, lambda: sync_mod.logs(remote))
+            finally:
+                sync_mod.open_backend = real_open
+                sync_mod.reset_sync_planners()
+                sync_mod.reset_poll_caches()
+                for key, value in saved.items():
+                    if value is None:
+                        os.environ.pop(key, None)
+                    else:
+                        os.environ[key] = value
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    before = data_before["requests"] + poll_before["requests"]
+    after = data_after["requests"] + poll_after["requests"]
+    return {
+        "n_files": n_files,
+        "n_machines": n_machines,
+        "initial_sync": initial,
+        "data_no_change_before": data_before,
+        "data_no_change_after": data_after,
+        "data_one_file_changed": data_changed,
+        "poll_unchanged_before": poll_before,
+        "poll_unchanged_after": poll_after,
+        "poll_one_log_grew": poll_tail,
+        "requests_per_tick_before": before,
+        "requests_per_tick_after": after,
+        "request_reduction_x": round(before / max(after, 1), 1),
+        "note": ("no-change tick = data sync + status/log poll of an "
+                 "unchanged fleet; before = TPU_TASK_SYNC_PLANNER=0 + "
+                 "TPU_TASK_POLL_CACHE=0 (the pre-manifest paths), after = "
+                 "defaults. Loopback GCS emulator counters; reconcile "
+                 "ticks excluded by a long TPU_TASK_SYNC_RECONCILE_EVERY."),
+    }
+
+
 def bench_checkpoint(n_saves: int = 6, leaf_mb: int = 8, n_leaves: int = 8) -> dict:
     """Blocked train-loop time per checkpoint save: sync vs async, same tree.
 
@@ -907,6 +1029,7 @@ def main() -> int:
     generation = bench_generation()
     transport = bench_transport()
     data_plane = bench_data_plane()
+    steady_state = bench_steady_state()
     checkpoint = bench_checkpoint()
     recovery = bench_recovery()
     lifecycle_s = bench_lifecycle()
@@ -919,6 +1042,7 @@ def main() -> int:
         "generation": generation,
         "transport": transport,
         "data_plane": data_plane,
+        "steady_state": steady_state,
         "checkpoint": checkpoint,
         "recovery": recovery,
         "lifecycle_wallclock_s": round(lifecycle_s, 2),
@@ -946,7 +1070,12 @@ def main() -> int:
 if __name__ == "__main__":
     # `python bench.py recovery` runs just the chaos-recovery section — the
     # fast way to re-measure MTTR (or replay a soak) without the full bench.
+    # `python bench.py steady_state` runs just the requests/tick section
+    # (also `make bench-steady`).
     if sys.argv[1:] == ["recovery"]:
         print(json.dumps({"recovery": bench_recovery()}))
+        raise SystemExit(0)
+    if sys.argv[1:] == ["steady_state"]:
+        print(json.dumps({"steady_state": bench_steady_state()}))
         raise SystemExit(0)
     raise SystemExit(main())

@@ -1,0 +1,432 @@
+"""O(changes) steady state: manifest-planned sync, conditional (ETag/304)
+polling, and incremental log tailing — emulator-counter-verified.
+
+The bucket is the only orchestrator↔worker channel, so every loop tick is
+paid in REST round-trips. These tests pin the steady-state cost model:
+
+* a no-change ``sync`` tick performs **zero** object-store round-trips;
+* a changed tick touches only the diff (one PUT per changed file, one
+  DELETE per removed file, no listings);
+* an unchanged status/log poll costs the listing only — zero per-blob
+  requests, zero body bytes — and a grown log blob fetches just the
+  ``Range: bytes={offset}-`` delta;
+* the planner self-heals against out-of-band bucket mutation on its
+  reconcile tick, and planned syncs produce the exact end state full
+  syncs do under randomized churn.
+"""
+
+import importlib
+import json
+import os
+import random
+import time
+
+import pytest
+
+from tpu_task.storage.backends import GCSBackend, NOT_MODIFIED
+from tpu_task.storage.cloud_backends import AzureBlobBackend, S3Backend
+from tpu_task.storage.gcs_emulator import LoopbackGCS
+from tpu_task.storage.object_store_emulators import (
+    LoopbackAzureBlob,
+    LoopbackS3,
+)
+
+sync_mod = importlib.import_module("tpu_task.storage.sync")
+
+REMOTE = ":googlecloudstorage:steady-bkt"
+
+
+@pytest.fixture(autouse=True)
+def fresh_steady_state():
+    """Planner manifests and poll caches are keyed by remote string —
+    reset them so reused connection strings never leak state between
+    tests."""
+    sync_mod.reset_sync_planners()
+    sync_mod.reset_poll_caches()
+    yield
+    sync_mod.reset_sync_planners()
+    sync_mod.reset_poll_caches()
+
+
+@pytest.fixture
+def gcs_remote(monkeypatch):
+    """A loopback-GCS-backed remote routed under the sync engine's
+    ``open_backend`` seam; yields (server, backend)."""
+    with LoopbackGCS() as server:
+        backend = GCSBackend("steady-bkt")
+        server.attach(backend)
+        real = sync_mod.open_backend
+
+        def route(remote):
+            if remote == REMOTE:
+                return backend, None
+            return real(remote)
+
+        monkeypatch.setattr(sync_mod, "open_backend", route)
+        yield server, backend
+
+
+def _workdir(tmp_path, n_files=12):
+    work = tmp_path / "work"
+    (work / "sub").mkdir(parents=True)
+    for index in range(n_files):
+        (work / f"f{index:02d}.txt").write_text(f"payload {index}")
+    (work / "sub" / "nested.txt").write_text("nested")
+    return work
+
+
+# --- tentpole: zero-round-trip no-change ticks -------------------------------
+
+@pytest.mark.perf
+def test_no_change_sync_tick_is_zero_round_trips(tmp_path, gcs_remote):
+    """Tier-1 perf smoke: the steady-state contract. A regression that
+    re-lists (or re-uploads) on an unchanged tick fails here fast."""
+    server, _backend = gcs_remote
+    work = _workdir(tmp_path)
+    sync_mod.sync(str(work), REMOTE)
+    assert len(server.objects) == 13
+
+    server.reset_counters()
+    sync_mod.sync(str(work), REMOTE)  # no change → planner skips the remote
+    assert server.request_total() == 0, server.requests
+    assert server.bytes_in == 0 and server.bytes_out == 0
+
+
+def test_changed_tick_touches_only_the_diff(tmp_path, gcs_remote):
+    server, _backend = gcs_remote
+    work = _workdir(tmp_path)
+    sync_mod.sync(str(work), REMOTE)
+
+    time.sleep(0.01)  # past mtime granularity
+    (work / "f00.txt").write_text("changed payload")
+    server.reset_counters()
+    sync_mod.sync(str(work), REMOTE)
+    assert server.requests == {"PUT": 1}, server.requests
+
+    (work / "f01.txt").unlink()
+    server.reset_counters()
+    sync_mod.sync(str(work), REMOTE)
+    assert server.requests == {"DELETE": 1}, server.requests
+    assert "f01.txt" not in server.objects
+
+
+def test_planned_tick_skips_files_already_uploaded_out_of_band(tmp_path,
+                                                               gcs_remote):
+    """An AsyncCheckpointer direct-uploads each published step off the sync
+    tick; the file then appears locally with no manifest entry. The planned
+    tick must probe (one scoped listing), see it durable, and NOT re-upload
+    a checkpoint-sized object."""
+    server, backend = gcs_remote
+    work = _workdir(tmp_path, n_files=3)
+    sync_mod.sync(str(work), REMOTE)
+
+    # Direct upload (bucket first), then the local file appears — mtime
+    # earlier than the upload, exactly the AsyncCheckpointer shape.
+    (work / "ckpt-000007.npz").write_bytes(b"c" * 4096)
+    backend.write_from_file("ckpt-000007.npz", str(work / "ckpt-000007.npz"))
+    server.reset_counters()
+    sync_mod.sync(str(work), REMOTE)
+    assert server.requests.get("PUT", 0) == 0, server.requests
+    assert server.requests.get("LIST") == 1  # the scoped probe
+
+    # And the NEXT no-change tick is back to zero round-trips.
+    server.reset_counters()
+    sync_mod.sync(str(work), REMOTE)
+    assert server.request_total() == 0, server.requests
+
+
+def test_reconcile_tick_heals_out_of_band_mutation(tmp_path, gcs_remote,
+                                                   monkeypatch):
+    """Mutate the bucket behind the planner's back (foreign write + foreign
+    delete): planned ticks cannot see it, the periodic reconcile tick
+    restores an exact mirror."""
+    monkeypatch.setenv("TPU_TASK_SYNC_RECONCILE_EVERY", "2")
+    server, backend = gcs_remote
+    work = _workdir(tmp_path, n_files=4)
+    sync_mod.sync(str(work), REMOTE)  # full tick 1 (seeds manifest)
+
+    backend.write("foreign.bin", b"out-of-band write")
+    backend.delete("f00.txt")
+
+    sync_mod.sync(str(work), REMOTE)  # planned tick: blind to the mutation
+    assert "foreign.bin" in server.objects
+    assert "f00.txt" not in server.objects
+
+    sync_mod.sync(str(work), REMOTE)  # planned tick 2
+    sync_mod.sync(str(work), REMOTE)  # reconcile: full both-sides listing
+    assert "foreign.bin" not in server.objects
+    assert server.objects["f00.txt"] == b"payload 0"
+    expected = {f"f{i:02d}.txt" for i in range(4)} | {"sub/nested.txt"}
+    assert set(server.objects) == expected
+
+
+def test_planned_sync_failure_invalidates_manifest(tmp_path, gcs_remote,
+                                                   monkeypatch):
+    """A failed tick leaves the remote state unknown: the next tick must
+    re-list instead of trusting the manifest (on-error self-heal)."""
+    server, _backend = gcs_remote
+    work = _workdir(tmp_path, n_files=3)
+    sync_mod.sync(str(work), REMOTE)
+
+    time.sleep(0.01)
+    (work / "f00.txt").write_text("will fail then succeed")
+    real_copy = sync_mod._copy_files
+    calls = {"n": 0}
+
+    def flaky_copy(source, destination, keys, src_meta=None):
+        calls["n"] += 1
+        if calls["n"] == 1 and keys:
+            raise OSError("chaos: transient upload fault")
+        return real_copy(source, destination, keys, src_meta)
+
+    monkeypatch.setattr(sync_mod, "_copy_files", flaky_copy)
+    with pytest.raises(OSError):
+        sync_mod.sync(str(work), REMOTE)
+    server.reset_counters()
+    sync_mod.sync(str(work), REMOTE)  # full (re-listing) tick after error
+    assert server.requests.get("LIST", 0) >= 1
+    assert server.objects["f00.txt"] == b"will fail then succeed"
+
+
+def test_planned_and_full_sync_converge_under_random_churn(tmp_path,
+                                                           monkeypatch):
+    """Property test: after every churn step, a planner-driven mirror and a
+    full-listing mirror of the same source hold identical end states."""
+    rng = random.Random(20260804)
+    src = tmp_path / "src"
+    src.mkdir()
+    planned_dst = tmp_path / "planned"
+    full_dst = tmp_path / "full"
+    monkeypatch.setenv("TPU_TASK_SYNC_RECONCILE_EVERY", "1000000")
+
+    def tree(root):
+        out = {}
+        for dirpath, _dirs, files in os.walk(root):
+            for name in files:
+                path = os.path.join(dirpath, name)
+                out[os.path.relpath(path, root)] = open(path, "rb").read()
+        return out
+
+    names = [f"d{i % 3}/file{i:02d}.bin" for i in range(14)]
+    for step in range(12):
+        for _ in range(rng.randint(1, 4)):
+            name = rng.choice(names)
+            path = src / name
+            verb = rng.random()
+            if verb < 0.55:  # write / rewrite
+                path.parent.mkdir(parents=True, exist_ok=True)
+                path.write_bytes(os.urandom(rng.randint(0, 64)))
+            elif path.exists():  # delete
+                path.unlink()
+        time.sleep(0.003)  # churn mtimes past the comparison tolerance
+        sync_mod.sync(str(src), str(planned_dst))      # planner engaged
+        with monkeypatch.context() as patch:
+            patch.setenv("TPU_TASK_SYNC_PLANNER", "0")  # pre-PR full path
+            sync_mod.sync(str(src), str(full_dst))
+        assert tree(planned_dst) == tree(full_dst) == tree(src), \
+            f"diverged at churn step {step}"
+
+
+# --- tentpole: conditional reads across every backend ------------------------
+
+def _conditional_contract(server, backend):
+    backend.write("reports/status-m0", b'{"code": "0"}')
+    data, validator = backend.read_conditional("reports/status-m0")
+    assert data == b'{"code": "0"}' and validator is not None
+
+    server.reset_counters()
+    again = backend.read_conditional("reports/status-m0", validator)
+    assert again[0] is NOT_MODIFIED
+    assert server.requests.get("not_modified") == 1  # one 304...
+    assert server.bytes_out == 0                     # ...with no body
+
+    backend.write("reports/status-m0", b'{"code": "1"}')
+    changed, fresh = backend.read_conditional("reports/status-m0", validator)
+    assert changed == b'{"code": "1"}' and fresh != validator
+
+
+def _tail_contract(backend):
+    backend.write("reports/task-m0", b"line one\n")
+    assert backend.read_range("reports/task-m0", 0) == b"line one\n"
+    backend.write("reports/task-m0", b"line one\nline two\n")
+    assert backend.read_range("reports/task-m0", 9) == b"line two\n"
+    assert backend.read_range("reports/task-m0", 18) == b""  # nothing new
+    assert backend.read_range("reports/task-m0", 999) == b""  # past EOF
+
+
+def test_gcs_conditional_and_ranged_reads():
+    with LoopbackGCS() as server:
+        backend = GCSBackend("bkt")
+        server.attach(backend)
+        _conditional_contract(server, backend)
+        _tail_contract(backend)
+
+
+def test_s3_conditional_and_ranged_reads():
+    with LoopbackS3() as server:
+        backend = S3Backend("bkt", config={
+            "access_key_id": "AKID", "secret_access_key": "sk",
+            "region": "us-east-1"})
+        server.attach(backend)
+        _conditional_contract(server, backend)
+        _tail_contract(backend)
+
+
+def test_azure_conditional_and_ranged_reads():
+    with LoopbackAzureBlob() as server:
+        backend = AzureBlobBackend("bkt", config={
+            "account": "acct", "key": "a2V5c2VjcmV0"})
+        server.attach(backend)
+        _conditional_contract(server, backend)
+        _tail_contract(backend)
+
+
+def test_local_conditional_read_is_one_stat(tmp_path):
+    from tpu_task.storage.backends import LocalBackend
+
+    backend = LocalBackend(str(tmp_path))
+    backend.write("reports/status-m0", b"body")
+    data, validator = backend.read_conditional("reports/status-m0")
+    assert data == b"body"
+    assert backend.read_conditional(
+        "reports/status-m0", validator)[0] is NOT_MODIFIED
+    time.sleep(0.01)
+    backend.write("reports/status-m0", b"body two")
+    changed, fresh = backend.read_conditional("reports/status-m0", validator)
+    assert changed == b"body two" and fresh != validator
+
+
+# --- tentpole: poll cache behind reports()/logs()/status() -------------------
+
+@pytest.mark.perf
+def test_unchanged_status_and_log_poll_is_listing_only(gcs_remote):
+    """32-machine poll: the first tick reads every blob; an unchanged tick
+    costs the listing alone — 0 GETs, 0 body bytes (≤1 conditional request
+    per blob is the ceiling; the listing validator gets it to zero)."""
+    server, backend = gcs_remote
+    for index in range(32):
+        backend.write(f"reports/status-m{index:02d}",
+                      json.dumps({"code": "0"}).encode())
+        backend.write(f"reports/task-m{index:02d}",
+                      f"machine {index} output\n".encode())
+
+    first = sync_mod.status(REMOTE)
+    assert first[list(first)[0]] == 32
+    sync_mod.logs(REMOTE)
+
+    server.reset_counters()
+    folded = sync_mod.status(REMOTE)
+    logs = sync_mod.logs(REMOTE)
+    assert len(logs) == 32
+    assert folded[list(folded)[0]] == 32
+    assert server.requests.get("GET", 0) == 0, server.requests
+    assert server.requests.get("LIST") == 2  # one listing per poll surface
+    # Listing JSON only (~85 bytes/item × 64 items × 2 sweeps) — no blob
+    # body was transferred on top of it.
+    assert server.bytes_out < 16384
+
+
+def test_grown_log_blob_fetches_only_the_delta(gcs_remote):
+    server, backend = gcs_remote
+    prefix = b"x" * 4096
+    backend.write("reports/task-m00", prefix)
+    assert sync_mod.logs(REMOTE) == [prefix.decode()]
+
+    backend.write("reports/task-m00", prefix + b"DELTA\n")
+    server.reset_counters()
+    assert sync_mod.logs(REMOTE) == [(prefix + b"DELTA\n").decode()]
+    assert server.requests.get("GET") == 1
+    # The ranged read shipped the 6-byte delta plus the TAIL_ANCHOR
+    # verification bytes, not the 4 KiB prefix.
+    anchor = sync_mod.RemotePollCache.TAIL_ANCHOR
+    listing_only = server.bytes_out - 6 - anchor
+    assert listing_only < 2048, server.bytes_out
+
+
+def test_restarted_log_blob_falls_back_to_full_read(gcs_remote):
+    """A requeued incarnation rewrites its log from scratch (shorter blob):
+    the tail path must detect the shrink and re-read in full."""
+    server, backend = gcs_remote
+    backend.write("reports/task-m00", b"old incarnation, long output\n")
+    sync_mod.logs(REMOTE)
+    backend.write("reports/task-m00", b"fresh start\n")
+    assert sync_mod.logs(REMOTE) == ["fresh start\n"]
+
+
+def test_rewritten_longer_log_blob_is_not_spliced(gcs_remote):
+    """A restarted incarnation may replay output FASTER than the poll
+    period, leaving the rewritten blob longer than the reader's cached
+    body: the tail anchor must catch the rewrite — never splice the new
+    suffix onto the old prefix."""
+    server, backend = gcs_remote
+    backend.write("reports/task-m00", b"OLD incarnation line\n")
+    sync_mod.logs(REMOTE)
+    rewritten = b"NEW incarnation: " + b"x" * 64 + b"\n"
+    assert len(rewritten) > len(b"OLD incarnation line\n")
+    backend.write("reports/task-m00", rewritten)
+    assert sync_mod.logs(REMOTE) == [rewritten.decode()]
+
+
+def test_same_size_rewritten_log_blob_is_reread(gcs_remote):
+    """Same-length rewrite (pathological restart): an unchanged size does
+    not prove unchanged content — the conditional read must notice."""
+    server, backend = gcs_remote
+    backend.write("reports/task-m00", b"aaaa-incarnation-one\n")
+    sync_mod.logs(REMOTE)
+    backend.write("reports/task-m00", b"bbbb-incarnation-two\n")
+    assert sync_mod.logs(REMOTE) == ["bbbb-incarnation-two\n"]
+
+
+def test_poll_cache_evicts_deleted_reports(gcs_remote):
+    server, backend = gcs_remote
+    backend.write("reports/status-m0", b'{"code": "0"}')
+    backend.write("reports/status-m1", b'{"code": "0"}')
+    sync_mod.status(REMOTE)
+    backend.delete("reports/status-m1")
+    folded = sync_mod.status(REMOTE)
+    assert folded[list(folded)[0]] == 1
+    cache = sync_mod.poll_cache(REMOTE)
+    assert "reports/status-m1" not in cache._entries
+
+
+def test_poll_cache_disabled_knob(gcs_remote, monkeypatch):
+    """TPU_TASK_POLL_CACHE=0 is the escape hatch (and the bench's pre-PR
+    measurement path): every poll re-reads every blob."""
+    monkeypatch.setenv("TPU_TASK_POLL_CACHE", "0")
+    server, backend = gcs_remote
+    backend.write("reports/status-m0", b'{"code": "0"}')
+    sync_mod.status(REMOTE)
+    server.reset_counters()
+    sync_mod.status(REMOTE)
+    assert server.requests.get("GET") == 1  # unconditional re-read
+
+
+# --- agent side: append-only log upload --------------------------------------
+
+def test_agent_log_sync_appends_only_the_delta(tmp_path):
+    from tpu_task.machine.local_agent import Agent
+
+    agent = Agent(remote=str(tmp_path / "bucket"),
+                  directory=str(tmp_path / "work"),
+                  script_path="/bin/true", machine_id="m0",
+                  timeout_epoch=0, log_period=1, data_period=1)
+    agent._append_log("first line\n")
+    agent._sync_logs()
+    blob = tmp_path / "bucket" / "reports" / "task-m0"
+    first = blob.read_bytes()
+    assert b"first line" in first
+
+    stamp = blob.stat().st_mtime_ns
+    agent._sync_logs()  # nothing appended → no write at all
+    assert blob.stat().st_mtime_ns == stamp
+
+    agent._append_log("second line\n")
+    agent._sync_logs()
+    data = blob.read_bytes()
+    assert data.startswith(first) and b"second line" in data
+
+    # Out-of-band truncation (fresh blob after requeue): full rewrite.
+    blob.write_bytes(b"")
+    agent._append_log("third line\n")
+    agent._sync_logs()
+    assert b"first line" in blob.read_bytes()

@@ -184,10 +184,67 @@ def test_status_uppercase_keys(mailbox):
     assert status(str(mailbox))[StatusCode.SUCCEEDED] == 1
 
 
-def test_status_malformed_report_raises(mailbox):
+def test_status_malformed_report_skipped_with_warning(mailbox, caplog):
+    """One corrupt blob (torn write, flaky store) must not kill the whole
+    poll tick: it is skipped with a warning and the rest still count."""
+    import logging
+
     (mailbox / "reports" / "status-m1").write_text("not json")
-    with pytest.raises(ValueError):
-        status(str(mailbox))
+    with caplog.at_level(logging.WARNING, logger="tpu_task"):
+        assert status(str(mailbox)) == {}
+    assert any("malformed status report" in record.message
+               for record in caplog.records)
+
+
+def test_status_counts_healthy_reports_around_corrupt_one(mailbox):
+    (mailbox / "reports" / "status-m1").write_text(
+        json.dumps({"result": "exit-code", "code": "0", "status": "0"}))
+    (mailbox / "reports" / "status-m2").write_text("{{{ torn write")
+    (mailbox / "reports" / "status-m3").write_text(
+        json.dumps({"result": "exit-code", "code": "1", "status": "1"}))
+    (mailbox / "reports" / "status-m4").write_text(
+        json.dumps([1, 2, 3]))  # valid JSON, wrong shape: also skipped
+    result = status(str(mailbox))
+    assert result[StatusCode.SUCCEEDED] == 1
+    assert result[StatusCode.FAILED] == 1
+
+
+# --- mtime-tolerance boundaries (the one named constant) ---------------------
+
+def test_changed_keys_mtime_tolerance_boundaries():
+    """Exactly-at-tolerance differences are up-to-date; just-beyond are
+    changed. Object stores (mtimes not preserved) list the UPLOAD time,
+    always later than the source mtime — only a source newer than the
+    stored copy re-uploads (the rclone caveat)."""
+    import importlib
+
+    sync_mod = importlib.import_module("tpu_task.storage.sync")
+    tol = sync_mod.MTIME_TOLERANCE
+
+    src = {"a": (10, 100.0)}
+    # Preserved mtimes (local↔local): a difference inside the tolerance
+    # (filesystem granularity) is up-to-date; beyond it — either
+    # direction — is changed. Margins at tol/2 and 1.5*tol keep the
+    # assertions float-rounding-proof.
+    within = {"a": (10, 100.0 + tol / 2)}
+    beyond = {"a": (10, 100.0 + tol * 1.5)}
+    behind = {"a": (10, 100.0 - tol * 1.5)}
+    assert sync_mod._changed_keys(["a"], src, within, True) == []
+    assert sync_mod._changed_keys(["a"], src, beyond, True) == ["a"]
+    assert sync_mod._changed_keys(["a"], src, behind, True) == ["a"]
+    # Object store (upload time always later than the source mtime): a
+    # later dst is up-to-date — a HUGE skew must not re-upload; dst behind
+    # src within tolerance is up-to-date; behind by more means the source
+    # was touched since the upload.
+    later = {"a": (10, 150.0)}
+    within_behind = {"a": (10, 100.0 - tol / 2)}
+    stale = {"a": (10, 100.0 - tol * 1.5)}
+    assert sync_mod._changed_keys(["a"], src, later, False) == []
+    assert sync_mod._changed_keys(["a"], src, within_behind, False) == []
+    assert sync_mod._changed_keys(["a"], src, stale, False) == ["a"]
+    # A size difference always wins, regardless of mtimes.
+    resized = {"a": (11, 150.0)}
+    assert sync_mod._changed_keys(["a"], src, resized, False) == ["a"]
 
 
 def test_reports_fans_out_cloud_reads_in_parallel(monkeypatch):

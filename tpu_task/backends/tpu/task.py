@@ -105,9 +105,10 @@ class TPUTask(GcsRemoteMixin, Task):
         # reads so the MTTR record survives transient storage faults.
         self._pending_event_writes: List[tuple] = []
         # Liveness + recovery-governor state (per queued-resource name).
-        # _heartbeat_records: blob key → (mtime, node, final) body cache —
-        # heartbeat bodies are re-read only when the blob's mtime moved.
-        self._heartbeat_records: Dict[str, tuple] = {}
+        # Heartbeat BODIES ride the shared per-remote poll cache
+        # (storage.sync.poll_cache): a blob whose listed (size, mtime) did
+        # not move is never re-read — the same conditional-read mechanism
+        # the status/log polls use.
         self._heartbeats_cache: Optional[Dict[str, dict]] = None
         self._heartbeats_at = float("-inf")
         self._first_active: Dict[str, float] = {}   # qr → first ACTIVE (wall)
@@ -561,15 +562,18 @@ class TPUTask(GcsRemoteMixin, Task):
         blobs: ``{node: {worker: {"mtime": epoch_s, "final": bool}}}``.
         ``None`` when this probe failed (or the backend lists no mtimes) —
         a flaky bucket must yield *no decision*, never a spurious requeue,
-        and never a stale snapshot that ages into one. Bodies (machine→node/worker
-        mapping) are cached per (key, mtime): a poll re-reads only blobs
-        that moved. Cached for TPU_TASK_HEARTBEAT_PROBE_PERIOD seconds
-        (default 20)."""
+        and never a stale snapshot that ages into one. Bodies
+        (machine→node/worker mapping) come through the shared per-remote
+        poll cache keyed on the listing's (size, mtime): a poll re-reads
+        only blobs that moved — the same conditional-read mechanism behind
+        the status/log polls. Cached for TPU_TASK_HEARTBEAT_PROBE_PERIOD
+        seconds (default 20)."""
         period = float(os.environ.get("TPU_TASK_HEARTBEAT_PROBE_PERIOD", "20"))
         now = time.monotonic()
         if now - self._heartbeats_at < period:
             return self._heartbeats_cache
         from tpu_task.storage.backends import open_backend
+        from tpu_task.storage.sync import _poll_cache_enabled, poll_cache
 
         try:
             backend, _ = open_backend(self._remote())
@@ -585,28 +589,30 @@ class TPUTask(GcsRemoteMixin, Task):
                 self._heartbeats_cache = None
                 self._heartbeats_at = now
                 return None
+            # Same kill switch as the status/log polls: with the cache
+            # disabled every heartbeat body is re-read unconditionally.
+            cache = poll_cache(self._remote()) if _poll_cache_enabled() \
+                else None
             index: Dict[str, Dict[int, dict]] = {}
             for key in sorted(meta):
                 name = key.rsplit("/", 1)[-1]
                 if not name.startswith("heartbeat-"):
                     continue
                 mtime = meta[key][1]
-                cached = self._heartbeat_records.get(key)
-                if cached is None or cached[0] != mtime:
-                    payload = json.loads(backend.read(key))
-                    cached = (mtime, payload.get("node", ""),
-                              int(payload.get("worker", 0)),
-                              bool(payload.get("final")))
-                    self._heartbeat_records[key] = cached
-                _, node, worker, final = cached
+                payload = json.loads(
+                    cache.read(backend, key, meta[key]) if cache is not None
+                    else backend.read(key))
+                node = payload.get("node", "")
+                worker = int(payload.get("worker", 0))
+                final = bool(payload.get("final"))
                 workers = index.setdefault(node, {})
                 entry = workers.get(worker)
                 if entry is None or mtime > entry["mtime"]:
                     workers[worker] = {"mtime": mtime, "final": final}
             # Drop cache entries for blobs that left the listing (pruned on
             # requeue / task teardown) so the cache stays bounded.
-            for key in [k for k in self._heartbeat_records if k not in meta]:
-                del self._heartbeat_records[key]
+            if cache is not None:
+                cache.prune(set(meta), "heartbeat-")
         except Exception as error:
             # Probe failed → NO decision (never a stale last-known-good: a
             # sustained observer-side outage would otherwise age the frozen
@@ -803,19 +809,25 @@ class TPUTask(GcsRemoteMixin, Task):
 
     def _prune_heartbeats(self, node_name: str) -> None:
         from tpu_task.storage.backends import open_backend
+        from tpu_task.storage.sync import _poll_cache_enabled, poll_cache
 
         try:
             backend, _ = open_backend(self._remote())
+            cache = poll_cache(self._remote()) if _poll_cache_enabled() \
+                else None
             for key in backend.list("reports/"):
                 name = key.rsplit("/", 1)[-1]
                 if not name.startswith("heartbeat-"):
                     continue
-                cached = self._heartbeat_records.get(key)
-                node = cached[1] if cached else \
-                    json.loads(backend.read(key)).get("node", "")
+                # Cache-served when the blob is unchanged since the last
+                # liveness probe; a conditional read otherwise.
+                body = cache.read(backend, key) if cache is not None \
+                    else backend.read(key)
+                node = json.loads(body).get("node", "")
                 if node == node_name:
                     backend.delete(key)
-                    self._heartbeat_records.pop(key, None)
+                    if cache is not None:
+                        cache.forget(key)
         except Exception as error:
             # Best effort: a failed prune leaves the (bounded) stale-blob
             # hazard, never breaks the requeue itself.
@@ -850,6 +862,11 @@ class TPUTask(GcsRemoteMixin, Task):
     # -- observation (data plane inherited from GcsRemoteMixin) ---------------
     def status(self, running: Optional[int] = None) -> Status:
         if running is None:
+            # read() just folded the QR fan-out + status mailbox into
+            # spec.status; a poll loop calling read()+status() must not redo
+            # the listing+fold (same contract as the gcp/aws backends).
+            if self.spec.status:
+                return self.spec.status
             running = 0
             for name in self._existing_qrs():
                 try:

@@ -190,6 +190,9 @@ def cmd_read(args) -> int:
         if not observed:
             observed = getattr(tsk, "observed_parallelism", lambda: None)() or 0
         parallelism = max(args.parallelism, observed)
+        # Backends whose read() already folded the status mailbox into
+        # spec.status return it from status() directly (gcp/aws/az/tpu) —
+        # the follow loop never pays a second listing+fold per tick.
         status = _derive_status(tsk.status(), parallelism)
 
         delta = "\n".join(lines[last:])

@@ -69,6 +69,7 @@ class Agent:
         self.node_name = node_name
         self.log_lines: list[str] = []
         self._log_lock = threading.Lock()
+        self._log_synced = 0  # bytes of log already durable in the blob
         self._done = threading.Event()
         # SIGTERM = preemption notice: stop the child, final-sync, report.
         self._preempted = threading.Event()
@@ -84,9 +85,29 @@ class Agent:
             handle.write(content)
 
     def _sync_logs(self) -> None:
+        """Ship the task log blob. The log only ever grows, so when the
+        durable blob still holds exactly the prefix we last shipped, only
+        the delta is appended — a tick's upload cost is O(new output), not
+        O(log so far) (the reader side tails the same way via ranged
+        reads). Any size mismatch (fresh blob, out-of-band rewrite) falls
+        back to a full rewrite."""
         with self._log_lock:
             content = "".join(self.log_lines)
-        self._write_report("task", content)
+        data = content.encode()
+        os.makedirs(self._reports_dir(), exist_ok=True)
+        path = os.path.join(self._reports_dir(), f"task-{self.machine_id}")
+        try:
+            durable = os.path.getsize(path)
+        except OSError:
+            durable = -1
+        if durable == self._log_synced and 0 <= durable <= len(data):
+            if durable < len(data):
+                with open(path, "ab") as handle:
+                    handle.write(data[durable:])
+        else:
+            with open(path, "wb") as handle:
+                handle.write(data)
+        self._log_synced = len(data)
 
     def _log_loop(self) -> None:
         last = None

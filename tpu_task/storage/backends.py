@@ -41,6 +41,18 @@ BACKEND_LOCAL = "local"
 CLOUD_COPY_WORKERS = int(os.environ.get("TPU_TASK_TRANSFERS", "16"))
 
 
+class _NotModified:
+    """Sentinel returned by :meth:`Backend.read_conditional` when the stored
+    object still matches the caller's validator — the poll made a round-trip
+    (one 304, no body) but transferred nothing."""
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return "NOT_MODIFIED"
+
+
+NOT_MODIFIED = _NotModified()
+
+
 @dataclass
 class Connection:
     """An rclone-compatible connection string (storage.go:236-263)."""
@@ -181,6 +193,28 @@ class Backend:
         too — a crash-orphaned part must not make bucket deletion fail
         (non-empty) or leak invisibly forever."""
         return []
+
+    def read_conditional(self, key: str, validator=None):
+        """Conditional read: ``(data | NOT_MODIFIED, new_validator)``.
+
+        ``validator`` is the opaque token a previous call returned (Local:
+        mtime+size; GCS: object generation; S3/Azure: ETag). When the stored
+        object still matches it, the call answers ``(NOT_MODIFIED,
+        validator)`` after one 304 round-trip with no body — the primitive
+        the steady-state poll cache rides. The default (backends without a
+        conditional protocol) degrades to an unconditional read with no
+        validator."""
+        return self.read(key), None
+
+    def read_range(self, key: str, start: int) -> bytes:
+        """Bytes of the object from ``start`` to EOF (``Range: bytes=N-``).
+
+        Returns ``b""`` when ``start`` is at or past EOF (HTTP 416) — the
+        append-only log-tailing contract: an unchanged blob costs one
+        bodyless round-trip, a grown one transfers only the delta. The
+        default buffers a full read and slices."""
+        data = self.read(key)
+        return data[start:]
 
 
 def parallel_map(fns, workers: int) -> list:
@@ -344,6 +378,33 @@ class LocalBackend(Backend):
         with open(path, "rb") as handle:
             return handle.read()
 
+    def read_conditional(self, key: str, validator=None):
+        """Local conditional read: the validator is ``(mtime_ns, size)``, so
+        an unchanged blob costs one stat — no data read at all."""
+        path = self._abs(key)
+        try:
+            handle = open(path, "rb")
+        except (FileNotFoundError, IsADirectoryError):
+            raise ResourceNotFoundError(key) from None
+        with handle:
+            stat = os.fstat(handle.fileno())
+            current = (stat.st_mtime_ns, stat.st_size)
+            if validator is not None and validator == current:
+                return NOT_MODIFIED, validator
+            # fstat on the open handle: the validator describes the bytes
+            # this very descriptor reads, not a racing rewrite's.
+            return handle.read(), current
+
+    def read_range(self, key: str, start: int) -> bytes:
+        path = self._abs(key)
+        try:
+            handle = open(path, "rb")
+        except (FileNotFoundError, IsADirectoryError):
+            raise ResourceNotFoundError(key) from None
+        with handle:
+            handle.seek(start)
+            return handle.read()
+
     def write(self, key: str, data: bytes) -> None:
         path = self._abs(key)
         os.makedirs(os.path.dirname(path), exist_ok=True)
@@ -472,15 +533,16 @@ class GCSBackend(Backend):
 
     def _request(self, method: str, url: str, data: Optional[bytes] = None,
                  headers: Optional[Dict[str, str]] = None,
-                 ok_statuses: Tuple[int, ...] = ()) -> bytes:
+                 ok_statuses: Tuple[int, ...] = (),
+                 with_headers: bool = False):
         import time
 
         from tpu_task.storage.http_util import authorized_send
 
         return authorized_send(
             self._token, method, url, data=data, headers=headers,
-            ok_statuses=ok_statuses, urlopen=self._urlopen,
-            sleep=self._sleep or time.sleep)
+            ok_statuses=ok_statuses, with_headers=with_headers,
+            urlopen=self._urlopen, sleep=self._sleep or time.sleep)
 
     def _key(self, key: str) -> str:
         return posixpath.join(self.prefix, key) if self.prefix else key
@@ -544,6 +606,45 @@ class GCSBackend(Backend):
         try:
             return self._request("GET", url)
         except urllib.error.HTTPError as error:
+            if error.code == 404:
+                raise ResourceNotFoundError(key) from error
+            raise
+
+    def read_conditional(self, key: str, validator=None):
+        """Conditional media GET keyed on the object generation
+        (``ifGenerationNotMatch``): a matching generation answers 304 with no
+        body; a changed object comes back with its new generation from the
+        ``x-goog-generation`` response header."""
+        import urllib.error
+        import urllib.parse
+
+        url = (f"https://storage.googleapis.com/storage/v1/b/{self.container}/o/"
+               f"{urllib.parse.quote(self._key(key), safe='')}?alt=media")
+        if validator is not None:
+            url += f"&ifGenerationNotMatch={urllib.parse.quote(str(validator))}"
+        try:
+            body, headers = self._request("GET", url, with_headers=True)
+        except urllib.error.HTTPError as error:
+            if error.code == 304:
+                return NOT_MODIFIED, validator
+            if error.code == 404:
+                raise ResourceNotFoundError(key) from error
+            raise
+        lowered = {name.lower(): value for name, value in headers.items()}
+        return body, lowered.get("x-goog-generation")
+
+    def read_range(self, key: str, start: int) -> bytes:
+        import urllib.error
+        import urllib.parse
+
+        url = (f"https://storage.googleapis.com/storage/v1/b/{self.container}/o/"
+               f"{urllib.parse.quote(self._key(key), safe='')}?alt=media")
+        try:
+            return self._request("GET", url,
+                                 headers={"Range": f"bytes={start}-"})
+        except urllib.error.HTTPError as error:
+            if error.code == 416:  # start at/past EOF: nothing appended
+                return b""
             if error.code == 404:
                 raise ResourceNotFoundError(key) from error
             raise

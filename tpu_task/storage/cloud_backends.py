@@ -25,7 +25,9 @@ from typing import Dict, List, Optional
 from xml.sax.saxutils import unescape as _xml_unescape
 
 from tpu_task.common.errors import ResourceNotFoundError
-from tpu_task.storage.backends import Backend, _resolve_conditional_loss, atomic_ranged_download
+from tpu_task.storage.backends import (
+    NOT_MODIFIED, Backend, _resolve_conditional_loss, atomic_ranged_download,
+)
 from tpu_task.storage.signing import (
     EMPTY_SHA256,
     azure_shared_key_auth,
@@ -41,6 +43,33 @@ def _amz_now() -> str:
 def _header_content_length(headers: Dict[str, str]) -> int:
     lowered = {name.lower(): value for name, value in headers.items()}
     return int(lowered.get("content-length", "0"))
+
+
+def _conditional_get(request_fn, path: str, validator):
+    """Shared ETag conditional GET (``If-None-Match`` → 304) for the
+    SigV4/SharedKey backends — ``request_fn`` is the backend's ``_request``
+    bound method, ``path`` its already-resolved object path."""
+    extra = {"If-None-Match": str(validator)} if validator else None
+    try:
+        body, headers = request_fn("GET", path, {}, extra_headers=extra,
+                                   with_headers=True)
+    except urllib.error.HTTPError as error:
+        if error.code == 304:
+            return NOT_MODIFIED, validator
+        raise
+    etag = {name.lower(): value for name, value in headers.items()}.get("etag")
+    return body, etag
+
+
+def _ranged_get(request_fn, path: str, start: int) -> bytes:
+    """Shared tail fetch (``Range: bytes=N-``; 416 = nothing appended)."""
+    try:
+        return request_fn("GET", path, {},
+                          extra_headers={"Range": f"bytes={start}-"})
+    except urllib.error.HTTPError as error:
+        if error.code == 416:  # start at/past EOF: nothing appended
+            return b""
+        raise
 
 
 def _http(request: urllib.request.Request, urlopen=None, sleep=None,
@@ -164,6 +193,14 @@ class S3Backend(Backend):
 
     def read(self, key: str) -> bytes:
         return self._request("GET", self._key(key), {})
+
+    def read_conditional(self, key: str, validator=None):
+        """Conditional GET keyed on the object ETag (``If-None-Match``): an
+        unchanged object answers 304 with no body."""
+        return _conditional_get(self._request, self._key(key), validator)
+
+    def read_range(self, key: str, start: int) -> bytes:
+        return _ranged_get(self._request, self._key(key), start)
 
     def write(self, key: str, data: bytes) -> None:
         self._request("PUT", self._key(key), {}, body=data)
@@ -416,6 +453,15 @@ class AzureBlobBackend(Backend):
 
     def read(self, key: str) -> bytes:
         return self._request("GET", self._blob_path(key), {})
+
+    def read_conditional(self, key: str, validator=None):
+        """Conditional Get Blob keyed on the ETag (``If-None-Match``) — the
+        SharedKey string-to-sign carries the header in its fixed position
+        (signing.py), so the conditional stays authenticated."""
+        return _conditional_get(self._request, self._blob_path(key), validator)
+
+    def read_range(self, key: str, start: int) -> bytes:
+        return _ranged_get(self._request, self._blob_path(key), start)
 
     def write(self, key: str, data: bytes) -> None:
         self._request("PUT", self._blob_path(key), {}, body=data,

@@ -24,6 +24,8 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 
+from tpu_task.storage.object_store_emulators import EmulatorCounters, _iso_stamp
+
 
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
@@ -47,6 +49,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _reply(self, code: int, body: bytes = b"",
                headers: Optional[Dict[str, str]] = None) -> None:
+        self._store().add_bytes(out=len(body))
         self.send_response(code)
         for key, value in (headers or {}).items():
             self.send_header(key, value)
@@ -56,7 +59,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _read_body(self) -> bytes:
         length = int(self.headers.get("Content-Length", "0"))
-        return self.rfile.read(length) if length else b""
+        body = self.rfile.read(length) if length else b""
+        self._store().add_bytes(in_=len(body))
+        return body
 
     def log_message(self, *args) -> None:  # quiet
         pass
@@ -90,7 +95,7 @@ class _Handler(BaseHTTPRequestHandler):
                                sub.group(2))
                 if obj:
                     key = urllib.parse.unquote(obj.group(1).decode())
-                    status = (404 if store.objects.pop(key, None) is None
+                    status = (404 if store.pop_object(key) is None
                               else 204)
             results.append((cid.group(1).decode() if cid else "", status))
         boundary = "batch_loopback_response"
@@ -110,6 +115,7 @@ class _Handler(BaseHTTPRequestHandler):
     # -- upload --------------------------------------------------------------
     def do_POST(self) -> None:
         if self.path == "/batch/storage/v1":
+            self._store().count_request("POST")
             self._handle_batch()
             return
         parsed = urllib.parse.urlparse(self.path)
@@ -117,6 +123,7 @@ class _Handler(BaseHTTPRequestHandler):
         compose = re.match(r"^/storage/v1/b/([^/]+)/o/(.+)/compose$",
                            parsed.path)
         if compose:  # stitch parallel-uploaded parts (composite upload)
+            self._store().count_request("POST")
             destination = urllib.parse.unquote(compose.group(2))
             body = json.loads(self._read_body() or b"{}")
             store = self._store()
@@ -127,10 +134,11 @@ class _Handler(BaseHTTPRequestHandler):
                     self._reply(404, b"component not found")
                     return
                 pieces.append(data)
-            store.objects[destination] = b"".join(pieces)
+            store.put_object(destination, b"".join(pieces))
             self._reply(200, json.dumps({"name": destination}).encode())
             return
         if parsed.path == "/storage/v1/b":  # bucket insert (resource_bucket.go)
+            self._store().count_request("POST")
             body = json.loads(self._read_body() or b"{}")
             bucket = body.get("name", "")
             if bucket in self._store().buckets:
@@ -142,6 +150,7 @@ class _Handler(BaseHTTPRequestHandler):
         name = urllib.parse.unquote(query.get("name", [""])[0])
         upload_type = query.get("uploadType", [""])[0]
         if upload_type == "media":
+            self._store().count_request("PUT")  # upload = a PUT in spirit
             body = self._read_body()  # drain before any reply: keep-alive
             if (query.get("ifGenerationMatch", [""])[0] == "0"
                     and name in self._store().objects):
@@ -149,9 +158,10 @@ class _Handler(BaseHTTPRequestHandler):
                 # the write_if_absent first-writer-wins contract.
                 self._reply(412, b'{"error": {"code": 412}}')
                 return
-            self._store().objects[name] = body
+            self._store().put_object(name, body)
             self._reply(200, b"{}")
         elif upload_type == "resumable":
+            self._store().count_request("PUT")
             self._read_body()
             session = self._store().new_session(name)
             host = self.headers.get("Host", "127.0.0.1")
@@ -161,6 +171,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(400, b"unknown uploadType")
 
     def do_PUT(self) -> None:
+        self._store().count_request("PUT")
         match = re.match(r"^/upload-session/(\d+)$", self.path)
         if not match:
             self._reply(404, b"no such session")
@@ -188,34 +199,54 @@ class _Handler(BaseHTTPRequestHandler):
         store = self._store()
         object_match = re.match(r"^/storage/v1/b/([^/]+)/o/(.+)$", parsed.path)
         if object_match:
+            store.count_request("GET")
             key = urllib.parse.unquote(object_match.group(2))
             data = store.objects.get(key)
             if data is None:
                 self._reply(404, b"not found")
                 return
+            generation = store.generations.get(key, 1)
+            gen_headers = {"x-goog-generation": str(generation)}
             if query.get("alt", [""])[0] == "media":
+                not_match = query.get("ifGenerationNotMatch", [""])[0]
+                if not_match and not_match == str(generation):
+                    # Conditional read: generation unchanged → 304, no body.
+                    store.count_request("not_modified")
+                    self._reply(304, b"", gen_headers)
+                    return
                 range_header = self.headers.get("Range", "")
-                range_match = re.match(r"bytes=(\d+)-(\d+)", range_header)
+                range_match = re.match(r"bytes=(\d+)-(\d*)$", range_header)
                 if range_match:
-                    start, end = int(range_match.group(1)), int(range_match.group(2))
+                    start = int(range_match.group(1))
+                    if start >= len(data):  # at/past EOF: unsatisfiable
+                        self._reply(416, b"", {
+                            "Content-Range": f"bytes */{len(data)}"})
+                        return
+                    end = (int(range_match.group(2))
+                           if range_match.group(2) else len(data) - 1)
+                    end = min(end, len(data) - 1)
                     self._reply(206, data[start:end + 1], {
-                        "Content-Range": f"bytes {start}-{end}/{len(data)}"})
+                        "Content-Range": f"bytes {start}-{end}/{len(data)}",
+                        **gen_headers})
                 else:
-                    self._reply(200, data)
+                    self._reply(200, data, gen_headers)
             else:  # metadata probe (?fields=size)
                 self._reply(200, json.dumps({
-                    "name": key, "size": str(len(data))}).encode())
+                    "name": key, "size": str(len(data)),
+                    "generation": str(generation)}).encode(), gen_headers)
             return
         if re.match(r"^/storage/v1/b/[^/]+/o$", parsed.path):  # list
+            store.count_request("LIST")
             prefix = urllib.parse.unquote(query.get("prefix", [""])[0])
-            items = [{"name": key, "size": str(len(value)), "updated":
-                      "2026-01-01T00:00:00Z"}
+            items = [{"name": key, "size": str(len(value)),
+                      "updated": store.updated_stamp(key)}
                      for key, value in sorted(store.objects.items())
                      if key.startswith(prefix)]
             self._reply(200, json.dumps({"items": items}).encode())
             return
         bucket_match = re.match(r"^/storage/v1/b/([^/]+)$", parsed.path)
         if bucket_match:  # bucket probe: only attached/created buckets exist
+            store.count_request("GET")
             if bucket_match.group(1) in store.buckets:
                 self._reply(200, b"{}")
             else:
@@ -224,6 +255,7 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply(404, b"not found")
 
     def do_DELETE(self) -> None:
+        self._store().count_request("DELETE")
         parsed = urllib.parse.urlparse(self.path)
         object_match = re.match(r"^/storage/v1/b/([^/]+)/o/(.+)$", parsed.path)
         if not object_match:
@@ -242,21 +274,28 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(404, b"not found")
             return
         key = urllib.parse.unquote(object_match.group(2))
-        if self._store().objects.pop(key, None) is None:
+        if self._store().pop_object(key) is None:
             self._reply(404, b"not found")
         else:
             self._reply(204)
 
 
-class LoopbackGCS:
+class LoopbackGCS(EmulatorCounters):
     """A loopback GCS server plus the transport hook that points a
     :class:`GCSBackend` at it (rewrites storage.googleapis.com → 127.0.0.1)."""
 
     def __init__(self):
         self.objects: Dict[str, bytes] = {}
         self.buckets: set = set()
+        # Per-object generation + updated stamp: the conditional-read and
+        # listing-validator contracts (a rewrite must change both, exactly
+        # like live GCS).
+        self.generations: Dict[str, int] = {}
+        self.updated: Dict[str, float] = {}
+        self._next_generation = 1
         self.connections = 0  # TCP connections accepted (keep-alive asserts)
         self.batch_calls = 0  # batch-endpoint POSTs served
+        self._init_counters()  # uniform request/byte counters (EmulatorCounters)
         self._sessions: Dict[int, Tuple[str, bytearray, int]] = {}
         self._next_session = 1
         self._lock = threading.Lock()
@@ -268,6 +307,25 @@ class LoopbackGCS:
     def count_connection(self) -> None:
         with self._lock:
             self.connections += 1
+
+    # -- object bookkeeping ---------------------------------------------------
+    def put_object(self, key: str, data: bytes) -> None:
+        import time as _time
+
+        with self._lock:
+            self.objects[key] = data
+            self.generations[key] = self._next_generation
+            self._next_generation += 1
+            self.updated[key] = _time.time()
+
+    def pop_object(self, key: str):
+        with self._lock:
+            self.generations.pop(key, None)
+            self.updated.pop(key, None)
+            return self.objects.pop(key, None)
+
+    def updated_stamp(self, key: str) -> str:
+        return _iso_stamp(self.updated.get(key))
 
     # -- lifecycle -----------------------------------------------------------
     def __enter__(self) -> "LoopbackGCS":
@@ -313,8 +371,8 @@ class LoopbackGCS:
     def finish_session(self, session: int) -> str:
         with self._lock:
             name, buffer, _ = self._sessions.pop(session)
-            self.objects[name] = bytes(buffer)
-            return name
+        self.put_object(name, bytes(buffer))
+        return name
 
     # -- client wiring ---------------------------------------------------------
     def attach(self, backend) -> None:

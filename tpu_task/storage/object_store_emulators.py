@@ -47,6 +47,42 @@ def loopback_transport(origin: str, port: int):
     return opener
 
 
+class EmulatorCounters:
+    """Uniform per-instance request/byte counters shared by every loopback
+    emulator (this module's S3/Azure stores and ``gcs_emulator``): tests
+    assert "no-change tick = 0 GETs/PUTs/LISTs" and ``bench.py
+    steady_state`` reports requests/tick against these."""
+
+    def _init_counters(self) -> None:
+        self._counters_lock = threading.Lock()
+        self.requests: Dict[str, int] = {}
+        self.bytes_out = 0
+        self.bytes_in = 0
+
+    def count_request(self, kind: str) -> None:
+        with self._counters_lock:
+            self.requests[kind] = self.requests.get(kind, 0) + 1
+
+    def add_bytes(self, out: int = 0, in_: int = 0) -> None:
+        with self._counters_lock:
+            self.bytes_out += out
+            self.bytes_in += in_
+
+    def request_total(self) -> int:
+        """All round-trips served (304s included — they are still
+        round-trips; ``not_modified`` is the separate tally of how many
+        were bodyless)."""
+        with self._counters_lock:
+            return sum(count for kind, count in self.requests.items()
+                       if kind != "not_modified")
+
+    def reset_counters(self) -> None:
+        with self._counters_lock:
+            self.requests = {}
+            self.bytes_out = 0
+            self.bytes_in = 0
+
+
 class _BaseHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     # Headers and body leave as separate segments (unbuffered wfile); Nagle
@@ -65,28 +101,39 @@ class _BaseHandler(BaseHTTPRequestHandler):
         return self.server.emulator  # type: ignore[attr-defined]
 
     def _reply(self, code: int, body: bytes = b"",
-               content_type: str = "application/xml") -> None:
+               content_type: str = "application/xml",
+               extra_headers: Dict[str, str] = None) -> None:
+        self._store().add_bytes(out=len(body))
         self.send_response(code)
         self.send_header("Content-Type", content_type)
+        for name, value in (extra_headers or {}).items():
+            self.send_header(name, value)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
 
     def _read_body(self) -> bytes:
         length = int(self.headers.get("Content-Length", "0"))
-        return self.rfile.read(length) if length else b""
+        body = self.rfile.read(length) if length else b""
+        self._store().add_bytes(in_=len(body))
+        return body
 
     def log_message(self, *args) -> None:
         pass
 
 
-class _LoopbackStore:
+class _LoopbackStore(EmulatorCounters):
     def __init__(self, handler):
         self.objects: Dict[str, bytes] = {}
+        # Per-object ETag + mtime: the conditional-read (If-None-Match →
+        # 304) and listing-validator contracts — a rewrite changes both.
+        self.etags: Dict[str, str] = {}
+        self.mtimes: Dict[str, float] = {}
         self.uploads: Dict[str, dict] = {}  # S3 multipart uploads in flight
         self.blocks: Dict[str, Dict[str, bytes]] = {}  # Azure uncommitted
         self.auth_headers: list = []  # recorded for assertions
         self.connections = 0  # TCP connections accepted (keep-alive asserts)
+        self._init_counters()
         self._counter_lock = threading.Lock()
         self._server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
         self._server.emulator = self  # type: ignore[attr-defined]
@@ -96,6 +143,25 @@ class _LoopbackStore:
     def count_connection(self) -> None:
         with self._counter_lock:
             self.connections += 1
+
+    # -- object bookkeeping ----------------------------------------------------
+    def put_object(self, key: str, data: bytes) -> None:
+        import hashlib
+        import time
+
+        with self._counter_lock:
+            self.objects[key] = data
+            self.etags[key] = '"' + hashlib.md5(data).hexdigest() + '"'
+            self.mtimes[key] = time.time()
+
+    def pop_object(self, key: str):
+        with self._counter_lock:
+            self.etags.pop(key, None)
+            self.mtimes.pop(key, None)
+            return self.objects.pop(key, None)
+
+    def etag(self, key: str) -> str:
+        return self.etags.get(key, '""')
 
     def __enter__(self):
         self._thread.start()
@@ -122,12 +188,43 @@ class _LoopbackStore:
             f"https://{backend.host}", self.port)
 
 
+# Sentinel for a syntactically-valid Range whose start is at/past EOF —
+# the 416 answer log tailing relies on ("nothing appended, no body").
+RANGE_UNSATISFIABLE = "unsatisfiable"
+
+
+def _iso_stamp(stamp) -> str:
+    """ISO-8601 LastModified for S3 listings (ms precision, like live S3)."""
+    from datetime import datetime, timezone
+
+    if stamp is None:
+        return "2026-01-01T00:00:00.000Z"
+    return datetime.fromtimestamp(stamp, tz=timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%S.%f")[:-3] + "Z"
+
+
+def _rfc1123_stamp(stamp) -> str:
+    """RFC-1123 Last-Modified for Azure listings (second precision — the
+    real service's granularity; the ETag conditional read is the precise
+    validator)."""
+    from email.utils import formatdate
+
+    if stamp is None:
+        return "Thu, 01 Jan 2026 00:00:00 GMT"
+    return formatdate(stamp, usegmt=True)
+
+
 def _parse_range(header: str, size: int):
-    """``bytes=a-b`` → (start, end inclusive), or None if absent/malformed."""
-    match = re.fullmatch(r"bytes=(\d+)-(\d+)", header or "")
+    """``bytes=a-b`` or open-ended ``bytes=a-`` → (start, end inclusive),
+    None if absent/malformed, or :data:`RANGE_UNSATISFIABLE` when the start
+    is at/past EOF."""
+    match = re.fullmatch(r"bytes=(\d+)-(\d*)", header or "")
     if not match:
         return None
-    start, end = int(match.group(1)), min(int(match.group(2)), size - 1)
+    start = int(match.group(1))
+    if start >= size:
+        return RANGE_UNSATISFIABLE
+    end = min(int(match.group(2)), size - 1) if match.group(2) else size - 1
     if start > end:
         return None
     return start, end
@@ -151,13 +248,15 @@ class _S3Handler(_BaseHandler):
         query = urllib.parse.parse_qs(parsed.query)
         store = self._store()
         if query.get("list-type", [""])[0] == "2":
+            store.count_request("LIST")
             prefix = query.get("prefix", [""])[0]
             start = int(query.get("continuation-token", ["0"])[0] or 0)
             matching = sorted(k for k in store.objects if k.startswith(prefix))
             page = matching[start:start + PAGE_SIZE]
             items = "".join(
                 f"<Contents><Key>{escape(key)}</Key>"
-                f"<LastModified>2026-01-01T00:00:00.000Z</LastModified>"
+                f"<LastModified>{_iso_stamp(store.mtimes.get(key))}"
+                f"</LastModified>"
                 f"<Size>{len(store.objects[key])}</Size></Contents>"
                 for key in page)
             token = ""
@@ -167,28 +266,43 @@ class _S3Handler(_BaseHandler):
             self._reply(200, (f"<ListBucketResult>{items}{token}"
                               "</ListBucketResult>").encode())
             return
+        store.count_request("GET")
         key = urllib.parse.unquote(parsed.path.lstrip("/"))
         data = store.objects.get(key)
         if data is None:
             self._reply(404, b"<Error><Code>NoSuchKey</Code></Error>")
             return
+        etag = store.etag(key)
+        if self.headers.get("If-None-Match", "") == etag:
+            # Conditional GET: ETag unchanged → 304, no body.
+            store.count_request("not_modified")
+            self._reply(304, b"", extra_headers={"ETag": etag})
+            return
         ranged = _parse_range(self.headers.get("Range", ""), len(data))
+        if ranged == RANGE_UNSATISFIABLE:
+            self._reply(416, b"", extra_headers={
+                "Content-Range": f"bytes */{len(data)}"})
+            return
         if ranged:
             start, end = ranged
+            store.add_bytes(out=end - start + 1)
             self.send_response(206)
             self.send_header("Content-Type", "application/octet-stream")
+            self.send_header("ETag", etag)
             self.send_header("Content-Range",
                              f"bytes {start}-{end}/{len(data)}")
             self.send_header("Content-Length", str(end - start + 1))
             self.end_headers()
             self.wfile.write(data[start:end + 1])
             return
-        self._reply(200, data, "application/octet-stream")
+        self._reply(200, data, "application/octet-stream",
+                    extra_headers={"ETag": etag})
 
     def do_HEAD(self) -> None:
         if not self._authorized():
             self._reply(403)
             return
+        self._store().count_request("HEAD")
         key = urllib.parse.unquote(
             urllib.parse.urlparse(self.path).path.lstrip("/"))
         data = self._store().objects.get(key)
@@ -206,6 +320,7 @@ class _S3Handler(_BaseHandler):
         if not self._authorized():
             self._reply(403, b"<Error>bad auth</Error>")
             return
+        self._store().count_request("POST")
         parsed = urllib.parse.urlparse(self.path)
         query = urllib.parse.parse_qs(parsed.query, keep_blank_values=True)
         key = urllib.parse.unquote(parsed.path.lstrip("/"))
@@ -240,7 +355,7 @@ class _S3Handler(_BaseHandler):
                 self._reply(400, b"<Error><Code>InvalidPart</Code></Error>")
                 return
             assembled.append(part)
-        store.objects[key] = b"".join(assembled)
+        store.put_object(key, b"".join(assembled))
         del store.uploads[upload_id]
         self._reply(200, (
             "<CompleteMultipartUploadResult>"
@@ -253,6 +368,7 @@ class _S3Handler(_BaseHandler):
         if not self._authorized():
             self._reply(403, b"<Error>bad auth</Error>")
             return
+        self._store().count_request("PUT")
         parsed = urllib.parse.urlparse(self.path)
         query = urllib.parse.parse_qs(parsed.query)
         key = urllib.parse.unquote(parsed.path.lstrip("/"))
@@ -277,13 +393,14 @@ class _S3Handler(_BaseHandler):
             # S3 conditional write: the object exists, precondition fails.
             self._reply(412, b"<Error><Code>PreconditionFailed</Code></Error>")
             return
-        store.objects[key] = body
-        self._reply(200)
+        store.put_object(key, body)
+        self._reply(200, extra_headers={"ETag": store.etag(key)})
 
     def do_DELETE(self) -> None:
         if not self._authorized():
             self._reply(403, b"<Error>bad auth</Error>")
             return
+        self._store().count_request("DELETE")
         parsed = urllib.parse.urlparse(self.path)
         query = urllib.parse.parse_qs(parsed.query)
         key = urllib.parse.unquote(parsed.path.lstrip("/"))
@@ -292,7 +409,7 @@ class _S3Handler(_BaseHandler):
             store.uploads.pop(query["uploadId"][0], None)
             self._reply(204)
             return
-        store.objects.pop(key, None)
+        store.pop_object(key)
         self._reply(204)
 
 
@@ -316,13 +433,15 @@ class _AzureHandler(_BaseHandler):
         query = urllib.parse.parse_qs(parsed.query)
         store = self._store()
         if query.get("comp", [""])[0] == "list":
+            store.count_request("LIST")
             prefix = query.get("prefix", [""])[0]
             start = int(query.get("marker", ["0"])[0] or 0)
             matching = sorted(k for k in store.objects if k.startswith(prefix))
             page = matching[start:start + PAGE_SIZE]
             items = "".join(
                 f"<Blob><Name>{escape(name)}</Name><Properties>"
-                f"<Last-Modified>Thu, 01 Jan 2026 00:00:00 GMT</Last-Modified>"
+                f"<Last-Modified>{_rfc1123_stamp(store.mtimes.get(name))}"
+                f"</Last-Modified>"
                 f"<Content-Length>{len(store.objects[name])}</Content-Length>"
                 f"</Properties></Blob>"
                 for name in page)
@@ -332,28 +451,43 @@ class _AzureHandler(_BaseHandler):
             self._reply(200, (f"<EnumerationResults><Blobs>{items}</Blobs>"
                               f"{marker}</EnumerationResults>").encode())
             return
+        store.count_request("GET")
         _, blob = self._split(parsed.path)
         data = store.objects.get(blob)
         if data is None:
             self._reply(404, b"<Error>BlobNotFound</Error>")
             return
+        etag = store.etag(blob)
+        if self.headers.get("If-None-Match", "") == etag:
+            # Conditional Get Blob: ETag unchanged → 304, no body.
+            store.count_request("not_modified")
+            self._reply(304, b"", extra_headers={"ETag": etag})
+            return
         ranged = _parse_range(self.headers.get("Range", ""), len(data))
+        if ranged == RANGE_UNSATISFIABLE:
+            self._reply(416, b"", extra_headers={
+                "Content-Range": f"bytes */{len(data)}"})
+            return
         if ranged:
             start, end = ranged
+            store.add_bytes(out=end - start + 1)
             self.send_response(206)
             self.send_header("Content-Type", "application/octet-stream")
+            self.send_header("ETag", etag)
             self.send_header("Content-Range",
                              f"bytes {start}-{end}/{len(data)}")
             self.send_header("Content-Length", str(end - start + 1))
             self.end_headers()
             self.wfile.write(data[start:end + 1])
             return
-        self._reply(200, data, "application/octet-stream")
+        self._reply(200, data, "application/octet-stream",
+                    extra_headers={"ETag": etag})
 
     def do_HEAD(self) -> None:
         if not self._authorized():
             self._reply(403)
             return
+        self._store().count_request("HEAD")
         _, blob = self._split(urllib.parse.urlparse(self.path).path)
         data = self._store().objects.get(blob)
         if data is None:
@@ -368,6 +502,7 @@ class _AzureHandler(_BaseHandler):
         if not self._authorized():
             self._reply(403, b"<Error>bad auth</Error>")
             return
+        self._store().count_request("PUT")
         parsed = urllib.parse.urlparse(self.path)
         query = urllib.parse.parse_qs(parsed.query)
         _, blob = self._split(parsed.path)
@@ -387,7 +522,7 @@ class _AzureHandler(_BaseHandler):
                     self._reply(400, b"<Error>InvalidBlockId</Error>")
                     return
                 assembled.append(staged[block_id])
-            store.objects[blob] = b"".join(assembled)
+            store.put_object(blob, b"".join(assembled))
             store.blocks.pop(blob, None)
             self._reply(201)
             return
@@ -397,15 +532,16 @@ class _AzureHandler(_BaseHandler):
             # Put Blob conditional create: Azure answers 409 BlobAlreadyExists.
             self._reply(409, b"<Error>BlobAlreadyExists</Error>")
             return
-        store.objects[blob] = body
-        self._reply(201)
+        store.put_object(blob, body)
+        self._reply(201, extra_headers={"ETag": store.etag(blob)})
 
     def do_DELETE(self) -> None:
         if not self._authorized():
             self._reply(403, b"<Error>bad auth</Error>")
             return
+        self._store().count_request("DELETE")
         _, blob = self._split(urllib.parse.urlparse(self.path).path)
-        self._store().objects.pop(blob, None)
+        self._store().pop_object(blob)
         self._reply(202)
 
 
