@@ -17,14 +17,15 @@ from __future__ import annotations
 import json
 import logging
 import os
-from typing import Dict, List, Optional, Sequence
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from tpu_task.common.errors import ResourceNotFoundError
 from tpu_task.common.values import Status, StatusCode
 from tpu_task.storage import native
 from tpu_task.storage.backends import (
-    CLOUD_COPY_WORKERS, Backend, Connection, LocalBackend, contained_path,
-    open_backend, parallel_map,
+    CLOUD_COPY_WORKERS, NOT_MODIFIED, Backend, Connection, LocalBackend,
+    contained_path, open_backend, parallel_map,
 )
 from tpu_task.storage.filters import FilterSet, compile_exclude_list, limit_transfer
 
@@ -33,11 +34,20 @@ logger = logging.getLogger("tpu_task")
 __all__ = [
     "transfer", "sync", "reports", "logs", "status", "delete_storage",
     "check_storage", "Connection", "limit_transfer",
+    "MTIME_TOLERANCE", "poll_cache", "reset_poll_caches",
+    "reset_sync_planners",
 ]
 
 
 # CLOUD_COPY_WORKERS (rclone's --transfers role) lives in backends.py — one
 # parse site for the knob — and is re-exported here for monkeypatching tests.
+
+
+# Modtime comparison slack for the incremental diff (rclone's --modify-window
+# role): covers filesystem timestamp granularity and float rounding through
+# listings. One named constant — the diff rules in :func:`_changed_keys` and
+# the planner both key off it.
+MTIME_TOLERANCE = 0.002
 
 
 def _for_each(fn, keys: Sequence[str], parallel: bool) -> None:
@@ -107,21 +117,108 @@ def _changed_keys(keys: Sequence[str], src_meta, dst_meta,
         if src is None or dst is None or src[0] != dst[0]:
             changed.append(key)
         elif mtimes_preserved:
-            if abs(dst[1] - src[1]) > 0.002:
+            if abs(dst[1] - src[1]) > MTIME_TOLERANCE:
                 changed.append(key)
-        elif dst[1] < src[1] - 0.002:
+        elif dst[1] < src[1] - MTIME_TOLERANCE:
             changed.append(key)
     return changed
 
 
+class SyncPlanner:
+    """Persisted destination manifest for one (source, destination, filter)
+    mirror: ``{key: (size, mtime)}`` of every key this engine mirrored, as of
+    the last successful tick.
+
+    With the manifest in hand, a steady-state tick diffs a local ``scandir``
+    sweep against it and never lists the remote at all — a no-change tick is
+    **zero** object-store round-trips, and a changed tick touches only the
+    diff (the rclone/rsync delta-transfer discipline applied to the whole
+    control loop, not just payloads). Out-of-band bucket mutation (an
+    ``AsyncCheckpointer`` direct upload, a foreign delete) is invisible to
+    the manifest, so it self-heals: every ``TPU_TASK_SYNC_RECONCILE_EVERY``
+    planned ticks — and after any failed tick — the next tick runs the full
+    both-sides listing, restoring today's mirror semantics exactly.
+    """
+
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.manifest: Optional[Dict[str, Tuple[int, float]]] = None
+        self.ticks = 0  # planned ticks since the last full (reconcile) tick
+
+
+_planners: Dict[tuple, SyncPlanner] = {}
+_planners_lock = threading.Lock()
+
+
+def _planner_for(key: tuple) -> SyncPlanner:
+    with _planners_lock:
+        return _planners.setdefault(key, SyncPlanner())
+
+
+def reset_sync_planners() -> None:
+    """Drop all planner manifests (tests/benchmarks): the next tick of every
+    mirror runs the full both-sides listing."""
+    with _planners_lock:
+        _planners.clear()
+
+
+def _reconcile_every() -> int:
+    """Planned ticks between full-listing reconciles (0 disables planning —
+    every tick is a full tick, the pre-manifest behavior)."""
+    try:
+        return int(os.environ.get("TPU_TASK_SYNC_RECONCILE_EVERY", "10"))
+    except ValueError:
+        return 10
+
+
+def _planner_enabled() -> bool:
+    return os.environ.get("TPU_TASK_SYNC_PLANNER", "1") != "0"
+
+
 def _transfer(source_remote: str, destination_remote: str, filters: FilterSet,
-              delete_extraneous: bool) -> None:
+              delete_extraneous: bool,
+              planner: Optional[SyncPlanner] = None) -> None:
     source, _ = open_backend(source_remote)
     destination, _ = open_backend(destination_remote)
 
     if not source.exists():
         raise ResourceNotFoundError(f"transfer source does not exist: {source_remote}")
 
+    # The planner needs the free local scandir sweep on the source side;
+    # remote-source transfers (pulls) always run the full listing.
+    if planner is not None and source.local_root() is None:
+        planner = None
+    if planner is None:
+        _full_transfer(source, destination, filters, delete_extraneous)
+        return
+    with planner.lock:
+        reconcile = _reconcile_every()
+        due = (planner.manifest is None
+               or reconcile <= 0 or planner.ticks >= reconcile)
+        try:
+            if due:
+                planner.manifest = _full_transfer(
+                    source, destination, filters, delete_extraneous)
+                planner.ticks = 0
+            else:
+                _planned_transfer(
+                    source, destination, filters, delete_extraneous, planner)
+                planner.ticks += 1
+        except BaseException:
+            # Self-heal: a failed tick leaves the remote state unknown —
+            # the next tick re-lists both sides instead of trusting the
+            # manifest.
+            planner.manifest = None
+            raise
+
+
+def _full_transfer(source: Backend, destination: Backend, filters: FilterSet,
+                   delete_extraneous: bool
+                   ) -> Optional[Dict[str, Tuple[int, float]]]:
+    """One full-listing transfer tick (the pre-planner path, and the
+    planner's reconcile tick). Returns the resulting destination manifest
+    for the mirrored keys when both sides produced cheap metadata, else
+    None (not plannable)."""
     # One metadata sweep per side per tick: keys, sizes, and the incremental
     # diff all come from the same listing.
     src_meta = source.list_meta()
@@ -165,6 +262,101 @@ def _transfer(source_remote: str, destination_remote: str, filters: FilterSet,
         if isinstance(destination, LocalBackend):
             destination.remove_empty_dirs()
 
+    if src_meta is None:
+        return None
+    # Post-tick destination state for the mirrored keys: freshly-copied keys
+    # carry the SOURCE meta (set_mtime preserves it locally; object-store
+    # upload times are always later, which the non-preserved diff rule
+    # treats as up-to-date); skipped keys keep what the listing reported.
+    changed_set = set(changed)
+    manifest: Dict[str, Tuple[int, float]] = {}
+    for key in keys:
+        if key in changed_set or dst_meta is None or key not in dst_meta:
+            manifest[key] = src_meta[key]
+        else:
+            manifest[key] = dst_meta[key]
+    return manifest
+
+
+def _probe_destination(destination: Backend,
+                       keys: Sequence[str]) -> Dict[str, Tuple[int, float]]:
+    """{key: (size, mtime)} for the given keys at the destination: local
+    stats when the destination is a directory, otherwise ONE metadata
+    listing scoped to the keys' common prefix — O(1) round-trips however
+    many new keys a tick discovers."""
+    dst_root = destination.local_root()
+    out: Dict[str, Tuple[int, float]] = {}
+    if dst_root is not None:
+        for key in keys:
+            try:
+                stat = os.stat(contained_path(dst_root, key))
+            except (OSError, ValueError):
+                continue
+            out[key] = (stat.st_size, stat.st_mtime)
+        return out
+    meta = destination.list_meta(os.path.commonprefix(list(keys)))
+    if meta:
+        for key in keys:
+            if key in meta:
+                out[key] = meta[key]
+    return out
+
+
+def _planned_transfer(source: Backend, destination: Backend,
+                      filters: FilterSet, delete_extraneous: bool,
+                      planner: SyncPlanner) -> None:
+    """One manifest-planned tick: local scandir sweep diffed against the
+    persisted manifest — no remote listing. A no-change tick performs zero
+    object-store round-trips; a changed tick uploads/deletes only the
+    diff."""
+    src_meta = source.list_meta()  # local walk: free of round-trips
+    keys = [key for key in sorted(src_meta) if filters.includes_file(key)]
+    mtimes_preserved = hasattr(destination, "set_mtime")
+    changed = _changed_keys(keys, src_meta, planner.manifest, mtimes_preserved)
+    # Keys the manifest has never seen may already be durable via an
+    # out-of-band producer (AsyncCheckpointer direct-uploads each published
+    # step, the checkpoint-priority mirror overlaps the workdir mirror) —
+    # one scoped listing beats blindly re-uploading GB-scale checkpoints.
+    unknown = [key for key in changed if key not in planner.manifest]
+    if unknown:
+        probed = _probe_destination(destination, unknown)
+        already_durable = set(unknown) - set(_changed_keys(
+            unknown, src_meta, probed, mtimes_preserved))
+        for key in already_durable:
+            planner.manifest[key] = probed[key]
+        changed = [key for key in changed if key not in already_durable]
+    # makedir is a no-op on flat object stores and an exist_ok local mkdir —
+    # keeping it every tick preserves the full path's empty-dir mirroring.
+    for dir_key in source.listdirs():
+        if filters.includes_dir(dir_key):
+            destination.makedir(dir_key)
+    if changed:
+        total_size = sum(src_meta[key][0] for key in changed)
+        logger.info("Transferring %.1fMB (%d changed files)...",
+                    total_size / 1e6, len(changed))
+    _copy_files(source, destination, changed, src_meta)
+    for key in changed:
+        planner.manifest[key] = src_meta[key]
+
+    if delete_extraneous:
+        wanted = set(keys)
+        src_root = source.local_root()
+        extraneous = []
+        for key in list(planner.manifest):
+            if key in wanted:
+                continue
+            # Same both-sides race guard as the full path: the key may have
+            # been re-created since the sweep (AsyncCheckpointer publish).
+            if src_root is not None and os.path.isfile(
+                    contained_path(src_root, key)):
+                continue
+            extraneous.append(key)
+        destination.delete_batch(extraneous)
+        for key in extraneous:
+            planner.manifest.pop(key, None)
+        if isinstance(destination, LocalBackend):
+            destination.remove_empty_dirs()
+
 
 def transfer(source: str, destination: str, exclude: Sequence[str] = ()) -> None:
     """Filtered directory copy; exclude entries are bare paths or rclone rules."""
@@ -172,27 +364,159 @@ def transfer(source: str, destination: str, exclude: Sequence[str] = ()) -> None
 
 
 def sync(source: str, destination: str, exclude: Sequence[str] = ()) -> None:
-    """Filtered mirror: like transfer, but removes extraneous destination files."""
-    _transfer(source, destination, compile_exclude_list(exclude), delete_extraneous=True)
+    """Filtered mirror: like transfer, but removes extraneous destination
+    files. Repeated in-process syncs of the same (source, destination,
+    exclude) triple ride the manifest planner: a no-change tick costs zero
+    remote round-trips (see :class:`SyncPlanner`)."""
+    planner = None
+    if _planner_enabled():
+        planner = _planner_for((source, destination, tuple(exclude)))
+    _transfer(source, destination, compile_exclude_list(exclude),
+              delete_extraneous=True, planner=planner)
+
+
+class RemotePollCache:
+    """Per-remote conditional-read cache behind ``reports``/``logs``/
+    ``status`` (and the TPU reconciler's heartbeat probe).
+
+    One entry per blob: the listing validator ``(size, mtime)`` from the
+    metadata sweep, the backend's conditional-read validator (ETag /
+    generation / local mtime), and the last body. A poll tick then costs,
+    per blob: **zero** requests when the listing already matches; one 304
+    round-trip with no body when only the conditional validator can decide;
+    a ranged ``bytes={offset}-`` fetch of just the delta for append-only
+    blobs (task logs); a full read only when the blob genuinely changed.
+    """
+
+    # Bytes of already-seen prefix re-fetched alongside each tail delta: a
+    # restarted incarnation that rewrote the blob from scratch (possibly
+    # LONGER than our cached body) must not get the new blob's suffix
+    # spliced onto the old prefix — the anchor bytes must match what we
+    # cached or the tail path falls back to a full read.
+    TAIL_ANCHOR = 256
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[str, dict] = {}
+
+    def read(self, backend: Backend, key: str,
+             listed: Optional[Tuple[int, float]] = None,
+             append_only: bool = False) -> bytes:
+        with self._lock:
+            entry = self._entries.get(key)
+            entry = dict(entry) if entry is not None else None
+        if entry is not None and listed is not None \
+                and entry.get("listed") == listed:
+            return entry["body"]
+        body = None
+        validator = entry.get("validator") if entry else None
+        if (append_only and entry is not None and listed is not None
+                and listed[0] > len(entry["body"])):
+            # Append-only blob that grew: fetch the delta from the last
+            # seen offset (plus the verification anchor), nothing else.
+            # Same-size-but-touched blobs take the conditional read below —
+            # an unchanged size does NOT prove unchanged content.
+            offset = len(entry["body"])
+            anchor = min(offset, self.TAIL_ANCHOR)
+            delta = _read_range(backend, key, offset - anchor)
+            if (len(delta) == anchor + (listed[0] - offset)
+                    and delta[:anchor] == entry["body"][offset - anchor:]):
+                body = entry["body"] + delta[anchor:]
+                validator = None  # a ranged read returns no fresh validator
+            # Anchor mismatch (rewritten blob) or length mismatch (listing
+            # raced a write): full read below.
+        if body is None:
+            data, validator = _read_conditional(backend, key, validator)
+            body = entry["body"] if (data is NOT_MODIFIED and entry) else data
+        with self._lock:
+            self._entries[key] = {
+                "listed": listed, "validator": validator, "body": body}
+        return body
+
+    def forget(self, key: str) -> None:
+        with self._lock:
+            self._entries.pop(key, None)
+
+    def prune(self, live_keys, name_prefix: str) -> None:
+        """Evict entries whose blob basename starts with ``name_prefix`` but
+        left the listing — deleted reports must not pin memory (or bodies)
+        forever."""
+        with self._lock:
+            for key in [k for k in self._entries
+                        if k not in live_keys
+                        and k.rsplit("/", 1)[-1].startswith(name_prefix)]:
+                del self._entries[key]
+
+
+def _read_conditional(backend, key: str, validator):
+    reader = getattr(backend, "read_conditional", None)
+    if reader is None:  # minimal test doubles / foreign backends
+        return backend.read(key), None
+    return reader(key, validator)
+
+
+def _read_range(backend, key: str, start: int) -> bytes:
+    reader = getattr(backend, "read_range", None)
+    if reader is None:
+        return backend.read(key)[start:]
+    return reader(key, start)
+
+
+_poll_caches: Dict[str, RemotePollCache] = {}
+_poll_caches_lock = threading.Lock()
+
+
+def poll_cache(remote: str) -> RemotePollCache:
+    """The per-remote poll cache (shared by status, log, and heartbeat
+    polls of one bucket)."""
+    with _poll_caches_lock:
+        return _poll_caches.setdefault(remote, RemotePollCache())
+
+
+def reset_poll_caches() -> None:
+    with _poll_caches_lock:
+        _poll_caches.clear()
+
+
+def _poll_cache_enabled() -> bool:
+    return os.environ.get("TPU_TASK_POLL_CACHE", "1") != "0"
 
 
 def reports(remote: str, prefix: str) -> List[str]:
     """Read every ``reports/{prefix}-*`` blob (one per machine).
 
-    Cloud reads fan out over the transfer pool: a status/log poll against a
-    32-worker pod is 32 blobs, and serial GETs would make every poll tick
-    32 sequential round-trips. Results keep the listing's deterministic
+    Steady-state cost is O(changes): one metadata listing discovers the
+    blobs, and each body comes from the per-remote poll cache — an
+    unchanged blob costs zero further requests (listing validator) or one
+    bodyless 304 (conditional read), and append-only task-log blobs fetch
+    only the ``Range: bytes={offset}-`` delta. Cloud reads still fan out
+    over the transfer pool; results keep the listing's deterministic
     (sorted-key) order regardless of fetch completion order."""
     backend, _ = open_backend(remote)
-    keys = [key for key in backend.list("reports")
+    lister = getattr(backend, "list_meta", None)
+    meta = lister("reports") if lister is not None else None
+    all_keys = sorted(meta) if meta is not None else backend.list("reports")
+    keys = [key for key in all_keys
             if key.rsplit("/", 1)[-1].startswith(prefix + "-")]
-    blobs: Dict[str, str] = {}
+    blobs: Dict[str, bytes] = {}
 
-    def fetch(key: str) -> None:
-        blobs[key] = backend.read(key).decode(errors="replace")
+    if _poll_cache_enabled():
+        cache = poll_cache(remote)
+        tail = prefix == "task"  # log blobs are append-only
 
-    _for_each(fetch, keys, parallel=backend.local_root() is None)
-    return [blobs[key] for key in keys]
+        def fetch(key: str) -> None:
+            blobs[key] = cache.read(
+                backend, key, meta.get(key) if meta is not None else None,
+                append_only=tail)
+
+        _for_each(fetch, keys, parallel=backend.local_root() is None)
+        cache.prune(set(keys), prefix + "-")
+    else:
+        def fetch(key: str) -> None:
+            blobs[key] = backend.read(key)
+
+        _for_each(fetch, keys, parallel=backend.local_root() is None)
+    return [blobs[key].decode(errors="replace") for key in keys]
 
 
 def logs(remote: str) -> List[str]:
@@ -205,14 +529,18 @@ def status(remote: str, initial_status: Optional[Status] = None) -> Status:
     The on-worker agent writes ``{"result": $SERVICE_RESULT, "code":
     $EXIT_STATUS, "status": $EXIT_CODE}`` on task exit
     (machine-script.sh.tpl:51); keys are matched case-insensitively like Go's
-    encoding/json.
+    encoding/json. A malformed report is skipped with a warning — one
+    corrupt blob (torn write, flaky store) must not kill the whole poll
+    tick; the healthy machines still count.
     """
     result: Status = dict(initial_status or {})
     for report in reports(remote, "status"):
         try:
             payload = {key.lower(): value for key, value in json.loads(report).items()}
         except (json.JSONDecodeError, AttributeError) as error:
-            raise ValueError(f"malformed status report: {report!r}") from error
+            logger.warning("skipping malformed status report: %.200r (%s)",
+                           report, error)
+            continue
         code = str(payload.get("code", "") or "")
         if code:
             if code == "0":
@@ -228,13 +556,21 @@ def delete_storage(remote: str) -> None:
     """Empty the remote (all objects — including crash-orphaned internal
     housekeeping keys hidden from list() — then empty dirs). Rides the
     backend's batch-delete path: GCS folds ≤100 deletes into one
-    round-trip; other cloud stores fan singles out on the transfer pool."""
+    round-trip; other cloud stores fan singles out on the transfer pool.
+    Also drops the remote's steady-state poll cache and any planner
+    manifest mirroring into it — a long-lived orchestrator deleting many
+    finished tasks must not pin their log bodies/manifests forever."""
     backend, _ = open_backend(remote)
     if not backend.exists():
         raise ResourceNotFoundError(remote)
     backend.delete_batch(backend.list() + backend.list_hidden())
     if isinstance(backend, LocalBackend):
         backend.remove_empty_dirs()
+    with _poll_caches_lock:
+        _poll_caches.pop(remote, None)
+    with _planners_lock:
+        for key in [k for k in _planners if remote in k]:
+            del _planners[key]
 
 
 def check_storage(remote: str) -> None:
