@@ -8,7 +8,7 @@ PYTHON ?= python3
 # Seed for the chaos soak: any run is replayable by pinning this.
 TPU_TASK_CHAOS_SEED ?= 20260804
 
-.PHONY: test smoke sweep bench bench-steady chaos wheel multichip kernels-tpu clean
+.PHONY: test smoke sweep bench bench-steady bench-serving chaos wheel multichip kernels-tpu clean
 
 # Hermetic suite (the reference's `make test`, 30 s budget there; ours spans
 # the fake control planes, sharded-compute CPU checks, and the loopback GCS
@@ -35,6 +35,12 @@ bench:
 # planner + conditional poll cache (loopback GCS emulator counters).
 bench-steady:
 	$(PYTHON) bench.py steady_state
+
+# Serving cost model only: continuous-batching engine (paged KV cache) vs
+# batch-static generate on one mixed-length Poisson workload — throughput,
+# TTFT percentiles, KV high-water vs the dense worst case (runs on CPU).
+bench-serving:
+	$(PYTHON) bench.py serving
 
 # Seeded fault-injection soak: preemptions + a hung worker + flaky storage
 # against the hermetic TPU control plane, replayable from the seed.

@@ -404,7 +404,13 @@ def bench_generation() -> dict:
     generate(new=1) isolates prefill, and the (new=129) − (new=1)
     difference over 128 steps isolates steady-state decode. Same max_len
     for both calls so cache shapes (and thus compiled programs) differ only
-    in scan length. min-of-5 with host-readback fences (shared chip)."""
+    in scan length. min-of-5 with host-readback fences (shared chip).
+
+    The ``batched`` curve (batch ∈ {1, 8, 32}) is the STRONGEST static
+    baseline the continuous-batching engine competes against: batch-static
+    decode amortizes the weight stream over the batch, but pays the dense
+    cache's O(batch × max_len) bytes (reported per point) and cannot admit
+    or retire mid-flight — the `serving` section measures that difference."""
     import jax
     import jax.numpy as jnp
 
@@ -417,17 +423,10 @@ def bench_generation() -> dict:
         vocab_size=32768, d_model=1024, n_layers=8, n_heads=8, d_head=128,
         d_ff=4096, dtype=jnp.bfloat16, n_kv_heads=2)
     params = transformer.init(jax.random.PRNGKey(0), cfg)
-    batch, prompt_len, new = 1, 2048, 129
+    prompt_len, new = 2048, 129
     total = prompt_len + new
-    prompt = jax.random.randint(
-        jax.random.PRNGKey(1), (batch, prompt_len), 0, cfg.vocab_size)
 
-    gen_many = jax.jit(lambda p, t: decoding.generate(
-        p, cfg, t, new, max_len=total))
-    gen_one = jax.jit(lambda p, t: decoding.generate(
-        p, cfg, t, 1, max_len=total))
-
-    def timed(fn, repeats=5):
+    def timed(fn, prompt, repeats=5):
         int(jnp.sum(fn(params, prompt)))  # compile + sync
         best = float("inf")
         for _ in range(repeats):
@@ -436,18 +435,212 @@ def bench_generation() -> dict:
             best = min(best, time.perf_counter() - t0)
         return best
 
-    t_one = timed(gen_one)    # prefill + 1 token
-    t_many = timed(gen_many)  # prefill + `new` tokens
-    decode_s = max(t_many - t_one, 1e-9) / (new - 1)
-    cache_mb = (cfg.n_layers * 2 * batch * total * cfg.kv_heads
-                * cfg.d_head * 2) / 1e6
+    def point(batch: int) -> dict:
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(1), (batch, prompt_len), 0, cfg.vocab_size)
+        gen_many = jax.jit(lambda p, t: decoding.generate(
+            p, cfg, t, new, max_len=total))
+        gen_one = jax.jit(lambda p, t: decoding.generate(
+            p, cfg, t, 1, max_len=total))
+        t_one = timed(gen_one, prompt)    # prefill + 1 token
+        t_many = timed(gen_many, prompt)  # prefill + `new` tokens
+        decode_s = max(t_many - t_one, 1e-9) / (new - 1)
+        cache_mb = (cfg.n_layers * 2 * batch * total * cfg.kv_heads
+                    * cfg.d_head * 2) / 1e6
+        return {
+            "batch": batch, "kv_cache_mb": round(cache_mb, 1),
+            "prefill_s": round(t_one, 4),
+            "prefill_tokens_per_s": round(batch * prompt_len / t_one, 1),
+            "decode_ms_per_token": round(decode_s * 1e3, 3),
+            "decode_tokens_per_s": round(batch / decode_s, 1),
+        }
+
+    points = [point(b) for b in (1, 8, 32)]
+    head = points[0]
     return {
-        "batch": batch, "prompt_len": prompt_len, "new_tokens": new,
-        "n_kv_heads": cfg.kv_heads, "kv_cache_mb": round(cache_mb, 1),
-        "prefill_s": round(t_one, 4),
-        "prefill_tokens_per_s": round(prompt_len / t_one, 1),
-        "decode_ms_per_token": round(decode_s * 1e3, 3),
-        "decode_tokens_per_s": round(batch / decode_s, 1),
+        "batch": 1, "prompt_len": prompt_len, "new_tokens": new,
+        "n_kv_heads": cfg.kv_heads, "kv_cache_mb": head["kv_cache_mb"],
+        "prefill_s": head["prefill_s"],
+        "prefill_tokens_per_s": head["prefill_tokens_per_s"],
+        "decode_ms_per_token": head["decode_ms_per_token"],
+        "decode_tokens_per_s": head["decode_tokens_per_s"],
+        "batched": points,
+    }
+
+
+def bench_serving(n_requests: int = 36, seed: int = 0) -> dict:
+    """Serving leg: the continuous-batching engine (paged KV cache,
+    iteration-level scheduling) vs batch-static ``generate`` on the SAME
+    mixed-length Poisson workload. Runs on any backend (CPU included) —
+    the model is sized so per-step compute dominates dispatch.
+
+    Workload: ``n_requests`` greedy requests, prompts at the prefill
+    bucket lengths, bimodal max_new (2/3 short, 1/3 long — the mix that
+    punishes head-of-line blocking), Poisson arrivals. Three legs, one
+    seeded arrival schedule:
+
+    - ``engine``: real-time loop — requests submit at their arrival
+      offsets, the engine steps continuously; per-request TTFT and
+      per-token latency come from the lifecycle records.
+    - ``generate_static_batch``: the strongest static baseline the API
+      allows — per-bucket rectangular batches of ``slots`` formed in
+      arrival order, dispatched when full (partials at the end), each
+      running max(max_new of the group) steps; generously modeled with
+      zero batching-timeout penalty on a virtual timeline (compute walls
+      are real, compile excluded). Tokens beyond a member's own max_new
+      are padding cost, not credited throughput; tokens reach the caller
+      only when the batch returns, which is what static TTFT means.
+    - ``generate_batch1_fifo``: the pre-engine reality (bench
+      ``generation`` is batch=1): one sequential generate per request.
+
+    Throughput = useful tokens / makespan from the first-arrival origin.
+    The KV lines report the allocator's high-water mark against the dense
+    cache's slots × max_len worst case (docs/parity.md cost model)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_task.ml.models import decoding, transformer
+    from tpu_task.ml.serving import ServingConfig, ServingEngine
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=512, d_model=256, n_layers=3, n_heads=8, d_head=32,
+        d_ff=512, dtype=jnp.float32, n_kv_heads=4)
+    scfg = ServingConfig(slots=8, block_size=8, n_blocks=80, max_len=96,
+                         prefill_buckets=(8, 16, 32))
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(seed)
+    buckets, short_new, long_new = scfg.prefill_buckets, 4, 64
+
+    work, t = [], 0.0
+    for _ in range(n_requests):
+        t += float(rng.exponential(0.008))
+        work.append({
+            "arrival": t,
+            "prompt": rng.integers(
+                0, cfg.vocab_size, size=int(rng.choice(buckets))),
+            "max_new": short_new if rng.random() < 2 / 3 else long_new,
+        })
+    useful = sum(w["max_new"] for w in work)
+
+    # -- engine leg (real-time) ----------------------------------------------
+    eng = ServingEngine(params, cfg, scfg)
+    for b in buckets:  # compile prefill-per-bucket + decode + samplers
+        eng.submit(np.zeros((b,), np.int32), 2)
+    eng.drain()
+    eng.allocator.high_water = 0
+    eng.steps = eng.decode_steps = eng.prefills = 0
+
+    rids = {}
+    # time.monotonic throughout this loop: the engine stamps its lifecycle
+    # records with monotonic, and mixing clocks with different epochs would
+    # corrupt the TTFT arithmetic below.
+    t0 = time.monotonic()
+    i = 0
+    while i < len(work) or eng.has_work:
+        now = time.monotonic() - t0
+        while i < len(work) and work[i]["arrival"] <= now:
+            rids[i] = eng.submit(work[i]["prompt"], work[i]["max_new"])
+            i += 1
+        if eng.has_work:
+            eng.step()
+        elif i < len(work):
+            time.sleep(max(0.0, min(work[i]["arrival"] - now, 0.002)))
+    eng_makespan = time.monotonic() - t0
+    eng_ttft, eng_per_tok = [], []
+    for j, w in enumerate(work):
+        r = eng.request(rids[j])
+        eng_ttft.append(r.first_token_t - (t0 + w["arrival"]))
+        if len(r.tokens) > 1:
+            eng_per_tok.append(
+                (r.finish_t - r.first_token_t) / (len(r.tokens) - 1))
+    stats = eng.stats()
+    preemptions = sum(
+        eng.request(r).preemptions for r in rids.values())
+
+    # -- generate baselines (virtual timeline, real compute walls; one jitted
+    # program per (bucket, batch, max_new) shape, compiled off-timeline) -----
+    gen_fns: dict = {}
+
+    def run_generate(prompts, max_new) -> float:
+        arr = jnp.asarray(np.stack(prompts)).astype(jnp.int32)
+        key = (arr.shape[1], arr.shape[0], max_new)
+        if key not in gen_fns:
+            gen_fns[key] = jax.jit(lambda p, t, mx=max_new: decoding.generate(
+                p, cfg, t, mx, max_len=t.shape[1] + mx))
+        w0 = time.perf_counter()
+        np.asarray(gen_fns[key](params, arr))
+        return time.perf_counter() - w0
+
+    def baseline_leg(cap: int):
+        groups, acc = [], {b: [] for b in buckets}
+        for j, w in enumerate(work):
+            acc[len(w["prompt"])].append(j)
+            if len(acc[len(w["prompt"])]) == cap:
+                groups.append(acc[len(w["prompt"])])
+                acc[len(w["prompt"])] = []
+        groups += [g for g in acc.values() if g]
+        shapes = {(len(work[g[0]]["prompt"]), len(g),
+                   max(work[j]["max_new"] for j in g)) for g in groups}
+        for bucket, size, mx in shapes:  # compile outside the timeline
+            run_generate([np.zeros((bucket,), np.int32)] * size, mx)
+        vt, ttft = 0.0, []
+        for g in groups:
+            vt = max(vt, max(work[j]["arrival"] for j in g))
+            vt += run_generate([work[j]["prompt"] for j in g],
+                               max(work[j]["max_new"] for j in g))
+            ttft += [vt - work[j]["arrival"] for j in g]
+        return ttft, vt, len(groups)
+
+    static_ttft, static_makespan, static_groups = baseline_leg(scfg.slots)
+    b1_ttft, b1_makespan, _ = baseline_leg(1)
+
+    def pct(xs, q) -> float:
+        return round(float(np.percentile(np.asarray(xs) * 1e3, q)), 1)
+
+    return {
+        "workload": {
+            "n_requests": n_requests, "useful_tokens": useful,
+            "prompt_buckets": list(buckets),
+            "max_new_mix": {"short": short_new, "long": long_new,
+                            "short_fraction": round(2 / 3, 3)},
+            "poisson_mean_interarrival_ms": 8,
+        },
+        "config": {"slots": scfg.slots, "block_size": scfg.block_size,
+                   "n_blocks": scfg.n_blocks, "max_len": scfg.max_len},
+        "engine": {
+            "decode_tokens_per_s": round(useful / eng_makespan, 1),
+            "makespan_s": round(eng_makespan, 3),
+            "ttft_p50_ms": pct(eng_ttft, 50),
+            "ttft_p99_ms": pct(eng_ttft, 99),
+            "per_token_ms_p50": pct(eng_per_tok, 50),
+            "decode_steps": eng.decode_steps, "prefills": eng.prefills,
+            "preemptions": preemptions,
+            "kv_blocks_high_water": stats["kv_blocks_high_water"],
+            "kv_high_water_mb": round(
+                stats["kv_high_water_bytes"] / 1e6, 3),
+        },
+        "generate_static_batch": {
+            "decode_tokens_per_s": round(useful / static_makespan, 1),
+            "makespan_s": round(static_makespan, 3),
+            "ttft_p50_ms": pct(static_ttft, 50),
+            "ttft_p99_ms": pct(static_ttft, 99),
+            "batches": static_groups,
+            "kv_dense_worst_case_mb": round(
+                stats["kv_dense_worst_case_bytes"] / 1e6, 3),
+        },
+        "generate_batch1_fifo": {
+            "decode_tokens_per_s": round(useful / b1_makespan, 1),
+            "makespan_s": round(b1_makespan, 3),
+            "ttft_p50_ms": pct(b1_ttft, 50),
+            "ttft_p99_ms": pct(b1_ttft, 99),
+        },
+        "engine_speedup_vs_static_batch": round(
+            static_makespan / eng_makespan, 2),
+        "engine_speedup_vs_batch1": round(b1_makespan / eng_makespan, 2),
+        "kv_high_water_vs_dense_worst_case": round(
+            stats["kv_high_water_bytes"]
+            / stats["kv_dense_worst_case_bytes"], 3),
     }
 
 
@@ -1027,6 +1220,7 @@ def main() -> int:
     flash = bench_flash_kernel()
     ring = bench_ring_schedule()
     generation = bench_generation()
+    serving = bench_serving()
     transport = bench_transport()
     data_plane = bench_data_plane()
     steady_state = bench_steady_state()
@@ -1040,6 +1234,7 @@ def main() -> int:
         "flash_attention": flash,
         "ring_schedule": ring,
         "generation": generation,
+        "serving": serving,
         "transport": transport,
         "data_plane": data_plane,
         "steady_state": steady_state,
@@ -1071,11 +1266,15 @@ if __name__ == "__main__":
     # `python bench.py recovery` runs just the chaos-recovery section — the
     # fast way to re-measure MTTR (or replay a soak) without the full bench.
     # `python bench.py steady_state` runs just the requests/tick section
-    # (also `make bench-steady`).
+    # (also `make bench-steady`). `python bench.py serving` runs just the
+    # continuous-batching-vs-generate section (also `make bench-serving`).
     if sys.argv[1:] == ["recovery"]:
         print(json.dumps({"recovery": bench_recovery()}))
         raise SystemExit(0)
     if sys.argv[1:] == ["steady_state"]:
         print(json.dumps({"steady_state": bench_steady_state()}))
+        raise SystemExit(0)
+    if sys.argv[1:] == ["serving"]:
+        print(json.dumps({"serving": bench_serving()}))
         raise SystemExit(0)
     raise SystemExit(main())

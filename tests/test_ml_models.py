@@ -447,3 +447,72 @@ def test_generate_top_p_restricts_support():
                           top_p=1.5, rng=jax.random.PRNGKey(0))
     with pytest.raises(ValueError, match="top_p"):
         decoding.generate(params, TINY, prompt, 2, top_p=0.5)
+
+
+# -- _top_p_filter edge-case properties (random-logit property tests) --------
+
+def _nucleus_cases(n=64, vocab=40):
+    """Random (logits, top_p) pairs spanning peaky and flat distributions."""
+    rng = np.random.default_rng(11)
+    for i in range(n):
+        scale = float(rng.uniform(0.2, 8.0))    # flat → peaky
+        logits = rng.standard_normal(vocab) * scale
+        top_p = float(rng.uniform(0.05, 1.0))
+        yield jnp.asarray(logits, jnp.float32), top_p
+
+
+def test_top_p_filter_kept_mass_is_at_least_top_p():
+    """Property: the surviving tokens always carry >= top_p of the original
+    probability mass (the nucleus is the SMALLEST prefix reaching top_p,
+    so it reaches it)."""
+    from tpu_task.ml.models import decoding
+
+    for logits, top_p in _nucleus_cases():
+        kept = np.asarray(decoding._top_p_filter(logits, top_p)) > -1e29
+        probs = np.asarray(jax.nn.softmax(logits))
+        assert probs[kept].sum() >= top_p - 1e-5, (top_p, probs[kept].sum())
+
+
+def test_top_p_filter_keeps_at_least_one_token_at_tiny_top_p():
+    """Property: even top_p ~ 0 keeps the argmax (its preceding mass is 0),
+    and drops everything else when the argmax alone covers top_p."""
+    from tpu_task.ml.models import decoding
+
+    for logits, _ in _nucleus_cases(n=16):
+        out = np.asarray(decoding._top_p_filter(logits, 1e-9))
+        kept = out > -1e29
+        assert kept.sum() == 1
+        assert kept[int(np.argmax(np.asarray(logits)))]
+
+
+def test_top_p_filter_threshold_ties_keep_all_tied_tokens():
+    """The keep rule is ``logits >= threshold``: tokens exactly tied with
+    the nucleus boundary all survive, whichever of them the sort placed
+    inside the prefix — no order-dependent coin flip."""
+    from tpu_task.ml.models import decoding
+
+    # Two exactly-tied top tokens, each ~49.9% — top_p=0.5 needs one of
+    # them, the tie keeps both, the tail token stays dropped.
+    logits = jnp.asarray([10.0, 10.0, 0.0], jnp.float32)
+    out = np.asarray(decoding._top_p_filter(logits, 0.5))
+    assert (out[:2] > -1e29).all() and out[2] < -1e29
+    # Four-way tie, top_p small: all four tied maxima survive.
+    logits = jnp.asarray([3.0, 3.0, 3.0, 3.0, -1.0], jnp.float32)
+    out = np.asarray(decoding._top_p_filter(logits, 0.1))
+    assert (out[:4] > -1e29).all() and out[4] < -1e29
+
+
+def test_top_p_filter_per_row_matches_scalar_rows():
+    """(batch,) top_p filters each row exactly as the scalar call would —
+    the serving engine samples every slot with its own request's top_p in
+    one program."""
+    from tpu_task.ml.models import decoding
+
+    rng = np.random.default_rng(12)
+    logits = jnp.asarray(rng.standard_normal((5, 32)), jnp.float32)
+    tops = [0.1, 0.3, 0.6, 0.9, 1.0]
+    batched = np.asarray(decoding._top_p_filter(
+        logits, jnp.asarray(tops, jnp.float32)))
+    for i, p in enumerate(tops):
+        np.testing.assert_array_equal(
+            batched[i], np.asarray(decoding._top_p_filter(logits[i], p)))

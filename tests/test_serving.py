@@ -1,0 +1,295 @@
+"""Serving engine + paged KV cache tests (CPU, tiny shapes).
+
+The two ``perf``-marked tests are the tier-1 smoke contract of the
+continuous-batching engine: token-level equivalence with the offline
+``generate`` path under greedy decoding, and no head-of-line blocking (a
+short request admitted behind a long one completes without waiting for
+it). The rest pin the paged/dense bit-exactness contract, the allocator,
+preemption-recompute, and the checkify debug guard."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_task.ml.models import decoding, transformer
+from tpu_task.ml.ops.attention import gqa_cached_attention
+from tpu_task.ml.serving import (
+    BlockAllocator,
+    ServingConfig,
+    ServingEngine,
+)
+from tpu_task.ml.serving.cache import flat_pool, gather_kv
+
+# GQA on purpose: the paged pool must stay at KV-head width end to end.
+TINY = transformer.TransformerConfig(
+    vocab_size=64, d_model=32, n_layers=2, n_heads=4, d_head=8, d_ff=64,
+    dtype=jnp.float32, n_kv_heads=2)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer.init(jax.random.PRNGKey(0), TINY)
+
+
+def _generate_ref(params, prompt, max_new):
+    return np.asarray(decoding.generate(
+        params, TINY, jnp.asarray(prompt)[None].astype(jnp.int32),
+        max_new)[0])
+
+
+# -- config + allocator ------------------------------------------------------
+
+def test_serving_config_validation():
+    with pytest.raises(ValueError, match="slots"):
+        ServingConfig(slots=0)
+    with pytest.raises(ValueError, match="n_blocks"):
+        ServingConfig(n_blocks=1)
+    with pytest.raises(ValueError, match="ascending"):
+        ServingConfig(prefill_buckets=(32, 16))
+    with pytest.raises(ValueError, match="max_len"):
+        ServingConfig(prefill_buckets=(16, 512), max_len=256)
+    scfg = ServingConfig(block_size=16, max_len=100,
+                         prefill_buckets=(16, 32, 64))
+    assert scfg.max_blocks_per_slot == 7     # ceil(100 / 16)
+    assert scfg.bucket_for(17) == 32
+    assert scfg.blocks_for(1) == 1 and scfg.blocks_for(16) == 1
+    assert scfg.blocks_for(17) == 2
+    with pytest.raises(ValueError, match="bucket"):
+        scfg.bucket_for(10_000)
+
+
+def test_block_allocator_accounting():
+    alloc = BlockAllocator(8)            # block 0 scratch → 7 allocatable
+    assert alloc.available == 7 and alloc.in_use == 0
+    a = alloc.alloc(3)
+    assert len(a) == 3 and 0 not in a and alloc.high_water == 3
+    b = alloc.alloc(4)
+    assert alloc.available == 0 and alloc.high_water == 7
+    assert alloc.alloc(1) is None        # exhausted: None, nothing taken
+    alloc.free(a)
+    assert alloc.available == 3 and alloc.high_water == 7  # HWM sticks
+    with pytest.raises(ValueError, match="double free"):
+        alloc.free([b[0], b[0]])
+    with pytest.raises(ValueError, match="invalid"):
+        alloc.free([0])                  # scratch is never freeable
+
+
+# -- paged/dense parity ------------------------------------------------------
+
+def test_paged_gather_attention_bit_exact_vs_dense():
+    """THE parity contract (docs/parity.md): gathering a scattered block
+    pool back through the block tables and running the shared core equals
+    the dense cache bit for bit at fp32 — including a pool whose unrelated
+    blocks hold garbage, because masked slots contribute exactly 0.0."""
+    rng = np.random.default_rng(3)
+    kv, d, bs, L, slots = 2, 8, 4, 16, 3
+    k_dense = jnp.asarray(rng.standard_normal((slots, L, kv, d)), jnp.float32)
+    v_dense = jnp.asarray(rng.standard_normal((slots, L, kv, d)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((slots, 1, 4, d)), jnp.float32)
+    positions = jnp.asarray([5, 9, 2])
+    # Scatter the dense rows into a garbage-initialized pool through a
+    # shuffled block map, then gather back.
+    tables = np.zeros((slots, L // bs), np.int32)
+    pool_k = np.asarray(rng.standard_normal((13, bs, kv, d)), np.float32)
+    pool_v = np.asarray(rng.standard_normal((13, bs, kv, d)), np.float32)
+    free = list(range(1, 13))
+    rng.shuffle(free)
+    for s in range(slots):
+        for b in range(L // bs):
+            blk = free.pop()
+            tables[s, b] = blk
+            pool_k[blk] = k_dense[s, b * bs:(b + 1) * bs]
+            pool_v[blk] = v_dense[s, b * bs:(b + 1) * bs]
+    k_view = gather_kv(flat_pool(jnp.asarray(pool_k)), jnp.asarray(tables), bs)
+    v_view = gather_kv(flat_pool(jnp.asarray(pool_v)), jnp.asarray(tables), bs)
+    dense = gqa_cached_attention(q, k_dense, v_dense, positions[:, None])
+    paged = gqa_cached_attention(q, k_view, v_view, positions[:, None])
+    assert (np.asarray(dense) == np.asarray(paged)).all()
+
+
+@pytest.mark.perf
+def test_engine_greedy_matches_generate(params):
+    """Tier-1 serving smoke: greedy tokens from the continuous-batching
+    engine are identical to ``generate``'s for the same prompts — across
+    mixed lengths, slot reuse, and lazy block growth."""
+    scfg = ServingConfig(slots=3, block_size=4, n_blocks=32, max_len=32,
+                         prefill_buckets=(8, 16))
+    eng = ServingEngine(params, TINY, scfg)
+    rng = np.random.default_rng(0)
+    reqs = []
+    for plen, new in [(5, 6), (8, 3), (12, 9), (3, 12), (7, 1), (16, 8)]:
+        prompt = rng.integers(0, TINY.vocab_size, size=plen)
+        reqs.append((eng.submit(prompt, new), prompt, new))
+    out = eng.drain()
+    for rid, prompt, new in reqs:
+        np.testing.assert_array_equal(
+            np.array(out[rid]), _generate_ref(params, prompt, new))
+    assert eng.allocator.in_use == 0          # every block returned
+    assert eng.allocator.high_water > 0
+
+
+@pytest.mark.perf
+def test_short_request_completes_before_long(params):
+    """No head-of-line blocking: a short request admitted behind a
+    long-running one retires as soon as ITS length hits, while the long
+    one is still decoding."""
+    scfg = ServingConfig(slots=2, block_size=4, n_blocks=32, max_len=64,
+                         prefill_buckets=(8,))
+    eng = ServingEngine(params, TINY, scfg)
+    rng = np.random.default_rng(1)
+    long_rid = eng.submit(rng.integers(0, 64, size=6), 40)
+    eng.step()                                 # long one admitted + decoding
+    short_rid = eng.submit(rng.integers(0, 64, size=6), 3)
+    while eng.poll(short_rid)["status"] != "done":
+        eng.step()
+    assert eng.poll(long_rid)["status"] == "running"
+    assert len(eng.poll(long_rid)["tokens"]) < 40
+    out = eng.drain()
+    assert len(out[long_rid]) == 40 and len(out[short_rid]) == 3
+
+
+# -- scheduler behaviors -----------------------------------------------------
+
+def test_engine_sampling_deterministic_per_request_under_any_schedule(params):
+    """Sampling keys derive from the request key alone (fold_in per token
+    index), so a request's stream is identical whether it runs solo or
+    co-scheduled — and across preemption-recompute."""
+    prompts = [np.random.default_rng(7).integers(0, 64, size=6)
+               for _ in range(4)]
+
+    def run(slots):
+        scfg = ServingConfig(slots=slots, block_size=4, n_blocks=32,
+                             max_len=32, prefill_buckets=(8,))
+        eng = ServingEngine(params, TINY, scfg, rng=jax.random.PRNGKey(42))
+        rids = [eng.submit(p, 8, temperature=0.9, top_p=0.8)
+                for p in prompts]
+        out = eng.drain()
+        return [out[r] for r in rids]
+
+    assert run(1) == run(4)
+
+
+def test_engine_pool_exhaustion_preempts_and_still_matches_generate(params):
+    """A pool far too small for the offered load forces recompute
+    preemptions — results must still be exact, every block must come back,
+    and the high-water mark must honor the pool bound."""
+    scfg = ServingConfig(slots=4, block_size=4, n_blocks=9, max_len=24,
+                         prefill_buckets=(8,))
+    eng = ServingEngine(params, TINY, scfg)
+    rng = np.random.default_rng(2)
+    reqs = []
+    for _ in range(4):
+        prompt = rng.integers(0, 64, size=6)
+        reqs.append((eng.submit(prompt, 14), prompt))
+    out = eng.drain()
+    assert sum(eng.request(r).preemptions for r, _ in reqs) > 0
+    for rid, prompt in reqs:
+        np.testing.assert_array_equal(
+            np.array(out[rid]), _generate_ref(params, prompt, 14))
+    assert eng.allocator.in_use == 0
+    assert eng.allocator.high_water <= scfg.n_blocks - 1
+
+
+def test_engine_eos_retires_early_and_prefix_matches(params):
+    scfg = ServingConfig(slots=2, block_size=4, n_blocks=32, max_len=32,
+                         prefill_buckets=(8,))
+    eng = ServingEngine(params, TINY, scfg)
+    prompt = np.random.default_rng(4).integers(0, 64, size=5)
+    plain = _generate_ref(params, prompt, 8)
+    eos = int(plain[2])
+    rid = eng.submit(prompt, 8, eos_token=eos)
+    out = eng.drain()[rid]
+    assert out == list(plain[:3])             # stops AT the eos, inclusive
+    assert eng.allocator.in_use == 0
+
+
+def test_engine_prefill_bucket_padding_has_no_effect(params):
+    """The same prompt through a tighter and a looser bucket produces the
+    same tokens — pad rows never reach an unmasked read."""
+    prompt = np.random.default_rng(5).integers(0, 64, size=5)
+
+    def run(buckets):
+        scfg = ServingConfig(slots=2, block_size=4, n_blocks=32, max_len=32,
+                             prefill_buckets=buckets)
+        eng = ServingEngine(params, TINY, scfg)
+        rid = eng.submit(prompt, 7)
+        return eng.drain()[rid]
+
+    assert run((8,)) == run((16,)) == list(_generate_ref(params, prompt, 7))
+
+
+def test_engine_submit_validation_and_poll(params):
+    scfg = ServingConfig(slots=2, block_size=4, n_blocks=8, max_len=24,
+                         prefill_buckets=(8,))
+    eng = ServingEngine(params, TINY, scfg)
+    prompt = np.zeros((5,), np.int32)
+    with pytest.raises(ValueError, match="at least one token"):
+        eng.submit(np.zeros((0,), np.int32), 2)      # empty prompt
+    with pytest.raises(ValueError, match="bucket"):
+        eng.submit(np.zeros((9,), np.int32), 2)      # prompt > largest bucket
+    with pytest.raises(ValueError, match="max_len"):
+        eng.submit(prompt, 100)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        eng.submit(prompt, 0)
+    with pytest.raises(ValueError, match="top_p"):
+        eng.submit(prompt, 2, top_p=0.5)             # greedy ignores top_p
+    with pytest.raises(ValueError, match="top_p"):
+        eng.submit(prompt, 2, temperature=1.0, top_p=1.5)
+    rid = eng.submit(prompt, 2)
+    assert eng.poll(rid) == {"status": "queued", "tokens": []}
+    with pytest.raises(RuntimeError, match="not done"):
+        eng.result(rid)
+    eng.drain()
+    assert eng.poll(rid)["status"] == "done"
+    assert len(eng.result(rid)) == 2
+    stats = eng.stats()
+    assert stats["kv_high_water_bytes"] < stats["kv_dense_worst_case_bytes"]
+
+
+# -- checkify debug guard ----------------------------------------------------
+
+def test_checkify_guard_trips_on_traced_overflow(params, monkeypatch):
+    """The documented hard contract (decoding.py): a TRACED ``start``
+    overflowing ``max_len`` corrupts silently — under TPU_TASK_CHECKIFY=1
+    a checkify-functionalized caller gets a loud error instead."""
+    monkeypatch.setenv("TPU_TASK_CHECKIFY", "1")
+    from jax.experimental import checkify
+
+    caches = decoding.init_cache(TINY, batch=1, max_len=4)
+    tokens = jnp.zeros((1, 2), jnp.int32)
+    fn = jax.jit(checkify.checkify(
+        lambda start: decoding.forward_with_cache(
+            params, TINY, tokens, caches, start)[0]))
+    err, _ = fn(jnp.int32(3))                  # 3 + 2 > 4: overflow
+    assert err.get() is not None and "overflow" in str(err.get())
+    err, _ = fn(jnp.int32(2))                  # 2 + 2 == 4: in bounds
+    assert err.get() is None
+
+
+def test_checkify_guard_is_noop_by_default(params, monkeypatch):
+    """Without the env flag the guard must not emit a check — plain jit
+    callers (all of production) would fail to trace otherwise."""
+    monkeypatch.delenv("TPU_TASK_CHECKIFY", raising=False)
+    caches = decoding.init_cache(TINY, batch=1, max_len=8)
+    tokens = jnp.zeros((1, 2), jnp.int32)
+    logits, _ = jax.jit(
+        lambda start: decoding.forward_with_cache(
+            params, TINY, tokens, caches, start))(jnp.int32(0))
+    assert logits.shape == (1, TINY.vocab_size)
+
+
+def test_engine_debug_mode_runs_checkified(params, monkeypatch):
+    """TPU_TASK_CHECKIFY=1 wraps every engine program in checkify: a clean
+    run throws nothing and still matches generate. (The reference runs
+    BEFORE the flag flips: under the flag, the guard inside generate's scan
+    requires its caller to functionalize too — that is the point.)"""
+    prompt = np.random.default_rng(6).integers(0, 64, size=5)
+    ref = _generate_ref(params, prompt, 4)
+    monkeypatch.setenv("TPU_TASK_CHECKIFY", "1")
+    scfg = ServingConfig(slots=2, block_size=4, n_blocks=16, max_len=16,
+                         prefill_buckets=(8,))
+    eng = ServingEngine(params, TINY, scfg)
+    assert eng.debug
+    rid = eng.submit(prompt, 4)
+    np.testing.assert_array_equal(np.array(eng.drain()[rid]), ref)

@@ -15,12 +15,13 @@ this completes the task library's train → eval → generate triangle.
 
 from __future__ import annotations
 
+import os
 from typing import Any, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
-from tpu_task.ml.ops.attention import NEG_INF
+from tpu_task.ml.ops.attention import NEG_INF, gqa_cached_attention
 from tpu_task.ml.models.transformer import (
     Params,
     TransformerConfig,
@@ -40,22 +41,30 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int) -> List[dict]:
 
 
 def _cached_attention(q, k_cache, v_cache, q_positions):
-    """q: (b, s, h, d) at absolute ``q_positions``; caches stay at KV-head
-    width (b, L, kv, d) and the einsums group q heads over them directly —
-    expanding the cache to h per step would stream group-factor times the
-    bytes through the memory-bound decode loop, forfeiting GQA's win.
-    Slot j holds the token at position j (zeros beyond the filled region,
-    masked off by the position test j <= q_pos)."""
-    b, s, h, d = q.shape
-    kv = k_cache.shape[2]
-    qg = q.reshape(b, s, kv, h // kv, d)
-    scores = jnp.einsum("bskgd,blkd->bkgsl", qg, k_cache) / (d ** 0.5)
-    slot = jnp.arange(k_cache.shape[1])
-    mask = slot[None, :] <= q_positions[:, None]           # (s, L)
-    scores = jnp.where(mask[None, None, None], scores, NEG_INF)
-    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
-    out = jnp.einsum("bkgsl,blkd->bskgd", probs.astype(q.dtype), v_cache)
-    return out.reshape(b, s, h, d)
+    """Dense-cache entry to the shared grouped-query cached-attention core
+    (``ml.ops.attention.gqa_cached_attention``) — the paged cache in
+    ``ml.serving`` decodes through the SAME core after gathering its block
+    pool into this layout, which is what makes paged-vs-dense bit-exactness
+    a checkable contract instead of a hope."""
+    return gqa_cached_attention(q, k_cache, v_cache, q_positions)
+
+
+def bounds_guard(ok, msg: str, **fmt):
+    """Opt-in traced bounds check (``TPU_TASK_CHECKIFY=1``): the cache's
+    overflow contract is only statically checkable when ``start`` is a
+    Python int — a TRACED ``start`` that overflows corrupts the cache tail
+    silently. Under the env flag, emit a ``checkify.check`` so callers that
+    functionalize (``checkify.checkify``; the serving engine's debug mode
+    does) get a loud error with the offending values; eager callers raise
+    immediately. Off (the default) this is a no-op — plain ``jit`` callers
+    never pay for (or trip over) the un-functionalized check. NOTE: with
+    the flag ON, every staged caller (including ``generate``'s scan) must
+    be run under ``checkify.checkify`` — that is checkify's contract, and
+    why the flag is a debug mode, not a default."""
+    if os.environ.get("TPU_TASK_CHECKIFY", "") == "1":
+        from jax.experimental import checkify
+
+        checkify.check(ok, msg, **fmt)
 
 
 def _cached_block(x, layer, cfg: TransformerConfig, cache: dict,
@@ -101,6 +110,11 @@ def forward_with_cache(params: Params, cfg: TransformerConfig, tokens,
         raise ValueError(
             f"cache overflow: start {start} + tokens {s} > max_len "
             f"{max_len} (the cache is a fixed buffer, not a ring)")
+    bounds_guard(start + s <= max_len,
+                 "cache overflow: start {start} + tokens {s} > max_len "
+                 "{max_len} (the cache is a fixed buffer, not a ring)",
+                 start=jnp.asarray(start), s=jnp.asarray(s),
+                 max_len=jnp.asarray(max_len))
     positions = start + jnp.arange(s)
     x = embed_lookup(params["embed"].astype(cfg.dtype), tokens)
     new_caches = []
@@ -112,9 +126,15 @@ def forward_with_cache(params: Params, cfg: TransformerConfig, tokens,
     return logits.astype(jnp.float32), new_caches
 
 
-def _top_p_filter(logits, top_p: float):
+def _top_p_filter(logits, top_p):
     """Nucleus filtering: keep the smallest probability mass >= top_p,
-    everything else to NEG_INF. Static shapes (sort + cumsum), jit-safe."""
+    everything else to NEG_INF. Static shapes (sort + cumsum), jit-safe.
+    ``top_p`` is a scalar, or a (batch,) array for per-row thresholds —
+    continuous batching samples every slot with its own request's params in
+    one program."""
+    top_p = jnp.asarray(top_p, jnp.float32)
+    if top_p.ndim:
+        top_p = top_p[:, None]
     sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
     probs = jax.nn.softmax(sorted_logits.astype(jnp.float32), axis=-1)
     cumulative = jnp.cumsum(probs, axis=-1)

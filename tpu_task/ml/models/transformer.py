@@ -261,17 +261,23 @@ def _rmsnorm(x, scale):
 def _rope(x, theta: float, positions=None):
     """Rotary embedding over (batch, seq, heads, head_dim).
 
-    ``positions`` (seq,) overrides the default 0..seq-1 — KV-cache decoding
-    applies rope at absolute offsets through this SAME function, so the
-    train and decode paths cannot drift apart."""
+    ``positions`` overrides the default 0..seq-1 — KV-cache decoding applies
+    rope at absolute offsets through this SAME function, so the train and
+    decode paths cannot drift apart. Shape (seq,) rotates every batch row at
+    the same offsets; (batch, seq) rotates per row — continuous-batching
+    decode steps one token per slot with every slot at its own depth."""
     _, seq, _, d = x.shape
     half = d // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
     if positions is None:
         positions = jnp.arange(seq)
-    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]
-    cos = jnp.cos(angles)[None, :, None, :]
-    sin = jnp.sin(angles)[None, :, None, :]
+    angles = positions.astype(jnp.float32)[..., None] * freqs
+    if angles.ndim == 2:                     # (seq, half): shared offsets
+        cos = jnp.cos(angles)[None, :, None, :]
+        sin = jnp.sin(angles)[None, :, None, :]
+    else:                                    # (batch, seq, half): per-row
+        cos = jnp.cos(angles)[:, :, None, :]
+        sin = jnp.sin(angles)[:, :, None, :]
     x1, x2 = x[..., :half], x[..., half:]
     out = jnp.concatenate(
         [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1

@@ -88,6 +88,42 @@ def reduce_kv_heads(d_expanded, kv_heads: int):
     return d_expanded.reshape(b, s, kv_heads, h // kv_heads, d).sum(axis=3)
 
 
+def gqa_cached_attention(q, k_cache, v_cache, q_positions):
+    """Grouped-query attention of q against a positional k/v cache — the ONE
+    attention core both cache layouts decode through: the dense per-sequence
+    cache (``ml.models.decoding``) feeds its (b, L, kv, d) buffers directly,
+    the paged cache (``ml.serving``) gathers the same layout through its
+    block tables first. Keeping a single core is what makes the paged/dense
+    parity contract (docs/parity.md) checkable: given equal gathered k/v the
+    two paths are the same arithmetic, bit for bit.
+
+    q: (b, s, h, d) at absolute ``q_positions`` — shape (s,) when every
+    batch row decodes the same positions (the dense ``generate`` path) or
+    (b, s) for per-row positions (continuous batching: every slot sits at
+    its own depth). Caches stay at KV-head width (b, L, kv, d) and the
+    einsums group q heads over them directly — expanding the cache to h per
+    step would stream group-factor times the bytes through the memory-bound
+    decode loop, forfeiting GQA's win. Cache slot j holds the token at
+    position j (arbitrary values beyond the filled region are masked off by
+    the position test j <= q_pos: their scores pin to NEG_INF, so softmax
+    contributes exactly 0.0 for them at any finite k/v)."""
+    b, s, h, d = q.shape
+    kv = k_cache.shape[2]
+    qg = q.reshape(b, s, kv, h // kv, d)
+    scores = jnp.einsum("bskgd,blkd->bkgsl", qg, k_cache) / (d ** 0.5)
+    slot = jnp.arange(k_cache.shape[1])
+    if q_positions.ndim == 1:                               # (s, L)
+        mask = slot[None, :] <= q_positions[:, None]
+        mask = mask[None, None, None]
+    else:                                                   # (b, s, L)
+        mask = slot[None, None, :] <= q_positions[:, :, None]
+        mask = mask[:, None, None]
+    scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    out = jnp.einsum("bkgsl,blkd->bskgd", probs.astype(q.dtype), v_cache)
+    return out.reshape(b, s, h, d)
+
+
 def mha_reference(q, k, v, causal: bool = True):
     """Plain XLA attention — the numerical ground truth for the kernels."""
     *_, d = q.shape

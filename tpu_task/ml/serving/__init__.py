@@ -1,0 +1,48 @@
+"""Serving subsystem: continuous batching over a paged KV cache.
+
+Two halves (see docs/parity.md "Serving cost model" for the contract):
+
+- ``cache``: the paged KV memory — a shared physical block pool per layer
+  plus per-slot block tables, host-side :class:`BlockAllocator`. KV bytes
+  are O(live tokens) instead of the dense cache's O(slots × max_len).
+- ``model`` + ``engine``: bucketed-length prefill and a single jitted
+  decode step over a fixed slot array, driven by an iteration-level
+  scheduler (:class:`ServingEngine`) that admits queued requests into free
+  slots every step and retires finished ones immediately.
+
+Both halves decode through the SAME attention core as the offline
+``generate`` path (``ml.ops.attention.gqa_cached_attention``), so paged
+and dense caches are bit-exact at fp32 — greedy tokens from the engine
+are pinned identical to ``generate``'s in the tier-1 suite.
+"""
+
+from tpu_task.ml.serving.cache import (
+    SCRATCH_BLOCK,
+    BlockAllocator,
+    ServingConfig,
+    dense_cache_bytes,
+    init_pools,
+    kv_token_bytes,
+    paged_cache_bytes,
+)
+from tpu_task.ml.serving.engine import Request, ServingEngine
+from tpu_task.ml.serving.model import (
+    paged_decode_step,
+    paged_prefill,
+    sample_tokens,
+)
+
+__all__ = [
+    "SCRATCH_BLOCK",
+    "BlockAllocator",
+    "Request",
+    "ServingConfig",
+    "ServingEngine",
+    "dense_cache_bytes",
+    "init_pools",
+    "kv_token_bytes",
+    "paged_cache_bytes",
+    "paged_decode_step",
+    "paged_prefill",
+    "sample_tokens",
+]

@@ -1,0 +1,195 @@
+"""Paged KV cache: one shared physical block pool + per-slot block tables.
+
+The dense decode cache (``ml.models.decoding.init_cache``) reserves
+``slots × max_len`` token slots per layer up front — O(slots × max_len)
+bytes whether or not anything lives there, and the worst-case ``max_len``
+must cover the LONGEST request the server will ever admit. Serving traffic
+is mixed-length, so almost all of that reservation is dead weight. The
+paged layout (vLLM/PagedAttention, Kwon et al., SOSP 2023) carves KV memory
+into fixed ``block_size``-token physical blocks shared by every slot:
+sequences allocate blocks lazily as they cross block boundaries and free
+them the step they finish, so live KV bytes are O(live tokens).
+
+Device side, each layer holds ``k``/``v`` pools of static shape
+``(n_blocks, block_size, kv_heads, d_head)``; a slot's logical token
+``p`` lives at flat pool slot ``block_table[p // block_size] * block_size
++ p % block_size``. Host side, :class:`BlockAllocator` is a plain free
+list — allocation is a scheduler decision, never traced.
+
+Physical block 0 is reserved as the SCRATCH block: it is never allocated,
+``0`` in a block table means "unallocated", and every masked write
+(inactive slots, prompt padding) is redirected into it. Gathers through
+unallocated table entries therefore read scratch garbage — which the
+positional mask pins to a score of NEG_INF, an exact softmax weight of
+0.0 at fp32, so the garbage never reaches an output bit (the paged/dense
+parity contract in docs/parity.md).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from tpu_task.ml.models.transformer import TransformerConfig
+
+#: Physical block index reserved for masked writes / the "unallocated"
+#: block-table sentinel. Never handed out by the allocator.
+SCRATCH_BLOCK = 0
+
+
+@dataclass(frozen=True)
+class ServingConfig:
+    """Admission knobs for the continuous-batching engine.
+
+    ``slots``: width of the fixed decode batch — how many sequences decode
+    per step (the one compiled decode program). ``block_size``/``n_blocks``:
+    paged-pool geometry (``n_blocks`` INCLUDES the reserved scratch block).
+    ``max_len``: per-slot logical capacity (prompt + generated); it bounds
+    the block table width, not any allocation. ``prefill_buckets``: padded
+    prompt lengths — prefill compiles one program per bucket instead of one
+    per prompt length.
+    """
+
+    slots: int = 8
+    block_size: int = 16
+    n_blocks: int = 128
+    max_len: int = 256
+    prefill_buckets: Tuple[int, ...] = (16, 32, 64, 128)
+
+    def __post_init__(self):
+        if self.slots < 1:
+            raise ValueError(f"slots must be >= 1, got {self.slots}")
+        if self.block_size < 1:
+            raise ValueError(
+                f"block_size must be >= 1, got {self.block_size}")
+        if self.n_blocks < 2:
+            raise ValueError(
+                f"n_blocks must be >= 2 (block 0 is scratch), got "
+                f"{self.n_blocks}")
+        if not self.prefill_buckets or list(self.prefill_buckets) != sorted(
+                set(self.prefill_buckets)):
+            raise ValueError(
+                f"prefill_buckets must be non-empty strictly ascending, got "
+                f"{self.prefill_buckets}")
+        if self.prefill_buckets[-1] > self.max_len:
+            raise ValueError(
+                f"largest prefill bucket {self.prefill_buckets[-1]} exceeds "
+                f"max_len {self.max_len}")
+
+    @property
+    def max_blocks_per_slot(self) -> int:
+        return -(-self.max_len // self.block_size)
+
+    def bucket_for(self, prompt_len: int) -> int:
+        """Smallest prefill bucket holding ``prompt_len`` tokens."""
+        for b in self.prefill_buckets:
+            if prompt_len <= b:
+                return b
+        raise ValueError(
+            f"prompt of {prompt_len} tokens exceeds the largest prefill "
+            f"bucket {self.prefill_buckets[-1]}")
+
+    def blocks_for(self, n_tokens: int) -> int:
+        """Physical blocks covering ``n_tokens`` logical tokens."""
+        return -(-n_tokens // self.block_size)
+
+
+def kv_token_bytes(cfg: TransformerConfig) -> int:
+    """KV bytes one token occupies across all layers (k + v)."""
+    return (2 * cfg.n_layers * cfg.kv_heads * cfg.d_head
+            * jnp.dtype(cfg.dtype).itemsize)
+
+
+def dense_cache_bytes(cfg: TransformerConfig, slots: int,
+                      max_len: int) -> int:
+    """Worst-case bytes of the dense layout: every slot reserves max_len."""
+    return slots * max_len * kv_token_bytes(cfg)
+
+
+def paged_cache_bytes(cfg: TransformerConfig, scfg: ServingConfig,
+                      n_blocks: int) -> int:
+    """Bytes of ``n_blocks`` physical blocks (e.g. the allocator's
+    high-water mark — what a right-sized pool would have needed)."""
+    return n_blocks * scfg.block_size * kv_token_bytes(cfg)
+
+
+def init_pools(cfg: TransformerConfig, scfg: ServingConfig) -> List[dict]:
+    """Per-layer k/v physical pools, same narrow KV-head layout (and the
+    same per-layer list-of-dicts pytree) as the dense cache."""
+    shape = (scfg.n_blocks, scfg.block_size, cfg.kv_heads, cfg.d_head)
+    return [{"k": jnp.zeros(shape, cfg.dtype),
+             "v": jnp.zeros(shape, cfg.dtype)}
+            for _ in range(cfg.n_layers)]
+
+
+# -- traced indexing helpers (used inside the jitted serving steps) ----------
+
+def flat_pool(pool):
+    """(n_blocks, block_size, kv, d) → (n_blocks·block_size, kv, d): all
+    reads/writes address the pool as flat token slots."""
+    n, bs = pool.shape[:2]
+    return pool.reshape(n * bs, *pool.shape[2:])
+
+
+def token_slots(block_table, positions, block_size: int):
+    """Flat pool slot of each logical ``positions`` entry through
+    ``block_table``. block_table: (max_blocks,) or (slots, max_blocks);
+    positions broadcasts accordingly ((s,) resp. (slots,))."""
+    block = positions // block_size
+    if block_table.ndim == 1:
+        phys = block_table[block]
+    else:
+        phys = jnp.take_along_axis(block_table, block[:, None], axis=1)[:, 0]
+    return phys * block_size + positions % block_size
+
+
+def gather_kv(pool_flat, block_table, block_size: int):
+    """Gather a (slots, max_blocks·block_size, kv, d) logical-order view of
+    the pool through the block tables — the dense (b, L, kv, d) cache layout
+    the shared attention core consumes. Unallocated table entries read the
+    scratch block; the core's positional mask zeroes them exactly."""
+    idx = (block_table[:, :, None] * block_size
+           + jnp.arange(block_size)[None, None, :])
+    return pool_flat[idx.reshape(block_table.shape[0], -1)]
+
+
+class BlockAllocator:
+    """Host-side free list over the physical blocks (block 0 excluded —
+    it is the scratch block). Tracks the high-water mark of live blocks so
+    the bench can report what a right-sized pool would have needed."""
+
+    def __init__(self, n_blocks: int):
+        if n_blocks < 2:
+            raise ValueError(f"n_blocks must be >= 2, got {n_blocks}")
+        self.n_blocks = n_blocks
+        # Pop from the tail → lowest block numbers first (determinism aid).
+        self._free = list(range(n_blocks - 1, SCRATCH_BLOCK, -1))
+        self.high_water = 0
+
+    @property
+    def available(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return (self.n_blocks - 1) - len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` blocks, or None (nothing allocated) if the pool can't."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        got = [self._free.pop() for _ in range(n)]
+        self.high_water = max(self.high_water, self.in_use)
+        return got
+
+    def free(self, blocks) -> None:
+        for b in blocks:
+            if not SCRATCH_BLOCK < b < self.n_blocks:
+                raise ValueError(f"free of invalid block {b}")
+            if b in self._free:
+                raise ValueError(f"double free of block {b}")
+            self._free.append(b)

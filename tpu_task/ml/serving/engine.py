@@ -1,0 +1,411 @@
+"""Continuous-batching serving engine: iteration-level scheduling over a
+fixed slot array (Orca, Yu et al., OSDI 2022) + the paged KV pool.
+
+The engine owns a fixed-width slot array and loops one scheduler iteration
+at a time (:meth:`ServingEngine.step`): retire slots that finished last
+step (their blocks return to the pool the same step), admit queued
+requests into free slots (bucketed-length prefill — one compiled program
+per bucket), then run ONE jitted decode step across all slots with
+per-slot positions and per-slot sampling params. A short request admitted
+behind a long one retires the moment ITS eos/length hits — no
+head-of-line blocking on the longest generation, which is the whole
+throughput argument (``bench.py serving`` measures it).
+
+Admission takes a request when a slot is free and the pool holds its
+prompt's blocks plus one spare; growth past that is lazy (a block at each
+block boundary). If the pool is exhausted mid-decode the youngest running
+request is preempted back to the queue head (recompute-style, vLLM's
+fallback policy): its blocks free immediately and its token stream is
+reproduced exactly on re-admission because sampling keys derive from the
+request key alone (fold_in per token index), never from the schedule.
+
+Host/device split: the scheduler (allocator, slot table, queues, timing)
+is plain Python/numpy; the device sees only static-shape jitted programs
+(prefill per bucket, one decode step, one sampler per logits shape) whose
+inputs — tokens, positions, block tables, active mask, sampling params —
+are tiny per-step arrays. ``TPU_TASK_CHECKIFY=1`` (debug mode) wraps every
+program in ``jax.experimental.checkify`` and throws on the bounds guards
+(`decoding.bounds_guard`) that are silent no-ops in production."""
+
+from __future__ import annotations
+
+import collections
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_task.ml.models.transformer import Params, TransformerConfig
+from tpu_task.ml.serving.cache import (
+    SCRATCH_BLOCK,
+    BlockAllocator,
+    ServingConfig,
+    init_pools,
+    paged_cache_bytes,
+)
+from tpu_task.ml.serving.model import (
+    decode_and_sample,
+    paged_prefill,
+    sample_tokens,
+)
+
+QUEUED, RUNNING, DONE = "queued", "running", "done"
+
+
+def _greedy_step(params, cfg, tokens, positions, tables, active, pools):
+    from tpu_task.ml.serving.model import paged_decode_step
+
+    logits, new_pools = paged_decode_step(
+        params, cfg, tokens, positions, tables, active, pools)
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), new_pools
+
+
+@dataclass
+class Request:
+    """One generation request and its lifecycle record."""
+
+    rid: int
+    prompt: np.ndarray                   # (prompt_len,) int32
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_p: float = 1.0                   # 1.0 = nucleus filter off
+    eos_token: Optional[int] = None
+    key: Optional[jax.Array] = None      # per-request PRNG key
+    status: str = QUEUED
+    tokens: List[int] = field(default_factory=list)
+    submit_t: float = 0.0
+    first_token_t: Optional[float] = None
+    finish_t: Optional[float] = None
+    preemptions: int = 0
+
+    @property
+    def finished(self) -> bool:
+        if len(self.tokens) >= self.max_new_tokens:
+            return True
+        return bool(self.tokens) and self.eos_token is not None \
+            and self.tokens[-1] == self.eos_token
+
+
+class ServingEngine:
+    """Front end: :meth:`submit` → request id, :meth:`poll` → status/tokens,
+    :meth:`step` → one scheduler iteration, :meth:`drain` → run to empty."""
+
+    def __init__(self, params: Params, cfg: TransformerConfig,
+                 scfg: Optional[ServingConfig] = None,
+                 rng: Optional[jax.Array] = None):
+        self.params = params
+        self.cfg = cfg
+        self.scfg = scfg = scfg or ServingConfig()
+        self.pools = init_pools(cfg, scfg)
+        self.allocator = BlockAllocator(scfg.n_blocks)
+        self.debug = os.environ.get("TPU_TASK_CHECKIFY", "") == "1"
+
+        n, m = scfg.slots, scfg.max_blocks_per_slot
+        self._slots: List[Optional[Request]] = [None] * n
+        self._admit_seq = [0] * n        # admission order, preemption victim pick
+        self._admit_counter = 0
+        self._tables = np.zeros((n, m), np.int32)
+        self._positions = np.zeros((n,), np.int32)
+        self._last_token = np.zeros((n,), np.int32)
+        self._slot_keys = np.zeros((n, 2), np.uint32)
+        self._queue: collections.deque = collections.deque()
+        self._requests: Dict[int, Request] = {}
+        self._next_rid = 0
+        self._base_key = rng if rng is not None else jax.random.PRNGKey(0)
+        self.steps = 0
+        self.decode_steps = 0
+        self.prefills = 0
+
+        # Pools are DONATED: the engine owns them exclusively and replaces
+        # its reference with the returned ones, so XLA updates the block
+        # pool in place — without donation every step would copy the whole
+        # pool, the one cost generate's in-scan cache carry never pays.
+        self._prefill_fn = self._wrap(jax.jit(
+            lambda params, tokens, length, table, pools: paged_prefill(
+                params, cfg, tokens, length, table, pools),
+            donate_argnums=(4,)))
+        # One fused program per decode iteration: forward + in-program key
+        # fold + sampler — per-step dispatch overhead is the engine's whole
+        # tax over generate's scan, so it is kept to a single call.
+        self._decode_fn = self._wrap(jax.jit(
+            lambda params, tokens, positions, tables, active, temps, tops,
+            keys, ngen, pools: decode_and_sample(
+                params, cfg, tokens, positions, tables, active, temps,
+                tops, keys, ngen, pools),
+            donate_argnums=(9,)))
+        # Greedy fast path: when every active slot decodes at temperature 0
+        # (the common serving default and the whole bench), the sampler
+        # reduces to argmax — no sort/cumsum/categorical/key-fold in the
+        # step program.
+        self._decode_greedy_fn = self._wrap(jax.jit(
+            lambda params, tokens, positions, tables, active, pools:
+            _greedy_step(params, cfg, tokens, positions, tables, active,
+                         pools),
+            donate_argnums=(5,)))
+        self._prefill_sample_fn = self._wrap(jax.jit(
+            lambda logits, temp, top, key, n: sample_tokens(
+                logits, temp, top, jax.random.fold_in(key, n)[None])))
+
+    def _wrap(self, fn):
+        """Debug mode: functionalize the bounds guards and throw on them."""
+        if not self.debug:
+            return fn
+        from jax.experimental import checkify
+
+        checked = checkify.checkify(fn)
+
+        def run(*args):
+            err, out = checked(*args)
+            err.throw()
+            return out
+
+        return run
+
+    # -- front end -----------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int, *, temperature: float = 0.0,
+               top_p: Optional[float] = None,
+               eos_token: Optional[int] = None) -> int:
+        """Queue a generation request; returns its id. Same sampling
+        contract as ``generate``: temperature 0 is greedy, ``top_p`` needs
+        temperature > 0."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if len(prompt) < 1:
+            raise ValueError("prompt must hold at least one token")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if top_p is not None and not 0 < top_p <= 1:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if top_p is not None and temperature == 0:
+            raise ValueError("top_p needs temperature > 0 (greedy ignores it)")
+        self.scfg.bucket_for(len(prompt))  # must fit a prefill bucket
+        total = len(prompt) + max_new_tokens
+        if total > self.scfg.max_len:
+            raise ValueError(
+                f"prompt {len(prompt)} + max_new_tokens {max_new_tokens} "
+                f"exceeds max_len {self.scfg.max_len}")
+        if self.scfg.blocks_for(total) > self.scfg.n_blocks - 1:
+            raise ValueError(
+                f"request needs {self.scfg.blocks_for(total)} blocks but the "
+                f"pool holds {self.scfg.n_blocks - 1}")
+        rid = self._next_rid
+        self._next_rid += 1
+        req = Request(
+            rid=rid, prompt=prompt, max_new_tokens=max_new_tokens,
+            temperature=temperature, top_p=1.0 if top_p is None else top_p,
+            eos_token=eos_token, key=jax.random.fold_in(self._base_key, rid),
+            submit_t=time.monotonic())
+        self._requests[rid] = req
+        self._queue.append(req)
+        return rid
+
+    def poll(self, rid: int) -> dict:
+        req = self._requests[rid]
+        return {"status": req.status, "tokens": list(req.tokens)}
+
+    def request(self, rid: int) -> Request:
+        """The full lifecycle record (timestamps, preemptions) — the bench
+        computes TTFT/latency percentiles from these."""
+        return self._requests[rid]
+
+    def result(self, rid: int) -> List[int]:
+        req = self._requests[rid]
+        if req.status != DONE:
+            raise RuntimeError(f"request {rid} is {req.status}, not done")
+        return list(req.tokens)
+
+    @property
+    def n_active(self) -> int:
+        return sum(r is not None for r in self._slots)
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self._queue) or self.n_active > 0
+
+    def step(self) -> dict:
+        """One scheduler iteration: admit → decode → retire. Returns what
+        happened (request ids admitted/finished, active count)."""
+        self.steps += 1
+        admitted, finished = [], []
+        self._admit(admitted, finished)
+        if self.n_active:
+            self._decode(finished)
+        return {"admitted": admitted, "finished": finished,
+                "active": self.n_active, "queued": len(self._queue)}
+
+    def drain(self, max_steps: int = 100_000) -> Dict[int, List[int]]:
+        """Step until queue and slots are empty; returns {rid: tokens} for
+        every request ever submitted."""
+        steps = 0
+        while self.has_work:
+            if steps >= max_steps:
+                raise RuntimeError(f"drain exceeded {max_steps} steps")
+            self.step()
+            steps += 1
+        return {rid: list(r.tokens) for rid, r in self._requests.items()}
+
+    # -- scheduler internals -------------------------------------------------
+
+    def _sample_one(self, req: Request, logits) -> int:
+        tok = self._prefill_sample_fn(
+            logits, jnp.asarray([req.temperature], jnp.float32),
+            jnp.asarray([req.top_p], jnp.float32), req.key,
+            jnp.int32(len(req.tokens)))
+        return int(tok[0])
+
+    def _admit(self, admitted: list, finished: list) -> None:
+        while self._queue:
+            slot = next(
+                (i for i, r in enumerate(self._slots) if r is None), None)
+            if slot is None:
+                return
+            req = self._queue[0]
+            need = self.scfg.blocks_for(len(req.prompt))
+            # Keep one spare so the running set can cross its next block
+            # boundary without an instant preemption; an idle engine admits
+            # with no spare (a solo request can always grow into the pool
+            # its own submit-time validation reserved).
+            if self.allocator.available < need + (1 if self.n_active else 0):
+                return
+            self._queue.popleft()
+            blocks = self.allocator.alloc(need)
+            bucket = self.scfg.bucket_for(len(req.prompt))
+            table = np.zeros((self.scfg.max_blocks_per_slot,), np.int32)
+            table[:need] = blocks
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :len(req.prompt)] = req.prompt
+            logits, self.pools = self._prefill_fn(
+                self.params, jnp.asarray(padded),
+                jnp.int32(len(req.prompt)), jnp.asarray(table), self.pools)
+            self.prefills += 1
+            first = self._sample_one(req, logits)
+            now = time.monotonic()
+            req.status = RUNNING
+            req.tokens.append(first)
+            if req.first_token_t is None:
+                req.first_token_t = now
+            self._slots[slot] = req
+            self._admit_counter += 1
+            self._admit_seq[slot] = self._admit_counter
+            self._slot_keys[slot] = np.asarray(req.key, np.uint32)
+            self._tables[slot] = table
+            self._positions[slot] = len(req.prompt)
+            self._last_token[slot] = first
+            admitted.append(req.rid)
+            if req.finished:
+                self._retire(slot)
+                finished.append(req.rid)
+
+    def _ensure_blocks(self) -> None:
+        """Every active slot whose next write crosses into an unallocated
+        block gets one — preempting the youngest running request (requeued
+        at the head, restart-from-scratch recompute) when the pool is dry."""
+        for slot in sorted(range(self.scfg.slots),
+                           key=lambda i: self._admit_seq[i]):
+            req = self._slots[slot]
+            if req is None:
+                continue
+            block_i = int(self._positions[slot]) // self.scfg.block_size
+            while self._tables[slot, block_i] == SCRATCH_BLOCK:
+                got = self.allocator.alloc(1)
+                if got is not None:
+                    self._tables[slot, block_i] = got[0]
+                    break
+                victim = max(
+                    (i for i, r in enumerate(self._slots) if r is not None),
+                    key=lambda i: self._admit_seq[i])
+                self._preempt(victim)
+                if victim == slot:
+                    break  # this slot itself was youngest — it is requeued
+                if self.n_active <= 1 and self.allocator.available == 0:
+                    raise RuntimeError(
+                        "KV pool too small for a single request — raise "
+                        "n_blocks")
+
+    def _preempt(self, slot: int) -> None:
+        req = self._slots[slot]
+        req.preemptions += 1
+        req.status = QUEUED
+        req.tokens.clear()   # recompute policy: the keyed sampling stream
+        req.first_token_t = None  # reproduces the same tokens on
+        self._release(slot)       # re-admission; TTFT restarts honestly
+        self._queue.appendleft(req)
+
+    def _decode(self, finished: list) -> None:
+        self._ensure_blocks()
+        active = np.array([r is not None for r in self._slots])
+        if not active.any():
+            return
+        if all(r is None or r.temperature == 0 for r in self._slots):
+            toks, self.pools = self._decode_greedy_fn(
+                self.params, jnp.asarray(self._last_token),
+                jnp.asarray(np.where(active, self._positions, 0)),
+                jnp.asarray(self._tables), jnp.asarray(active), self.pools)
+        else:
+            temps = np.array(
+                [r.temperature if r else 0.0 for r in self._slots],
+                np.float32)
+            tops = np.array([r.top_p if r else 1.0 for r in self._slots],
+                            np.float32)
+            ngen = np.array([len(r.tokens) if r else 0 for r in self._slots],
+                            np.int32)
+            toks, self.pools = self._decode_fn(
+                self.params, jnp.asarray(self._last_token),
+                jnp.asarray(np.where(active, self._positions, 0)),
+                jnp.asarray(self._tables), jnp.asarray(active),
+                jnp.asarray(temps), jnp.asarray(tops),
+                jnp.asarray(self._slot_keys), jnp.asarray(ngen), self.pools)
+        self.decode_steps += 1
+        toks = np.asarray(toks)
+        now = time.monotonic()
+        for slot, req in enumerate(self._slots):
+            if req is None:
+                continue
+            tok = int(toks[slot])
+            req.tokens.append(tok)
+            if req.first_token_t is None:
+                req.first_token_t = now
+            self._positions[slot] += 1
+            self._last_token[slot] = tok
+            if req.finished:
+                self._retire(slot)
+                finished.append(req.rid)
+
+    def _release(self, slot: int) -> None:
+        """Free the slot's blocks and clear its row — same step it ends."""
+        live = self._tables[slot][self._tables[slot] != SCRATCH_BLOCK]
+        self.allocator.free(live.tolist())
+        self._tables[slot] = 0
+        self._positions[slot] = 0
+        self._last_token[slot] = 0
+        self._slots[slot] = None
+
+    def _retire(self, slot: int) -> None:
+        req = self._slots[slot]
+        req.status = DONE
+        req.finish_t = time.monotonic()
+        self._release(slot)
+
+    # -- observability -------------------------------------------------------
+
+    def stats(self) -> dict:
+        """Scheduler counters + the KV cost model (docs/parity.md)."""
+        from tpu_task.ml.serving.cache import dense_cache_bytes
+
+        return {
+            "steps": self.steps,
+            "decode_steps": self.decode_steps,
+            "prefills": self.prefills,
+            "kv_blocks_high_water": self.allocator.high_water,
+            "kv_high_water_bytes": paged_cache_bytes(
+                self.cfg, self.scfg, self.allocator.high_water),
+            "kv_pool_bytes": paged_cache_bytes(
+                self.cfg, self.scfg, self.scfg.n_blocks),
+            "kv_dense_worst_case_bytes": dense_cache_bytes(
+                self.cfg, self.scfg.slots, self.scfg.max_len),
+        }

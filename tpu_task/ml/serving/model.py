@@ -1,0 +1,156 @@
+"""Paged-cache forward passes: bucketed prefill + one-step batched decode.
+
+Both functions run the TRAINING block (``transformer._block``) with an
+attention closure over the paged pool, exactly as the dense decode path
+does — every projection, norm, rope application, and residual is shared,
+and the attention itself goes through the one grouped-query cached core
+(``ml.ops.attention.gqa_cached_attention``). The only paged-specific code
+is addressing: scatter new k/v into flat pool slots through the block
+table, gather the logical-order (slots, L, kv, d) view back out. That is
+what makes the paged/dense parity contract bit-exact at fp32 (see
+docs/parity.md): identical arithmetic over identical valid entries, and
+masked entries contribute an exact 0.0 either way.
+
+Shapes are static everywhere: prefill compiles once per
+``(bucket, max_blocks)`` and decode once per ``(slots, max_blocks)`` — a
+handful of programs serve every request mix, the serving-side analogue of
+``generate``'s one-compiled-program discipline.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpu_task.ml.models.decoding import _top_p_filter, bounds_guard
+from tpu_task.ml.models.transformer import (
+    Params,
+    TransformerConfig,
+    _block,
+    _rmsnorm,
+    embed_lookup,
+)
+from tpu_task.ml.ops.attention import gqa_cached_attention
+from tpu_task.ml.serving.cache import flat_pool, gather_kv, token_slots
+
+
+def paged_prefill(params: Params, cfg: TransformerConfig, tokens, length,
+                  block_table, pools: List[dict]) -> Tuple[jax.Array, List[dict]]:
+    """One request's prompt through the model, writing its k/v into the
+    paged pool. ``tokens``: (1, bucket) right-padded to a prefill bucket;
+    ``length``: the real prompt length (may be traced — one compile per
+    bucket, not per length); ``block_table``: (max_blocks,) int32 with the
+    prompt's blocks allocated. Returns (last-real-position logits
+    (1, vocab) float32, updated pools).
+
+    A fresh slot attends only itself, so prefill attention is causal
+    self-attention over the bucket via the shared core — no gather. Pad
+    rows (p >= length) compute garbage q/k/v: their writes land either in
+    the tail of the slot's own last allocated block (overwritten by the
+    real token before any unmasked read — decode writes position p before
+    attending it) or, beyond the allocated region, in the scratch block;
+    their attention rows are never read (logits are gathered at
+    length - 1, and pads sit at positions > every real row's mask)."""
+    b, s = tokens.shape
+    block_size = pools[0]["k"].shape[1]
+    bounds_guard(length <= block_table.shape[0] * block_size,
+                 "prefill overflow: length {length} exceeds the slot's "
+                 "block-table capacity {cap}",
+                 length=jnp.asarray(length),
+                 cap=jnp.asarray(block_table.shape[0] * block_size))
+    positions = jnp.arange(s)
+    write_idx = token_slots(block_table, positions, block_size)
+    x = embed_lookup(params["embed"].astype(cfg.dtype), tokens)
+    new_pools: List[dict] = []
+    for layer, pool in zip(params["layers"], pools):
+        updated: dict = {}
+
+        def attn_fn(q, k, v, pool=pool, updated=updated):
+            updated["k"] = flat_pool(pool["k"]).at[write_idx].set(
+                k[0]).reshape(pool["k"].shape)
+            updated["v"] = flat_pool(pool["v"]).at[write_idx].set(
+                v[0]).reshape(pool["v"].shape)
+            return gqa_cached_attention(q, k, v, positions)
+
+        x, _aux = _block(x, layer, cfg, attn_fn, positions=positions)
+        new_pools.append(updated)
+    x = _rmsnorm(x, params["final_norm"])
+    logits = x[:, length - 1] @ params["unembed"].astype(cfg.dtype)
+    return logits.astype(jnp.float32), new_pools
+
+
+def paged_decode_step(params: Params, cfg: TransformerConfig, tokens,
+                      positions, block_tables, active,
+                      pools: List[dict]) -> Tuple[jax.Array, List[dict]]:
+    """ONE decode step across every slot: each slot's last token in, each
+    slot's next-token logits out. ``tokens``: (slots,) int32; ``positions``:
+    (slots,) — the absolute position each new token occupies (per-slot: no
+    two slots need be at the same depth, THE continuous-batching property);
+    ``block_tables``: (slots, max_blocks) int32; ``active``: (slots,) bool —
+    inactive slots still compute (static shapes) but write only scratch and
+    their outputs are discarded by the host scheduler. Returns
+    ((slots, vocab) float32 logits, updated pools)."""
+    slots = tokens.shape[0]
+    block_size = pools[0]["k"].shape[1]
+    capacity = block_tables.shape[1] * block_size
+    bounds_guard(jnp.all(jnp.where(active, positions, 0) < capacity),
+                 "decode overflow: a slot position reached the block-table "
+                 "capacity {cap}", cap=jnp.asarray(capacity))
+    pos2d = positions[:, None]
+    write_idx = jnp.where(
+        active, token_slots(block_tables, positions, block_size), 0)
+    x = embed_lookup(params["embed"].astype(cfg.dtype), tokens[:, None])
+    new_pools: List[dict] = []
+    for layer, pool in zip(params["layers"], pools):
+        updated: dict = {}
+
+        def attn_fn(q, k, v, pool=pool, updated=updated):
+            # Scatter this step's k/v (slots, 1, kv, d), THEN gather — the
+            # new token must attend itself, same order as the dense path.
+            kf = flat_pool(pool["k"]).at[write_idx].set(k[:, 0])
+            vf = flat_pool(pool["v"]).at[write_idx].set(v[:, 0])
+            updated["k"] = kf.reshape(pool["k"].shape)
+            updated["v"] = vf.reshape(pool["v"].shape)
+            k_view = gather_kv(kf, block_tables, block_size)
+            v_view = gather_kv(vf, block_tables, block_size)
+            return gqa_cached_attention(q, k_view, v_view, pos2d)
+
+        x, _aux = _block(x, layer, cfg, attn_fn, positions=pos2d)
+        new_pools.append(updated)
+    x = _rmsnorm(x, params["final_norm"])
+    logits = x[:, -1] @ params["unembed"].astype(cfg.dtype)
+    return logits.astype(jnp.float32), new_pools
+
+
+def decode_and_sample(params: Params, cfg: TransformerConfig, tokens,
+                      positions, block_tables, active, temperature, top_p,
+                      slot_keys, n_generated, pools):
+    """Fused decode step + sampler: ONE program (one dispatch, one (slots,)
+    readback) per engine iteration — the serving analogue of ``generate``
+    folding its sampler into the scan body. Per-token sampling keys are
+    derived in-program: ``fold_in(slot_keys[i], n_generated[i])``, so a
+    request's stream still depends only on its own key and token index,
+    never on co-scheduling. Returns ((slots,) int32 next tokens, pools)."""
+    logits, new_pools = paged_decode_step(
+        params, cfg, tokens, positions, block_tables, active, pools)
+    keys = jax.vmap(jax.random.fold_in)(slot_keys, n_generated)
+    return sample_tokens(logits, temperature, top_p, keys), new_pools
+
+
+def sample_tokens(logits, temperature, top_p, keys):
+    """Per-row sampling with per-row params in one program: row i is greedy
+    when ``temperature[i] == 0``, else softmax-samples at its temperature
+    through its nucleus (``top_p[i]``; 1.0 disables). ``keys``: (n, 2)
+    uint32 — one PRNG key per row, so a request's token stream depends only
+    on its own key, never on which slots it happens to share a step with
+    (per-request determinism under any schedule). Same temper-then-filter
+    order and the same ``_top_p_filter`` as ``generate``."""
+    greedy = jnp.argmax(logits, axis=-1)
+    safe_t = jnp.where(temperature > 0, temperature, 1.0)
+    filtered = _top_p_filter(logits / safe_t[:, None], top_p)
+    sampled = jax.vmap(
+        lambda key, row: jax.random.categorical(key, row, axis=-1)
+    )(keys, filtered)
+    return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
