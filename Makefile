@@ -52,10 +52,14 @@ chaos:
 wheel:
 	$(PYTHON) -m pip wheel --no-deps --no-build-isolation -w dist .
 
-# Compile-check the multi-chip sharded train step on a virtual 8-device mesh.
+# Compile-check the multi-chip sharded train step on a virtual 8-device
+# mesh, then the tensor-parallel serving points (engine tok/s + KV
+# bytes/shard at tp 1 and 8) — MULTICHIP captures cover serve AND train.
 multichip:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 		$(PYTHON) __graft_entry__.py
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		$(PYTHON) bench.py serving --tp 1,8
 
 # Compiled-path correctness on an attached real TPU (not interpret mode):
 # flash fwd+bwd + zigzag ring vs the XLA reference, fused cross-entropy,

@@ -644,6 +644,91 @@ def bench_serving(n_requests: int = 36, seed: int = 0) -> dict:
     }
 
 
+def bench_serving_multichip(tps=(1, 8), n_requests: int = 16,
+                            seed: int = 0) -> dict:
+    """Tensor-parallel serving points: the SAME continuous-batching engine
+    with its paged KV pools kv-head-sharded over a tp mesh (one partition
+    registry with training — `ml.parallel.sharding`). Per tp width: engine
+    aggregate decode tok/s on a mixed-length greedy workload and KV pool
+    bytes per shard (the capacity claim: per-device KV divides by tp, so a
+    pool too big for one chip serves across the mesh). Runs on the
+    forced-host 8-device CPU platform (`make multichip`) or any backend
+    with enough devices; greedy streams are ASSERTED identical across tp
+    widths — a divergence raises (nonzero exit from `make multichip`), it
+    is never just a buried JSON field (the docs/parity.md token-identity
+    contract)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_task.ml.models import transformer
+    from tpu_task.ml.parallel.mesh import make_mesh
+    from tpu_task.ml.serving import ServingConfig, ServingEngine
+    from tpu_task.ml.serving.cache import kv_shard_bytes, paged_cache_bytes
+
+    tps = tuple(tps)
+    n_dev = len(jax.devices())
+    if not tps or n_dev < max(tps):
+        return {"skipped": f"need {max(tps or (1,))} devices, have {n_dev} "
+                           "(run via `make multichip` for the forced-host "
+                           "8-device CPU platform)"}
+
+    # kv_heads=8 so every tp in {1,2,4,8} divides the pool's kv-head axis.
+    cfg = transformer.TransformerConfig(
+        vocab_size=512, d_model=256, n_layers=3, n_heads=8, d_head=32,
+        d_ff=512, dtype=jnp.float32, n_kv_heads=8)
+    scfg = ServingConfig(slots=8, block_size=8, n_blocks=80, max_len=96,
+                         prefill_buckets=(8, 16, 32))
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    pool_bytes = paged_cache_bytes(cfg, scfg, scfg.n_blocks)
+
+    rng = np.random.default_rng(seed)
+    work = [{
+        "prompt": rng.integers(0, cfg.vocab_size,
+                               size=int(rng.choice(scfg.prefill_buckets))),
+        "max_new": 4 if rng.random() < 2 / 3 else 48,
+    } for _ in range(n_requests)]
+    useful = sum(w["max_new"] for w in work)
+
+    points, streams = [], {}
+    for tp in tps:
+        mesh = (None if tp == 1 else
+                make_mesh(tp, axis_names=("tp",), axis_sizes=(tp,)))
+        eng = ServingEngine(params, cfg, scfg, mesh=mesh)
+        for b in scfg.prefill_buckets:   # compile off the clock
+            eng.submit(np.zeros((b,), np.int32), 2)
+        eng.drain()
+        t0 = time.perf_counter()
+        rids = [eng.submit(w["prompt"], w["max_new"]) for w in work]
+        out = eng.drain()
+        wall = time.perf_counter() - t0
+        streams[tp] = [out[r] for r in rids]
+        points.append({
+            "tp": tp,
+            "decode_tokens_per_s": round(useful / wall, 1),
+            "makespan_s": round(wall, 3),
+            "kv_pool_mb": round(pool_bytes / 1e6, 3),
+            "kv_pool_mb_per_shard": round(
+                kv_shard_bytes(cfg, scfg, scfg.n_blocks, tp) / 1e6, 3),
+        })
+    for tp in tps:
+        if streams[tp] != streams[tps[0]]:
+            raise RuntimeError(
+                f"greedy token streams diverged between tp={tps[0]} and "
+                f"tp={tp} — the docs/parity.md token-identity contract is "
+                "broken")
+    return {
+        "config": {"slots": scfg.slots, "block_size": scfg.block_size,
+                   "n_blocks": scfg.n_blocks, "kv_heads": cfg.kv_heads,
+                   "n_requests": n_requests, "useful_tokens": useful},
+        "points": points,
+        "greedy_streams_identical_across_tp": True,
+        "kv_shard_fraction_at_max_tp": round(
+            points[-1]["kv_pool_mb_per_shard"] / points[-1]["kv_pool_mb"],
+            4),
+    }
+
+
 def bench_transport(n_objects: int = 200, rounds: int = 3) -> dict:
     """Small-object PUT/GET/DELETE ops/s against the loopback GCS emulator,
     plus the emulator-side count of TCP connections that served them: the
@@ -1221,6 +1306,9 @@ def main() -> int:
     ring = bench_ring_schedule()
     generation = bench_generation()
     serving = bench_serving()
+    # Needs >= 8 devices (real chips or a forced-host CPU platform); a
+    # single-device full bench reports the section as skipped.
+    serving["multichip"] = bench_serving_multichip()
     transport = bench_transport()
     data_plane = bench_data_plane()
     steady_state = bench_steady_state()
@@ -1262,19 +1350,77 @@ def main() -> int:
     return 0
 
 
+def _ensure_host_devices(n: int) -> None:
+    """Force an ``n``-device virtual CPU host platform for the multichip
+    serving points. Must run BEFORE jax initializes (bench sections import
+    jax lazily, so dispatch-time is early enough); a real multi-chip
+    backend is left alone — the flag only affects the CPU platform."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()
+
+
+def _parse_args(argv):
+    """Subcommand dispatch: no subcommand = the full headline bench; each
+    section runs standalone with composable flags (the old exact-match
+    `sys.argv == ["serving"]` dispatch could not take a flag)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="bench.py",
+        description="Headline benchmark: one JSON line (no subcommand), "
+                    "or a single section standalone.")
+    sub = parser.add_subparsers(dest="section")
+    sub.add_parser("recovery",
+                   help="chaos-recovery MTTR section only")
+    sub.add_parser("steady_state",
+                   help="requests/tick steady-state section only "
+                        "(also `make bench-steady`)")
+    serving = sub.add_parser(
+        "serving",
+        help="continuous-batching vs generate section only "
+             "(also `make bench-serving`), plus the tensor-parallel "
+             "multichip sub-section")
+    serving.add_argument("--requests", type=int, default=36,
+                         help="workload size for the single-chip section")
+    serving.add_argument("--seed", type=int, default=0)
+    serving.add_argument(
+        "--tp", default=None, metavar="W[,W...]",
+        help="comma-separated tensor-parallel widths for the multichip "
+             "sub-section (default 1,8). Passing the flag EXPLICITLY also "
+             "forces a virtual multi-device CPU platform — which skews the "
+             "single-chip section's absolute numbers (each virtual device "
+             "gets a slice of the host's threads), so the default leaves "
+             "the platform alone and the sub-section reports skipped "
+             "unless enough devices exist (`make multichip` passes "
+             "--tp 1,8)")
+    serving.add_argument("--no-multichip", action="store_true",
+                         help="skip the tensor-parallel sub-section")
+    return parser.parse_args(argv)
+
+
 if __name__ == "__main__":
-    # `python bench.py recovery` runs just the chaos-recovery section — the
-    # fast way to re-measure MTTR (or replay a soak) without the full bench.
-    # `python bench.py steady_state` runs just the requests/tick section
-    # (also `make bench-steady`). `python bench.py serving` runs just the
-    # continuous-batching-vs-generate section (also `make bench-serving`).
-    if sys.argv[1:] == ["recovery"]:
+    args = _parse_args(sys.argv[1:])
+    if args.section == "recovery":
         print(json.dumps({"recovery": bench_recovery()}))
         raise SystemExit(0)
-    if sys.argv[1:] == ["steady_state"]:
+    if args.section == "steady_state":
         print(json.dumps({"steady_state": bench_steady_state()}))
         raise SystemExit(0)
-    if sys.argv[1:] == ["serving"]:
-        print(json.dumps({"serving": bench_serving()}))
+    if args.section == "serving":
+        tps = tuple(int(t) for t in str(args.tp or "1,8").split(",")
+                    if t.strip())
+        # Force virtual devices only on an EXPLICIT --tp: the single-chip
+        # section's numbers must stay comparable with prior captures, and
+        # splitting the host into 8 XLA CPU devices changes them.
+        if args.tp is not None and not args.no_multichip \
+                and max(tps, default=1) > 1:
+            _ensure_host_devices(max(tps))
+        result = bench_serving(n_requests=args.requests, seed=args.seed)
+        if not args.no_multichip:
+            result["multichip"] = bench_serving_multichip(
+                tps=tps, seed=args.seed)
+        print(json.dumps({"serving": result}))
         raise SystemExit(0)
     raise SystemExit(main())

@@ -41,6 +41,128 @@ def test_logical_rules_drop_missing_axes():
     assert batch == PartitionSpec(("dp",), None)
 
 
+# -- partition registry: rule resolution + the compile seam -------------------
+
+
+def test_match_partition_rules_regex_over_paths():
+    """Regex rules resolve a tree WITHOUT logical annotations (the paged
+    pools' case): "/"-joined paths, first match wins, logical targets go
+    through the same table as annotations."""
+    tree = [{"k": jnp.zeros((8, 4, 4, 2)), "v": jnp.zeros((8, 4, 4, 2))}
+            for _ in range(2)]
+    mesh = meshlib.make_mesh(8, axis_names=("tp",), axis_sizes=(8,))
+    specs = sharding.match_partition_rules(
+        ((r"(^|/)[kv]$", (None, None, "heads", None)),), tree, mesh=mesh)
+    for layer in specs:
+        assert layer["k"] == PartitionSpec(None, None, "tp", None)
+        assert layer["v"] == PartitionSpec(None, None, "tp", None)
+
+
+def test_match_partition_rules_logical_annotation_beats_regex():
+    """A logical-axis annotation wins over a regex that also matches — the
+    annotation sits next to the parameter definition and is the model's
+    source of truth; regex covers the unannotated rest."""
+    mesh = meshlib.make_mesh(8)
+    tree = {"wq": jnp.zeros((8, 8)), "wz": jnp.zeros((8, 8))}
+    specs = sharding.match_partition_rules(
+        ((r"^w", ("mlp", None)),), tree, mesh=mesh,
+        logical_axes={"wq": ("embed", "heads"), "wz": None})
+    assert specs["wq"] == PartitionSpec("fsdp", "tp")   # annotation
+    assert specs["wz"] == PartitionSpec("tp", None)     # regex fallback
+
+
+def test_match_partition_rules_scalars_replicate():
+    """Scalar / single-element leaves (optimizer counts, schedules) never
+    partition, whatever the rules say."""
+    tree = {"count": jnp.zeros(()), "one": jnp.zeros((1,)),
+            "big": jnp.zeros((8, 8))}
+    specs = sharding.match_partition_rules(
+        ((r".", ("embed", "heads")),), tree,
+        mesh=meshlib.make_mesh(8))
+    assert specs["count"] == PartitionSpec()
+    assert specs["one"] == PartitionSpec()
+    assert specs["big"] == PartitionSpec("fsdp", "tp")
+
+
+def test_match_partition_rules_unmatched_raises_with_path():
+    """An unmatched parameter fails LOUDLY, naming its tree path — silent
+    replication of a new 10B-param tensor is the failure mode this guards."""
+    tree = {"layers": [{"mystery": jnp.zeros((4, 4))}]}
+    with pytest.raises(ValueError, match=r"layers/0/mystery"):
+        sharding.match_partition_rules(
+            ((r"(^|/)wq$", ("embed", "heads")),), tree)
+
+
+def test_match_partition_rules_drops_missing_mesh_axes():
+    """Mesh axes absent from the target mesh drop to None — one rules
+    table serves every mesh shape, for raw-PartitionSpec targets too."""
+    mesh = meshlib.make_mesh(8, axis_names=("dp", "tp"), axis_sizes=(4, 2))
+    tree = {"a": jnp.zeros((4, 4)), "b": jnp.zeros((4, 4))}
+    specs = sharding.match_partition_rules(
+        ((r"^a$", ("embed", "heads")),          # embed→fsdp: not in mesh
+         (r"^b$", PartitionSpec("pp", "tp"))),  # raw spec, pp not in mesh
+        tree, mesh=mesh)
+    assert specs["a"] == PartitionSpec(None, "tp")
+    assert specs["b"] == PartitionSpec(None, "tp")
+
+
+def test_compile_step_modes_agree_with_eager():
+    """The one compile seam: no-mesh plans are plain jit, jit-mode plans
+    pin shardings, shard_map-mode plans run per shard — all three compute
+    the same numbers for a collective-free fn."""
+    mesh = meshlib.make_mesh(8, axis_names=("tp",), axis_sizes=(8,))
+    x = jnp.arange(8 * 4, dtype=jnp.float32).reshape(8, 4)
+
+    def fn(x):
+        return x * 2.0 + 1.0
+
+    ref = fn(x)
+    plain = sharding.compile_step(fn, sharding.PartitionPlan())(x)
+    spec = PartitionSpec("tp", None)
+    jitted = sharding.compile_step(fn, sharding.PartitionPlan(
+        mesh=mesh, in_specs=(spec,), out_specs=spec))(x)
+    mapped = sharding.compile_step(fn, sharding.PartitionPlan(
+        mesh=mesh, mode="shard_map", in_specs=(spec,), out_specs=spec))(x)
+    for out in (plain, jitted, mapped):
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    assert jitted.sharding.spec == spec
+    with pytest.raises(ValueError, match="mode"):
+        sharding.PartitionPlan(mode="pmap")
+
+
+def test_gqa_shard_map_core_bit_exact_per_slice():
+    """The gqa core under shard_map (kv heads over tp) is bit-exact against
+    running the core on each head slice separately — no cross-shard
+    reduction exists, so sharding cannot change a bit. (vs the MONOLITHIC
+    full-width program it is tolerance-only: XLA schedules the fused
+    einsum differently — the documented split in docs/parity.md.)"""
+    from tpu_task.ml.ops.attention import (
+        gqa_cached_attention,
+        gqa_cached_attention_tp,
+    )
+
+    mesh = meshlib.make_mesh(8, axis_names=("tp",), axis_sizes=(8,))
+    rng = np.random.default_rng(3)
+    b, s, h, kv, L, d = 2, 1, 8, 8, 16, 8
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((b, L, kv, d)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((b, L, kv, d)), jnp.float32)
+    pos = jnp.asarray([[5], [9]])
+    out = np.asarray(gqa_cached_attention_tp(q, kc, vc, pos, mesh))
+    hs, kvs = h // 8, kv // 8
+    jit_core = jax.jit(gqa_cached_attention)   # compiled, like the shards
+    per_slice = np.concatenate([
+        np.asarray(jit_core(
+            q[:, :, i * hs:(i + 1) * hs], kc[:, :, i * kvs:(i + 1) * kvs],
+            vc[:, :, i * kvs:(i + 1) * kvs], pos))
+        for i in range(8)], axis=2)
+    assert (out == per_slice).all()
+    np.testing.assert_allclose(
+        out, np.asarray(gqa_cached_attention(q, kc, vc, pos)), atol=1e-6)
+    with pytest.raises(ValueError, match="kv_heads"):
+        gqa_cached_attention_tp(q, kc[:, :, :6], vc[:, :, :6], pos, mesh)
+
+
 def test_sharded_train_step_matches_single_device():
     """The dp/fsdp/tp-sharded step computes the same numbers as 1 device."""
     mesh = meshlib.make_mesh(8)

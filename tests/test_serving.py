@@ -149,6 +149,117 @@ def test_short_request_completes_before_long(params):
     assert len(out[long_rid]) == 40 and len(out[short_rid]) == 3
 
 
+# -- tensor-parallel serving (8-device virtual mesh, kv heads over tp) -------
+
+# kv_heads == 8 so tp=8 gives every shard one kv head (its whole query
+# group rides along: n_heads % kv_heads == 0 keeps groups contiguous).
+TP8 = transformer.TransformerConfig(
+    vocab_size=64, d_model=32, n_layers=2, n_heads=8, d_head=8, d_ff=64,
+    dtype=jnp.float32, n_kv_heads=8)
+
+
+def _tp_mesh(n=8):
+    from tpu_task.ml.parallel.mesh import make_mesh
+
+    return make_mesh(n, axis_names=("tp",), axis_sizes=(n,))
+
+
+@pytest.mark.perf
+def test_engine_tp8_greedy_matches_single_chip():
+    """Tier-1 sharded-serving smoke: the tp=8 engine's greedy token streams
+    are IDENTICAL to the single-chip engine's on the same requests — mixed
+    lengths, slot reuse, lazy block growth, pools donated and kv-head
+    sharded. (Logits agree to accumulation-order tolerance; token identity
+    is the pinned contract — docs/parity.md.)"""
+    params = transformer.init(jax.random.PRNGKey(0), TP8)
+    scfg = ServingConfig(slots=3, block_size=4, n_blocks=32, max_len=32,
+                         prefill_buckets=(8, 16))
+    rng = np.random.default_rng(0)
+    reqs = [(rng.integers(0, TP8.vocab_size, size=plen), new)
+            for plen, new in [(5, 6), (8, 3), (12, 9), (3, 12), (16, 8)]]
+
+    def run(mesh):
+        eng = ServingEngine(params, TP8, scfg, mesh=mesh)
+        rids = [eng.submit(p, n) for p, n in reqs]
+        out = eng.drain()
+        assert eng.allocator.in_use == 0
+        return [out[r] for r in rids], eng
+
+    single, _ = run(None)
+    sharded, eng = run(_tp_mesh())
+    assert single == sharded
+    # The pools really shard: kv-head axis over tp, 1/8 of the bytes per
+    # device, and the donated round-trip kept the layout.
+    from jax.sharding import PartitionSpec
+
+    k0 = eng.pools[0]["k"]
+    assert k0.sharding.spec == PartitionSpec(None, None, "tp", None)
+    assert k0.addressable_shards[0].data.nbytes * 8 == k0.nbytes
+    assert eng.stats()["kv_pool_bytes_per_shard"] * 8 == \
+        eng.stats()["kv_pool_bytes"]
+
+
+def test_engine_mesh_validation_rejects_indivisible_kv_heads(params):
+    """TINY has kv_heads=2: an 8-way tp mesh cannot shard the pool's
+    kv-head axis — loud error at construction, not a wrong answer later."""
+    with pytest.raises(ValueError, match="kv_heads"):
+        ServingEngine(params, TINY, ServingConfig(), mesh=_tp_mesh())
+
+
+def test_engine_tp8_decodes_pool_exceeding_single_chip_budget():
+    """THE multichip exit criterion: a KV pool bigger than one chip's
+    (notional) budget decodes across tp=8, each device holding exactly 1/8
+    of the pool — the serving analogue of model-parallel training."""
+    from tpu_task.ml.serving.cache import kv_shard_bytes, paged_cache_bytes
+
+    budget = 8 * 1024 * 1024          # per-"chip" KV budget for this test
+    cfg = transformer.TransformerConfig(
+        vocab_size=64, d_model=64, n_layers=2, n_heads=8, d_head=16,
+        d_ff=64, dtype=jnp.float32, n_kv_heads=8)
+    scfg = ServingConfig(slots=2, block_size=8, n_blocks=1024, max_len=64,
+                         prefill_buckets=(8,))
+    pool_bytes = paged_cache_bytes(cfg, scfg, scfg.n_blocks)
+    assert pool_bytes > budget                      # won't fit one chip
+    assert kv_shard_bytes(cfg, scfg, scfg.n_blocks, 8) <= budget
+
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    eng = ServingEngine(params, cfg, scfg, mesh=_tp_mesh())
+    for layer in eng.pools:
+        for leaf in layer.values():
+            assert leaf.addressable_shards[0].data.nbytes * 8 == leaf.nbytes
+    prompt = np.random.default_rng(1).integers(0, cfg.vocab_size, size=5)
+    rid = eng.submit(prompt, 8)
+    out = eng.drain()[rid]
+    assert len(out) == 8
+    assert all(0 <= t < cfg.vocab_size for t in out)
+    assert eng.allocator.in_use == 0
+
+
+def test_engine_tp8_prefill_logits_match_to_tolerance():
+    """The tolerance half of the sharded-serving contract: tp-sharded
+    logits equal the single-chip program's to accumulation-order tolerance
+    (the wo/unembed contractions partial-sum across shards), while the
+    token streams above stay exactly equal."""
+    params = transformer.init(jax.random.PRNGKey(0), TP8)
+    scfg = ServingConfig(slots=2, block_size=4, n_blocks=16, max_len=16,
+                         prefill_buckets=(8,))
+    prompt = np.random.default_rng(2).integers(0, TP8.vocab_size, size=6)
+
+    def prefill_logits(mesh):
+        eng = ServingEngine(params, TP8, scfg, mesh=mesh)
+        table = np.zeros((scfg.max_blocks_per_slot,), np.int32)
+        table[:2] = eng.allocator.alloc(2)
+        padded = np.zeros((1, 8), np.int32)
+        padded[0, :len(prompt)] = prompt
+        logits, _pools = eng._prefill_fn(
+            eng.params, jnp.asarray(padded), jnp.int32(len(prompt)),
+            jnp.asarray(table), eng.pools)
+        return np.asarray(logits)
+
+    single, sharded = prefill_logits(None), prefill_logits(_tp_mesh())
+    np.testing.assert_allclose(single, sharded, atol=1e-5, rtol=1e-5)
+
+
 # -- scheduler behaviors -----------------------------------------------------
 
 def test_engine_sampling_deterministic_per_request_under_any_schedule(params):

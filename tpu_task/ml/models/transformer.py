@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from tpu_task.ml.ops.attention import dot_product_attention
-from tpu_task.ml.parallel.sharding import logical_to_mesh_axes
+from tpu_task.ml.parallel.sharding import logical_tree_pspecs
 
 Params = Dict[str, Any]
 
@@ -160,12 +160,11 @@ def param_logical_axes(cfg: TransformerConfig) -> Params:
 
 
 def param_pspecs(cfg: TransformerConfig, mesh=None, rules=None) -> Params:
-    axes = param_logical_axes(cfg)
-    return jax.tree.map(
-        lambda a: logical_to_mesh_axes(a, rules=rules, mesh=mesh),
-        axes,
-        is_leaf=lambda x: isinstance(x, tuple),
-    )
+    """PartitionSpecs for every parameter, resolved from the logical-axis
+    annotations through the shared partition registry — train-step state
+    sharding and the serving engine's weight placement both read THIS."""
+    return logical_tree_pspecs(param_logical_axes(cfg), mesh=mesh,
+                               rules=rules)
 
 
 # -- forward -----------------------------------------------------------------

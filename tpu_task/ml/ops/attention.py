@@ -124,6 +124,45 @@ def gqa_cached_attention(q, k_cache, v_cache, q_positions):
     return out.reshape(b, s, h, d)
 
 
+def gqa_cached_attention_tp(q, k_cache, v_cache, q_positions, mesh,
+                            axis_name: str = "tp"):
+    """The gqa cached core under ``shard_map`` — the per-shard spelling the
+    partition registry's ``mode="shard_map"`` plans compile to. The kv-head
+    axis shards over ``axis_name``; q's head axis shards with it (each kv
+    head's whole query group stays on its shard, so the grouped reshape
+    inside the core is local), positions replicate, and the output gathers
+    back at query-head width. No cross-shard reduction exists — softmax and
+    both einsums are per-kv-head — so the result is BIT-EXACT against
+    running the core on each head slice separately (pinned in
+    tests/test_ml_parallel.py). Against the MONOLITHIC unsharded program it
+    agrees only to kernel-scheduling tolerance: XLA may order the d-axis
+    contraction differently for the fused full-width einsum (the tolerance
+    half of the parity contract, docs/parity.md)."""
+    kv = k_cache.shape[2]
+    tp = dict(mesh.shape)[axis_name]
+    if kv % tp:
+        raise ValueError(f"kv_heads {kv} not divisible by {axis_name}={tp}")
+    return _gqa_tp_compiled(mesh, axis_name)(q, k_cache, v_cache,
+                                             q_positions)
+
+
+@functools.lru_cache(maxsize=None)
+def _gqa_tp_compiled(mesh, axis_name: str):
+    """One compiled shard_map program per (mesh, axis_name) — jit's own
+    cache covers shape variation inside it; without this memo every call
+    would rebuild the closure and retrace."""
+    from jax.sharding import PartitionSpec
+
+    from tpu_task.ml.parallel.sharding import PartitionPlan, compile_step
+
+    heads = PartitionSpec(None, None, axis_name, None)
+    plan = PartitionPlan(
+        mesh=mesh, mode="shard_map",
+        in_specs=(heads, heads, heads, PartitionSpec()),
+        out_specs=heads, check_vma=False)
+    return compile_step(gqa_cached_attention, plan)
+
+
 def mha_reference(q, k, v, causal: bool = True):
     """Plain XLA attention — the numerical ground truth for the kernels."""
     *_, d = q.shape

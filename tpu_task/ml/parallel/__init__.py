@@ -6,16 +6,26 @@ from tpu_task.ml.parallel.mesh import (
     make_mesh,
 )
 from tpu_task.ml.parallel.sharding import (
+    PartitionPlan,
+    compile_step,
+    device_put_tree,
     logical_to_mesh_axes,
+    match_partition_rules,
     named_sharding,
+    pspecs_to_shardings,
     shard_pytree,
 )
 
 __all__ = [
+    "PartitionPlan",
     "balanced_mesh_shape",
+    "compile_step",
+    "device_put_tree",
     "distributed_init_from_env",
     "logical_to_mesh_axes",
     "make_mesh",
+    "match_partition_rules",
     "named_sharding",
+    "pspecs_to_shardings",
     "shard_pytree",
 ]

@@ -1,13 +1,33 @@
-"""Logical-axis sharding rules (t5x/maxtext style).
+"""The repo's ONE partition engine: logical-axis rules, regex-over-path
+rules, and the compile seam every sharded program goes through.
 
-Model code annotates parameters with *logical* axis names; one rules table
-maps those to mesh axes. Changing the parallelism layout means changing the
-table, not the model.
+Three layers, each feeding the next:
+
+1. **Logical rules** (t5x/MaxText style): model code annotates parameters
+   with *logical* axis names (``transformer.param_logical_axes``); the
+   :data:`DEFAULT_RULES` table maps those to mesh axes. Changing the
+   parallelism layout means changing the table, not the model.
+2. **Rule resolution** (:func:`match_partition_rules`): turns a pytree of
+   arrays into a pytree of ``PartitionSpec`` — logical-axis annotations
+   where the tree carries them, regex-over-"/"-joined-path rules for trees
+   that don't (the paged KV pools, ad-hoc state), scalars replicated, and
+   a loud error naming any leaf nothing matched. Mesh axes absent from the
+   target mesh drop to ``None`` everywhere, so one rules table serves
+   every mesh shape.
+3. **The compile seam** (:class:`PartitionPlan` + :func:`compile_step`):
+   one function that turns (fn, plan) into the compiled program — plain
+   ``jit`` when the plan has no mesh, ``jit`` with
+   ``in_shardings``/``out_shardings`` derived from the plan's specs when it
+   does, or ``shard_map``-then-``jit`` when the plan demands per-shard
+   semantics (Titanax-style mode switch). Train and serve both compile
+   through here, so they cannot drift on donation/sharding plumbing.
 """
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Sequence, Tuple
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 from jax.sharding import NamedSharding, PartitionSpec
@@ -62,6 +82,17 @@ def logical_to_mesh_axes(
     return PartitionSpec(*(resolve(a) for a in logical_axes))
 
 
+def logical_tree_pspecs(axes_tree, mesh=None, rules=None):
+    """A whole pytree of logical-axis tuples → pytree of PartitionSpecs —
+    the annotated-tree half of rule resolution (``match_partition_rules``
+    is the unannotated half; both resolve through the same table)."""
+    return jax.tree.map(
+        lambda a: logical_to_mesh_axes(a, rules=rules, mesh=mesh),
+        axes_tree,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
 def mesh_batch_axes(mesh) -> Tuple[str, ...]:
     """The mesh axes the logical "batch" dim shards over, normalized to a
     (possibly empty) tuple — the one resolution every train-step builder
@@ -75,23 +106,206 @@ def mesh_batch_axes(mesh) -> Tuple[str, ...]:
     return (resolved,)
 
 
-def named_sharding(mesh, *spec) -> NamedSharding:
-    return NamedSharding(mesh, PartitionSpec(*spec))
+# -- regex-over-path rule resolution ------------------------------------------
+
+def tree_path_str(path) -> str:
+    """A tree_util key path as a "/"-joined name (``layers/0/wq``) — the
+    format regex partition rules match against."""
+    parts: List[str] = []
+    for key in path:
+        if hasattr(key, "key"):          # DictKey
+            parts.append(str(key.key))
+        elif hasattr(key, "idx"):        # SequenceKey
+            parts.append(str(key.idx))
+        elif hasattr(key, "name"):       # GetAttrKey / NamedTuple field
+            parts.append(str(key.name))
+        else:
+            parts.append(str(key))
+    return "/".join(parts)
 
 
-def shard_pytree(tree, pspec_tree, mesh):
-    """Place every leaf of ``tree`` per the matching PartitionSpec leaf."""
+def _leaf_size(leaf) -> int:
+    size = 1
+    for dim in getattr(leaf, "shape", ()):
+        size *= int(dim)
+    return size
+
+
+def match_partition_rules(rules, tree, mesh=None, logical_axes=None,
+                          logical_rules=None):
+    """Resolve a PartitionSpec for every array leaf of ``tree``.
+
+    Per leaf (its tree path "/"-joined, e.g. ``layers/0/wq`` or ``0/k``),
+    resolution order:
+
+    1. scalar leaves (0-d or single-element — optimizer counts, schedule
+       state) replicate: ``PartitionSpec()``;
+    2. a **logical-axis annotation** — ``logical_axes`` is a matching
+       pytree of logical-axis tuples (``transformer.param_logical_axes``
+       style) — wins over any regex: annotations sit next to the parameter
+       definition and are the model's source of truth;
+    3. else the FIRST entry of ``rules`` whose regex ``re.search``-matches
+       the path wins. ``rules`` is a sequence of ``(pattern, target)``
+       where ``target`` is either a tuple of LOGICAL axis names (resolved
+       through the same table as annotations) or a raw ``PartitionSpec``
+       (mesh axes used verbatim);
+    4. nothing matched → ``ValueError`` naming the offending path, so a
+       new parameter cannot silently replicate.
+
+    Mesh axes absent from ``mesh`` drop to None in every case (the
+    missing-axis contract of :func:`logical_to_mesh_axes`).
+    """
+    rules = tuple(rules or ())
+    annotations: Dict[str, Any] = {}
+    if logical_axes is not None:
+        flat, _ = jax.tree_util.tree_flatten_with_path(
+            logical_axes,
+            is_leaf=lambda x: isinstance(x, tuple) or x is None)
+        for path, axes in flat:
+            annotations[tree_path_str(path)] = axes
+
+    def resolve(path, leaf):
+        name = tree_path_str(path)
+        if getattr(leaf, "ndim", None) == 0 or _leaf_size(leaf) == 1:
+            return PartitionSpec()
+        axes = annotations.get(name)
+        if axes is not None:
+            return logical_to_mesh_axes(axes, rules=logical_rules, mesh=mesh)
+        for pattern, target in rules:
+            if re.search(pattern, name):
+                if isinstance(target, PartitionSpec):
+                    return filter_spec(target, mesh)
+                return logical_to_mesh_axes(target, rules=logical_rules,
+                                            mesh=mesh)
+        raise ValueError(
+            f"no partition rule matched param {name!r} "
+            f"(shape {tuple(getattr(leaf, 'shape', ()))}); add a regex rule "
+            f"or a logical-axis annotation for it")
+
+    return jax.tree_util.tree_map_with_path(resolve, tree)
+
+
+def filter_spec(spec: PartitionSpec, mesh) -> PartitionSpec:
+    """Drop mesh axes absent from ``mesh`` out of a raw PartitionSpec —
+    the same missing-axis contract logical resolution has."""
+    if mesh is None:
+        return spec
+    names = set(mesh.axis_names)
+
+    def fix(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, tuple):
+            kept = tuple(a for a in entry if a in names)
+            return kept if kept else None
+        return entry if entry in names else None
+
+    return PartitionSpec(*(fix(e) for e in spec))
+
+
+# -- spec-tree plumbing (the one home for the is_leaf=PartitionSpec idiom) ----
+
+def _is_spec_leaf(x) -> bool:
+    return isinstance(x, PartitionSpec) or x is None
+
+
+def pspecs_to_shardings(pspec_tree, mesh):
+    """PartitionSpec tree → NamedSharding tree (jit in/out_shardings)."""
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        pspec_tree,
+        is_leaf=_is_spec_leaf,
+    )
+
+
+def device_put_tree(tree, pspec_tree, mesh):
+    """Place every leaf of ``tree`` per the matching PartitionSpec leaf —
+    the one device_put used by train state AND the serving pools."""
     return jax.tree.map(
         lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
         tree,
         pspec_tree,
-        is_leaf=lambda x: x is None,
+        is_leaf=_is_spec_leaf,
     )
 
 
-def pspecs_to_shardings(pspec_tree, mesh):
-    return jax.tree.map(
-        lambda spec: NamedSharding(mesh, spec),
-        pspec_tree,
-        is_leaf=lambda x: isinstance(x, PartitionSpec) or x is None,
-    )
+def spec_leaves_with_paths(pspec_tree) -> List[Tuple[Tuple[str, ...], PartitionSpec]]:
+    """Flatten a spec tree to [(path-key strings, spec)] — the shared
+    flatten the optimizer-state suffix matcher (train._opt_specs_like)
+    and any other spec-tree consumer use."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(
+        pspec_tree, is_leaf=lambda x: isinstance(x, PartitionSpec))
+    return [(tuple(str(k) for k in path), spec) for path, spec in flat]
+
+
+def shard_pytree(tree, pspec_tree, mesh):
+    """Legacy alias of :func:`device_put_tree` (kept for importers)."""
+    return device_put_tree(tree, pspec_tree, mesh)
+
+
+def named_sharding(mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+# -- the compile seam ---------------------------------------------------------
+
+@dataclass(frozen=True)
+class PartitionPlan:
+    """Everything :func:`compile_step` needs to compile one program.
+
+    ``in_specs``: a tuple with one PartitionSpec-pytree per positional
+    argument (a bare ``PartitionSpec`` is a valid pytree: it pins the whole
+    argument); ``out_specs``: same for the result. ``donate``: argnums
+    whose buffers the program may consume in place (the KV pools, the train
+    state). ``mode``:
+
+    - ``"jit"`` (default): one SPMD program — ``jax.jit`` with
+      ``in_shardings``/``out_shardings`` derived from the specs; XLA
+      inserts the collectives the shardings imply.
+    - ``"shard_map"``: per-shard semantics — the fn body runs once per
+      shard with the specs as ``shard_map`` in/out specs (collectives are
+      explicit in the body), then the whole map is jitted.
+
+    ``mesh=None`` means single-device: specs are ignored and the fn is
+    plainly jitted (with donation), so every call site can build a plan
+    unconditionally and let the seam pick.
+    """
+
+    mesh: Any = None
+    in_specs: Tuple[Any, ...] = ()
+    out_specs: Any = None
+    donate: Tuple[int, ...] = ()
+    mode: str = "jit"
+    check_vma: Optional[bool] = field(default=None)
+
+    def __post_init__(self):
+        if self.mode not in ("jit", "shard_map"):
+            raise ValueError(
+                f"unknown PartitionPlan mode {self.mode!r} "
+                "(use 'jit' or 'shard_map')")
+
+
+def compile_step(fn, plan: Optional[PartitionPlan] = None):
+    """The one compile seam: (fn, plan) → compiled program.
+
+    See :class:`PartitionPlan` for the mode semantics. Train-step builders
+    and the serving engine both compile through here — donation, sharding
+    derivation, and the jit/shard_map switch live in exactly one place.
+    """
+    if plan is None:
+        return jax.jit(fn)
+    if plan.mesh is None:
+        return jax.jit(fn, donate_argnums=plan.donate)
+    if plan.mode == "jit":
+        return jax.jit(
+            fn,
+            in_shardings=tuple(
+                pspecs_to_shardings(spec, plan.mesh) for spec in plan.in_specs),
+            out_shardings=pspecs_to_shardings(plan.out_specs, plan.mesh),
+            donate_argnums=plan.donate,
+        )
+    from tpu_task.ml.parallel.mesh import shard_map
+
+    mapped = shard_map(fn, plan.mesh, in_specs=plan.in_specs,
+                       out_specs=plan.out_specs, check_vma=plan.check_vma)
+    return jax.jit(mapped, donate_argnums=plan.donate)

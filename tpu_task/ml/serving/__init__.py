@@ -18,15 +18,19 @@ are pinned identical to ``generate``'s in the tier-1 suite.
 
 from tpu_task.ml.serving.cache import (
     SCRATCH_BLOCK,
+    SERVING_POOL_RULES,
     BlockAllocator,
     ServingConfig,
     dense_cache_bytes,
     init_pools,
+    kv_shard_bytes,
     kv_token_bytes,
     paged_cache_bytes,
+    pool_pspecs,
 )
 from tpu_task.ml.serving.engine import Request, ServingEngine
 from tpu_task.ml.serving.model import (
+    greedy_decode_step,
     paged_decode_step,
     paged_prefill,
     sample_tokens,
@@ -34,15 +38,19 @@ from tpu_task.ml.serving.model import (
 
 __all__ = [
     "SCRATCH_BLOCK",
+    "SERVING_POOL_RULES",
     "BlockAllocator",
     "Request",
     "ServingConfig",
     "ServingEngine",
     "dense_cache_bytes",
+    "greedy_decode_step",
     "init_pools",
+    "kv_shard_bytes",
     "kv_token_bytes",
     "paged_cache_bytes",
     "paged_decode_step",
     "paged_prefill",
+    "pool_pspecs",
     "sample_tokens",
 ]

@@ -124,6 +124,35 @@ def init_pools(cfg: TransformerConfig, scfg: ServingConfig) -> List[dict]:
             for _ in range(cfg.n_layers)]
 
 
+#: Regex partition rules for the paged pools — the pool pytree carries no
+#: logical-axis annotations (it is built here, not by the model), so the
+#: registry's regex-over-path half covers it: every ``<layer>/k`` and
+#: ``<layer>/v`` leaf is ``(n_blocks, block_size, kv_heads, d_head)`` and
+#: shards its KV-HEAD axis wherever the "heads" logical axis goes (tp).
+#: Paging stays along the token axis, so block accounting — tables,
+#: allocator, scratch block — is identical at every tp width.
+SERVING_POOL_RULES = (
+    (r"(^|/)[kv]$", (None, None, "heads", None)),
+)
+
+
+def pool_pspecs(pools, mesh) -> List[dict]:
+    """PartitionSpecs for the pool pytree via the shared partition registry
+    (kv-heads over tp; block grid, block offset, and head_dim replicated)."""
+    from tpu_task.ml.parallel.sharding import match_partition_rules
+
+    return match_partition_rules(SERVING_POOL_RULES, pools, mesh=mesh)
+
+
+def kv_shard_bytes(cfg: TransformerConfig, scfg: ServingConfig,
+                   n_blocks: int, tp: int) -> int:
+    """Per-device bytes of ``n_blocks`` physical blocks under a ``tp``-way
+    kv-head shard: each device holds ``kv_heads / tp`` heads of every
+    block, so the pool cost divides by tp exactly (kv_heads % tp == 0 is
+    validated at engine construction)."""
+    return paged_cache_bytes(cfg, scfg, n_blocks) // max(1, tp)
+
+
 # -- traced indexing helpers (used inside the jitted serving steps) ----------
 
 def flat_pool(pool):

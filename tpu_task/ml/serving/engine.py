@@ -38,30 +38,32 @@ from typing import Dict, List, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec
 
+from tpu_task.ml.models import transformer
 from tpu_task.ml.models.transformer import Params, TransformerConfig
+from tpu_task.ml.parallel.sharding import (
+    PartitionPlan,
+    compile_step,
+    device_put_tree,
+)
 from tpu_task.ml.serving.cache import (
     SCRATCH_BLOCK,
     BlockAllocator,
     ServingConfig,
     init_pools,
+    kv_shard_bytes,
     paged_cache_bytes,
+    pool_pspecs,
 )
 from tpu_task.ml.serving.model import (
     decode_and_sample,
+    greedy_decode_step,
     paged_prefill,
     sample_tokens,
 )
 
 QUEUED, RUNNING, DONE = "queued", "running", "done"
-
-
-def _greedy_step(params, cfg, tokens, positions, tables, active, pools):
-    from tpu_task.ml.serving.model import paged_decode_step
-
-    logits, new_pools = paged_decode_step(
-        params, cfg, tokens, positions, tables, active, pools)
-    return jnp.argmax(logits, axis=-1).astype(jnp.int32), new_pools
 
 
 @dataclass
@@ -92,15 +94,47 @@ class Request:
 
 class ServingEngine:
     """Front end: :meth:`submit` → request id, :meth:`poll` → status/tokens,
-    :meth:`step` → one scheduler iteration, :meth:`drain` → run to empty."""
+    :meth:`step` → one scheduler iteration, :meth:`drain` → run to empty.
+
+    ``mesh=`` turns on tensor-parallel serving: weights shard per the
+    logical rules (heads/mlp/vocab over ``tp``), the paged KV pools shard
+    their kv-head axis over ``tp`` (so per-device KV bytes divide by tp —
+    a model whose KV pool exceeds one chip decodes across the mesh), and
+    the scheduler is UNCHANGED: block tables, positions, and masks
+    replicate, and paging stays along the token axis. Requires
+    ``cfg.kv_heads % tp == 0``. Greedy token streams are schedule- and
+    shard-identical to the single-chip engine on small configs (pinned in
+    tier-1); logits agree to accumulation-order tolerance (docs/parity.md)."""
 
     def __init__(self, params: Params, cfg: TransformerConfig,
                  scfg: Optional[ServingConfig] = None,
-                 rng: Optional[jax.Array] = None):
-        self.params = params
+                 rng: Optional[jax.Array] = None, mesh=None):
         self.cfg = cfg
         self.scfg = scfg = scfg or ServingConfig()
-        self.pools = init_pools(cfg, scfg)
+        self.mesh = mesh
+        self.tp = 1
+        pools = init_pools(cfg, scfg)
+        if mesh is None:
+            self.params = params
+            self.pools = pools
+        else:
+            # Tensor-parallel serving: weights lay out per the SAME logical
+            # rules training uses (param_pspecs), the paged pools shard
+            # their kv-head axis over tp (pool_pspecs, regex registry), and
+            # everything the host scheduler owns — tokens, positions, block
+            # tables, active masks, sampling params — replicates. Paging is
+            # along the token axis, so block accounting (allocator, tables,
+            # scratch block) is IDENTICAL at every tp width.
+            self.tp = int(dict(mesh.shape).get("tp", 1))
+            if cfg.kv_heads % self.tp:
+                raise ValueError(
+                    f"kv_heads {cfg.kv_heads} not divisible by tp "
+                    f"{self.tp} (mesh axes {tuple(mesh.axis_names)}): the "
+                    "paged pools shard their kv-head axis over tp")
+            self._param_specs = transformer.param_pspecs(cfg, mesh=mesh)
+            self._pool_specs = pool_pspecs(pools, mesh)
+            self.params = device_put_tree(params, self._param_specs, mesh)
+            self.pools = device_put_tree(pools, self._pool_specs, mesh)
         self.allocator = BlockAllocator(scfg.n_blocks)
         self.debug = os.environ.get("TPU_TASK_CHECKIFY", "") == "1"
 
@@ -124,28 +158,44 @@ class ServingEngine:
         # its reference with the returned ones, so XLA updates the block
         # pool in place — without donation every step would copy the whole
         # pool, the one cost generate's in-scan cache carry never pays.
-        self._prefill_fn = self._wrap(jax.jit(
+        # Every program compiles through the shared seam
+        # (sharding.compile_step): single-device plans are plain jit, mesh
+        # plans pin weight/pool shardings and keep the donation — the same
+        # seam the train-step builders use.
+        rep = PartitionSpec()
+
+        def plan(arg_specs, donate):
+            if mesh is None:
+                return PartitionPlan(donate=donate)
+            return PartitionPlan(
+                mesh=mesh, in_specs=arg_specs,
+                out_specs=(rep, self._pool_specs), donate=donate)
+
+        p_specs = getattr(self, "_param_specs", None)
+        k_specs = getattr(self, "_pool_specs", None)
+        self._prefill_fn = self._wrap(compile_step(
             lambda params, tokens, length, table, pools: paged_prefill(
                 params, cfg, tokens, length, table, pools),
-            donate_argnums=(4,)))
+            plan((p_specs, rep, rep, rep, k_specs), (4,))))
         # One fused program per decode iteration: forward + in-program key
         # fold + sampler — per-step dispatch overhead is the engine's whole
         # tax over generate's scan, so it is kept to a single call.
-        self._decode_fn = self._wrap(jax.jit(
+        self._decode_fn = self._wrap(compile_step(
             lambda params, tokens, positions, tables, active, temps, tops,
             keys, ngen, pools: decode_and_sample(
                 params, cfg, tokens, positions, tables, active, temps,
                 tops, keys, ngen, pools),
-            donate_argnums=(9,)))
+            plan((p_specs, rep, rep, rep, rep, rep, rep, rep, rep,
+                  k_specs), (9,))))
         # Greedy fast path: when every active slot decodes at temperature 0
         # (the common serving default and the whole bench), the sampler
         # reduces to argmax — no sort/cumsum/categorical/key-fold in the
         # step program.
-        self._decode_greedy_fn = self._wrap(jax.jit(
+        self._decode_greedy_fn = self._wrap(compile_step(
             lambda params, tokens, positions, tables, active, pools:
-            _greedy_step(params, cfg, tokens, positions, tables, active,
-                         pools),
-            donate_argnums=(5,)))
+            greedy_decode_step(params, cfg, tokens, positions, tables,
+                               active, pools),
+            plan((p_specs, rep, rep, rep, rep, k_specs), (5,))))
         self._prefill_sample_fn = self._wrap(jax.jit(
             lambda logits, temp, top, key, n: sample_tokens(
                 logits, temp, top, jax.random.fold_in(key, n)[None])))
@@ -401,11 +451,14 @@ class ServingEngine:
             "steps": self.steps,
             "decode_steps": self.decode_steps,
             "prefills": self.prefills,
+            "tp": self.tp,
             "kv_blocks_high_water": self.allocator.high_water,
             "kv_high_water_bytes": paged_cache_bytes(
                 self.cfg, self.scfg, self.allocator.high_water),
             "kv_pool_bytes": paged_cache_bytes(
                 self.cfg, self.scfg, self.scfg.n_blocks),
+            "kv_pool_bytes_per_shard": kv_shard_bytes(
+                self.cfg, self.scfg, self.scfg.n_blocks, self.tp),
             "kv_dense_worst_case_bytes": dense_cache_bytes(
                 self.cfg, self.scfg.slots, self.scfg.max_len),
         }

@@ -124,6 +124,17 @@ def paged_decode_step(params: Params, cfg: TransformerConfig, tokens,
     return logits.astype(jnp.float32), new_pools
 
 
+def greedy_decode_step(params: Params, cfg: TransformerConfig, tokens,
+                       positions, block_tables, active, pools):
+    """Fused decode + argmax: the greedy fast path of the engine — when
+    every active slot decodes at temperature 0 the sampler reduces to one
+    argmax and the step program carries no sort/cumsum/key-fold. Returns
+    ((slots,) int32 next tokens, pools)."""
+    logits, new_pools = paged_decode_step(
+        params, cfg, tokens, positions, block_tables, active, pools)
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), new_pools
+
+
 def decode_and_sample(params: Params, cfg: TransformerConfig, tokens,
                       positions, block_tables, active, temperature, top_p,
                       slot_keys, n_generated, pools):

@@ -16,7 +16,14 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec
 
 from tpu_task.ml.models import transformer
-from tpu_task.ml.parallel.sharding import logical_to_mesh_axes, mesh_batch_axes
+from tpu_task.ml.parallel.sharding import (
+    PartitionPlan,
+    compile_step,
+    device_put_tree,
+    logical_to_mesh_axes,
+    mesh_batch_axes,
+    spec_leaves_with_paths,
+)
 
 
 class TrainState(NamedTuple):
@@ -47,11 +54,7 @@ def _opt_specs_like(p_specs, opt_state):
     trace terms, ...); suffix matching is structural, so two same-shaped
     params with different layouts can't collide. Scalars (counts,
     schedules) fall through to replicated."""
-    param_paths = {}
-    for path, spec in jax.tree_util.tree_flatten_with_path(
-        p_specs, is_leaf=lambda x: isinstance(x, PartitionSpec)
-    )[0]:
-        param_paths[tuple(str(k) for k in path)] = spec
+    param_paths = dict(spec_leaves_with_paths(p_specs))
 
     def spec_for(path, leaf):
         keys = tuple(str(k) for k in path)
@@ -77,12 +80,7 @@ def state_pspecs(state: TrainState, cfg: transformer.TransformerConfig, mesh) ->
 def shard_state(state: TrainState, cfg, mesh) -> Tuple[TrainState, TrainState]:
     """Place a TrainState on the mesh; returns (sharded_state, pspecs)."""
     specs = state_pspecs(state, cfg, mesh)
-    sharded = jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-        state, specs,
-        is_leaf=lambda x: isinstance(x, PartitionSpec),
-    )
-    return sharded, specs
+    return device_put_tree(state, specs, mesh), specs
 
 
 def _token_shard_factor(mesh, activation_spec) -> int:
@@ -167,22 +165,19 @@ def make_train_step(cfg: transformer.TransformerConfig, optimizer=None, mesh=Non
         return new_state, {"loss": loss, "grad_norm": gnorm}
 
     if mesh is None:
-        return jax.jit(step, donate_argnums=(0,) if donate else ())
+        return compile_step(step, PartitionPlan(
+            donate=(0,) if donate else ()))
 
     batch_spec = logical_to_mesh_axes(("batch", "seq"), mesh=mesh)
 
     def jit_with_state(state: TrainState):
         specs = state_pspecs(state, cfg, mesh)
-        state_shardings = jax.tree.map(
-            lambda s: NamedSharding(mesh, s), specs,
-            is_leaf=lambda x: isinstance(x, PartitionSpec),
-        )
-        return jax.jit(
-            step,
-            in_shardings=(state_shardings, NamedSharding(mesh, batch_spec)),
-            out_shardings=(state_shardings, NamedSharding(mesh, PartitionSpec())),
-            donate_argnums=(0,) if donate else (),
-        )
+        return compile_step(step, PartitionPlan(
+            mesh=mesh,
+            in_specs=(specs, batch_spec),
+            out_specs=(specs, PartitionSpec()),
+            donate=(0,) if donate else (),
+        ))
 
     return jit_with_state
 
@@ -259,12 +254,7 @@ def pp_state_pspecs(state: TrainState, mesh, axis_name: str = "pp") -> TrainStat
 def shard_pp_state(state: TrainState, mesh,
                    axis_name: str = "pp") -> Tuple[TrainState, TrainState]:
     specs = pp_state_pspecs(state, mesh, axis_name)
-    sharded = jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-        state, specs,
-        is_leaf=lambda x: isinstance(x, PartitionSpec),
-    )
-    return sharded, specs
+    return device_put_tree(state, specs, mesh), specs
 
 
 def make_pp_train_step(cfg: transformer.TransformerConfig, mesh,
@@ -349,20 +339,14 @@ def make_pp_train_step(cfg: transformer.TransformerConfig, mesh,
 
     def jit_with_state(state: TrainState):
         specs = pp_state_pspecs(state, mesh, axis_name)
-        state_shardings = jax.tree.map(
-            lambda s: NamedSharding(mesh, s), specs,
-            is_leaf=lambda x: isinstance(x, PartitionSpec),
-        )
         token_spec = (PartitionSpec(batch_axes, None) if batch_axes
                       else PartitionSpec())
-        return jax.jit(
-            step,
-            in_shardings=(state_shardings,
-                          NamedSharding(mesh, token_spec)),
-            out_shardings=(state_shardings,
-                           NamedSharding(mesh, PartitionSpec())),
-            donate_argnums=(0,) if donate else (),
-        )
+        return compile_step(step, PartitionPlan(
+            mesh=mesh,
+            in_specs=(specs, token_spec),
+            out_specs=(specs, PartitionSpec()),
+            donate=(0,) if donate else (),
+        ))
 
     return jit_with_state
 
