@@ -8,7 +8,7 @@ PYTHON ?= python3
 # Seed for the chaos soak: any run is replayable by pinning this.
 TPU_TASK_CHAOS_SEED ?= 20260804
 
-.PHONY: test smoke sweep bench bench-steady bench-serving chaos wheel multichip kernels-tpu clean
+.PHONY: test smoke sweep bench bench-steady bench-serving bench-sched sched sched-soak chaos wheel multichip kernels-tpu clean
 
 # Hermetic suite (the reference's `make test`, 30 s budget there; ours spans
 # the fake control planes, sharded-compute CPU checks, and the loopback GCS
@@ -26,7 +26,10 @@ smoke:
 sweep:
 	SMOKE_TEST_SWEEP=1 $(PYTHON) -m pytest tests/test_smoke_real.py -m smoke -q
 
-# Headline benchmark: one JSON line (driver contract).
+# Headline benchmark: one JSON line (driver contract). The extra section
+# carries every subsystem's cost model, including the gang scheduler
+# (`scheduler`: queue-latency p50/p99, utilization, requeue fairness —
+# standalone via `make bench-sched` / `bench.py scheduler`).
 bench:
 	$(PYTHON) bench.py
 
@@ -41,6 +44,25 @@ bench-steady:
 # TTFT percentiles, KV high-water vs the dense worst case (runs on CPU).
 bench-serving:
 	$(PYTHON) bench.py serving
+
+# Gang-scheduler cost model only: queue-latency percentiles, pool
+# utilization, and per-tenant requeue fairness under Poisson arrivals on
+# the virtual clock (pure model; milliseconds per hundred tasks).
+bench-sched:
+	$(PYTHON) bench.py scheduler
+
+# Tier-1-speed gang-scheduler tests: queue/quota/pool model, fair-share
+# ordering, victim-order properties, CLI, bench smoke (all virtual-time).
+sched:
+	$(PYTHON) -m pytest tests/ -m "scheduler and not slow" -q
+
+# Fleet-scale soak: the 1000-task multi-tenant chaos soak (3 seeded
+# preemption waves + durable-queue restart, virtual clock) plus the
+# real-task integration test where a scheduler preemption rides the PR 3
+# requeue governor of live fake-mode agents. Replayable from the seed.
+sched-soak:
+	TPU_TASK_CHAOS_SEED=$(TPU_TASK_CHAOS_SEED) \
+		$(PYTHON) -m pytest tests/ -m "scheduler and slow" -q
 
 # Seeded fault-injection soak: preemptions + a hung worker + flaky storage
 # against the hermetic TPU control plane, replayable from the seed.

@@ -48,6 +48,7 @@ AGENT_SUBPROCESS_MODULES = {
     "test_cli",
     "test_frontend",
     "test_lifecycle_local",
+    "test_scheduler",
     "test_tpu_backend",
 }
 

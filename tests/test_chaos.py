@@ -580,3 +580,44 @@ def test_healthy_requeue_resets_recovery_budget(tpu_cloud, monkeypatch):
         assert "recovery-budget-exhausted" not in codes
     finally:
         task.delete()
+
+
+def test_deleted_then_recreated_task_starts_with_fresh_budget(tpu_cloud,
+                                                              monkeypatch):
+    """The governor record must die with the slice: delete()/budget
+    exhaustion prune `_requeue_state` (the heartbeat cache already prunes
+    dead incarnations), so a deleted-then-recreated task gets a FRESH
+    recovery budget instead of inheriting a latched `exhausted` — the leak
+    also grew the dict forever under task churn."""
+    monkeypatch.setenv("TPU_TASK_HEARTBEAT_STALE_AFTER", "0")
+    monkeypatch.setenv("TPU_TASK_REQUEUE_BACKOFF_BASE", "0")
+    monkeypatch.setenv("TPU_TASK_RECOVERY_BUDGET", "2")
+    monkeypatch.setenv("TPU_TASK_RECOVERY_HEALTHY_AFTER", "999")
+    task = _make_task(tpu_cloud, "budget-prune", run_workers=False)
+    task.create()
+    qr = task._qr_name(0)
+    try:
+        for _ in range(3):       # burn the budget, then trip exhaustion
+            _wait_active(task, qr)
+            task.client.preempt_node(qr)
+            task.read()
+        assert qr not in task.client.list_queued_resources()
+        # Exhaustion released the slice AND its governor record.
+        assert qr not in task._requeue_state
+        assert qr not in task._first_active
+
+        # Same task object, new life: delete + create must start clean.
+        task.delete()
+        assert task._requeue_state == {}
+        assert task._first_active == {}
+        task.create()
+        _wait_active(task, qr)
+        task.client.preempt_node(qr)
+        task.read()
+        # Fresh budget: attempt 1 of 2, no inherited latch — and the
+        # requeue actually went through the control plane again.
+        assert task._requeue_state[qr]["attempts"] == 1
+        assert not task._requeue_state[qr]["exhausted"]
+        _wait_active(task, qr)
+    finally:
+        task.delete()

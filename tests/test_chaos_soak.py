@@ -29,7 +29,12 @@ from tpu_task.common.values import (
     StatusCode,
     Task as TaskSpec,
 )
-from tpu_task.testing.chaos import ChaosSchedule, ChaosTpuClient, flaky_storage
+from tpu_task.testing.chaos import (
+    ChaosSchedule,
+    ChaosTpuClient,
+    flaky_storage,
+    preemption_wave_at,
+)
 from tpu_task import task as task_factory
 
 pytestmark = [pytest.mark.chaos, pytest.mark.slow]
@@ -173,6 +178,175 @@ def test_seeded_chaos_soak(tmp_path, monkeypatch):
         # Teardown outside the flaky-storage window: cleanup is not the
         # system under test.
         task.delete()
+
+
+@pytest.mark.scheduler
+def test_scheduler_chaos_soak_1000_tasks(tmp_path, monkeypatch):
+    """The fleet-scale soak: 1000 gangs, 4 tenants, Poisson arrivals, a
+    durable queue, a mid-soak scheduler restart, and ≥3 seeded preemption
+    waves through the chaos schedule — all on the virtual clock, so the
+    whole fleet runs in seconds of wall time and replays from one seed.
+
+    Invariants pinned at EVERY tick:
+      * no tenant's quota (chips or concurrent gangs) ever exceeded;
+      * no gang ever partially placed (whole-gang placements, domain
+        accounting exact);
+    and at the end:
+      * every submission reaches ``succeeded`` — or ``failed`` with the
+        durable ``recovery-budget-exhausted`` record (the deliberately
+        poisoned gangs, killed on sight, prove that path);
+      * fair-share deficit stays bounded: no tenant's deficit ever exceeds
+        its entitlement, and its time-averaged deficit stays a small
+        fraction of it — freed capacity really is re-offered by deficit.
+    """
+    from tpu_task.scheduler import (
+        CapacityPool, GangScheduler, SimGangDriver, TenantQuota,
+    )
+
+    seed = int(os.environ.get("TPU_TASK_CHAOS_SEED", "20260804"))
+    monkeypatch.setenv("TPU_TASK_RECOVERY_BUDGET", "6")
+    monkeypatch.setenv("TPU_TASK_REQUEUE_BACKOFF_BASE", "0.5")
+    monkeypatch.setenv("TPU_TASK_REQUEUE_BACKOFF_CAP", "8")
+
+    now = [0.0]
+    clock = lambda: now[0]  # noqa: E731 - the shared virtual clock
+    schedule = ChaosSchedule(seed=seed, now=clock)
+    rng = schedule.derive("scheduler-soak")
+    quotas = {
+        "prod": TenantQuota(chips=512, max_tasks=200, weight=3.0),
+        "batch": TenantQuota(chips=384, max_tasks=200, weight=1.0),
+        "research": TenantQuota(chips=384, max_tasks=200, weight=1.0),
+        "flaky": TenantQuota(chips=384, max_tasks=200, weight=1.0),
+    }
+    remote = str(tmp_path / "sched")
+
+    def fresh_plant():
+        driver = SimGangDriver(clock=clock, checkpoint_period=1.0)
+        scheduler = GangScheduler(CapacityPool([256] * 4), quotas, driver,
+                                  remote=remote, clock=clock)
+        return scheduler, driver
+
+    scheduler, driver = fresh_plant()
+    plant = {"scheduler": scheduler, "driver": driver}
+
+    n_tasks = 1000
+    tenants = sorted(quotas)
+    arrivals = []
+    stamp = 0.0
+    for index in range(n_tasks):
+        stamp += rng.expovariate(12.0)
+        arrivals.append((stamp, tenants[rng.randrange(len(tenants))],
+                         rng.choice(["v4-8", "v4-16", "v4-32"]),
+                         rng.randint(1, 2), rng.randrange(3),
+                         rng.uniform(4.0, 20.0)))
+    horizon = arrivals[-1][0]
+    # Gangs poisoned from birth: chaos kills them the moment they run, so
+    # they must burn their whole budget and fail DURABLY, never linger.
+    doomed = {f"task-{index:04d}" for index in rng.sample(range(n_tasks), 5)}
+
+    # Three seeded preemption waves through the chaos plane's scheduler
+    # seam; the driver_ref indirection survives the mid-soak restart.
+    wave_times = [horizon * (index + 1) / 4 for index in range(3)]
+    for wave_at in wave_times:
+        preemption_wave_at(schedule, wave_at, lambda: plant["driver"])
+    restart_at = wave_times[1] + 5.0
+    restarted = False
+
+    submitted = 0
+    deficit_integral = {tenant: 0.0 for tenant in quotas}
+    dt = 0.5
+    ticks = 0
+    while submitted < n_tasks or not plant["scheduler"].idle():
+        scheduler = plant["scheduler"]
+        while submitted < n_tasks and arrivals[submitted][0] <= now[0]:
+            _, tenant, accelerator, slices, priority, work = \
+                arrivals[submitted]
+            scheduler.submit(tenant, accelerator, slices=slices,
+                             priority=priority, work=work,
+                             task_id=f"task-{submitted:04d}")
+            submitted += 1
+        schedule.tick()
+        for task_id in plant["driver"].running_ids():
+            if task_id in doomed:
+                plant["driver"].kill(task_id)
+        scheduler.tick()
+
+        # -- invariants, every tick ---------------------------------------
+        pool = scheduler.pool
+        for tenant, quota in quotas.items():
+            chips = scheduler.queue.running_chips(tenant)
+            assert chips <= quota.chips, \
+                f"t={now[0]}: {tenant} at {chips} chips > quota {quota.chips}"
+            assert scheduler.queue.running_tasks(tenant) <= quota.max_tasks
+        placed_chips = 0
+        for task in scheduler.queue.placed():
+            placement = pool.placements.get(task.task_id)
+            assert placement is not None, \
+                f"placed task {task.task_id} holds no reservation"
+            assert len(placement.domains) == task.gang.slices, \
+                f"partial gang: {task.task_id}"
+            placed_chips += placement.total_chips
+        assert placed_chips == pool.used_chips
+        assert all(0 <= free <= cap
+                   for free, cap in zip(pool.free, pool.capacity))
+        for tenant, deficit in scheduler.deficits().items():
+            deficit_integral[tenant] += deficit * dt
+
+        if not restarted and now[0] >= restart_at:
+            # Scheduler process "dies" between ticks: a fresh one reloads
+            # the durable queue and carries the whole fleet forward.
+            restarted = True
+            plant["scheduler"], plant["driver"] = fresh_plant()
+            assert len(plant["scheduler"].queue.tasks) == submitted
+
+        now[0] += dt
+        ticks += 1
+        assert now[0] < 3000, "soak did not converge in virtual time"
+
+    scheduler = plant["scheduler"]
+    # ≥3 preemption waves actually fired (plus the per-tick doomed kills).
+    waves_fired = [fault for fault in schedule.injected
+                   if fault.kind == "wave"]
+    assert len(waves_fired) >= 3, schedule.pending()
+    assert restarted
+
+    # Every submission is terminal: succeeded, or failed with the durable
+    # budget-exhausted record. The poisoned gangs all exhausted.
+    states = {task.task_id: task for task in scheduler.queue.tasks.values()}
+    assert len(states) == n_tasks
+    for task in states.values():
+        assert task.state in ("succeeded", "failed"), \
+            f"{task.task_id} stuck in {task.state}"
+        if task.state == "failed":
+            assert task.failure == "recovery-budget-exhausted"
+    assert all(states[task_id].state == "failed" for task_id in doomed)
+    assert sum(1 for task in states.values()
+               if task.state == "failed") <= len(doomed) + 25
+
+    # Preemption touched a meaningful slice of the fleet and every
+    # preempted gang still converged (completes-or-budget invariant).
+    preempted_ever = [task for task in states.values() if task.preemptions]
+    assert len(preempted_ever) >= 100
+    assert all(task.state in ("succeeded", "failed")
+               for task in preempted_ever)
+
+    # Fair-share deficit bounded: never beyond entitlement (+ one gang of
+    # slack for the restart transient), time-average a small fraction.
+    total_weight = sum(quota.weight for quota in quotas.values())
+    for tenant, quota in quotas.items():
+        entitlement = 1024 * quota.weight / total_weight
+        assert scheduler.max_deficit.get(tenant, 0.0) <= entitlement + 32.0, \
+            f"{tenant} deficit {scheduler.max_deficit[tenant]} unbounded"
+        mean_deficit = deficit_integral[tenant] / now[0]
+        assert mean_deficit <= 0.35 * entitlement, \
+            f"{tenant} time-averaged deficit {mean_deficit:.1f} too high"
+
+    # The durable record agrees with memory: a fresh observer reloads the
+    # same terminal fleet (the CLI's `sched status` view).
+    observer, _ = fresh_plant()
+    assert {task_id: task.state
+            for task_id, task in observer.queue.tasks.items()} == {
+        task_id: task.state for task_id, task in states.items()}
 
 
 def test_soak_schedule_is_replayable():

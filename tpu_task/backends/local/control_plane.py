@@ -279,15 +279,22 @@ class MachineGroup:
             except (ProcessLookupError, PermissionError):
                 pass
 
-    def preempt(self, index: int = 0) -> None:
-        """Simulate a spot preemption: hard-kill one worker. The next
-        reconcile respawns it, restoring state from the bucket — the
-        hermetic equivalent of ASG spot-recovery."""
+    def preempt(self, index: int = 0, graceful: bool = False) -> None:
+        """Simulate a spot preemption of one worker. The next reconcile
+        respawns it, restoring state from the bucket — the hermetic
+        equivalent of ASG spot-recovery. ``graceful`` delivers the SIGTERM
+        preemption notice (agent stops the task, final-syncs, reports
+        ``preempted``) instead of a hard kill — the reclaim-warning shape
+        real clouds give, and what a scheduler-initiated eviction uses so
+        the worker's last state still lands in the bucket."""
         state = self._load()
         for worker in state.workers:
             if worker.index == index:
-                self._kill(worker)
-                self._log_event("preempt", f"worker {index} (pid {worker.pid}) preempted")
+                self._kill(worker, graceful=graceful)
+                self._log_event(
+                    "preempt",
+                    f"worker {index} (pid {worker.pid}) preempted"
+                    f"{' (graceful)' if graceful else ''}")
                 return
         raise ResourceNotFoundError(f"worker {index}")
 

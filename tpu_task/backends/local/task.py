@@ -135,9 +135,10 @@ class LocalTask(Task):
         return list(self.spec.addresses)
 
     # -- test/bench hooks ----------------------------------------------------
-    def preempt(self, index: int = 0) -> None:
-        """Simulate spot preemption of one worker (hermetic recovery tests)."""
-        self.group.preempt(index)
+    def preempt(self, index: int = 0, graceful: bool = False) -> None:
+        """Simulate spot preemption of one worker (hermetic recovery tests;
+        graceful = SIGTERM preemption notice, the scheduler's eviction path)."""
+        self.group.preempt(index, graceful=graceful)
 
 
 def list_local_tasks(cloud: Cloud) -> List[Identifier]:

@@ -769,6 +769,12 @@ class TPUTask(GcsRemoteMixin, Task):
             self.client.delete_queued_resource(info.name, force=True)
         except ResourceNotFoundError:
             pass
+        # The slice is gone: drop its governor record (the heartbeat cache
+        # prunes dead incarnations the same way). A later re-create of the
+        # same queued-resource name must start with a fresh budget, not
+        # inherit a latched "exhausted" from a previous life.
+        self._requeue_state.pop(info.name, None)
+        self._first_active.pop(info.name, None)
 
     def _recover(self, info: QueuedResourceInfo) -> None:
         """The preemption-recovery reconciler: SUSPENDED → delete → re-queue.
@@ -844,6 +850,13 @@ class TPUTask(GcsRemoteMixin, Task):
             except ResourceNotFoundError:
                 pass
         self.stop()
+        # Terminal teardown: prune the in-process governor + liveness state
+        # for every slice (the heartbeat cache already resets via its
+        # probe-period stamp). A deleted-then-recreated task must start
+        # with a fresh recovery budget — without this, a reused task object
+        # inherits attempts/backoff/exhaustion from the previous life.
+        self._requeue_state.clear()
+        self._first_active.clear()
         if not fake_mode() and self._is_per_task_bucket(remote):
             # Per-task bucket: empty it AND delete the bucket itself.
             self._bucket_resource().delete()

@@ -283,6 +283,66 @@ def cmd_exec(args) -> int:
     return worst
 
 
+def cmd_sched(args) -> int:
+    """Fleet-scheduler observability: per-tenant queue depth, running gangs,
+    quota usage, and fair-share deficit, read from the durable scheduler
+    state (the queue records + the status snapshot each tick persists)."""
+    import json as json_module
+    import os as _os
+
+    from tpu_task.scheduler.queue import DurableQueue
+    from tpu_task.scheduler.scheduler import STATUS_KEY
+    from tpu_task.storage.backends import open_backend
+
+    remote = args.remote or _os.environ.get("TPU_TASK_SCHED_REMOTE") or \
+        _os.path.join(_os.path.expanduser("~/.tpu-task"), "scheduler")
+    backend, _ = open_backend(remote)
+    try:
+        snapshot = json_module.loads(backend.read(STATUS_KEY))
+    except Exception:
+        snapshot = None
+
+    queue = DurableQueue(remote)
+    if not queue.tasks and snapshot is None:
+        print(f"no scheduler state at {remote}")
+        return 1
+
+    columns = ("TENANT", "QUEUED", "RUNNING", "CHIPS", "QUOTA", "SHARE",
+               "DEFICIT", "REQUEUES", "DONE", "FAILED")
+    rows = []
+    if snapshot is not None:
+        for tenant, info in sorted(snapshot.get("tenants", {}).items()):
+            rows.append((tenant, info["queued"], info["running_gangs"],
+                         f"{info['running_chips']}", f"{info['quota_chips']}",
+                         f"{info['share_chips']}", f"{info['deficit_chips']}",
+                         info["requeues"], info["succeeded"], info["failed"]))
+    else:
+        # No snapshot (scheduler never ticked): fold the queue records.
+        for tenant, tasks in sorted(queue.by_tenant().items()):
+            rows.append((
+                tenant,
+                sum(1 for task in tasks if task.schedulable),
+                sum(1 for task in tasks if task.state == "placed"),
+                f"{queue.running_chips(tenant)}", "-", "-", "-",
+                sum(task.preemptions for task in tasks),
+                sum(1 for task in tasks if task.state == "succeeded"),
+                sum(1 for task in tasks if task.state == "failed")))
+    widths = [max(len(str(column)), *(len(str(row[i])) for row in rows))
+              if rows else len(str(column))
+              for i, column in enumerate(columns)]
+    print("  ".join(str(column).ljust(widths[i])
+                    for i, column in enumerate(columns)))
+    for row in rows:
+        print("  ".join(str(cell).ljust(widths[i])
+                        for i, cell in enumerate(row)))
+    if snapshot is not None:
+        pool = snapshot.get("pool", {})
+        print(f"pool: {pool.get('used_chips', 0)}/"
+              f"{pool.get('capacity_chips', 0)} chips in use "
+              f"(utilization {pool.get('utilization', 0.0)})")
+    return 0
+
+
 def cmd_storage(args) -> int:
     from tpu_task.storage import sync as storage_sync, transfer as storage_transfer
 
@@ -502,6 +562,17 @@ def make_parser(defaults: Optional[dict] = None) -> argparse.ArgumentParser:
     # flags; everything after a "--" separator is the worker command.
     exec_cmd.add_argument("command", nargs="*")
     exec_cmd.set_defaults(func=cmd_exec)
+
+    sched = sub.add_parser("sched", help="fleet-scheduler observability")
+    sched_sub = sched.add_subparsers(dest="sched_command", required=True)
+    sched_status = sched_sub.add_parser(
+        "status", help="per-tenant queue depth, running gangs, quota usage, "
+                       "and fair-share deficit")
+    sched_status.add_argument(
+        "--remote", default="",
+        help="scheduler state root (connection string or path; default "
+             "$TPU_TASK_SCHED_REMOTE or ~/.tpu-task/scheduler)")
+    sched_status.set_defaults(func=cmd_sched)
 
     storage = sub.add_parser("storage", help="data-plane operations (used on workers)")
     storage_sub = storage.add_subparsers(dest="storage_command", required=True)

@@ -1,0 +1,54 @@
+"""Fleet-scale control plane: a multi-tenant gang scheduler over tasks.
+
+The paper's control plane (PAPER.md §L3/L4) stops at *independent* tasks —
+each reconciler loop manages one slice and "scheduling" is whatever the
+cloud's queued-resource API happens to admit next. This package is the layer
+ROADMAP item 4 calls for on top of the cheap, robust reconcilers PR 3/4
+built: tenants, priorities, quotas, gang admission, preemption-aware
+bin-packing, and fair-share requeue after chaos — the Borg-shaped piece
+between task submission and per-task reconciliation.
+
+Four parts:
+
+* :mod:`tpu_task.scheduler.queue` — a durable priority queue (persisted
+  through the storage ``Backend`` seam, so it survives scheduler restarts the
+  same way the reconciler's durable events survive observer restarts) with
+  per-tenant quota accounting and weighted fair-share ordering.
+* :mod:`tpu_task.scheduler.pool` — the modeled capacity pool: gang admission
+  is all-or-nothing against bounded placement domains (a slice never spans a
+  domain, a gang never holds partial capacity), with best-fit bin-packing
+  and a documented preemption victim order.
+* :mod:`tpu_task.scheduler.driver` — the seam to the things that actually
+  run: :class:`TpuTaskDriver` drives real fake-mode TPU ``Task`` objects
+  (scheduler-initiated preemption rides the control plane's graceful
+  SIGTERM path, indistinguishable from a cloud reclaim to the task, and
+  recovery rides the PR 3 requeue governor in ``backends/tpu/task.py``);
+  :class:`SimGangDriver` runs virtual-time gangs for 1000-task soaks and
+  benchmarks.
+* :mod:`tpu_task.scheduler.scheduler` — :class:`GangScheduler`, the tick
+  loop tying them together.
+"""
+
+from tpu_task.scheduler.driver import GangDriver, SimGangDriver, TpuTaskDriver
+from tpu_task.scheduler.pool import CapacityPool, PoolInvariantError
+from tpu_task.scheduler.queue import (
+    DurableQueue,
+    GangSpec,
+    QueuedTask,
+    TenantQuota,
+)
+from tpu_task.scheduler.scheduler import GangScheduler, SchedulerInvariantError
+
+__all__ = [
+    "CapacityPool",
+    "DurableQueue",
+    "GangDriver",
+    "GangScheduler",
+    "GangSpec",
+    "PoolInvariantError",
+    "QueuedTask",
+    "SchedulerInvariantError",
+    "SimGangDriver",
+    "TenantQuota",
+    "TpuTaskDriver",
+]

@@ -1,0 +1,239 @@
+"""Gang drivers: the seam between the scheduler and what actually runs.
+
+Two implementations of one small protocol:
+
+* :class:`TpuTaskDriver` — drives REAL ``Task`` objects against the
+  fake-mode TPU control plane (or, unchanged, a real one). Scheduler-
+  initiated preemption goes through the control plane's graceful reclaim
+  (``preempt_node(graceful=True)`` → SIGTERM to the agents → final sync →
+  SUSPENDED queued resource), which is byte-for-byte what a cloud spot
+  reclaim or the chaos plane does — the task cannot tell the scheduler
+  preempted it. Recovery is NOT re-implemented here: resuming a victim just
+  means polling its own reconciler (``read()``), whose PR 3 requeue
+  governor (``backends/tpu/task.py:_maybe_recover``) does the
+  backoff-gated, budget-bounded requeue; budget exhaustion surfaces as the
+  task's durable FAILED, which this driver reports as terminal.
+* :class:`SimGangDriver` — virtual-time gangs for 1000-task soaks and the
+  scheduler benchmark: each gang runs ``task.work`` seconds of simulated
+  compute, checkpoints ``progress`` continuously while placed, and resumes
+  from the checkpoint after preemption (the Check-N-Run frequent-checkpoint
+  shape the real agents implement with buckets). Chaos kills arrive through
+  :meth:`SimGangDriver.kill`, which a ``ChaosSchedule`` action can call on
+  the same virtual clock.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Protocol
+
+from tpu_task.scheduler.queue import QueuedTask
+
+#: poll() results. "preempted" means the gang lost its capacity (scheduler-
+#: or chaos-initiated — the scheduler treats both identically, which is the
+#: point); terminal states match the queue's.
+RUNNING = "running"
+SUCCEEDED = "succeeded"
+FAILED = "failed"
+PREEMPTED = "preempted"
+
+
+class GangDriver(Protocol):
+    #: True when the launched object runs its own requeue governor (the PR 3
+    #: reconciler): the scheduler then leaves backoff/budget accounting to
+    #: it instead of applying its own.
+    self_recovering: bool
+
+    def launch(self, task: QueuedTask) -> None: ...
+
+    def poll(self, task: QueuedTask) -> str: ...
+
+    def preempt(self, task: QueuedTask, graceful: bool = True) -> None: ...
+
+    def release(self, task: QueuedTask) -> None: ...
+
+    def failure_reason(self, task: QueuedTask) -> str:
+        """Durable failure code for a gang whose poll() returned FAILED."""
+        ...
+
+
+class SimGangDriver:
+    """Virtual-time gang executor (no processes, no wall clock)."""
+
+    self_recovering = False
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic,
+                 checkpoint_period: float = 0.0):
+        self._clock = clock
+        #: hard-kill progress granularity: a graceful preemption checkpoints
+        #: to "now", a hard kill loses the tail since the last checkpoint.
+        self._checkpoint_period = checkpoint_period
+        self._started: Dict[str, float] = {}
+        self._killed: Dict[str, bool] = {}  # task_id → graceful
+
+    # -- protocol --------------------------------------------------------------
+    def launch(self, task: QueuedTask) -> None:
+        self._started[task.task_id] = self._clock()
+        self._killed.pop(task.task_id, None)
+
+    def _checkpointed(self, task: QueuedTask, graceful: bool) -> float:
+        ran = max(0.0, self._clock() - self._started[task.task_id])
+        if not graceful and self._checkpoint_period > 0:
+            ran -= ran % self._checkpoint_period
+        return min(task.work, task.progress + ran)
+
+    def poll(self, task: QueuedTask) -> str:
+        if task.task_id not in self._started:
+            return PREEMPTED  # lost without a kill record: treat as reclaim
+        if task.task_id in self._killed:
+            graceful = self._killed.pop(task.task_id)
+            task.progress = self._checkpointed(task, graceful)
+            self._started.pop(task.task_id, None)
+            return PREEMPTED
+        if self._checkpointed(task, graceful=True) >= task.work:
+            task.progress = task.work
+            self._started.pop(task.task_id, None)
+            return SUCCEEDED
+        return RUNNING
+
+    def preempt(self, task: QueuedTask, graceful: bool = True) -> None:
+        # The scheduler requeues a victim right after this call with no
+        # poll() in between (and launch() on re-grant resets the start
+        # clock), so the checkpoint must land here — a pending chaos kill's
+        # gracefulness wins, the gang was already dead the hard way.
+        if task.task_id not in self._started:
+            self._killed.pop(task.task_id, None)
+            return
+        graceful = self._killed.pop(task.task_id, graceful) and graceful
+        task.progress = self._checkpointed(task, graceful)
+        self._started.pop(task.task_id, None)
+
+    def release(self, task: QueuedTask) -> None:
+        self._started.pop(task.task_id, None)
+        self._killed.pop(task.task_id, None)
+
+    def failure_reason(self, task: QueuedTask) -> str:
+        return "task-failed"  # sim gangs never fail on their own
+
+    # -- chaos seam ------------------------------------------------------------
+    def kill(self, task_id: str, graceful: bool = False) -> bool:
+        """Reclaim a running gang's capacity (a ``ChaosSchedule`` action or
+        a scheduler preemption — the poll result is identical either way).
+        Returns False when the gang is not running (action retried)."""
+        if task_id not in self._started:
+            return False
+        self._killed[task_id] = graceful
+        return True
+
+    def running_ids(self) -> List[str]:
+        return sorted(self._started)
+
+
+class TpuTaskDriver:
+    """Drives real ``Task`` objects — the fake-mode TPU backend and the
+    local ``MachineGroup`` backend both work (hermetic in tests; the real
+    control planes ride the same calls).
+
+    ``factory(task)`` builds the backend ``Task`` for one queued record —
+    the scheduler stays ignorant of clouds, specs, and credentials. Every
+    launched task's object is cached so the reconciler's in-memory governor
+    state (backoff, budget) survives across polls, exactly as a long-lived
+    monitor process would hold it. Recovery is the backend's own: the TPU
+    reconciler's requeue governor, or the machine group's reconcile-respawn
+    (both fire on ``read()``, which poll() drives only while the gang holds
+    a reservation — an evicted gang stays down until re-granted).
+    """
+
+    self_recovering = True
+
+    def __init__(self, factory: Callable[[QueuedTask], object],
+                 delete_on_release: bool = True):
+        self._factory = factory
+        self._delete_on_release = delete_on_release
+        self._tasks: Dict[str, object] = {}
+        self._created: Dict[str, bool] = {}
+
+    def backend_task(self, task: QueuedTask):
+        if task.task_id not in self._tasks:
+            self._tasks[task.task_id] = self._factory(task)
+        return self._tasks[task.task_id]
+
+    def launch(self, task: QueuedTask) -> None:
+        backend = self.backend_task(task)
+        if not self._created.get(task.task_id):
+            backend.create()
+            self._created[task.task_id] = True
+            return
+        # Re-launch after preemption: the durable bucket (checkpoints) must
+        # survive, so never a second create. start() restores any queued
+        # resource a pre-ACTIVE preemption had to delete outright
+        # (idempotent no-op for surviving ones); a SUSPENDED slice is the
+        # reconciler's own requeue — poll() drives read(), whose PR 3
+        # governor re-queues it.
+        backend.start()
+
+    def poll(self, task: QueuedTask) -> str:
+        from tpu_task.common.values import StatusCode
+
+        backend = self.backend_task(task)
+        backend.read()  # runs the PR 3 reconciler: recovery, liveness, fold
+        status = backend.status()
+        if status.get(StatusCode.FAILED, 0) > 0:
+            return FAILED
+        if status.get(StatusCode.SUCCEEDED, 0) >= task.gang.slices:
+            return SUCCEEDED
+        return RUNNING
+
+    def failure_reason(self, task: QueuedTask) -> str:
+        """The status fold says FAILED for an ordinary nonzero exit code and
+        for governor budget exhaustion alike; only the durable event stream
+        distinguishes them, so read it back before stamping the queue
+        record."""
+        backend = self.backend_task(task)
+        events = getattr(backend, "events", None)
+        if events is not None:
+            try:
+                if any(event.code == "recovery-budget-exhausted"
+                       for event in events()):
+                    return "recovery-budget-exhausted"
+            except Exception:
+                pass  # forensics only — never block the terminal transition
+        return "task-failed"
+
+    def preempt(self, task: QueuedTask, graceful: bool = True) -> None:
+        """Reclaim through the backend's own preemption surface — the same
+        calls the chaos plane makes, so to the agents this is a cloud
+        reclaim: SIGTERM, final sync, ``preempted`` report.
+
+        TPU backend: ``preempt_node`` per slice; a slice whose node never
+        materialized (still WAITING/PROVISIONING) has no agents to warn, so
+        its queued resource is deleted instead — launch() restores it on
+        re-grant. Local machine-group backend: the group's own per-worker
+        ``preempt`` (reconcile-respawn stays parked until poll() resumes
+        reading)."""
+        backend = self.backend_task(task)
+        from tpu_task.common.errors import ResourceNotFoundError
+
+        if hasattr(backend, "_existing_qrs"):
+            for name in backend._existing_qrs():
+                try:
+                    backend.client.preempt_node(name, graceful=graceful)
+                except (ResourceNotFoundError, OSError, KeyError):
+                    try:
+                        backend.client.delete_queued_resource(name, force=True)
+                    except ResourceNotFoundError:
+                        pass
+            return
+        group = getattr(backend, "group", None)
+        if group is not None:
+            for worker in group.live_workers():
+                backend.preempt(worker.index, graceful=graceful)
+            return
+        raise TypeError(
+            f"backend {type(backend).__name__} exposes no preemption seam")
+
+    def release(self, task: QueuedTask) -> None:
+        backend = self._tasks.pop(task.task_id, None)
+        self._created.pop(task.task_id, None)
+        if backend is not None and self._delete_on_release:
+            backend.delete()

@@ -1,0 +1,239 @@
+"""The modeled capacity pool: bounded domains, gang admission, bin-packing.
+
+The pool models what the cloud's queued-resource API hides: a bounded set of
+placement **domains** (pods/zones — ``FakeTpuControlPlane``'s
+``capacity_chips`` generalized to several bounded pools), each holding a
+fixed number of chips. A TPU slice cannot span domains, so placing a gang is
+a bin-packing problem: every slice of the gang must fit wholly inside some
+domain, and admission is **all-or-nothing** — either every slice gets a
+reservation or the pool is left untouched. No partial gang ever holds
+capacity (the deadlock Borg/Gang-scheduling literature exists to prevent:
+two half-placed gangs each waiting for the other's remainder).
+
+Placement is best-fit-decreasing: slices (all equal within a gang) go to the
+feasible domain with the least free capacity, tightest first — keeps big
+contiguous holes available for big slices. Deterministic: ties break on
+domain index.
+
+Victim selection for preemption lives here too (:func:`select_victims`) with
+the documented order — see the function docstring; the scheduler decides
+*whether* to preempt, the pool decides *whom*.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tpu_task.scheduler.queue import GangSpec, QueuedTask
+
+
+class PoolInvariantError(AssertionError):
+    """A placement would overcommit a domain — the invariant the property
+    tests pin. Raised defensively; a correct scheduler never triggers it."""
+
+
+@dataclass
+class Placement:
+    """Where one gang's slices landed: domain index per slice."""
+
+    task_id: str
+    chips_per_slice: int
+    domains: List[int] = field(default_factory=list)
+
+    @property
+    def total_chips(self) -> int:
+        return self.chips_per_slice * len(self.domains)
+
+
+class CapacityPool:
+    """Bounded multi-domain chip pool with all-or-nothing gang reservation."""
+
+    def __init__(self, domains: Sequence[int]):
+        if not domains or any(chips <= 0 for chips in domains):
+            raise ValueError(f"domains must be positive chip counts: {domains}")
+        self.capacity = list(domains)
+        self.free = list(domains)
+        self.placements: Dict[str, Placement] = {}
+
+    @property
+    def total_capacity(self) -> int:
+        return sum(self.capacity)
+
+    @property
+    def used_chips(self) -> int:
+        return self.total_capacity - sum(self.free)
+
+    def utilization(self) -> float:
+        return self.used_chips / self.total_capacity
+
+    def ever_fits(self, gang: GangSpec) -> bool:
+        """Could this gang fit an EMPTY pool? False → reject at submit time
+        (an impossible gang must not camp at the head of the queue)."""
+        free = list(self.capacity)
+        return self._pack(gang, free) is not None
+
+    def _pack(self, gang: GangSpec,
+              free: List[int]) -> Optional[List[int]]:
+        """Best-fit-decreasing trial placement against a free-vector copy;
+        mutates ``free`` on success, returns the per-slice domain list (or
+        None, with ``free`` restored — all-or-nothing even mid-trial)."""
+        need = gang.chips_per_slice
+        chosen: List[int] = []
+        taken: List[Tuple[int, int]] = []
+        for _ in range(gang.slices):
+            best = -1
+            for index, chips in enumerate(free):
+                if chips >= need and (best < 0 or chips < free[best]):
+                    best = index
+            if best < 0:
+                for index, chips in taken:  # rollback: nothing held
+                    free[index] += chips
+                return None
+            free[best] -= need
+            taken.append((best, need))
+            chosen.append(best)
+        return chosen
+
+    def try_place(self, task: QueuedTask) -> Optional[Placement]:
+        """Reserve the whole gang, or nothing."""
+        if task.task_id in self.placements:
+            raise PoolInvariantError(f"{task.task_id} is already placed")
+        domains = self._pack(task.gang, self.free)
+        if domains is None:
+            return None
+        if any(chips < 0 for chips in self.free):  # defensive; _pack rolls back
+            raise PoolInvariantError(f"overcommitted free vector: {self.free}")
+        placement = Placement(task_id=task.task_id,
+                              chips_per_slice=task.gang.chips_per_slice,
+                              domains=domains)
+        self.placements[task.task_id] = placement
+        return placement
+
+    def release(self, task_id: str) -> None:
+        placement = self.placements.pop(task_id, None)
+        if placement is None:
+            return
+        for domain in placement.domains:
+            self.free[domain] += placement.chips_per_slice
+        if any(self.free[i] > self.capacity[i] for i in range(len(self.free))):
+            raise PoolInvariantError(
+                f"release overflowed a domain: free={self.free} "
+                f"capacity={self.capacity}")
+
+    def fits_with_released(self, gang: GangSpec,
+                           victim_ids: Sequence[str]) -> bool:
+        """Would ``gang`` fit if these victims were released? (Trial only —
+        nothing is actually freed.)"""
+        free = list(self.free)
+        for task_id in victim_ids:
+            placement = self.placements.get(task_id)
+            if placement is None:
+                continue
+            for domain in placement.domains:
+                free[domain] += placement.chips_per_slice
+        return self._pack(gang, free) is not None
+
+
+def select_victims(candidate: QueuedTask,
+                   placed: List[QueuedTask],
+                   pool: CapacityPool,
+                   running: Dict[str, float],
+                   shares: Dict[str, float]) -> List[QueuedTask]:
+    """Minimal victim set that makes room for ``candidate``, or ``[]``.
+
+    Documented victim order (the property tests pin it):
+
+    1. gangs of tenants OVER their fair share before gangs of tenants under
+       it — over-share capacity is borrowed and reclaimable by anyone;
+    2. within each class, lowest priority first;
+    3. among equals, youngest placement first (most recent ``placed_at``) —
+       it has the least sunk work to lose.
+
+    Eligibility guards:
+
+    * Preemption only serves a candidate whose tenant sits strictly BELOW
+      its fair share: priority buys eviction within your entitlement;
+      beyond it you wait like everyone else. (Without this, an over-share
+      tenant's high-priority backlog keeps evicting a deficient tenant's
+      low-priority gangs — starvation by priority churn.)
+    * Over-share reclaim takes only the EXCESS above entitlement: a gang is
+      over-share-eligible only if its tenant stays at/above its share after
+      losing it. Otherwise two tenants whose shares are smaller than one
+      gang would evict each other forever (fairness cannot be improved
+      below the gang granularity — so don't try).
+    * Other gangs are preemptible only by a strictly higher-priority
+      candidate.
+    * The candidate's own gangs are never victims.
+
+    Victims accumulate in order until the candidate fits — eligibility is
+    re-checked against the running total as gangs are (notionally) removed —
+    then the set is pruned to minimality. If even the full eligible set is
+    not enough, NO victim is preempted: all-or-nothing applies to preemption
+    too (killing work without admitting the candidate would be pure loss).
+    """
+    if (running.get(candidate.tenant, 0.0)
+            >= shares.get(candidate.tenant, float("inf"))):
+        return []
+    remaining = dict(running)
+
+    def classify(task: QueuedTask) -> Optional[int]:
+        if task.tenant == candidate.tenant:
+            return None
+        excess_ok = (remaining.get(task.tenant, 0.0) - task.gang.total_chips
+                     >= shares.get(task.tenant, 0.0))
+        if excess_ok:
+            return 0
+        if task.priority < candidate.priority:
+            return 1
+        return None
+
+    # Equal-size slices make feasibility exact and cheap: the gang fits iff
+    # Σ_d ⌊free_d / chips_per_slice⌋ ≥ slices.
+    need = candidate.gang.chips_per_slice
+
+    def placeable(free: List[int]) -> int:
+        return sum(chips // need for chips in free)
+
+    def released(free: List[int], task: QueuedTask) -> List[int]:
+        trial = list(free)
+        placement = pool.placements.get(task.task_id)
+        if placement is not None:
+            for domain in placement.domains:
+                trial[domain] += placement.chips_per_slice
+        return trial
+
+    victims: List[QueuedTask] = []
+    candidates = list(placed)
+    free = list(pool.free)
+    while placeable(free) < candidate.gang.slices:
+        eligible = [(rank, task) for task in candidates
+                    if (rank := classify(task)) is not None]
+        if not eligible:
+            return []
+        eligible.sort(key=lambda pair: (
+            pair[0], pair[1].priority, -pair[1].placed_at,
+            pair[1].submit_seq))
+        # First in documented order whose release actually opens slice
+        # room — a victim in a domain too fragmented to host a slice must
+        # not burn its (well-ordered) eviction for nothing. When no single
+        # release helps, fall back to strict order: several small releases
+        # in one domain can add up.
+        victim = next(
+            (task for _, task in eligible
+             if placeable(released(free, task)) > placeable(free)),
+            eligible[0][1])
+        victims.append(victim)
+        candidates.remove(victim)
+        free = released(free, victim)
+        remaining[victim.tenant] = remaining.get(victim.tenant, 0.0) \
+            - victim.gang.total_chips
+    # Prune: drop any victim whose capacity turned out not to be needed —
+    # preemption kills work, so the set must be minimal, not just
+    # sufficient. (Safe w.r.t. the excess guard: removing a victim only
+    # raises its tenant's running total, which keeps the rest eligible.)
+    for victim in list(victims):
+        rest = [v.task_id for v in victims if v is not victim]
+        if rest and pool.fits_with_released(candidate.gang, rest):
+            victims.remove(victim)
+    return victims

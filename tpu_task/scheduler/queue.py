@@ -1,0 +1,230 @@
+"""Durable priority queue with per-tenant quotas and weighted fair share.
+
+Submissions are persisted through the storage ``Backend`` seam (one JSON
+record per task under ``scheduler/tasks/``), the same durability style as the
+reconciler's event mailbox: a scheduler process that restarts reloads the
+queue and resumes with identical ordering — nothing is lost, nothing is
+reordered. In-memory mode (``remote=None``) serves pure-model tests and
+benchmarks.
+
+Ordering is two-level, both levels deterministic:
+
+* ACROSS tenants: weighted fair share. Tenants are ordered by
+  ``running_chips / weight`` ascending (the classic fair-share rule: the
+  tenant furthest below its share goes first), tie-broken by tenant name.
+* WITHIN a tenant: priority descending, then submission sequence — a strict
+  priority queue with FIFO among equals.
+
+Quota accounting (``TenantQuota``) bounds *concurrent* usage — chips and
+running tasks — not queue depth: a tenant may queue arbitrarily much, but
+admission never takes it beyond its quota.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field
+from functools import lru_cache
+from typing import Dict, List, Optional
+
+from tpu_task.backends.tpu.accelerators import parse_accelerator
+
+
+@lru_cache(maxsize=None)
+def _accelerator_chips(accelerator: str) -> int:
+    # The usage sweeps touch every task's gang once per scheduling pass;
+    # re-running the accelerator grammar there is pure waste.
+    return parse_accelerator(accelerator).chips
+
+#: Task states. ``queued`` and ``preempted`` are schedulable (preempted sorts
+#: with its original submission sequence — a victim does not lose its place);
+#: ``placed`` holds pool capacity; ``succeeded``/``failed`` are terminal.
+SCHEDULABLE = ("queued", "preempted")
+TERMINAL = ("succeeded", "failed")
+
+
+@dataclass(frozen=True)
+class TenantQuota:
+    """Concurrent-usage bounds + fair-share weight for one tenant."""
+
+    chips: int              # max chips placed at once
+    max_tasks: int = 1 << 30  # max gangs placed at once
+    weight: float = 1.0     # weighted fair-share entitlement
+
+
+@dataclass(frozen=True)
+class GangSpec:
+    """A gang: ``slices`` × one accelerator slice, admitted all-or-nothing.
+
+    Mirrors the task spec's (machine, parallelism) pair: ``accelerator`` is a
+    ``backends/tpu/accelerators.py`` type (``v4-16``, ``v5p-8``, ...) and
+    ``slices`` is the parallelism — the number of queued resources the task
+    backend would submit. Placement units are slices; admission units are
+    whole gangs.
+    """
+
+    accelerator: str
+    slices: int = 1
+
+    @property
+    def chips_per_slice(self) -> int:
+        return _accelerator_chips(self.accelerator)
+
+    @property
+    def total_chips(self) -> int:
+        return self.chips_per_slice * self.slices
+
+
+@dataclass
+class QueuedTask:
+    """One submission's durable record, updated through its whole life."""
+
+    task_id: str
+    tenant: str
+    gang: GangSpec
+    priority: int = 0
+    state: str = "queued"
+    submit_seq: int = 0
+    submitted_at: float = 0.0
+    placed_at: float = -1.0      # latest placement (virtual/monotonic clock)
+    first_placed_at: float = -1.0  # first placement → queue-latency metric
+    finished_at: float = -1.0
+    attempts: int = 0            # requeue-governor attempts since last reset
+    next_eligible_at: float = 0.0  # backoff gate for requeue-after-preemption
+    preemptions: int = 0         # lifetime count (scheduler- or chaos-caused)
+    failure: str = ""            # terminal failure code (durable forensics)
+    # SimGangDriver contract: ``work`` seconds of compute, resumed from the
+    # last checkpointed ``progress`` after preemption. Ignored by real tasks.
+    work: float = 0.0
+    progress: float = 0.0
+    #: extra driver payload (e.g. the real driver's task spec fields)
+    payload: Dict[str, str] = field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        record = asdict(self)
+        record["gang"] = asdict(self.gang)
+        return record
+
+    @classmethod
+    def from_json(cls, record: dict) -> "QueuedTask":
+        record = dict(record)
+        record["gang"] = GangSpec(**record["gang"])
+        return cls(**record)
+
+    @property
+    def schedulable(self) -> bool:
+        return self.state in SCHEDULABLE
+
+
+class DurableQueue:
+    """The scheduler's task store: write-through JSON records per task.
+
+    ``remote`` is any storage connection string (or plain path → the local
+    backend); ``None`` keeps everything in memory. Records live under
+    ``scheduler/tasks/<task_id>.json``; :meth:`load` restores them, so a
+    fresh scheduler process sees the queue exactly as the dead one left it.
+    """
+
+    PREFIX = "scheduler/tasks/"
+
+    def __init__(self, remote: Optional[str] = None):
+        self._remote = remote
+        self._backend = None
+        if remote is not None:
+            from tpu_task.storage.backends import open_backend
+
+            self._backend, _ = open_backend(remote)
+        self.tasks: Dict[str, QueuedTask] = {}
+        self._seq = 0
+        if self._backend is not None:
+            self.load()
+
+    # -- persistence -----------------------------------------------------------
+    def _key(self, task_id: str) -> str:
+        return f"{self.PREFIX}{task_id}.json"
+
+    def persist(self, task: QueuedTask) -> None:
+        if self._backend is None:
+            return
+        self._backend.write(self._key(task.task_id),
+                            json.dumps(task.to_json()).encode())
+
+    def load(self) -> None:
+        """Restore every record; the next submission sequence continues past
+        the highest restored one so restart never reorders FIFO ties."""
+        if self._backend is None:
+            return
+        self.tasks = {}
+        for key in sorted(self._backend.list(self.PREFIX)):
+            if not key.endswith(".json"):
+                continue
+            task = QueuedTask.from_json(json.loads(self._backend.read(key)))
+            self.tasks[task.task_id] = task
+            self._seq = max(self._seq, task.submit_seq + 1)
+
+    # -- submission ------------------------------------------------------------
+    def submit(self, task: QueuedTask) -> QueuedTask:
+        if task.task_id in self.tasks:
+            raise ValueError(f"duplicate task id: {task.task_id!r}")
+        task.submit_seq = self._seq
+        self._seq += 1
+        self.tasks[task.task_id] = task
+        self.persist(task)
+        return task
+
+    def update(self, task: QueuedTask) -> None:
+        self.persist(task)
+
+    # -- views -----------------------------------------------------------------
+    def schedulable(self) -> List[QueuedTask]:
+        return [task for task in self.tasks.values() if task.schedulable]
+
+    def placed(self) -> List[QueuedTask]:
+        return [task for task in self.tasks.values() if task.state == "placed"]
+
+    def by_tenant(self) -> Dict[str, List[QueuedTask]]:
+        tenants: Dict[str, List[QueuedTask]] = {}
+        for task in self.tasks.values():
+            tenants.setdefault(task.tenant, []).append(task)
+        return tenants
+
+    def running_chips(self, tenant: str) -> int:
+        return sum(task.gang.total_chips for task in self.tasks.values()
+                   if task.tenant == tenant and task.state == "placed")
+
+    def running_tasks(self, tenant: str) -> int:
+        return sum(1 for task in self.tasks.values()
+                   if task.tenant == tenant and task.state == "placed")
+
+
+def fair_share_order(tasks: List[QueuedTask],
+                     running_chips: Dict[str, int],
+                     weights: Dict[str, float]) -> List[QueuedTask]:
+    """Schedulable tasks in fair-share dispatch order.
+
+    Tenants sort by ``running_chips / weight`` ascending (most-deficient
+    first, name tie-break); each tenant's own backlog sorts by priority
+    descending then submission sequence. The result interleaves: first the
+    head of every tenant in tenant order, then the seconds, and so on — so
+    capacity freed mid-pass keeps being offered by deficit, not FIFO.
+
+    Pure function of its inputs → deterministic for a fixed seed upstream.
+    """
+    per_tenant: Dict[str, List[QueuedTask]] = {}
+    for task in tasks:
+        per_tenant.setdefault(task.tenant, []).append(task)
+    for backlog in per_tenant.values():
+        backlog.sort(key=lambda task: (-task.priority, task.submit_seq))
+    tenant_order = sorted(
+        per_tenant,
+        key=lambda tenant: (running_chips.get(tenant, 0)
+                            / max(weights.get(tenant, 1.0), 1e-9), tenant))
+    ordered: List[QueuedTask] = []
+    depth = 0
+    while True:
+        row = [per_tenant[tenant][depth] for tenant in tenant_order
+               if depth < len(per_tenant[tenant])]
+        if not row:
+            return ordered
+        ordered.extend(row)
+        depth += 1

@@ -1,0 +1,318 @@
+"""GangScheduler: the tick loop over queue, pool, and driver.
+
+One :meth:`GangScheduler.tick` is one reconciliation pass, in the same
+observe-decide-act shape as the per-task reconciler underneath it:
+
+1. **Observe** every placed gang through the driver. Completions and
+   failures release capacity; a chaos-reclaimed gang (the driver reports
+   ``preempted``) is routed through the requeue governor — backoff-gated,
+   budget-bounded, converging to a durable ``recovery-budget-exhausted``
+   failure — unless the driver is *self-recovering* (real tasks run the
+   PR 3 governor in their own reconciler; the scheduler never duplicates
+   it, the gang simply keeps its reservation through recovery).
+2. **Admit** from the backlog in weighted fair-share order, all-or-nothing
+   per gang, inside per-tenant quotas. A gang that doesn't fit may preempt:
+   victims follow the documented order in
+   :func:`tpu_task.scheduler.pool.select_victims` and are reclaimed through
+   the driver's *graceful* path — to the victim this is exactly a cloud
+   spot reclaim. Scheduler-initiated preemption charges no recovery budget
+   (policy, not failure) and the victim keeps its queue position.
+3. **Account**: fair-share deficits, queue-latency samples, per-tenant
+   requeue counters, and a status snapshot persisted next to the durable
+   queue (``scheduler/status.json``) for the CLI.
+
+Freed capacity — chaos or preemption — is re-offered by fair-share deficit,
+never FIFO: each admission pass re-sorts tenants by ``running/weight`` after
+every placement, so one tenant's flaky workload cannot starve another.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import Callable, Dict, List, Optional
+
+from tpu_task.scheduler import driver as driver_module
+from tpu_task.scheduler.pool import CapacityPool, select_victims
+from tpu_task.scheduler.queue import (
+    DurableQueue,
+    GangSpec,
+    QueuedTask,
+    TenantQuota,
+    fair_share_order,
+)
+
+STATUS_KEY = "scheduler/status.json"
+
+
+class SchedulerInvariantError(AssertionError):
+    """A quota or admission invariant broke — never expected to raise; the
+    soak and property tests run with these checks live."""
+
+
+class GangScheduler:
+    def __init__(self, pool: CapacityPool,
+                 quotas: Dict[str, TenantQuota],
+                 driver,
+                 remote: Optional[str] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.pool = pool
+        self.quotas = dict(quotas)
+        self.driver = driver
+        self.clock = clock
+        self.queue = DurableQueue(remote)
+        # Same governor knobs as the per-task reconciler (PR 3): one
+        # environment contract for both layers.
+        self.recovery_budget = int(os.environ.get("TPU_TASK_RECOVERY_BUDGET", "5"))
+        self.backoff_base = float(os.environ.get("TPU_TASK_REQUEUE_BACKOFF_BASE", "2"))
+        self.backoff_cap = float(os.environ.get("TPU_TASK_REQUEUE_BACKOFF_CAP", "60"))
+        self.healthy_after = float(os.environ.get(
+            "TPU_TASK_RECOVERY_HEALTHY_AFTER", "120"))
+        # -- metrics (benchmark + soak read these) ----------------------------
+        self.queue_latency: List[float] = []   # submit → first placement
+        self.requeues: Dict[str, int] = {}     # tenant → requeue count
+        self.max_deficit: Dict[str, float] = {}  # tenant → worst deficit seen
+        self.chip_seconds = 0.0                # utilization integral
+        self._last_tick_at: Optional[float] = None
+        # A scheduler that died mid-flight left "placed" records whose
+        # driver state is gone; demote them to preempted (no budget charge —
+        # the scheduler failed, not the gang) so they re-place first thing.
+        for task in self.queue.placed():
+            task.state = "preempted"
+            task.next_eligible_at = 0.0
+            self.queue.update(task)
+
+    # -- submission ------------------------------------------------------------
+    def submit(self, tenant: str, accelerator: str, slices: int = 1,
+               priority: int = 0, work: float = 0.0,
+               task_id: Optional[str] = None) -> QueuedTask:
+        if tenant not in self.quotas:
+            raise ValueError(f"unknown tenant: {tenant!r}")
+        gang = GangSpec(accelerator=accelerator, slices=slices)
+        if gang.total_chips > self.quotas[tenant].chips:
+            raise ValueError(
+                f"gang needs {gang.total_chips} chips; tenant {tenant!r} "
+                f"quota is {self.quotas[tenant].chips} — it could never run")
+        if not self.pool.ever_fits(gang):
+            raise ValueError(
+                f"gang {gang} cannot fit the pool even when empty")
+        task = QueuedTask(
+            task_id=task_id or uuid.uuid4().hex[:12], tenant=tenant,
+            gang=gang, priority=priority, work=work,
+            submitted_at=self.clock())
+        return self.queue.submit(task)
+
+    # -- quota / fair-share accounting ----------------------------------------
+    def _demand_chips(self) -> Dict[str, float]:
+        demand: Dict[str, float] = {}
+        for task in self.queue.tasks.values():
+            if task.state == "placed" or task.schedulable:
+                demand[task.tenant] = demand.get(task.tenant, 0) \
+                    + task.gang.total_chips
+        return demand
+
+    def _shares(self) -> Dict[str, float]:
+        """Entitled chips per tenant: pool capacity split by weight across
+        tenants with live demand (an idle tenant is owed nothing)."""
+        demand = self._demand_chips()
+        total_weight = sum(self.quotas[tenant].weight for tenant in demand)
+        if not total_weight:
+            return {}
+        return {tenant: self.pool.total_capacity
+                * self.quotas[tenant].weight / total_weight
+                for tenant in demand}
+
+    def deficits(self) -> Dict[str, float]:
+        """Fair-share deficit per tenant: how far below min(entitlement,
+        demand) its placed chips sit. Bounded deficit is the soak's fairness
+        invariant — a starved tenant's deficit grows without bound."""
+        demand = self._demand_chips()
+        shares = self._shares()
+        return {tenant: max(0.0, min(shares[tenant], demand[tenant])
+                            - self.queue.running_chips(tenant))
+                for tenant in shares}
+
+    # -- state transitions -----------------------------------------------------
+    def _place(self, task: QueuedTask, now: float) -> bool:
+        if self.pool.try_place(task) is None:
+            return False
+        task.state = "placed"
+        task.placed_at = now
+        if task.first_placed_at < 0:
+            task.first_placed_at = now
+            self.queue_latency.append(now - task.submitted_at)
+        quota = self.quotas[task.tenant]
+        running = self.queue.running_chips(task.tenant)
+        if running > quota.chips:
+            raise SchedulerInvariantError(
+                f"tenant {task.tenant} at {running} chips exceeds quota "
+                f"{quota.chips} after placing {task.task_id}")
+        self.queue.update(task)
+        self.driver.launch(task)
+        return True
+
+    def _finish(self, task: QueuedTask, state: str, now: float,
+                failure: str = "") -> None:
+        self.pool.release(task.task_id)
+        task.state = state
+        task.failure = failure
+        task.finished_at = now
+        self.queue.update(task)
+        self.driver.release(task)
+
+    def _requeue(self, task: QueuedTask, now: float, charge_budget: bool) -> None:
+        """Route a reclaimed gang through the requeue governor. Scheduler-
+        initiated preemptions don't charge the recovery budget (the gang did
+        nothing wrong); chaos reclaims do — a gang that keeps dying burns
+        its budget and converges to a durable failure, exactly like the
+        per-task reconciler's poisoned-spec path."""
+        self.pool.release(task.task_id)
+        task.preemptions += 1
+        self.requeues[task.tenant] = self.requeues.get(task.tenant, 0) + 1
+        if charge_budget and not self.driver.self_recovering:
+            task.attempts += 1
+            if task.attempts > self.recovery_budget:
+                task.state = "failed"
+                task.failure = "recovery-budget-exhausted"
+                task.finished_at = now
+                self.queue.update(task)
+                self.driver.release(task)
+                return
+            task.next_eligible_at = now + min(
+                self.backoff_base * (2 ** (task.attempts - 1)),
+                self.backoff_cap)
+        else:
+            task.next_eligible_at = now
+        task.state = "preempted"
+        self.queue.update(task)
+
+    # -- the tick --------------------------------------------------------------
+    def tick(self) -> None:
+        now = self.clock()
+        if self._last_tick_at is not None:
+            self.chip_seconds += self.pool.used_chips * (now - self._last_tick_at)
+        self._last_tick_at = now
+
+        # 1. Observe placed gangs (submit order: deterministic).
+        for task in sorted(self.queue.placed(),
+                           key=lambda task: task.submit_seq):
+            try:
+                result = self.driver.poll(task)
+            except Exception:
+                # Transient observation failure (a chaos-faulted probe, a
+                # 429 burst): no decision this tick — the same shrug the
+                # per-task monitor loop gives a failed read().
+                continue
+            if result == driver_module.SUCCEEDED:
+                self._finish(task, "succeeded", now)
+            elif result == driver_module.FAILED:
+                # The status fold can't tell a plain nonzero exit from
+                # governor budget exhaustion — the driver reads its own
+                # forensic record (durable events) to label the cause.
+                self._finish(task, "failed", now,
+                             failure=self.driver.failure_reason(task))
+            elif result == driver_module.PREEMPTED:
+                self._requeue(task, now, charge_budget=True)
+            elif task.attempts and now - task.placed_at > self.healthy_after:
+                task.attempts = 0  # healthy comeback resets the budget
+                self.queue.update(task)
+
+        # 2. Admission in fair-share order; re-sort after every placement so
+        #    freed capacity keeps flowing to the most-deficient tenant. Gangs
+        #    preempted THIS tick sit the rest of it out — without that, two
+        #    tenants straddling the share line could preempt each other's
+        #    gangs in one unbounded loop.
+        bumped: set = set()
+        weights = {tenant: quota.weight
+                   for tenant, quota in self.quotas.items()}
+        while True:
+            # One O(tasks) usage sweep per placement pass; headroom checks
+            # below read the dicts, not the queue.
+            running: Dict[str, int] = {tenant: 0 for tenant in self.quotas}
+            gangs: Dict[str, int] = {tenant: 0 for tenant in self.quotas}
+            for task in self.queue.placed():
+                running[task.tenant] += task.gang.total_chips
+                gangs[task.tenant] += 1
+            eligible = [
+                task for task in self.queue.schedulable()
+                if task.next_eligible_at <= now
+                and task.task_id not in bumped
+                and running[task.tenant] + task.gang.total_chips
+                <= self.quotas[task.tenant].chips
+                and gangs[task.tenant] < self.quotas[task.tenant].max_tasks]
+            shares = self._shares()
+            placed_one = False
+            for candidate in fair_share_order(eligible, running, weights):
+                if self._place(candidate, now):
+                    placed_one = True
+                    break
+                victims = select_victims(candidate, self.queue.placed(),
+                                         self.pool, running, shares)
+                if not victims:
+                    continue  # backfill: a later, smaller gang may still fit
+                for victim in victims:
+                    self.driver.preempt(victim, graceful=True)
+                    self._requeue(victim, now, charge_budget=False)
+                    bumped.add(victim.task_id)
+                if not self._place(candidate, now):
+                    raise SchedulerInvariantError(
+                        f"{candidate.task_id} still does not fit after "
+                        f"preempting {[victim.task_id for victim in victims]}")
+                placed_one = True
+                break
+            if not placed_one:
+                break
+
+        # 3. Fairness accounting + durable status snapshot.
+        for tenant, deficit in self.deficits().items():
+            if deficit > self.max_deficit.get(tenant, 0.0):
+                self.max_deficit[tenant] = deficit
+        self._persist_status(now)
+
+    # -- observation -----------------------------------------------------------
+    def status(self) -> dict:
+        shares = self._shares()
+        deficits = self.deficits()
+        tenants = {}
+        for tenant, quota in sorted(self.quotas.items()):
+            backlog = [task for task in self.queue.tasks.values()
+                       if task.tenant == tenant]
+            tenants[tenant] = {
+                "queued": sum(1 for task in backlog if task.schedulable),
+                "running_gangs": sum(1 for task in backlog
+                                     if task.state == "placed"),
+                "running_chips": self.queue.running_chips(tenant),
+                "quota_chips": quota.chips,
+                "quota_tasks": quota.max_tasks,
+                "weight": quota.weight,
+                "share_chips": round(shares.get(tenant, 0.0), 1),
+                "deficit_chips": round(deficits.get(tenant, 0.0), 1),
+                "requeues": self.requeues.get(tenant, 0),
+                "succeeded": sum(1 for task in backlog
+                                 if task.state == "succeeded"),
+                "failed": sum(1 for task in backlog if task.state == "failed"),
+            }
+        return {
+            "tenants": tenants,
+            "pool": {
+                "capacity_chips": self.pool.total_capacity,
+                "used_chips": self.pool.used_chips,
+                "utilization": round(self.pool.utilization(), 4),
+                "free_by_domain": list(self.pool.free),
+            },
+        }
+
+    def _persist_status(self, now: float) -> None:
+        backend = self.queue._backend
+        if backend is None:
+            return
+        snapshot = self.status()
+        snapshot["tick_at"] = now
+        backend.write(STATUS_KEY, json.dumps(snapshot, indent=2).encode())
+
+    def idle(self) -> bool:
+        """No schedulable or placed work left (every submission terminal)."""
+        return all(task.state in ("succeeded", "failed")
+                   for task in self.queue.tasks.values())

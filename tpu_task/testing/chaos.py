@@ -43,6 +43,7 @@ __all__ = [
     "ChaosTpuClient",
     "ChaosTransport",
     "flaky_storage",
+    "preemption_wave_at",
     "transient_http_error",
 ]
 
@@ -348,6 +349,42 @@ class ChaosTransport:
             return _TruncatedResponse(response, keep=max(
                 0, self._rng.randrange(0, 64)))
         return response
+
+
+# -- gang-scheduler seam -------------------------------------------------------
+
+def preemption_wave_at(schedule: ChaosSchedule, seconds: float, driver_ref,
+                       fraction: float = 0.4,
+                       graceful_rate: float = 0.5) -> None:
+    """Schedule a fleet-wide preemption wave: at ``seconds``, reclaim a
+    seeded ``fraction`` of every gang the scheduler has placed (mixed hard
+    and graceful kills per ``graceful_rate``), through the driver's chaos
+    seam — the capacity-reclaim shape a zone-wide spot event has.
+
+    ``driver_ref`` is a zero-arg callable returning the live driver (the
+    scheduler soak restarts its scheduler+driver mid-run; a direct
+    reference would address the dead one). The wave retries until at least
+    one gang is running, and records one ``wave`` fault for the flight
+    record. Draws come from the schedule's ``scheduler`` stream, so wave
+    composition replays from the seed like every other seam.
+    """
+    rng = schedule.derive(f"scheduler:wave:{seconds}")
+
+    def fire() -> bool:
+        driver = driver_ref()
+        running = driver.running_ids()
+        if not running:
+            return False
+        killed = 0
+        for task_id in running:
+            if rng.random() < fraction:
+                driver.kill(task_id, graceful=rng.random() < graceful_rate)
+                killed += 1
+        schedule.record("wave", detail=f"killed {killed}/{len(running)}")
+        return True
+
+    schedule.at(seconds, fire, label=f"preemption wave @{seconds:.0f}s",
+                deadline=300.0)
 
 
 # -- storage Backend seam ------------------------------------------------------
