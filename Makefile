@@ -41,7 +41,10 @@ bench-steady:
 
 # Serving cost model only: continuous-batching engine (paged KV cache) vs
 # batch-static generate on one mixed-length Poisson workload — throughput,
-# TTFT percentiles, KV high-water vs the dense worst case (runs on CPU).
+# TTFT percentiles, KV high-water vs the dense worst case — plus the three
+# production-traffic scenarios (shared-prefix workload through the
+# refcounted prefix cache, long-prompt-under-load through chunked prefill,
+# speculative accept-rate sweep); all run on CPU.
 bench-serving:
 	$(PYTHON) bench.py serving
 

@@ -48,7 +48,8 @@ def test_serving_config_validation():
     with pytest.raises(ValueError, match="ascending"):
         ServingConfig(prefill_buckets=(32, 16))
     with pytest.raises(ValueError, match="max_len"):
-        ServingConfig(prefill_buckets=(16, 512), max_len=256)
+        ServingConfig(prefill_buckets=(16, 512), max_len=256,
+                      prefill="bucketed", prefix_cache=False)
     scfg = ServingConfig(block_size=16, max_len=100,
                          prefill_buckets=(16, 32, 64))
     assert scfg.max_blocks_per_slot == 7     # ceil(100 / 16)
@@ -125,7 +126,7 @@ def test_engine_greedy_matches_generate(params):
     for rid, prompt, new in reqs:
         np.testing.assert_array_equal(
             np.array(out[rid]), _generate_ref(params, prompt, new))
-    assert eng.allocator.in_use == 0          # every block returned
+    assert eng.allocator.referenced == 0      # every reference returned
     assert eng.allocator.high_water > 0
 
 
@@ -182,7 +183,7 @@ def test_engine_tp8_greedy_matches_single_chip():
         eng = ServingEngine(params, TP8, scfg, mesh=mesh)
         rids = [eng.submit(p, n) for p, n in reqs]
         out = eng.drain()
-        assert eng.allocator.in_use == 0
+        assert eng.allocator.referenced == 0
         return [out[r] for r in rids], eng
 
     single, _ = run(None)
@@ -232,7 +233,7 @@ def test_engine_tp8_decodes_pool_exceeding_single_chip_budget():
     out = eng.drain()[rid]
     assert len(out) == 8
     assert all(0 <= t < cfg.vocab_size for t in out)
-    assert eng.allocator.in_use == 0
+    assert eng.allocator.referenced == 0
 
 
 def test_engine_tp8_prefill_logits_match_to_tolerance():
@@ -298,7 +299,7 @@ def test_engine_pool_exhaustion_preempts_and_still_matches_generate(params):
     for rid, prompt in reqs:
         np.testing.assert_array_equal(
             np.array(out[rid]), _generate_ref(params, prompt, 14))
-    assert eng.allocator.in_use == 0
+    assert eng.allocator.referenced == 0
     assert eng.allocator.high_water <= scfg.n_blocks - 1
 
 
@@ -312,17 +313,20 @@ def test_engine_eos_retires_early_and_prefix_matches(params):
     rid = eng.submit(prompt, 8, eos_token=eos)
     out = eng.drain()[rid]
     assert out == list(plain[:3])             # stops AT the eos, inclusive
-    assert eng.allocator.in_use == 0
+    assert eng.allocator.referenced == 0
 
 
 def test_engine_prefill_bucket_padding_has_no_effect(params):
     """The same prompt through a tighter and a looser bucket produces the
-    same tokens — pad rows never reach an unmasked read."""
+    same tokens — pad rows never reach an unmasked read. (Pinned to the
+    legacy bucketed path: the chunked default never pads to a bucket, so
+    only prefill="bucketed" exercises the pad-row masking.)"""
     prompt = np.random.default_rng(5).integers(0, 64, size=5)
 
     def run(buckets):
         scfg = ServingConfig(slots=2, block_size=4, n_blocks=32, max_len=32,
-                             prefill_buckets=buckets)
+                             prefill_buckets=buckets, prefill="bucketed",
+                             prefix_cache=False)
         eng = ServingEngine(params, TINY, scfg)
         rid = eng.submit(prompt, 7)
         return eng.drain()[rid]
@@ -331,8 +335,11 @@ def test_engine_prefill_bucket_padding_has_no_effect(params):
 
 
 def test_engine_submit_validation_and_poll(params):
+    # Legacy bucketed prefill: the bucket-fit check only applies there
+    # (chunked admits any prompt up to max_len).
     scfg = ServingConfig(slots=2, block_size=4, n_blocks=8, max_len=24,
-                         prefill_buckets=(8,))
+                         prefill_buckets=(8,), prefill="bucketed",
+                         prefix_cache=False)
     eng = ServingEngine(params, TINY, scfg)
     prompt = np.zeros((5,), np.int32)
     with pytest.raises(ValueError, match="at least one token"):

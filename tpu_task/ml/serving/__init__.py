@@ -5,22 +5,34 @@ Two halves (see docs/parity.md "Serving cost model" for the contract):
 - ``cache``: the paged KV memory — a shared physical block pool per layer
   plus per-slot block tables, host-side :class:`BlockAllocator`. KV bytes
   are O(live tokens) instead of the dense cache's O(slots × max_len).
-- ``model`` + ``engine``: bucketed-length prefill and a single jitted
-  decode step over a fixed slot array, driven by an iteration-level
-  scheduler (:class:`ServingEngine`) that admits queued requests into free
-  slots every step and retires finished ones immediately.
+- ``model`` + ``engine``: chunked (or legacy bucketed) prefill and a
+  single jitted decode step over a fixed slot array, driven by an
+  iteration-level scheduler (:class:`ServingEngine`) that admits queued
+  requests into free slots every step and retires finished ones
+  immediately.
+
+Production-traffic pieces ride the same substrate (ROADMAP item 2): a
+refcounted content-hash :class:`PrefixCache` (shared-prefix admissions
+prefill only the O(new tokens) tail, copy-on-write on shared partial
+blocks, LRU eviction only when the free list runs dry), Sarathi-style
+chunked prefill folded into the fused step, and speculative decoding
+(draft proposals scored by one fused multi-token target step, rejection
+sampling keeps the output distribution exact).
 
 Both halves decode through the SAME attention core as the offline
 ``generate`` path (``ml.ops.attention.gqa_cached_attention``), so paged
 and dense caches are bit-exact at fp32 — greedy tokens from the engine
-are pinned identical to ``generate``'s in the tier-1 suite.
+are pinned identical to ``generate``'s in the tier-1 suite, with the
+cache on or off, chunked or bucketed, speculative or not.
 """
 
 from tpu_task.ml.serving.cache import (
     SCRATCH_BLOCK,
     SERVING_POOL_RULES,
     BlockAllocator,
+    PrefixCache,
     ServingConfig,
+    chain_block_hashes,
     dense_cache_bytes,
     init_pools,
     kv_shard_bytes,
@@ -28,10 +40,11 @@ from tpu_task.ml.serving.cache import (
     paged_cache_bytes,
     pool_pspecs,
 )
-from tpu_task.ml.serving.engine import Request, ServingEngine
+from tpu_task.ml.serving.engine import DrainTimeout, Request, ServingEngine
 from tpu_task.ml.serving.model import (
     greedy_decode_step,
     paged_decode_step,
+    paged_multitoken_logits,
     paged_prefill,
     sample_tokens,
 )
@@ -40,9 +53,12 @@ __all__ = [
     "SCRATCH_BLOCK",
     "SERVING_POOL_RULES",
     "BlockAllocator",
+    "DrainTimeout",
+    "PrefixCache",
     "Request",
     "ServingConfig",
     "ServingEngine",
+    "chain_block_hashes",
     "dense_cache_bytes",
     "greedy_decode_step",
     "init_pools",

@@ -27,10 +27,12 @@ parity contract in docs/parity.md).
 
 from __future__ import annotations
 
+import hashlib
 from dataclasses import dataclass
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
+import numpy as np
 
 from tpu_task.ml.models.transformer import TransformerConfig
 
@@ -48,8 +50,24 @@ class ServingConfig:
     paged-pool geometry (``n_blocks`` INCLUDES the reserved scratch block).
     ``max_len``: per-slot logical capacity (prompt + generated); it bounds
     the block table width, not any allocation. ``prefill_buckets``: padded
-    prompt lengths — prefill compiles one program per bucket instead of one
-    per prompt length.
+    prompt lengths — legacy ``prefill="bucketed"`` compiles one program per
+    bucket instead of one per prompt length.
+
+    Production-traffic knobs:
+
+    - ``prefill``: ``"chunked"`` (default) folds prompt ingestion into the
+      fused decode step — each step ingests at most ``chunk_tokens`` prompt
+      positions of ONE admitting slot while every running slot still
+      decodes its token, so a long admission never stalls the others'
+      inter-token latency. ``"bucketed"`` is the legacy PR 5 path (whole
+      prompt in one padded program at admission) kept as the baseline.
+    - ``prefix_cache``: content-hash full KV blocks and share them across
+      requests (refcounts + copy-on-write); admission prefills only the
+      O(new tokens) tail. Requires ``prefill="chunked"`` (the tail is
+      ingested through the chunk program).
+    - ``spec_k``: speculative decoding — a draft model (passed to the
+      engine) proposes ``spec_k`` tokens per slot per step and ONE fused
+      target step scores all ``spec_k + 1`` positions. 0 disables.
     """
 
     slots: int = 8
@@ -57,6 +75,10 @@ class ServingConfig:
     n_blocks: int = 128
     max_len: int = 256
     prefill_buckets: Tuple[int, ...] = (16, 32, 64, 128)
+    prefill: str = "chunked"
+    chunk_tokens: int = 16
+    prefix_cache: bool = True
+    spec_k: int = 0
 
     def __post_init__(self):
         if self.slots < 1:
@@ -73,10 +95,25 @@ class ServingConfig:
             raise ValueError(
                 f"prefill_buckets must be non-empty strictly ascending, got "
                 f"{self.prefill_buckets}")
-        if self.prefill_buckets[-1] > self.max_len:
+        if self.prefill == "bucketed" and self.prefill_buckets[-1] > self.max_len:
+            # Chunked prefill never pads to a bucket, so the default bucket
+            # table may exceed a small max_len there without harm.
             raise ValueError(
                 f"largest prefill bucket {self.prefill_buckets[-1]} exceeds "
                 f"max_len {self.max_len}")
+        if self.prefill not in ("chunked", "bucketed"):
+            raise ValueError(
+                f"prefill must be 'chunked' or 'bucketed', got "
+                f"{self.prefill!r}")
+        if self.chunk_tokens < 1:
+            raise ValueError(
+                f"chunk_tokens must be >= 1, got {self.chunk_tokens}")
+        if self.prefix_cache and self.prefill != "chunked":
+            raise ValueError(
+                "prefix_cache needs prefill='chunked': a cache-hit "
+                "admission prefills only the tail, which is a chunk step")
+        if self.spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {self.spec_k}")
 
     @property
     def max_blocks_per_slot(self) -> int:
@@ -174,6 +211,17 @@ def token_slots(block_table, positions, block_size: int):
     return phys * block_size + positions % block_size
 
 
+def copy_block(pools: List[dict], src, dst) -> List[dict]:
+    """Copy physical block ``src`` to ``dst`` in every layer's k/v pool —
+    the device half of copy-on-write: a slot about to write into a block it
+    shares with the prefix cache gets a private copy first, so the donor
+    block's bytes (and every other reader's view) stay untouched. ``src``/
+    ``dst`` may be traced scalars: one compiled program covers every COW."""
+    return [{"k": pool["k"].at[dst].set(pool["k"][src]),
+             "v": pool["v"].at[dst].set(pool["v"][src])}
+            for pool in pools]
+
+
 def gather_kv(pool_flat, block_table, block_size: int):
     """Gather a (slots, max_blocks·block_size, kv, d) logical-order view of
     the pool through the block tables — the dense (b, L, kv, d) cache layout
@@ -185,9 +233,23 @@ def gather_kv(pool_flat, block_table, block_size: int):
 
 
 class BlockAllocator:
-    """Host-side free list over the physical blocks (block 0 excluded —
-    it is the scratch block). Tracks the high-water mark of live blocks so
-    the bench can report what a right-sized pool would have needed."""
+    """Host-side refcounted free list over the physical blocks (block 0
+    excluded — it is the scratch block). Every allocated block carries a
+    refcount: ``alloc`` hands out blocks at refcount 1, shared-prefix
+    mappings ``incref``, releases ``decref``. A block whose refcount hits 0
+    returns to the free list UNLESS the prefix cache has ``retain``-ed it —
+    retained refcount-0 blocks sit off both the free list and the live set
+    until the cache either resurrects them (``incref``) or evicts them
+    (``release``). Tracks the high-water mark of REFERENCED blocks (the
+    real working set) so the bench can report what a right-sized pool
+    would have needed — cache-retained refcount-0 blocks are excluded:
+    they are instantly reclaimable, so counting them would inflate the
+    metric toward the full pool size on any cache-on engine.
+
+    Invariants (property-tested in tests/test_serving_production.py):
+    refcounts are never negative; a block is never simultaneously free and
+    referenced (or free and retained); only refcount-0 blocks are ever
+    evicted back to the free list."""
 
     def __init__(self, n_blocks: int):
         if n_blocks < 2:
@@ -195,6 +257,8 @@ class BlockAllocator:
         self.n_blocks = n_blocks
         # Pop from the tail → lowest block numbers first (determinism aid).
         self._free = list(range(n_blocks - 1, SCRATCH_BLOCK, -1))
+        self._ref: Dict[int, int] = {}     # block -> refcount (>= 1)
+        self._retained: set = set()        # refcount-0 blocks the cache holds
         self.high_water = 0
 
     @property
@@ -203,22 +267,198 @@ class BlockAllocator:
 
     @property
     def in_use(self) -> int:
+        """Blocks off the free list — referenced or cache-retained."""
         return (self.n_blocks - 1) - len(self._free)
 
+    @property
+    def referenced(self) -> int:
+        """Blocks some slot still holds a reference to — the leak check:
+        after a full drain this must be 0 (cache-retained blocks are not
+        leaks; they are reclaimable the moment the free list runs dry)."""
+        return len(self._ref)
+
+    def refcount(self, block: int) -> int:
+        return self._ref.get(block, 0)
+
+    def is_free(self, block: int) -> bool:
+        return block in self._free
+
+    def is_retained(self, block: int) -> bool:
+        return block in self._retained
+
+    def _check(self, block: int) -> None:
+        if not SCRATCH_BLOCK < block < self.n_blocks:
+            raise ValueError(f"invalid block {block}")
+
     def alloc(self, n: int) -> Optional[List[int]]:
-        """``n`` blocks, or None (nothing allocated) if the pool can't."""
+        """``n`` fresh blocks at refcount 1, or None (nothing allocated) if
+        the free list can't cover it — the engine evicts cache-retained
+        blocks and retries before resorting to preemption."""
         if n < 0:
             raise ValueError(f"alloc({n})")
         if n > len(self._free):
             return None
         got = [self._free.pop() for _ in range(n)]
-        self.high_water = max(self.high_water, self.in_use)
+        for b in got:
+            self._ref[b] = 1
+        self.high_water = max(self.high_water, len(self._ref))
         return got
 
+    def incref(self, block: int) -> int:
+        """Add a reference — a new slot mapping a shared (possibly
+        retained refcount-0) block. The block must be off the free list."""
+        self._check(block)
+        if block in self._free:
+            raise ValueError(f"incref of free block {block}")
+        self._ref[block] = self._ref.get(block, 0) + 1
+        self.high_water = max(self.high_water, len(self._ref))
+        return self._ref[block]
+
+    def decref(self, block: int) -> int:
+        """Drop a reference; at 0 the block frees unless retained."""
+        self._check(block)
+        count = self._ref.get(block, 0)
+        if count < 1:
+            raise ValueError(f"decref of unreferenced block {block}")
+        count -= 1
+        if count:
+            self._ref[block] = count
+        else:
+            del self._ref[block]
+            if block not in self._retained:
+                self._free.append(block)
+        return count
+
+    def retain(self, block: int) -> None:
+        """Prefix-cache hold: keep the block off the free list at ref 0."""
+        self._check(block)
+        if block in self._free:
+            raise ValueError(f"retain of free block {block}")
+        self._retained.add(block)
+
+    def release(self, block: int) -> None:
+        """Drop the cache hold (eviction); frees the block iff ref 0."""
+        self._check(block)
+        if block not in self._retained:
+            raise ValueError(f"release of unretained block {block}")
+        self._retained.discard(block)
+        if block not in self._ref:
+            self._free.append(block)
+
     def free(self, blocks) -> None:
+        """Legacy exclusive-owner release: decref blocks that must be at
+        refcount 1 (kept for the bucketed path and the PR 5 tests)."""
         for b in blocks:
-            if not SCRATCH_BLOCK < b < self.n_blocks:
-                raise ValueError(f"free of invalid block {b}")
+            self._check(b)
             if b in self._free:
                 raise ValueError(f"double free of block {b}")
-            self._free.append(b)
+            self.decref(b)
+
+
+def chain_block_hashes(token_ids, block_size: int) -> List[bytes]:
+    """Content hash of each FULL block of ``token_ids``: the hash of the
+    token ids the block covers, chained on the previous block's hash — so a
+    block's hash identifies the whole prefix through it, and equal hashes
+    mean equal KV contents (same tokens, same positions, same weights)."""
+    ids = np.asarray(token_ids, np.int32)
+    out: List[bytes] = []
+    h = b""
+    for i in range(len(ids) // block_size):
+        h = hashlib.blake2b(
+            h + ids[i * block_size:(i + 1) * block_size].tobytes(),
+            digest_size=16).digest()
+        out.append(h)
+    return out
+
+
+class PrefixCache:
+    """Content-addressed registry of full KV blocks (vLLM-style shared
+    prefixes): hash → physical block. Retiring slots ``register`` their
+    full blocks; ``lookup`` maps a new prompt's longest cached prefix to
+    existing block ids (incref — zero prefill for those tokens). Blocks
+    whose refcount is 0 stay retained off the free list and are evicted in
+    LRU order ONLY when the free list runs dry, so caching never causes a
+    recompute preemption that an uncached engine would not have had."""
+
+    def __init__(self, allocator: BlockAllocator, block_size: int):
+        self._alloc = allocator
+        self.block_size = block_size
+        self._by_hash: Dict[bytes, int] = {}
+        self._hash_of: Dict[int, bytes] = {}
+        self._lru: Dict[int, int] = {}     # block -> last-touch tick
+        self._tick = 0
+        self.evictions = 0     # hit/miss/saved counters live on the engine
+                               # (admission-level, not per-lookup)
+
+    def __len__(self) -> int:
+        return len(self._by_hash)
+
+    def _touch(self, block: int) -> None:
+        self._tick += 1
+        self._lru[block] = self._tick
+
+    def lookup(self, token_ids) -> List[int]:
+        """Longest cached FULL-block prefix of ``token_ids``; each matched
+        block is incref'd (resurrecting retained refcount-0 blocks) and
+        LRU-touched, so a subsequent eviction pass cannot reclaim it out
+        from under the admission. Returns the physical block ids (possibly
+        empty); the caller decrefs them if the admission falls through."""
+        blocks: List[int] = []
+        for h in chain_block_hashes(token_ids, self.block_size):
+            b = self._by_hash.get(h)
+            if b is None:
+                break
+            blocks.append(b)
+        for b in blocks:
+            self._alloc.incref(b)
+            self._touch(b)
+        return blocks
+
+    def register(self, token_ids, table_blocks: Sequence[int]) -> int:
+        """Offer a releasing slot's blocks to the cache: every FULL block
+        of ``token_ids`` (``table_blocks[i]`` covers tokens [i·bs, (i+1)·bs))
+        is registered under its chained hash, or deduped onto an existing
+        entry holding the same content. Must be called BEFORE the caller
+        decrefs the blocks (registration retains them, so the decref leaves
+        them cached instead of free). Returns newly registered count."""
+        hashes = chain_block_hashes(token_ids, self.block_size)
+        if len(hashes) != len(table_blocks):
+            raise ValueError(
+                f"register: {len(table_blocks)} blocks but the token ids "
+                f"cover {len(hashes)} full blocks — the ids must be exactly "
+                "the context that produced the blocks' KV")
+        new = 0
+        for h, b in zip(hashes, table_blocks):
+            have = self._by_hash.get(h)
+            if have is not None:
+                self._touch(have)   # dedup: caller's decref frees b if sole
+                continue
+            self._by_hash[h] = b
+            self._hash_of[b] = h
+            self._alloc.retain(b)
+            self._touch(b)
+            new += 1
+        return new
+
+    def evict(self, n: int) -> int:
+        """Evict up to ``n`` refcount-0 cached blocks, LRU first, back to
+        the free list. Referenced blocks are never touched. Returns how
+        many blocks were actually reclaimed."""
+        victims = sorted(
+            (t, b) for b, t in self._lru.items()
+            if self._alloc.refcount(b) == 0)
+        freed = 0
+        for _, b in victims:
+            if freed >= n:
+                break
+            del self._by_hash[self._hash_of.pop(b)]
+            del self._lru[b]
+            self._alloc.release(b)
+            self.evictions += 1
+            freed += 1
+        return freed
+
+    def shared_blocks(self) -> int:
+        """Registered blocks currently referenced by at least one slot."""
+        return sum(1 for b in self._hash_of
+                   if self._alloc.refcount(b) > 0)

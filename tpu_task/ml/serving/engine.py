@@ -1,31 +1,56 @@
 """Continuous-batching serving engine: iteration-level scheduling over a
-fixed slot array (Orca, Yu et al., OSDI 2022) + the paged KV pool.
+fixed slot array (Orca, Yu et al., OSDI 2022) + the paged KV pool, grown
+for production-shaped traffic: shared-prefix KV caching, chunked prefill,
+and speculative decoding (ROADMAP item 2).
 
 The engine owns a fixed-width slot array and loops one scheduler iteration
 at a time (:meth:`ServingEngine.step`): retire slots that finished last
 step (their blocks return to the pool the same step), admit queued
-requests into free slots (bucketed-length prefill — one compiled program
-per bucket), then run ONE jitted decode step across all slots with
-per-slot positions and per-slot sampling params. A short request admitted
-behind a long one retires the moment ITS eos/length hits — no
-head-of-line blocking on the longest generation, which is the whole
-throughput argument (``bench.py serving`` measures it).
+requests into free slots, then run ONE jitted step across all slots.
+A short request admitted behind a long one retires the moment ITS
+eos/length hits — no head-of-line blocking on the longest generation.
+
+Three production pieces ride the paged substrate (all host-side scheduling
+over the same static-shape programs):
+
+- **Prefix cache** (``ServingConfig.prefix_cache``): full KV blocks are
+  content-hashed (chained over the token ids they cover) and registered
+  when a slot releases them; a new admission maps its longest cached
+  prefix to the existing physical blocks (refcounted) and prefills only
+  the O(new tokens) tail. A slot that must write into a shared block gets
+  a private copy first (copy-on-write); refcount-0 cached blocks are
+  evicted LRU only when the free list runs dry, so the cache never causes
+  a recompute preemption an uncached engine would not have had.
+- **Chunked prefill** (``ServingConfig.prefill="chunked"``, the default):
+  prompt ingestion folds into the fused step — each iteration ingests at
+  most ``chunk_tokens`` prompt positions of ONE admitting slot while every
+  running slot still decodes its token (Sarathi-style), so a long
+  admission bounds other slots' inter-token stall by one chunk, not one
+  prompt. ``"bucketed"`` keeps the legacy PR 5 whole-prompt-per-program
+  path as the comparison baseline; both produce bit-identical greedy
+  streams (docs/parity.md).
+- **Speculative decoding** (``ServingConfig.spec_k`` + a draft model):
+  a small draft proposes up to ``spec_k`` tokens per slot (greedy, its own
+  cache in a statically-tabled paged pool), ONE fused target step scores
+  all ``spec_k + 1`` positions (the chunked multi-token step reused), and
+  acceptance commits in place — greedy output is bit-identical to
+  non-speculative decoding (longest agreeing prefix + bonus token);
+  sampled requests go through rejection sampling against the SAME
+  temper-then-top_p-filtered target distribution (distribution-exact).
 
 Admission takes a request when a slot is free and the pool holds its
-prompt's blocks plus one spare; growth past that is lazy (a block at each
-block boundary). If the pool is exhausted mid-decode the youngest running
-request is preempted back to the queue head (recompute-style, vLLM's
-fallback policy): its blocks free immediately and its token stream is
+(uncached) prompt blocks plus one spare; growth past that is lazy. If the
+pool is exhausted mid-decode the engine first evicts refcount-0 cached
+blocks, then preempts the youngest running request back to the queue head
+(recompute-style): its blocks free immediately and its token stream is
 reproduced exactly on re-admission because sampling keys derive from the
 request key alone (fold_in per token index), never from the schedule.
 
-Host/device split: the scheduler (allocator, slot table, queues, timing)
-is plain Python/numpy; the device sees only static-shape jitted programs
-(prefill per bucket, one decode step, one sampler per logits shape) whose
-inputs — tokens, positions, block tables, active mask, sampling params —
-are tiny per-step arrays. ``TPU_TASK_CHECKIFY=1`` (debug mode) wraps every
-program in ``jax.experimental.checkify`` and throws on the bounds guards
-(`decoding.bounds_guard`) that are silent no-ops in production."""
+Host/device split: the scheduler (allocator, prefix cache, slot table,
+queues, timing) is plain Python/numpy; the device sees only static-shape
+jitted programs whose inputs are tiny per-step arrays.
+``TPU_TASK_CHECKIFY=1`` (debug mode) wraps every program in
+``jax.experimental.checkify`` and throws on the bounds guards."""
 
 from __future__ import annotations
 
@@ -50,20 +75,43 @@ from tpu_task.ml.parallel.sharding import (
 from tpu_task.ml.serving.cache import (
     SCRATCH_BLOCK,
     BlockAllocator,
+    PrefixCache,
     ServingConfig,
+    copy_block,
     init_pools,
     kv_shard_bytes,
     paged_cache_bytes,
     pool_pspecs,
 )
 from tpu_task.ml.serving.model import (
+    chunked_step_greedy,
     decode_and_sample,
     greedy_decode_step,
     paged_prefill,
     sample_tokens,
+    spec_score_greedy,
+    spec_score_probs,
 )
 
 QUEUED, RUNNING, DONE = "queued", "running", "done"
+
+#: Salt folded into a request's key before deriving per-position uniforms
+#: for speculative rejection sampling — keeps the spec stream disjoint from
+#: the ``fold_in(key, token_index)`` stream the plain sampler consumes.
+_SPEC_SALT = 0x5BEC
+
+
+class DrainTimeout(RuntimeError):
+    """:meth:`ServingEngine.drain` ran out of steps with work in flight.
+    Carries the ids of every request not yet done so callers can requeue
+    or report them instead of silently losing partial results."""
+
+    def __init__(self, max_steps: int, unfinished: List[int]):
+        self.max_steps = max_steps
+        self.unfinished = sorted(unfinished)
+        super().__init__(
+            f"drain exceeded {max_steps} steps with {len(self.unfinished)} "
+            f"unfinished request(s): {self.unfinished}")
 
 
 @dataclass
@@ -96,19 +144,16 @@ class ServingEngine:
     """Front end: :meth:`submit` → request id, :meth:`poll` → status/tokens,
     :meth:`step` → one scheduler iteration, :meth:`drain` → run to empty.
 
-    ``mesh=`` turns on tensor-parallel serving: weights shard per the
-    logical rules (heads/mlp/vocab over ``tp``), the paged KV pools shard
-    their kv-head axis over ``tp`` (so per-device KV bytes divide by tp —
-    a model whose KV pool exceeds one chip decodes across the mesh), and
-    the scheduler is UNCHANGED: block tables, positions, and masks
-    replicate, and paging stays along the token axis. Requires
-    ``cfg.kv_heads % tp == 0``. Greedy token streams are schedule- and
-    shard-identical to the single-chip engine on small configs (pinned in
-    tier-1); logits agree to accumulation-order tolerance (docs/parity.md)."""
+    ``mesh=`` turns on tensor-parallel serving exactly as in PR 6 (weights
+    per the logical rules, paged pools kv-head-sharded, scheduler
+    unchanged). ``draft_params``/``draft_cfg`` + ``scfg.spec_k > 0`` turn
+    on speculative decoding (single-chip only for now)."""
 
     def __init__(self, params: Params, cfg: TransformerConfig,
                  scfg: Optional[ServingConfig] = None,
-                 rng: Optional[jax.Array] = None, mesh=None):
+                 rng: Optional[jax.Array] = None, mesh=None,
+                 draft_params: Optional[Params] = None,
+                 draft_cfg: Optional[TransformerConfig] = None):
         self.cfg = cfg
         self.scfg = scfg = scfg or ServingConfig()
         self.mesh = mesh
@@ -124,7 +169,7 @@ class ServingEngine:
             # everything the host scheduler owns — tokens, positions, block
             # tables, active masks, sampling params — replicates. Paging is
             # along the token axis, so block accounting (allocator, tables,
-            # scratch block) is IDENTICAL at every tp width.
+            # scratch block, prefix cache) is IDENTICAL at every tp width.
             self.tp = int(dict(mesh.shape).get("tp", 1))
             if cfg.kv_heads % self.tp:
                 raise ValueError(
@@ -136,7 +181,25 @@ class ServingEngine:
             self.params = device_put_tree(params, self._param_specs, mesh)
             self.pools = device_put_tree(pools, self._pool_specs, mesh)
         self.allocator = BlockAllocator(scfg.n_blocks)
+        self._pcache = (PrefixCache(self.allocator, scfg.block_size)
+                        if scfg.prefix_cache else None)
         self.debug = os.environ.get("TPU_TASK_CHECKIFY", "") == "1"
+
+        # Speculative decoding: validate the draft triple together.
+        self._spec_on = scfg.spec_k > 0
+        if self._spec_on and (draft_params is None or draft_cfg is None):
+            raise ValueError(
+                "spec_k > 0 needs draft_params and draft_cfg")
+        if self._spec_on and mesh is not None:
+            raise ValueError(
+                "speculative decoding is single-chip for now (the draft "
+                "cache is unsharded)")
+        if draft_cfg is not None and draft_cfg.vocab_size != cfg.vocab_size:
+            raise ValueError(
+                f"draft vocab {draft_cfg.vocab_size} != target vocab "
+                f"{cfg.vocab_size}")
+        self.draft_cfg = draft_cfg
+        self.draft_params = draft_params
 
         n, m = scfg.slots, scfg.max_blocks_per_slot
         self._slots: List[Optional[Request]] = [None] * n
@@ -153,6 +216,32 @@ class ServingEngine:
         self.steps = 0
         self.decode_steps = 0
         self.prefills = 0
+        self.prefill_chunks = 0
+        self.chunk_steps = 0
+        self.preemption_count = 0
+        self.cow_copies = 0
+        self.prefix_hit_blocks = 0
+        self.prefix_miss_blocks = 0
+        self.prefix_hit_requests = 0
+        self.prefix_tokens_saved = 0
+        self.spec_rounds = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+
+        # Draft-model state: its "dense" cache is a paged pool with a
+        # STATIC identity block layout — slot s owns blocks
+        # [1 + s·m, 1 + (s+1)·m), never allocated or freed — so every
+        # draft pass reuses the battle-tested paged programs unchanged.
+        if self._spec_on:
+            d_shape = (n * m + 1, scfg.block_size, draft_cfg.kv_heads,
+                       draft_cfg.d_head)
+            self._draft_pools = [
+                {"k": jnp.zeros(d_shape, draft_cfg.dtype),
+                 "v": jnp.zeros(d_shape, draft_cfg.dtype)}
+                for _ in range(draft_cfg.n_layers)]
+            self._draft_tables = jnp.asarray(
+                1 + np.arange(n * m, dtype=np.int32).reshape(n, m))
+        self._draft_pos = np.zeros((n,), np.int32)
 
         # Pools are DONATED: the engine owns them exclusively and replaces
         # its reference with the returned ones, so XLA updates the block
@@ -164,12 +253,13 @@ class ServingEngine:
         # seam the train-step builders use.
         rep = PartitionSpec()
 
-        def plan(arg_specs, donate):
+        def plan(arg_specs, donate, out=None):
             if mesh is None:
                 return PartitionPlan(donate=donate)
             return PartitionPlan(
                 mesh=mesh, in_specs=arg_specs,
-                out_specs=(rep, self._pool_specs), donate=donate)
+                out_specs=(rep, self._pool_specs) if out is None else out,
+                donate=donate)
 
         p_specs = getattr(self, "_param_specs", None)
         k_specs = getattr(self, "_pool_specs", None)
@@ -199,6 +289,53 @@ class ServingEngine:
         self._prefill_sample_fn = self._wrap(jax.jit(
             lambda logits, temp, top, key, n: sample_tokens(
                 logits, temp, top, jax.random.fold_in(key, n)[None])))
+        # Chunked prefill needs no program of its own: the fused chunk
+        # step is the decode program above, specialized at the packed
+        # batch slots + chunk_tokens (see _chunk_step).
+        # Copy-on-write: one compiled program copies a physical block in
+        # every layer (traced src/dst — a single compile covers all COWs).
+        self._copy_block_fn = self._wrap(compile_step(
+            lambda pools, src, dst: copy_block(pools, src, dst),
+            plan((k_specs, rep, rep), (0,),
+                 out=k_specs if mesh is not None else None)))
+        if self._spec_on:
+            # Target scoring: the chunked multi-token step at width k+1.
+            self._spec_greedy_fn = self._wrap(compile_step(
+                lambda params, tokens, positions, valid, tables, pools:
+                spec_score_greedy(params, cfg, tokens, positions, valid,
+                                  tables, pools),
+                PartitionPlan(donate=(5,))))
+            self._spec_probs_fn = self._wrap(compile_step(
+                lambda params, tokens, positions, valid, tables, temps,
+                tops, pools: spec_score_probs(
+                    params, cfg, tokens, positions, valid, tables, temps,
+                    tops, pools),
+                PartitionPlan(donate=(7,))))
+            # Draft programs: plain decode step (proposals) + multi-token
+            # chunk (prompt ingestion / catch-up), compiled on draft_cfg.
+            self._draft_decode_fn = self._wrap(compile_step(
+                lambda params, tokens, positions, tables, active, pools:
+                greedy_decode_step(params, draft_cfg, tokens, positions,
+                                   tables, active, pools),
+                PartitionPlan(donate=(5,))))
+            self._draft_chunk_fn = self._wrap(compile_step(
+                lambda params, tokens, positions, valid, last_idx, tables,
+                pools: chunked_step_greedy(
+                    params, draft_cfg, tokens, positions, valid, last_idx,
+                    tables, pools),
+                PartitionPlan(donate=(6,))))
+            # Rejection-sampling uniforms for a WHOLE round in one call:
+            # (slots, k+1, 2) — two uniforms per (request, absolute
+            # position), derived exactly as the per-position contract
+            # documents (fold_in(key, SALT) then fold_in(position)), so
+            # one dispatch replaces up to slots × (k+1) host round-trips.
+            self._spec_uniform_fn = jax.jit(
+                lambda keys, positions: jax.vmap(
+                    lambda k_, p_: jax.random.uniform(
+                        jax.random.fold_in(
+                            jax.random.fold_in(k_, _SPEC_SALT), p_), (2,))
+                )(jnp.repeat(keys, positions.shape[1], axis=0),
+                  positions.reshape(-1)).reshape(*positions.shape, 2))
 
     def _wrap(self, fn):
         """Debug mode: functionalize the bounds guards and throw on them."""
@@ -233,7 +370,8 @@ class ServingEngine:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         if top_p is not None and temperature == 0:
             raise ValueError("top_p needs temperature > 0 (greedy ignores it)")
-        self.scfg.bucket_for(len(prompt))  # must fit a prefill bucket
+        if self.scfg.prefill == "bucketed":
+            self.scfg.bucket_for(len(prompt))  # must fit a prefill bucket
         total = len(prompt) + max_new_tokens
         if total > self.scfg.max_len:
             raise ValueError(
@@ -278,28 +416,56 @@ class ServingEngine:
         return bool(self._queue) or self.n_active > 0
 
     def step(self) -> dict:
-        """One scheduler iteration: admit → decode → retire. Returns what
-        happened (request ids admitted/finished, active count)."""
+        """One scheduler iteration: admit → (chunk|spec|decode) → retire.
+        Returns what happened (request ids admitted/finished, active)."""
         self.steps += 1
         admitted, finished = [], []
         self._admit(admitted, finished)
         if self.n_active:
-            self._decode(finished)
+            prefilling = self.scfg.prefill == "chunked" and any(
+                self._prefilling(i) for i in range(self.scfg.slots))
+            if prefilling:
+                # With spec on, the chunk program advances ONLY the
+                # ingesting slot and the spec round below advances the
+                # decoders: a request's post-first tokens then ALWAYS come
+                # from the position-keyed spec streams, so its sampled
+                # stream is identical under any co-scheduling (the same
+                # schedule-independence the plain sampler's fold_in keys
+                # give the non-speculative engine).
+                self._chunk_step(finished)
+            if self._spec_on:
+                self._spec_step(finished)
+            elif not prefilling:
+                self._decode(finished)
         return {"admitted": admitted, "finished": finished,
                 "active": self.n_active, "queued": len(self._queue)}
 
     def drain(self, max_steps: int = 100_000) -> Dict[int, List[int]]:
         """Step until queue and slots are empty; returns {rid: tokens} for
-        every request ever submitted."""
+        every request ever submitted. Raises :class:`DrainTimeout` (with
+        the unfinished request ids) if ``max_steps`` is exhausted first —
+        partial results are never returned silently."""
         steps = 0
         while self.has_work:
             if steps >= max_steps:
-                raise RuntimeError(f"drain exceeded {max_steps} steps")
+                raise DrainTimeout(
+                    max_steps,
+                    [rid for rid, r in self._requests.items()
+                     if r.status != DONE])
             self.step()
             steps += 1
         return {rid: list(r.tokens) for rid, r in self._requests.items()}
 
     # -- scheduler internals -------------------------------------------------
+
+    def _prefilling(self, slot: int) -> bool:
+        req = self._slots[slot]
+        return req is not None and \
+            int(self._positions[slot]) < len(req.prompt)
+
+    def _context_ids(self, req: Request) -> np.ndarray:
+        return np.concatenate(
+            [req.prompt, np.asarray(req.tokens, np.int32)])
 
     def _sample_one(self, req: Request, logits) -> int:
         tok = self._prefill_sample_fn(
@@ -308,7 +474,88 @@ class ServingEngine:
             jnp.int32(len(req.tokens)))
         return int(tok[0])
 
+    def _reserve(self, n: int, spare: int) -> Optional[List[int]]:
+        """``n`` blocks with ``spare`` more left free afterwards, evicting
+        refcount-0 cached blocks (LRU) if the free list alone can't cover
+        it. None (nothing taken) when even eviction can't."""
+        shortfall = n + spare - self.allocator.available
+        if shortfall > 0 and self._pcache is not None:
+            self._pcache.evict(shortfall)
+        if self.allocator.available < n + spare:
+            return None
+        return self.allocator.alloc(n)
+
     def _admit(self, admitted: list, finished: list) -> None:
+        if self.scfg.prefill == "chunked":
+            self._admit_chunked(admitted)
+        else:
+            self._admit_bucketed(admitted, finished)
+
+    def _admit_chunked(self, admitted: list) -> None:
+        """Assign a free slot + blocks; prompt ingestion happens across the
+        following steps' chunk programs. At most ONE slot prefills at a
+        time — its chunk IS the step's prefill token budget."""
+        bs = self.scfg.block_size
+        while self._queue:
+            if any(self._prefilling(i) for i in range(self.scfg.slots)):
+                return
+            slot = next(
+                (i for i, r in enumerate(self._slots) if r is None), None)
+            if slot is None:
+                return
+            req = self._queue[0]
+            plen = len(req.prompt)
+            cached: List[int] = []
+            if self._pcache is not None:
+                cached = self._pcache.lookup(req.prompt)   # increfs
+            # The last prompt token is ALWAYS recomputed (its logits seed
+            # the first sample), so a whole-prompt hit caps at plen - 1 —
+            # and that one write lands inside the final shared block, the
+            # copy-on-write case (cow below).
+            cached_len = min(len(cached) * bs, plen - 1)
+            cow = 1 if cached_len < len(cached) * bs else 0
+            need = self.scfg.blocks_for(plen) - len(cached)
+            got = self._reserve(need + cow,
+                                1 if self.n_active else 0)
+            if got is None:
+                for b in cached:
+                    self.allocator.decref(b)
+                return
+            self._queue.popleft()
+            table = np.zeros((self.scfg.max_blocks_per_slot,), np.int32)
+            table[:len(cached)] = cached
+            if need:
+                table[len(cached):len(cached) + need] = got[:need]
+            if cow:
+                # COW the final shared block: private copy, rewire the
+                # table, drop our ref on the donor (it stays cached, its
+                # bytes untouched — pinned by the property test).
+                src = int(table[cached_len // bs])
+                dst = got[need]
+                self.pools = self._copy_block_fn(
+                    self.pools, jnp.int32(src), jnp.int32(dst))
+                table[cached_len // bs] = dst
+                self.allocator.decref(src)
+                self.cow_copies += 1
+            if cached:
+                self.prefix_hit_requests += 1
+            self.prefix_hit_blocks += len(cached)
+            self.prefix_miss_blocks += plen // bs - len(cached)
+            self.prefix_tokens_saved += cached_len
+            req.status = RUNNING
+            self._slots[slot] = req
+            self._admit_counter += 1
+            self._admit_seq[slot] = self._admit_counter
+            self._slot_keys[slot] = np.asarray(req.key, np.uint32)
+            self._tables[slot] = table
+            self._positions[slot] = cached_len
+            self._last_token[slot] = 0
+            self._draft_pos[slot] = 0
+            admitted.append(req.rid)
+
+    def _admit_bucketed(self, admitted: list, finished: list) -> None:
+        """Legacy PR 5 admission: the whole prompt through one padded
+        prefill program, first token sampled immediately."""
         while self._queue:
             slot = next(
                 (i for i, r in enumerate(self._slots) if r is None), None)
@@ -320,10 +567,10 @@ class ServingEngine:
             # boundary without an instant preemption; an idle engine admits
             # with no spare (a solo request can always grow into the pool
             # its own submit-time validation reserved).
-            if self.allocator.available < need + (1 if self.n_active else 0):
+            blocks = self._reserve(need, 1 if self.n_active else 0)
+            if blocks is None:
                 return
             self._queue.popleft()
-            blocks = self.allocator.alloc(need)
             bucket = self.scfg.bucket_for(len(req.prompt))
             table = np.zeros((self.scfg.max_blocks_per_slot,), np.int32)
             table[:need] = blocks
@@ -346,62 +593,94 @@ class ServingEngine:
             self._tables[slot] = table
             self._positions[slot] = len(req.prompt)
             self._last_token[slot] = first
+            self._draft_pos[slot] = 0
             admitted.append(req.rid)
             if req.finished:
                 self._retire(slot)
                 finished.append(req.rid)
 
-    def _ensure_blocks(self) -> None:
-        """Every active slot whose next write crosses into an unallocated
-        block gets one — preempting the youngest running request (requeued
-        at the head, restart-from-scratch recompute) when the pool is dry."""
+    def _ensure_blocks(self, widths: Optional[np.ndarray] = None) -> None:
+        """Every active slot gets blocks covering its next ``widths[i]``
+        writes (default 1; a prefill chunk or a speculative span needs
+        more) — evicting refcount-0 cached blocks first, then preempting
+        the youngest running request (requeued at the head,
+        restart-from-scratch recompute) when the pool is truly dry."""
         for slot in sorted(range(self.scfg.slots),
                            key=lambda i: self._admit_seq[i]):
             req = self._slots[slot]
             if req is None:
                 continue
-            block_i = int(self._positions[slot]) // self.scfg.block_size
-            while self._tables[slot, block_i] == SCRATCH_BLOCK:
-                got = self.allocator.alloc(1)
-                if got is not None:
-                    self._tables[slot, block_i] = got[0]
+            w = int(widths[slot]) if widths is not None else 1
+            if not w:                     # spec-held row: nothing to write
+                continue
+            pos = int(self._positions[slot])
+            last_block = (pos + max(w, 1) - 1) // self.scfg.block_size
+            preempted_self = False
+            for block_i in range(pos // self.scfg.block_size,
+                                 last_block + 1):
+                while self._tables[slot, block_i] == SCRATCH_BLOCK:
+                    got = self._reserve(1, 0)
+                    if got is not None:
+                        self._tables[slot, block_i] = got[0]
+                        break
+                    victim = max(
+                        (i for i, r in enumerate(self._slots)
+                         if r is not None),
+                        key=lambda i: self._admit_seq[i])
+                    self._preempt(victim)
+                    if victim == slot:
+                        preempted_self = True
+                        break  # this slot itself was youngest — requeued
+                    if self.n_active <= 1 and self.allocator.available == 0 \
+                            and (self._pcache is None
+                                 or self._pcache.evict(1) == 0):
+                        raise RuntimeError(
+                            "KV pool too small for a single request — "
+                            "raise n_blocks")
+                if preempted_self:
                     break
-                victim = max(
-                    (i for i, r in enumerate(self._slots) if r is not None),
-                    key=lambda i: self._admit_seq[i])
-                self._preempt(victim)
-                if victim == slot:
-                    break  # this slot itself was youngest — it is requeued
-                if self.n_active <= 1 and self.allocator.available == 0:
-                    raise RuntimeError(
-                        "KV pool too small for a single request — raise "
-                        "n_blocks")
 
     def _preempt(self, slot: int) -> None:
         req = self._slots[slot]
         req.preemptions += 1
+        self.preemption_count += 1
         req.status = QUEUED
-        req.tokens.clear()   # recompute policy: the keyed sampling stream
-        req.first_token_t = None  # reproduces the same tokens on
-        self._release(slot)       # re-admission; TTFT restarts honestly
+        # Release BEFORE clearing tokens: _release registers full blocks
+        # with the prefix cache under the ids that produced their KV
+        # (prompt + generated so far), so the hash list and the block list
+        # must line up. The keyed sampling stream reproduces the same
+        # tokens on re-admission; TTFT restarts honestly.
+        self._release(slot)
+        req.tokens.clear()
+        req.first_token_t = None
         self._queue.appendleft(req)
+
+    # -- fused steps ---------------------------------------------------------
+
+    def _all_greedy(self) -> bool:
+        return all(r is None or r.temperature == 0 for r in self._slots)
+
+    def _temps_tops(self):
+        """Per-slot (temperature, top_p) arrays for the sampling programs
+        (empty slots: greedy/identity — their outputs are discarded)."""
+        temps = np.array(
+            [r.temperature if r else 0.0 for r in self._slots], np.float32)
+        tops = np.array(
+            [r.top_p if r else 1.0 for r in self._slots], np.float32)
+        return temps, tops
 
     def _decode(self, finished: list) -> None:
         self._ensure_blocks()
         active = np.array([r is not None for r in self._slots])
         if not active.any():
             return
-        if all(r is None or r.temperature == 0 for r in self._slots):
+        if self._all_greedy():
             toks, self.pools = self._decode_greedy_fn(
                 self.params, jnp.asarray(self._last_token),
                 jnp.asarray(np.where(active, self._positions, 0)),
                 jnp.asarray(self._tables), jnp.asarray(active), self.pools)
         else:
-            temps = np.array(
-                [r.temperature if r else 0.0 for r in self._slots],
-                np.float32)
-            tops = np.array([r.top_p if r else 1.0 for r in self._slots],
-                            np.float32)
+            temps, tops = self._temps_tops()
             ngen = np.array([len(r.tokens) if r else 0 for r in self._slots],
                             np.int32)
             toks, self.pools = self._decode_fn(
@@ -426,13 +705,331 @@ class ServingEngine:
                 self._retire(slot)
                 finished.append(req.rid)
 
+    def _chunk_step(self, finished: list) -> None:
+        """ONE fused iteration: the admitting slot ingests its next prompt
+        chunk (≤ chunk_tokens positions) while every decode-phase slot
+        advances its token — the Sarathi fold that bounds running slots'
+        inter-token stall by one chunk instead of one whole prompt.
+
+        The step is TOKEN-PACKED: the program is the plain decode step at
+        batch ``slots + chunk_tokens`` — rows 0..slots-1 are the decode
+        slots (one token each) and rows slots.. are the admitting slot's
+        chunk, one token per row, all sharing that slot's block table. The
+        per-step token budget is therefore exactly slots + chunk_tokens
+        positions of compute (a padded (slots, chunk) layout would pay
+        slots × chunk — width for every row), and the program is the SAME
+        jitted decode function, merely specialized at the packed batch.
+        In-chunk causality needs no extra machinery: every row scatters
+        its k/v before any row gathers, and the position mask gives each
+        chunk token exactly its predecessors."""
+        n, W = self.scfg.slots, self.scfg.chunk_tokens
+
+        def chunk_widths() -> np.ndarray:
+            # With spec on, decode rows are HELD here (width 0) — the spec
+            # round this same scheduler step advances them instead, keeping
+            # every sampled token on the position-keyed spec streams.
+            w = np.zeros((n,), np.int32)
+            for i, req in enumerate(self._slots):
+                if req is None:
+                    continue
+                pos = int(self._positions[i])
+                if pos < len(req.prompt):
+                    w[i] = min(W, len(req.prompt) - pos)
+                elif not self._spec_on:
+                    w[i] = 1
+            return w
+
+        self._ensure_blocks(chunk_widths())
+        if not self.n_active:
+            return
+        widths = chunk_widths()           # preemption may have freed slots
+        if not widths.max():              # the ingesting slot was preempted
+            return
+        pre = next((i for i in range(n) if self._prefilling(i)), None)
+        R = n + W
+        tokens = np.zeros((R,), np.int32)
+        positions = np.zeros((R,), np.int32)
+        tables = np.zeros((R, self.scfg.max_blocks_per_slot), np.int32)
+        active = np.zeros((R,), bool)
+        temps = np.zeros((R,), np.float32)
+        tops = np.ones((R,), np.float32)
+        keys = np.zeros((R, 2), np.uint32)
+        ngen = np.zeros((R,), np.int32)
+        tables[:n] = self._tables
+        temps[:n], tops[:n] = self._temps_tops()
+        for i, req in enumerate(self._slots):
+            if req is None or not widths[i] or i == pre:
+                continue
+            tokens[i] = self._last_token[i]
+            positions[i] = self._positions[i]
+            active[i] = True
+            keys[i], ngen[i] = self._slot_keys[i], len(req.tokens)
+        c = 0
+        if pre is not None:
+            req = self._slots[pre]
+            pos, c = int(self._positions[pre]), int(widths[pre])
+            tokens[n:n + c] = req.prompt[pos:pos + c]
+            positions[n:n + c] = np.arange(pos, pos + c)
+            tables[n:] = self._tables[pre]
+            active[n:n + c] = True
+            temps[n:n + c], tops[n:n + c] = req.temperature, req.top_p
+            keys[n:n + c] = self._slot_keys[pre]   # ngen 0: first token rides
+            # the same fold_in(key, 0) draw a bucketed admission makes.
+        if self._all_greedy():
+            toks, self.pools = self._decode_greedy_fn(
+                self.params, jnp.asarray(tokens),
+                jnp.asarray(np.where(active, positions, 0)),
+                jnp.asarray(tables), jnp.asarray(active), self.pools)
+        else:
+            toks, self.pools = self._decode_fn(
+                self.params, jnp.asarray(tokens),
+                jnp.asarray(np.where(active, positions, 0)),
+                jnp.asarray(tables), jnp.asarray(active),
+                jnp.asarray(temps), jnp.asarray(tops), jnp.asarray(keys),
+                jnp.asarray(ngen), self.pools)
+        self.chunk_steps += 1
+        toks = np.asarray(toks)
+        now = time.monotonic()
+        for i, req in enumerate(self._slots):
+            if req is None or not widths[i]:        # empty or spec-held row
+                continue
+            if i == pre:                            # prefill rows
+                self._positions[i] = pos + c
+                self.prefill_chunks += 1
+                if pos + c < len(req.prompt):
+                    continue                        # mid-prompt: no token
+                self.prefills += 1                  # prompt complete
+                tok = int(toks[n + c - 1])          # last chunk row's sample
+            else:                                   # decode row
+                self._positions[i] = int(self._positions[i]) + 1
+                tok = int(toks[i])
+            req.tokens.append(tok)
+            if req.first_token_t is None:
+                req.first_token_t = now
+            self._last_token[i] = tok
+            if req.finished:
+                self._retire(i)
+                finished.append(req.rid)
+
+    # -- speculative decoding ------------------------------------------------
+
+    def _spec_step(self, finished: list) -> None:
+        """One speculative round: the draft proposes up to ``spec_k``
+        tokens per slot (greedy — its proposal distribution is a point
+        mass, so rejection sampling reduces to accept-with-prob-p(d)), ONE
+        fused target step scores all k+1 positions, and the host commits
+        the accepted prefix + one bonus/replacement token in place."""
+        n, k = self.scfg.slots, self.scfg.spec_k
+        bs = self.scfg.block_size
+
+        def live(i: int) -> bool:
+            # Mid-prompt slots advance through the chunk program, never a
+            # spec round — their row here stays fully masked.
+            return self._slots[i] is not None and not self._prefilling(i)
+
+        def eff() -> np.ndarray:
+            ke = np.zeros((n,), np.int32)
+            for i, req in enumerate(self._slots):
+                if not live(i):
+                    continue
+                remaining = req.max_new_tokens - len(req.tokens)
+                # emitted ≤ ke+1 must stay within remaining, and the last
+                # scored position must stay inside the slot's table.
+                cap = self.scfg.max_blocks_per_slot * bs - 1 \
+                    - int(self._positions[i])
+                ke[i] = max(0, min(k, remaining - 1, cap))
+            return ke
+
+        want = eff()
+        self._ensure_blocks(np.asarray(
+            [want[i] + 1 if live(i) else 0 for i in range(n)], np.int32))
+        if not any(live(i) for i in range(n)):
+            return
+        k_eff = eff()                      # preemption may have freed slots
+        # NOTE: even an all-zero k_eff round scores through the spec
+        # program (width 1 valid), so a sampled request's tokens always
+        # ride the position-keyed spec streams — never a mix with the
+        # plain sampler that would make the stream schedule-dependent.
+        self._draft_catchup()
+        proposals = self._draft_propose(k_eff)
+
+        tokens = np.zeros((n, k + 1), np.int32)
+        positions = np.zeros((n, k + 1), np.int32)
+        valid = np.zeros((n, k + 1), bool)
+        for i, req in enumerate(self._slots):
+            if not live(i):
+                continue
+            ke, pos = int(k_eff[i]), int(self._positions[i])
+            tokens[i, 0] = self._last_token[i]
+            tokens[i, 1:ke + 1] = proposals[i, :ke]
+            positions[i, :ke + 1] = np.arange(pos, pos + ke + 1)
+            valid[i, :ke + 1] = True
+        if self._all_greedy():
+            scored, self.pools = self._spec_greedy_fn(
+                self.params, jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(valid), jnp.asarray(self._tables), self.pools)
+            probs = None
+            scored = np.asarray(scored)
+        else:
+            temps, tops = self._temps_tops()
+            probs, self.pools = self._spec_probs_fn(
+                self.params, jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(valid), jnp.asarray(self._tables),
+                jnp.asarray(temps), jnp.asarray(tops), self.pools)
+            probs = np.asarray(probs)
+            scored = None
+            uniforms = np.asarray(self._spec_uniform_fn(
+                jnp.asarray(self._slot_keys), jnp.asarray(positions)))
+        self.spec_rounds += 1
+        now = time.monotonic()
+        for i, req in enumerate(self._slots):
+            if not live(i):
+                continue
+            ke, pos = int(k_eff[i]), int(self._positions[i])
+            if scored is not None or req.temperature == 0:
+                row = scored[i] if scored is not None \
+                    else probs[i].argmax(-1)
+                a = 0
+                while a < ke and proposals[i, a] == row[a]:
+                    a += 1
+                emitted = [int(t) for t in row[:a + 1]]
+            else:
+                emitted = self._spec_accept_sampled(
+                    probs[i], proposals[i], ke, uniforms[i])
+                a = len(emitted) - 1
+            self.spec_proposed += ke
+            self.spec_accepted += a
+            # eos / max_new truncation — both imply this slot retires now.
+            lim = req.max_new_tokens - len(req.tokens)
+            emitted = emitted[:lim]
+            if req.eos_token is not None and req.eos_token in emitted:
+                emitted = emitted[:emitted.index(req.eos_token) + 1]
+            m = len(emitted)
+            req.tokens.extend(emitted)
+            if req.first_token_t is None:
+                req.first_token_t = now
+            self._positions[i] = pos + m
+            self._last_token[i] = emitted[-1]
+            # Draft KV is valid through position pos + min(m, ke) - 1; a
+            # full accept leaves the draft one token behind (it never fed
+            # its own last proposal) — the next round's catch-up feeds it.
+            self._draft_pos[i] = pos + min(m, ke)
+            if req.finished:
+                self._retire(i)
+                finished.append(req.rid)
+
+    @staticmethod
+    def _inv_cdf(p: np.ndarray, u: float) -> int:
+        c = np.cumsum(p, dtype=np.float64)
+        total = c[-1] if c[-1] > 0 else 1.0
+        return int(min(np.searchsorted(c / total, u, side="right"),
+                       len(p) - 1))
+
+    def _spec_accept_sampled(self, probs: np.ndarray, proposals: np.ndarray,
+                             ke: int, uniforms: np.ndarray) -> List[int]:
+        """Standard rejection sampling against the target distribution
+        ``probs[j]`` (already tempered + top_p-filtered in-program). The
+        greedy draft's proposal distribution is a point mass, so proposal
+        ``d`` is accepted with probability p(d) and a rejection samples the
+        residual p-without-d renormalized — the emitted stream is
+        distribution-exact vs non-speculative sampling. ``uniforms[j]`` is
+        the (accept coin, residual/bonus inverse-CDF draw) pair keyed by
+        (request, absolute position j) — position-keyed, so a preempted-
+        and-replayed request makes identical accept decisions regardless
+        of schedule or accept history."""
+        emitted: List[int] = []
+        for j in range(ke):
+            d = int(proposals[j])
+            u_accept, u_res = uniforms[j]
+            if u_accept < probs[j, d]:
+                emitted.append(d)
+                continue
+            residual = probs[j].astype(np.float64).copy()
+            residual[d] = 0.0
+            if residual.sum() <= 0:
+                emitted.append(int(probs[j].argmax()))
+            else:
+                emitted.append(self._inv_cdf(residual, u_res))
+            return emitted
+        u_bonus = uniforms[ke, 0]
+        emitted.append(self._inv_cdf(probs[ke].astype(np.float64), u_bonus))
+        return emitted
+
+    def _draft_catchup(self) -> None:
+        """Feed the draft cache every context token it has not seen —
+        prompt ingestion right after admission (chunk_tokens per program
+        call) and the 1-2 token catch-up after each committed round."""
+        n, W = self.scfg.slots, self.scfg.chunk_tokens
+        while True:
+            need = [i for i in range(n) if self._slots[i] is not None
+                    and int(self._draft_pos[i]) < int(self._positions[i])]
+            if not need:
+                return
+            tokens = np.zeros((n, W), np.int32)
+            positions = np.zeros((n, W), np.int32)
+            valid = np.zeros((n, W), bool)
+            last_idx = np.zeros((n,), np.int32)
+            for i in need:
+                dp = int(self._draft_pos[i])
+                c = min(W, int(self._positions[i]) - dp)
+                ctx = self._context_ids(self._slots[i])
+                tokens[i, :c] = ctx[dp:dp + c]
+                positions[i, :c] = np.arange(dp, dp + c)
+                valid[i, :c] = True
+                last_idx[i] = c - 1
+                self._draft_pos[i] = dp + c
+            _, self._draft_pools = self._draft_chunk_fn(
+                self.draft_params, jnp.asarray(tokens),
+                jnp.asarray(positions), jnp.asarray(valid),
+                jnp.asarray(last_idx), self._draft_tables,
+                self._draft_pools)
+
+    def _draft_propose(self, k_eff: np.ndarray) -> np.ndarray:
+        """Greedy draft proposals: up to ``k_eff[i]`` sequential tokens per
+        slot through the batched draft decode step (rows past their own
+        k_eff go inactive — writes land in scratch)."""
+        n, kmax = self.scfg.slots, int(k_eff.max())
+        cur = self._last_token.copy()
+        dpos = self._positions.copy()
+        out = np.zeros((n, max(kmax, 1)), np.int32)
+        for j in range(kmax):
+            act = np.array([self._slots[i] is not None and k_eff[i] > j
+                            for i in range(n)])
+            toks, self._draft_pools = self._draft_decode_fn(
+                self.draft_params, jnp.asarray(cur),
+                jnp.asarray(np.where(act, dpos, 0)), self._draft_tables,
+                jnp.asarray(act), self._draft_pools)
+            toks = np.asarray(toks)
+            for i in range(n):
+                if act[i]:
+                    out[i, j] = toks[i]
+                    cur[i] = toks[i]
+                    dpos[i] += 1
+        return out
+
+    # -- release / retire ----------------------------------------------------
+
     def _release(self, slot: int) -> None:
-        """Free the slot's blocks and clear its row — same step it ends."""
+        """Free the slot's blocks and clear its row — same step it ends.
+        With the prefix cache on, every FULL block of valid KV is first
+        offered to the cache (registered under its chained content hash, or
+        deduped onto an existing entry), so the decref leaves shareable
+        blocks cached instead of free."""
+        req = self._slots[slot]
         live = self._tables[slot][self._tables[slot] != SCRATCH_BLOCK]
-        self.allocator.free(live.tolist())
+        if self._pcache is not None and req is not None:
+            n_valid = int(self._positions[slot])
+            n_full = n_valid // self.scfg.block_size
+            if n_full:
+                ids = self._context_ids(req)[:n_valid]
+                self._pcache.register(
+                    ids, [int(b) for b in self._tables[slot, :n_full]])
+        for b in live:
+            self.allocator.decref(int(b))
         self._tables[slot] = 0
         self._positions[slot] = 0
         self._last_token[slot] = 0
+        self._draft_pos[slot] = 0
         self._slots[slot] = None
 
     def _retire(self, slot: int) -> None:
@@ -447,10 +1044,13 @@ class ServingEngine:
         """Scheduler counters + the KV cost model (docs/parity.md)."""
         from tpu_task.ml.serving.cache import dense_cache_bytes
 
-        return {
+        out = {
             "steps": self.steps,
             "decode_steps": self.decode_steps,
+            "chunk_steps": self.chunk_steps,
             "prefills": self.prefills,
+            "prefill_chunks": self.prefill_chunks,
+            "recompute_preemptions": self.preemption_count,
             "tp": self.tp,
             "kv_blocks_high_water": self.allocator.high_water,
             "kv_high_water_bytes": paged_cache_bytes(
@@ -461,4 +1061,28 @@ class ServingEngine:
                 self.cfg, self.scfg, self.scfg.n_blocks, self.tp),
             "kv_dense_worst_case_bytes": dense_cache_bytes(
                 self.cfg, self.scfg.slots, self.scfg.max_len),
+            "prefix_cache": {
+                "enabled": self._pcache is not None,
+                "miss_blocks": self.prefix_miss_blocks,
+                "hit_requests": self.prefix_hit_requests,
+                "tokens_saved": self.prefix_tokens_saved,
+                # Block-level hits ARE the saved prefill blocks — one key.
+                "blocks_saved": self.prefix_hit_blocks,
+                "cow_copies": self.cow_copies,
+                "cached_blocks": len(self._pcache) if self._pcache else 0,
+                "shared_blocks": (self._pcache.shared_blocks()
+                                  if self._pcache else 0),
+                "evictions": (self._pcache.evictions
+                              if self._pcache else 0),
+            },
+            "spec": {
+                "k": self.scfg.spec_k,
+                "rounds": self.spec_rounds,
+                "proposed": self.spec_proposed,
+                "accepted": self.spec_accepted,
+                "accept_rate": round(
+                    self.spec_accepted / self.spec_proposed, 4)
+                if self.spec_proposed else 0.0,
+            },
         }
+        return out

@@ -150,6 +150,122 @@ def decode_and_sample(params: Params, cfg: TransformerConfig, tokens,
     return sample_tokens(logits, temperature, top_p, keys), new_pools
 
 
+# -- multi-token step: chunked prefill + speculative scoring -----------------
+
+def _multitoken_features(params: Params, cfg: TransformerConfig, tokens,
+                         positions, valid, block_tables, pools):
+    """The width-``w`` generalization of ``paged_decode_step``: run
+    ``tokens`` (slots, w) through the model with PER-TOKEN absolute
+    ``positions`` (slots, w) and a ``valid`` mask (slots, w), scattering
+    each valid token's k/v into its flat pool slot and attending the
+    gathered logical view. Invalid tokens (ragged rows: a decode row uses
+    1 column, a prefill chunk ``c <= w``, an exhausted spec row fewer than
+    ``k+1``) write only scratch and their outputs are garbage the host
+    discards — same masked-write discipline as inactive decode slots.
+    Returns ((slots, w, d_model) final-norm features, updated pools).
+
+    Width 1 with a full mask is exactly ``paged_decode_step``'s semantics;
+    a chunk at positions [p, p+c) is causally identical to the same tokens
+    inside a bucketed prefill (each query attends cache entries <= its own
+    position, and every extra masked pool slot contributes an exact 0.0
+    softmax weight at fp32) — which is why chunked-vs-bucketed greedy
+    bit-identity is a checkable contract, not a hope (docs/parity.md)."""
+    block_size = pools[0]["k"].shape[1]
+    capacity = block_tables.shape[1] * block_size
+    bounds_guard(jnp.all(jnp.where(valid, positions, 0) < capacity),
+                 "multitoken overflow: a position reached the block-table "
+                 "capacity {cap}", cap=jnp.asarray(capacity))
+    slots, w = tokens.shape
+    qpos = jnp.where(valid, positions, 0)
+    block = qpos // block_size
+    phys = jnp.take_along_axis(block_tables, block, axis=1)   # (slots, w)
+    write_idx = jnp.where(
+        valid, phys * block_size + qpos % block_size, 0).reshape(-1)
+    x = embed_lookup(params["embed"].astype(cfg.dtype), tokens)
+    new_pools: List[dict] = []
+    for layer, pool in zip(params["layers"], pools):
+        updated: dict = {}
+
+        def attn_fn(q, k, v, pool=pool, updated=updated):
+            # Scatter every valid token's k/v, THEN gather: a chunk token
+            # must attend its in-chunk predecessors (written this call) as
+            # well as the cached prefix — the position mask provides the
+            # causal cut, exactly as in the bucketed program.
+            kv_heads, d_head = k.shape[2], k.shape[3]
+            kf = flat_pool(pool["k"]).at[write_idx].set(
+                k.reshape(-1, kv_heads, d_head))
+            vf = flat_pool(pool["v"]).at[write_idx].set(
+                v.reshape(-1, kv_heads, d_head))
+            updated["k"] = kf.reshape(pool["k"].shape)
+            updated["v"] = vf.reshape(pool["v"].shape)
+            k_view = gather_kv(kf, block_tables, block_size)
+            v_view = gather_kv(vf, block_tables, block_size)
+            return gqa_cached_attention(q, k_view, v_view, qpos)
+
+        x, _aux = _block(x, layer, cfg, attn_fn, positions=qpos)
+        new_pools.append(updated)
+    return _rmsnorm(x, params["final_norm"]), new_pools
+
+
+def paged_multitoken_logits(params: Params, cfg: TransformerConfig, tokens,
+                            positions, valid, block_tables, pools):
+    """Full-width logits (slots, w, vocab) float32 — the speculative
+    scoring step: ONE fused target pass scores all k+1 positions of every
+    slot's [last_token, draft_1..draft_k] row against the paged cache."""
+    x, new_pools = _multitoken_features(
+        params, cfg, tokens, positions, valid, block_tables, pools)
+    logits = x @ params["unembed"].astype(cfg.dtype)
+    return logits.astype(jnp.float32), new_pools
+
+
+def spec_score_greedy(params: Params, cfg: TransformerConfig, tokens,
+                      positions, valid, block_tables, pools):
+    """Fused speculative scoring + argmax: (slots, w) int32 target tokens
+    — the greedy accept rule (longest agreeing prefix + bonus token) runs
+    on these host-side and is bit-identical to non-speculative decoding."""
+    logits, new_pools = paged_multitoken_logits(
+        params, cfg, tokens, positions, valid, block_tables, pools)
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), new_pools
+
+
+def spec_score_probs(params: Params, cfg: TransformerConfig, tokens,
+                     positions, valid, block_tables, temperature, top_p,
+                     pools):
+    """Fused speculative scoring for SAMPLED requests: per-position target
+    probabilities (slots, w, vocab) float32 after the SAME temper-then-
+    top_p filter ``sample_tokens`` applies — so host-side rejection
+    sampling targets exactly the distribution non-speculative decoding
+    samples from (the distribution-exactness contract). Greedy rows
+    (temperature 0) run at temp 1 and the host takes argmax(probs), which
+    equals argmax(logits) — softmax is monotonic."""
+    logits, new_pools = paged_multitoken_logits(
+        params, cfg, tokens, positions, valid, block_tables, pools)
+    safe_t = jnp.where(temperature > 0, temperature, 1.0)
+    filtered = _top_p_filter(
+        (logits / safe_t[:, None, None]).reshape(-1, logits.shape[-1]),
+        jnp.repeat(top_p, logits.shape[1]))
+    probs = jax.nn.softmax(filtered, axis=-1).reshape(logits.shape)
+    return probs, new_pools
+
+
+def chunked_step_greedy(params: Params, cfg: TransformerConfig, tokens,
+                        positions, valid, last_idx, block_tables, pools):
+    """Fused multi-row chunk ingestion: every row advances by its own
+    ``valid`` span and emits the argmax at its LAST valid position
+    (``last_idx``: (slots,)); mid-prompt rows' outputs are discarded by
+    the host. The TARGET engine ingests through the token-packed decode
+    step instead (engine._chunk_step — slots + chunk rows of width 1);
+    this (slots, w) layout remains for the DRAFT cache catch-up, where
+    several slots may need multi-token ingestion in one call. Returns
+    ((slots,) int32, pools)."""
+    x, new_pools = _multitoken_features(
+        params, cfg, tokens, positions, valid, block_tables, pools)
+    slots = tokens.shape[0]
+    last = x[jnp.arange(slots), last_idx]           # (slots, d_model)
+    logits = (last @ params["unembed"].astype(cfg.dtype)).astype(jnp.float32)
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), new_pools
+
+
 def sample_tokens(logits, temperature, top_p, keys):
     """Per-row sampling with per-row params in one program: row i is greedy
     when ``temperature[i] == 0``, else softmax-samples at its temperature
