@@ -8,7 +8,7 @@ PYTHON ?= python3
 # Seed for the chaos soak: any run is replayable by pinning this.
 TPU_TASK_CHAOS_SEED ?= 20260804
 
-.PHONY: test smoke sweep bench bench-steady bench-serving bench-sched sched sched-soak chaos wheel multichip kernels-tpu clean
+.PHONY: test smoke sweep bench bench-steady bench-serving bench-sched bench-decode sched sched-soak chaos wheel multichip kernels-tpu clean
 
 # Hermetic suite (the reference's `make test`, 30 s budget there; ours spans
 # the fake control planes, sharded-compute CPU checks, and the loopback GCS
@@ -53,6 +53,14 @@ bench-serving:
 # the virtual clock (pure model; milliseconds per hundred tasks).
 bench-sched:
 	$(PYTHON) bench.py scheduler
+
+# Paged-decode kernel grid only: impl (xla gather vs Pallas kernel) ×
+# kv_dtype (model dtype vs int8) × batch {1,8,32} — decode ms/token and
+# KV bytes/token. Runs on CPU via the Pallas interpreter (emulation tax,
+# not kernel speed); compiled kernel numbers need a TPU backend. The
+# tier-1 interpret-mode parity/smoke suite is tests/test_paged_attention.py.
+bench-decode:
+	$(PYTHON) bench.py generation --decode-kernel
 
 # Tier-1-speed gang-scheduler tests: queue/quota/pool model, fair-share
 # ordering, victim-order properties, CLI, bench smoke (all virtual-time).

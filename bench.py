@@ -468,6 +468,131 @@ def bench_generation() -> dict:
     }
 
 
+def bench_generation_decode_kernel(batches=(1, 8, 32), steps: int = 6,
+                                   depth: int = 96) -> dict:
+    """Paged-decode attention grid (ROADMAP item 3): impl × kv_dtype at
+    batch ∈ ``batches``, timing the ONE fused greedy decode program the
+    serving engine dispatches per iteration, with every slot ``depth``
+    tokens deep. Reported per point: decode ms/token and KV bytes/token.
+
+    Runs on ANY backend: the kernel leg compiles the Pallas kernel on a
+    TPU (``impl="pallas"``, flagship-like d_head=128 geometry) and runs
+    the SAME kernel through the Pallas interpreter on CPU
+    (``impl="interpret"``) — interpreter wall-clock is an emulation tax,
+    NOT a kernel speed claim; the grid exists so the kernel path is
+    exercised and tracked everywhere, with the real speedup measured on
+    chip. The XLA legs are the gather+dense reference (the pre-kernel
+    serving path); int8 legs halve-or-better the KV bytes and pay a
+    per-step requantize of the written blocks."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_task.ml.models import transformer
+    from tpu_task.ml.serving.cache import (
+        ServingConfig, init_pools, kv_token_bytes)
+    from tpu_task.ml.serving.model import greedy_decode_step
+
+    on_tpu = jax.default_backend() == "tpu"
+    kernel_impl = "pallas" if on_tpu else "interpret"
+    if on_tpu:
+        cfg = transformer.TransformerConfig(
+            vocab_size=32768, d_model=1024, n_layers=8, n_heads=8,
+            d_head=128, d_ff=4096, dtype=jnp.bfloat16, n_kv_heads=2)
+        # block_size 32: the int8 pools' 1-byte elements need the
+        # 32-sublane Mosaic tile; max_len 1088 (34 blocks/slot) keeps the
+        # batch-32 int8 point's scale sidecars inside the kernel's
+        # scalar-prefetch SMEM budget (kernel_constraint_violation —
+        # checked per point below, so an oversized grid point reports
+        # skipped instead of dying in Mosaic).
+        block_size, max_len = 32, 1088
+        depth = max(depth, 1024)
+    else:
+        cfg = transformer.TransformerConfig(
+            vocab_size=512, d_model=256, n_layers=3, n_heads=8, d_head=32,
+            d_ff=512, dtype=jnp.float32, n_kv_heads=4)
+        block_size, max_len = 16, 128
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(0)
+
+    def point(impl: str, kv_dtype, batch: int) -> dict:
+        m = -(-max_len // block_size)
+        scfg = ServingConfig(
+            slots=batch, block_size=block_size, max_len=max_len,
+            n_blocks=batch * m + 1, kv_dtype=kv_dtype, decode_impl=impl)
+        if impl == "pallas":
+            # Same gate the engine applies at construction — an
+            # unsatisfiable point reports itself instead of handing
+            # Mosaic an allocation failure mid-bench.
+            from tpu_task.ml.ops.paged_attention import (
+                kernel_constraint_violation)
+
+            viol = kernel_constraint_violation(
+                block_size, cfg.d_head,
+                1 if kv_dtype == "int8" else jnp.dtype(cfg.dtype).itemsize,
+                n_blocks=scfg.n_blocks, kv_heads=cfg.kv_heads,
+                slots=batch, max_blocks=m, quantized=kv_dtype == "int8")
+            if viol:
+                return {"impl": impl, "kv_dtype": kv_dtype or "model",
+                        "batch": batch, "skipped": viol}
+        pools = init_pools(cfg, scfg)
+        # Contiguous static tables (slot s owns blocks [1+s·m, 1+(s+1)·m)),
+        # every slot `depth` deep — the steady decode state.
+        tables = jnp.asarray(
+            1 + np.arange(batch * m, dtype=np.int32).reshape(batch, m))
+        positions = jnp.full((batch,), depth, jnp.int32)
+        active = jnp.ones((batch,), bool)
+        tokens = jnp.asarray(
+            rng.integers(0, cfg.vocab_size, size=batch), jnp.int32)
+        qa = None
+        if kv_dtype == "int8":
+            bs = block_size
+            T = batch + 1
+            touched = np.zeros(T, np.int32)
+            touched[:batch] = np.asarray(
+                tables)[np.arange(batch), depth // bs]
+            filled = np.zeros(T, np.int32)
+            filled[:batch] = depth % bs + 1
+            qa = (jnp.asarray(touched), jnp.asarray(filled),
+                  jnp.asarray(np.arange(batch, dtype=np.int32)),
+                  jnp.full((batch,), depth % bs, jnp.int32))
+        fn = jax.jit(
+            lambda tk, pools: greedy_decode_step(
+                params, cfg, tk, positions, tables, active, pools, qa,
+                attn_impl=impl),
+            donate_argnums=(1,))
+        out = fn(tokens, pools)             # compile + warm
+        jax.block_until_ready(out)
+        pools = out[1]
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = fn(out[0], pools)
+            pools = out[1]
+        jax.block_until_ready(out)
+        wall = time.perf_counter() - t0
+        return {
+            "impl": impl, "kv_dtype": kv_dtype or str(jnp.dtype(cfg.dtype)),
+            "batch": batch,
+            "decode_ms_per_token": round(wall * 1e3 / (steps * batch), 4),
+            "kv_bytes_per_token": kv_token_bytes(cfg, scfg),
+        }
+
+    grid = [point(impl, kv_dtype, b)
+            for impl in ("xla", kernel_impl)
+            for kv_dtype in (None, "int8")
+            for b in batches]
+    return {
+        "backend": jax.default_backend(),
+        "kernel_impl": kernel_impl,
+        "context_depth": depth,
+        "steps_timed": steps,
+        "note": ("interpret-mode ms is the Pallas interpreter's emulation "
+                 "tax, not kernel speed — the kernel's win is measured "
+                 "compiled on a TPU backend"),
+        "grid": grid,
+    }
+
+
 def bench_serving(n_requests: int = 36, seed: int = 0) -> dict:
     """Serving leg: the continuous-batching engine (paged KV cache,
     iteration-level scheduling) vs batch-static ``generate`` on the SAME
@@ -627,10 +752,17 @@ def bench_serving(n_requests: int = 36, seed: int = 0) -> dict:
             "per_token_ms_p50": pct(eng_per_tok, 50),
             "decode_steps": eng.decode_steps, "prefills": eng.prefills,
             "preemptions": preemptions,
+            "decode_impl": stats["decode_impl"],
             "kv_blocks_high_water": stats["kv_blocks_high_water"],
             "kv_high_water_mb": round(
                 stats["kv_high_water_bytes"] / 1e6, 3),
         },
+        # int8 KV density (cost model, exact formulas): what the SAME HBM
+        # budget holds when the pools store int8 codes + per-(block,
+        # kv-head) scales instead of the model dtype — the tracked number
+        # behind the `kv_dtype="int8"` knob (≥ 1.9× blocks is the
+        # acceptance line; the fp32 toy model here quantizes 4×-ish).
+        "kv_density": _kv_density(cfg, scfg),
         "generate_static_batch": {
             "decode_tokens_per_s": round(useful / static_makespan, 1),
             "makespan_s": round(static_makespan, 3),
@@ -652,6 +784,34 @@ def bench_serving(n_requests: int = 36, seed: int = 0) -> dict:
         "kv_high_water_vs_dense_worst_case": round(
             stats["kv_high_water_bytes"]
             / stats["kv_dense_worst_case_bytes"], 3),
+    }
+
+
+def _kv_density(cfg, scfg, budget_bytes=None) -> dict:
+    """bytes/token + effective ``n_blocks`` at a fixed byte budget, model
+    dtype vs int8 — the density half of ROADMAP item 3 in one dict."""
+    import dataclasses
+
+    from tpu_task.ml.serving.cache import (
+        blocks_in_budget, kv_token_bytes, paged_cache_bytes)
+
+    int8_scfg = dataclasses.replace(scfg, kv_dtype="int8")
+    budget = (paged_cache_bytes(cfg, scfg, scfg.n_blocks)
+              if budget_bytes is None else budget_bytes)
+    fp_tok = kv_token_bytes(cfg)
+    i8_tok = kv_token_bytes(cfg, int8_scfg)
+    fp_blocks = blocks_in_budget(cfg, scfg, budget)
+    i8_blocks = blocks_in_budget(cfg, int8_scfg, budget)
+    import jax.numpy as jnp
+
+    return {
+        "model_dtype": str(jnp.dtype(cfg.dtype)),
+        "kv_bytes_per_token": {"model_dtype": fp_tok, "int8": i8_tok},
+        "int8_bytes_ratio": round(i8_tok / fp_tok, 4),
+        "pool_budget_mb": round(budget / 1e6, 3),
+        "n_blocks_at_fixed_budget": {"model_dtype": fp_blocks,
+                                     "int8": i8_blocks},
+        "int8_blocks_ratio": round(i8_blocks / max(1, fp_blocks), 2),
     }
 
 
@@ -737,6 +897,11 @@ def bench_serving_multichip(tps=(1, 8), n_requests: int = 16,
         "kv_shard_fraction_at_max_tp": round(
             points[-1]["kv_pool_mb_per_shard"] / points[-1]["kv_pool_mb"],
             4),
+        # Per-SHARD density: int8 multiplies the block capacity of each
+        # shard's fixed HBM slice on top of the 1/tp byte split.
+        "kv_density_per_shard_at_max_tp": _kv_density(
+            cfg, scfg, budget_bytes=kv_shard_bytes(
+                cfg, scfg, scfg.n_blocks, max(tps))),
     }
 
 
@@ -1658,6 +1823,9 @@ def main() -> int:
     flash = bench_flash_kernel()
     ring = bench_ring_schedule()
     generation = bench_generation()
+    # The paged-decode kernel grid runs on ANY backend (interpret mode on
+    # CPU) — the kernel + int8 paths stay tracked even off-chip.
+    generation["decode_kernel"] = bench_generation_decode_kernel()
     serving = bench_serving()
     # Needs >= 8 devices (real chips or a forced-host CPU platform); a
     # single-device full bench reports the section as skipped.
@@ -1747,6 +1915,19 @@ def _parse_args(argv):
     sub.add_parser("steady_state",
                    help="requests/tick steady-state section only "
                         "(also `make bench-steady`)")
+    generation = sub.add_parser(
+        "generation",
+        help="inference section standalone: TPU-gated prefill/decode "
+             "curves plus the paged-decode kernel grid (impl × kv_dtype × "
+             "batch; runs on CPU via the Pallas interpreter — also "
+             "`make bench-decode`)")
+    generation.add_argument(
+        "--decode-kernel", action="store_true",
+        help="run ONLY the paged-decode kernel grid (skip the TPU-gated "
+             "generate() curves)")
+    generation.add_argument(
+        "--batches", default="1,8,32", metavar="B[,B...]",
+        help="batch sizes for the decode-kernel grid (default 1,8,32)")
     serving = sub.add_parser(
         "serving",
         help="continuous-batching vs generate section only "
@@ -1786,6 +1967,14 @@ if __name__ == "__main__":
     if args.section == "scheduler":
         print(json.dumps({"scheduler": bench_scheduler(
             n_tasks=args.tasks, seed=args.seed, waves=args.waves)}))
+        raise SystemExit(0)
+    if args.section == "generation":
+        batches = tuple(int(b) for b in str(args.batches).split(",")
+                        if b.strip())
+        result = ({} if args.decode_kernel else bench_generation())
+        result["decode_kernel"] = bench_generation_decode_kernel(
+            batches=batches)
+        print(json.dumps({"generation": result}))
         raise SystemExit(0)
     if args.section == "serving":
         tps = tuple(int(t) for t in str(args.tp or "1,8").split(",")

@@ -1,0 +1,419 @@
+"""Pallas paged-decode kernel + int8 KV blocks (interpret mode, CPU).
+
+Three contract groups (docs/parity.md "Decode kernel + quantized KV"):
+
+- **Kernel parity**: the block-table-walking kernel matches the XLA
+  ``gather_kv`` + ``gqa_cached_attention`` reference within pinned
+  tolerance over randomized block tables (fragmented, shared/refcounted,
+  scratch sentinel), per-row positions, GQA group widths, and the
+  ``spec_k + 1``-wide speculative shape — the same values through a
+  different accumulation order (online softmax vs one dense rectangle).
+- **int8 quantization**: per-(block, kv-head) symmetric round trip is
+  bounded by scale/2 per element (property test); ``quantized_append``
+  writes land at their offsets, zero garbage rows, and never touch
+  un-written blocks.
+- **Engine smokes** (tier-1 ``perf``): fp32 greedy streams through the
+  interpret-mode kernel are identical to the XLA path's; the int8 engine
+  reproduces the fp32 greedy stream on the pinned small config (the
+  tolerance contract's stream-identity anchor); geometry validation is an
+  actionable error / warned fallback, never a Pallas trace failure.
+"""
+
+import warnings
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_task.ml.models import transformer
+from tpu_task.ml.ops import paged_attention as pa
+from tpu_task.ml.ops.paged_attention import (
+    kernel_constraint_violation,
+    paged_decode_attention,
+    paged_reference_attention,
+)
+from tpu_task.ml.serving import ServingConfig, ServingEngine
+from tpu_task.ml.serving.cache import (
+    INT8_SCALE_EPS,
+    dequantize_blocks,
+    quantize_blocks,
+    quantized_append,
+)
+
+ATOL = 2e-5  # accumulation-order tolerance, same pin as the flash suite
+
+
+def _random_case(rng, slots=4, w=1, h=4, kv=2, d=16, n_blocks=32, bs=8,
+                 max_blocks=5, int8=False):
+    """A deliberately nasty paged layout: tables draw blocks in scrambled
+    (fragmented) order, two slots SHARE their first block (the prefix-cache
+    shape), unallocated tails keep the scratch sentinel 0, and per-row
+    positions put every slot at a different depth."""
+    q = jnp.asarray(rng.normal(size=(slots, w, h, d)), jnp.float32)
+    kp = jnp.asarray(rng.normal(size=(n_blocks, bs, kv, d)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(n_blocks, bs, kv, d)), jnp.float32)
+    tables = np.zeros((slots, max_blocks), np.int32)
+    perm = rng.permutation(np.arange(1, n_blocks))
+    pos = np.zeros((slots, w), np.int32)
+    used = 0
+    for s in range(slots):
+        depth = int(rng.integers(1, max_blocks * bs - w))
+        n_full = (depth + w - 1) // bs + 1
+        tables[s, :n_full] = perm[used:used + n_full]
+        used += n_full
+        pos[s] = depth + np.arange(w)
+    tables[1, 0] = tables[0, 0]          # shared (refcounted) first block
+    pos[-1, :] = np.arange(w)            # a fresh slot right at position 0
+    ks = vs = None
+    if int8:
+        kp, ks = quantize_blocks(kp)
+        vp, vs = quantize_blocks(vp)
+    return q, kp, vp, jnp.asarray(tables), jnp.asarray(pos), ks, vs
+
+
+@pytest.mark.parametrize("kv", [1, 2, 4])
+@pytest.mark.parametrize("w", [1, 3])
+def test_kernel_matches_gather_reference(kv, w):
+    """Kernel vs the XLA gather+dense reference over randomized fragmented
+    / shared / scratch-holding tables, per-row positions, GQA widths
+    (kv=4 is MHA), and the multi-token (spec-shaped) width."""
+    rng = np.random.default_rng(100 * kv + w)
+    q, kp, vp, tables, pos, _, _ = _random_case(rng, kv=kv, w=w)
+    out = paged_decode_attention(q, kp, vp, tables, pos, interpret=True)
+    ref = paged_reference_attention(q, kp, vp, tables, pos)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=ATOL)
+
+
+def test_kernel_spec_shape_with_invalid_rows():
+    """The k+1-wide speculative layout: invalid tail positions are zeroed
+    by the engine (same contract as the XLA path) — outputs for them are
+    garbage the host discards, but VALID rows must still be exact."""
+    rng = np.random.default_rng(7)
+    w = 4
+    q, kp, vp, tables, pos, _, _ = _random_case(rng, w=w)
+    pos = np.asarray(pos)
+    valid = np.ones_like(pos, bool)
+    valid[0, 2:] = False                  # slot 0 exhausted after 2
+    valid[2, 1:] = False                  # slot 2 holds a bare re-score
+    pos = jnp.asarray(np.where(valid, pos, 0))
+    out = paged_decode_attention(q, kp, vp, tables, pos, interpret=True)
+    ref = paged_reference_attention(q, kp, vp, tables, pos)
+    np.testing.assert_allclose(
+        np.asarray(out)[valid], np.asarray(ref)[valid], atol=ATOL)
+
+
+def test_kernel_int8_matches_dequant_reference():
+    """int8 pools: the kernel's in-register dequantization (scale factored
+    out of both matmuls) vs the XLA gather→dequantize→dense reference —
+    both read the SAME codes, so this is tight accumulation tolerance,
+    not the quantization error."""
+    rng = np.random.default_rng(11)
+    q, kp, vp, tables, pos, ks, vs = _random_case(rng, w=2, int8=True)
+    out = paged_decode_attention(q, kp, vp, tables, pos, ks, vs,
+                                 interpret=True)
+    ref = paged_reference_attention(q, kp, vp, tables, pos, ks, vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=ATOL)
+
+
+# -- int8 quantization properties --------------------------------------------
+
+def test_int8_round_trip_error_bound():
+    """|dequant(quantize(x)) − x| ≤ scale/2 per element, across blocks of
+    wildly mixed magnitudes (each block/head pair gets its own scale, so a
+    hot block cannot poison a quiet one's precision)."""
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(16, 8, 4, 32)) * (
+        10.0 ** rng.integers(-3, 3, size=(16, 1, 4, 1)))
+    x = jnp.asarray(x, jnp.float32)
+    codes, scale = quantize_blocks(x)
+    err = np.abs(np.asarray(dequantize_blocks(codes, scale)) - np.asarray(x))
+    bound = np.broadcast_to(
+        np.asarray(scale)[:, None, :, None] / 2, err.shape)
+    assert (err <= bound * (1 + 1e-6) + 1e-12).all()
+    # The amax element maps to exactly ±127 — nothing clips.
+    assert int(np.abs(np.asarray(codes)).max()) == 127
+    # All-zero blocks stay exactly zero at the epsilon scale.
+    z_codes, z_scale = quantize_blocks(jnp.zeros((2, 4, 2, 8)))
+    assert not np.asarray(z_codes).any()
+    np.testing.assert_allclose(np.asarray(z_scale), INT8_SCALE_EPS,
+                               rtol=1e-6)
+
+
+def test_quantized_append_writes_offsets_and_zeroes_garbage():
+    """Append into a half-filled block: the new token lands at its offset
+    within scale/2, earlier tokens survive requantization within the
+    documented drift, rows past ``filled`` are zeroed, and blocks OUTSIDE
+    ``touched`` keep their codes and scales bit-identical."""
+    rng = np.random.default_rng(5)
+    n, bs, kv, d = 6, 4, 2, 8
+    base = jnp.asarray(rng.normal(size=(n, bs, kv, d)), jnp.float32)
+    codes, scale = quantize_blocks(base)
+    pool = {"k": codes, "k_scale": scale, "v": codes, "v_scale": scale}
+    new = jnp.asarray(rng.normal(size=(1, kv, d)), jnp.float32)
+    # Write one token at offset 2 of physical block 3: filled becomes 3.
+    touched = jnp.asarray([3, 0], jnp.int32)   # + pad entry
+    filled = jnp.asarray([3, 0], jnp.int32)
+    wt = jnp.asarray([0], jnp.int32)
+    wo = jnp.asarray([2], jnp.int32)
+    out, qerr = quantized_append(pool, new, new, touched, filled, wt, wo)
+    got = np.asarray(dequantize_blocks(out["k"], out["k_scale"]))
+    s3 = float(np.asarray(out["k_scale"])[3].max())
+    # The written token is exact to its block's new scale.
+    assert np.abs(got[3, 2] - np.asarray(new)[0]).max() <= s3 / 2 + 1e-9
+    # Garbage rows (>= filled) zeroed; earlier rows survive within drift.
+    assert (got[3, 3:] == 0).all()
+    old = np.asarray(dequantize_blocks(codes, scale))
+    assert np.abs(got[3, :2] - old[3, :2]).max() <= s3 + 1e-9
+    # Untouched blocks: codes AND scales bit-identical.
+    keep = [1, 2, 4, 5]
+    np.testing.assert_array_equal(
+        np.asarray(out["k"])[keep], np.asarray(codes)[keep])
+    np.testing.assert_array_equal(
+        np.asarray(out["k_scale"])[keep], np.asarray(scale)[keep])
+    assert float(qerr) <= s3 / 2 + 1e-9
+
+
+# -- geometry validation / impl resolution ------------------------------------
+
+def test_kernel_constraint_violation_reasons():
+    assert kernel_constraint_violation(16, 128) is None
+    assert "d_head" in kernel_constraint_violation(16, 96)
+    assert "block_size" in kernel_constraint_violation(6, 128)
+    # The sublane tile tracks the POOL element width: int8 pools (1 byte)
+    # need block_size % 32, bf16 % 16 — fp32's % 8 is the loosest.
+    assert "block_size" in kernel_constraint_violation(16, 128, 1)
+    assert kernel_constraint_violation(32, 128, 1) is None
+    assert kernel_constraint_violation(16, 128, 2) is None
+    # And the engine resolver feeds the kv_dtype-aware width through.
+    from tpu_task.ml.serving.engine import _kv_itemsize
+    assert _kv_itemsize(ServingConfig(kv_dtype="int8"), TINY) == 1
+    assert _kv_itemsize(ServingConfig(), TINY) == 4
+    # Scalar-prefetch SMEM budget: a huge int8 pool's scale sidecars are
+    # rejected even with perfect tiling (compiled path only).
+    assert "SMEM" in kernel_constraint_violation(
+        32, 128, 1, n_blocks=65536, kv_heads=8, slots=8, max_blocks=16,
+        quantized=True)
+    assert kernel_constraint_violation(
+        32, 128, 1, n_blocks=512, kv_heads=2, slots=8, max_blocks=16,
+        quantized=True) is None
+
+
+def test_quantized_public_entry_requires_qa(params):
+    """The exported step fns fail ACTIONABLY when handed int8 pools
+    without the host-computed write layout, instead of an opaque
+    TypeError from inside a traced closure."""
+    from tpu_task.ml.serving.cache import init_pools
+    from tpu_task.ml.serving.model import paged_decode_step
+
+    scfg = ServingConfig(slots=2, block_size=4, n_blocks=8, max_len=16,
+                         kv_dtype="int8")
+    pools = init_pools(TINY, scfg)
+    with pytest.raises(ValueError, match="qa"):
+        paged_decode_step(
+            transformer.init(jax.random.PRNGKey(0), TINY), TINY,
+            jnp.zeros((2,), jnp.int32), jnp.zeros((2,), jnp.int32),
+            jnp.zeros((2, 4), jnp.int32), jnp.ones((2,), bool), pools)
+
+
+TINY = transformer.TransformerConfig(
+    vocab_size=64, d_model=32, n_layers=2, n_heads=4, d_head=8, d_ff=64,
+    dtype=jnp.float32, n_kv_heads=2)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer.init(jax.random.PRNGKey(0), TINY)
+
+
+def test_decode_impl_validation_and_fallback(params, monkeypatch):
+    """Bad geometry under an explicit 'pallas' is an ACTIONABLE error (and
+    off-TPU 'pallas' names the interpret alternative); under 'auto' on a
+    TPU backend it warns once and falls back to XLA — recorded in stats,
+    never a Pallas trace failure mid-decode."""
+    with pytest.raises(ValueError, match="decode_impl"):
+        ServingConfig(decode_impl="mosaic")
+    with pytest.raises(ValueError, match="kv_dtype"):
+        ServingConfig(kv_dtype="fp8")
+    # CPU backend: explicit pallas points at interpret/xla.
+    with pytest.raises(ValueError, match="interpret"):
+        ServingEngine(params, TINY, ServingConfig(decode_impl="pallas"))
+    # "TPU" backend (faked), geometry violating the lane tile (d_head=8):
+    monkeypatch.setattr(pa, "use_pallas_paged", lambda: True)
+    with pytest.raises(ValueError, match="d_head"):
+        ServingEngine(params, TINY, ServingConfig(decode_impl="pallas"))
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        eng = ServingEngine(params, TINY, ServingConfig())
+    assert eng.decode_impl == "xla"
+    assert eng.stats()["decode_impl"] == "xla"
+    assert any("falling back" in str(w.message).lower()
+               or "falls back" in str(w.message).lower() for w in caught)
+
+
+def test_draft_geometry_falls_back_without_losing_target_kernel(monkeypatch):
+    """Speculative decoding with a draft whose d_head violates the kernel
+    tile constraints: the TARGET keeps the compiled kernel, the DRAFT
+    programs fall back to XLA with a warning — construction never defers
+    a Mosaic trace failure into the first speculative round."""
+    monkeypatch.setattr(pa, "use_pallas_paged", lambda: True)
+    target = transformer.TransformerConfig(
+        vocab_size=64, d_model=256, n_layers=1, n_heads=2, d_head=128,
+        d_ff=64, dtype=jnp.float32, n_kv_heads=2)
+    draft = transformer.TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=1, n_heads=2, d_head=16,
+        d_ff=64, dtype=jnp.float32, n_kv_heads=2)
+    scfg = ServingConfig(slots=2, block_size=8, n_blocks=16, max_len=64,
+                         spec_k=2, prefix_cache=False)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        eng = ServingEngine(
+            transformer.init(jax.random.PRNGKey(0), target), target, scfg,
+            draft_params=transformer.init(jax.random.PRNGKey(1), draft),
+            draft_cfg=draft)
+    assert eng.decode_impl == "pallas"
+    assert any("draft" in str(w.message).lower() for w in caught)
+
+
+# -- engine smokes (tier-1 perf) ----------------------------------------------
+
+def _drain(params, cfg, scfg, reqs):
+    eng = ServingEngine(params, cfg, scfg)
+    rids = [eng.submit(p, n) for p, n in reqs]
+    out = eng.drain()
+    assert eng.allocator.referenced == 0
+    return [out[r] for r in rids], eng
+
+
+@pytest.mark.perf
+def test_engine_interpret_kernel_greedy_matches_xla(params):
+    """Tier-1 kernel smoke: the engine's fused steps routed through the
+    interpret-mode Pallas kernel produce the SAME greedy streams as the
+    XLA gather path on a mixed-length workload (chunked prefill, slot
+    reuse, lazy growth) — the kernel path exercised end to end on CPU."""
+    rng = np.random.default_rng(0)
+    reqs = [(rng.integers(0, TINY.vocab_size, size=plen), new)
+            for plen, new in [(5, 6), (9, 4), (3, 8), (14, 5)]]
+    base_cfg = dict(slots=3, block_size=4, n_blocks=24, max_len=32,
+                    chunk_tokens=6)
+    xla, eng_x = _drain(params, TINY, ServingConfig(**base_cfg), reqs)
+    krn, eng_k = _drain(
+        params, TINY, ServingConfig(decode_impl="interpret", **base_cfg),
+        reqs)
+    assert xla == krn
+    assert eng_x.stats()["decode_impl"] == "xla"
+    assert eng_k.stats()["decode_impl"] == "interpret"
+
+
+# GQA + d_head sized so int8 rounding does not flip any argmax on this
+# seeded workload — the "greedy-stream-identity on small configs" anchor
+# of the tolerance contract (docs/parity.md). Deterministic on CPU.
+INT8_PIN = transformer.TransformerConfig(
+    vocab_size=128, d_model=128, n_layers=2, n_heads=4, d_head=16,
+    d_ff=256, dtype=jnp.float32, n_kv_heads=2)
+
+
+@pytest.mark.perf
+def test_engine_int8_greedy_stream_identity_small_config():
+    """Tier-1 int8 smoke: the int8 engine reproduces the fp32 engine's
+    greedy streams exactly on the pinned config, halves (here: quarters —
+    fp32 model) the per-token KV bytes, and counts its block writes."""
+    from tpu_task.ml.serving.cache import kv_token_bytes
+
+    params = transformer.init(jax.random.PRNGKey(0), INT8_PIN)
+    rng = np.random.default_rng(0)
+    reqs = [(rng.integers(0, INT8_PIN.vocab_size, size=plen), 8)
+            for plen in (5, 11, 3)]
+    base_cfg = dict(slots=3, block_size=4, n_blocks=32, max_len=48,
+                    chunk_tokens=6, prefix_cache=False)
+    fp, _ = _drain(params, INT8_PIN, ServingConfig(**base_cfg), reqs)
+    i8, eng = _drain(params, INT8_PIN,
+                     ServingConfig(kv_dtype="int8", **base_cfg), reqs)
+    assert fp == i8
+    st = eng.stats()
+    assert st["kv_quant"]["kv_dtype"] == "int8"
+    assert st["kv_quant"]["quantized_block_writes"] > 0
+    fp_bytes = kv_token_bytes(INT8_PIN)
+    assert st["kv_bytes_per_token"] < fp_bytes / 2
+    # Pool bytes shrink accordingly (scale sidecars included).
+    assert st["kv_pool_bytes"] < ServingEngine(
+        params, INT8_PIN, ServingConfig(**base_cfg)
+    ).stats()["kv_pool_bytes"] / 2
+
+
+def test_engine_int8_interpret_matches_int8_xla():
+    """The kernel's in-register dequantization agrees with the XLA
+    dequantize-then-attend reference at the STREAM level too: both int8
+    paths read the same codes, so greedy tokens match exactly."""
+    params = transformer.init(jax.random.PRNGKey(0), INT8_PIN)
+    rng = np.random.default_rng(1)
+    reqs = [(rng.integers(0, INT8_PIN.vocab_size, size=plen), 6)
+            for plen in (4, 9)]
+    base_cfg = dict(slots=2, block_size=4, n_blocks=24, max_len=32,
+                    chunk_tokens=5, prefix_cache=False, kv_dtype="int8")
+    a, _ = _drain(params, INT8_PIN, ServingConfig(**base_cfg), reqs)
+    b, _ = _drain(params, INT8_PIN,
+                  ServingConfig(decode_impl="interpret", **base_cfg), reqs)
+    assert a == b
+
+
+def test_engine_int8_spec_and_cache_modes_drain():
+    """int8 under the production modes: speculative decoding (the k+1-wide
+    quantized write/score round) and the prefix cache + COW (scale
+    sidecars copy with their blocks) both run to completion and produce
+    full streams; stream CONTENT under these modes is tolerance-class,
+    not pinned (requantization drift depends on write history)."""
+    params = transformer.init(jax.random.PRNGKey(0), INT8_PIN)
+    rng = np.random.default_rng(2)
+    shared = rng.integers(0, INT8_PIN.vocab_size, size=9)
+    prompts = [np.concatenate([shared,
+                               rng.integers(0, INT8_PIN.vocab_size, size=3)])
+               for _ in range(3)]
+    scfg = ServingConfig(slots=2, block_size=4, n_blocks=32, max_len=48,
+                         chunk_tokens=6, kv_dtype="int8")
+    eng = ServingEngine(params, INT8_PIN, scfg)
+    rids = [eng.submit(p, 6) for p in prompts]
+    out = eng.drain()
+    assert all(len(out[r]) == 6 for r in rids)
+    assert eng.stats()["prefix_cache"]["hit_requests"] >= 1
+
+    draft = transformer.init(jax.random.PRNGKey(1), INT8_PIN)
+    scfg = ServingConfig(slots=2, block_size=4, n_blocks=32, max_len=48,
+                         chunk_tokens=6, kv_dtype="int8", spec_k=2,
+                         prefix_cache=False)
+    eng = ServingEngine(params, INT8_PIN, scfg, draft_params=draft,
+                        draft_cfg=INT8_PIN)
+    rids = [eng.submit(p, 6) for p in prompts[:2]]
+    out = eng.drain()
+    assert all(len(out[r]) == 6 for r in rids)
+    assert eng.stats()["spec"]["rounds"] > 0
+    assert eng.allocator.referenced == 0
+
+
+def test_engine_tp8_interpret_kernel_matches_single_chip():
+    """The kernel under tensor parallelism: pools kv-head-sharded over a
+    tp=8 mesh, the kernel running per shard under shard_map (kv-head axis
+    local, no cross-shard reduction) — greedy streams identical to the
+    single-chip XLA engine's."""
+    from tpu_task.ml.parallel.mesh import make_mesh
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=8, d_head=8,
+        d_ff=64, dtype=jnp.float32, n_kv_heads=8)
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(0)
+    reqs = [(rng.integers(0, cfg.vocab_size, size=plen), new)
+            for plen, new in [(5, 4), (9, 3)]]
+    scfg = ServingConfig(slots=2, block_size=4, n_blocks=24, max_len=24,
+                         chunk_tokens=5)
+    single, _ = _drain(params, cfg, scfg, reqs)
+
+    mesh = make_mesh(8, axis_names=("tp",), axis_sizes=(8,))
+    eng = ServingEngine(
+        params, cfg,
+        ServingConfig(slots=2, block_size=4, n_blocks=24, max_len=24,
+                      chunk_tokens=5, decode_impl="interpret"),
+        mesh=mesh)
+    rids = [eng.submit(p, n) for p, n in reqs]
+    out = eng.drain()
+    assert [out[r] for r in rids] == single

@@ -10,6 +10,10 @@ The kernels support a static ``q_offset`` (global position of q row 0
 relative to k col 0) so causal masking works for sq != sk and for ring
 attention's off-diagonal blocks. ``block_attention_fwd``/``block_attention_bwd``
 are the block primitives the ring (sequence-parallel) path folds over.
+The serving engine's decode analogue — a block-table-aware paged kernel
+that walks the physical KV pools with the same online-softmax discipline
+— lives in the sibling ``ml.ops.paged_attention`` and shares this
+module's layout helpers (``LANES``, ``NEG_INF``, the vma shims).
 
 Shapes follow (batch, seq, heads, head_dim) throughout.
 
@@ -109,6 +113,8 @@ def gqa_cached_attention(q, k_cache, v_cache, q_positions):
     contributes exactly 0.0 for them at any finite k/v)."""
     b, s, h, d = q.shape
     kv = k_cache.shape[2]
+    if h % kv:
+        raise ValueError(f"n_heads {h} not divisible by kv_heads {kv}")
     qg = q.reshape(b, s, kv, h // kv, d)
     scores = jnp.einsum("bskgd,blkd->bkgsl", qg, k_cache) / (d ** 0.5)
     slot = jnp.arange(k_cache.shape[1])

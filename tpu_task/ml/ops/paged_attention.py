@@ -1,0 +1,378 @@
+"""Pallas paged-decode attention: stream KV straight from the block pools.
+
+The serving engine's decode attention (ROADMAP item 3) was a two-step XLA
+program: ``gather_kv`` materializes every slot's KV through its block table
+into a dense ``(slots, L, kv, d)`` buffer, then the shared dense core
+(``ml.ops.attention.gqa_cached_attention``) attends it. That streams
+O(slots × max_len) bytes per token whether or not the slots are full —
+exactly the cost PagedAttention (Kwon et al., SOSP 2023) exists to avoid.
+
+:func:`paged_decode_attention` is the kernel analogue: one grid program per
+``(slot, kv_head, block)`` walks the slot's block table (a scalar-prefetch
+argument, so the table entry indexes the KV block's DMA before the body
+runs), streams each physical block ``(block_size, d_head)`` of the pool
+into VMEM exactly once, and folds it into an online softmax — the gathered
+dense buffer never exists. Blocks past a slot's current position are
+skipped (their table entries are the scratch sentinel 0 and the position
+mask would zero them anyway), so compute follows live tokens, not
+capacity. Grouped-query attention keeps the pool at KV-head width: each
+grid cell loads one KV head's block once and attends the whole
+``n_heads / kv_heads`` query group against it.
+
+**int8 KV blocks** ride the same walk: when the pool stores int8 codes
+with a per-``(block, kv_head)`` fp32 scale sidecar
+(``ml.serving.cache`` quantizes at append/COW time), the kernel
+dequantizes IN REGISTER — the scale is constant over a grid cell, so it
+factors out of both matmuls (``scores = (q·kᵀ)·k_scale``,
+``out = (p·v)·v_scale``) and the dequantized block never round-trips
+through memory either.
+
+Exactness contract (docs/parity.md "Decode kernel + quantized KV"):
+the kernel is tolerance-pinned against the XLA gather+dense reference
+(same values, different accumulation order — online softmax vs one
+rectangle); the fp32 ENGINE keeps its bit-exact greedy-stream pins by
+leaving the XLA path byte-identical and selecting the kernel only where
+configured. The int8 path is a documented tolerance contract.
+
+``interpret=True`` runs the kernel through the Pallas interpreter on any
+backend — the CPU parity suite (tests/test_paged_attention.py) and the
+``decode_impl="interpret"`` engine mode use it; real-TPU runs compile the
+same kernel (``decode_impl="pallas"`` / auto-selection on a TPU backend).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_task.ml.ops.attention import (
+    LANES,
+    NEG_INF,
+    _out_struct,
+    _use_pallas,
+    _vma,
+    gqa_cached_attention,
+)
+
+#: Mosaic tile constraints the COMPILED kernel's block shapes must satisfy
+#: (the interpreter has none): the trailing (lane) dim of every VMEM block
+#: is ``d_head`` and must tile by 128; the second-to-last (sublane) dim of
+#: the KV blocks is ``block_size`` and must tile by the POOL dtype's
+#: native sublane count — 8 at fp32, 16 at bf16, 32 at int8 (the narrower
+#: the element, the taller the (sublane, 128) tile). The pool dtype is
+#: the KV storage dtype, so int8 KV tightens the block_size constraint.
+LANE_TILE = 128
+
+
+def kernel_sublane_tile(kv_itemsize: int) -> int:
+    """Native Mosaic sublane count for a KV element of ``kv_itemsize``
+    bytes: the (sublane × 128-lane) tile holds 32 bytes per lane."""
+    return 32 // kv_itemsize
+
+
+def use_pallas_paged() -> bool:
+    """Whether auto-selection picks the compiled kernel on this backend."""
+    return _use_pallas()
+
+
+#: Conservative budget for the kernel's scalar-prefetch operands (block
+#: tables, positions, int8 scale sidecars — all SMEM-resident on the
+#: compiled path). TPU SMEM is tens of KB per core; staying under this
+#: keeps headroom for Mosaic's own scalar state. Interpret mode ignores
+#: it (no SMEM exists to exhaust).
+PREFETCH_SMEM_BUDGET = 32 * 1024
+
+
+def kernel_constraint_violation(block_size: int, d_head: int,
+                                kv_itemsize: int = 4, *,
+                                n_blocks: int = 0, kv_heads: int = 0,
+                                slots: int = 0, max_blocks: int = 0,
+                                q_width: int = 1,
+                                quantized: bool = False) -> Optional[str]:
+    """Why the COMPILED kernel cannot run on this pool geometry, or None.
+    ``kv_itemsize``: bytes per KV POOL element (1 for int8 pools, else the
+    model dtype's) — it sets the sublane tile ``block_size`` must honor.
+    The optional sizes enable the scalar-prefetch SMEM budget check: the
+    block tables, positions, and (when ``quantized``) the per-(block,
+    kv-head) scale sidecars all ride SMEM on the compiled path, so a huge
+    pool can exceed it even with perfect tiling.
+
+    The serving engine consults this at construction: an unsatisfiable
+    geometry under ``decode_impl="auto"`` falls back to the XLA gather
+    path with a one-time warning, and under an explicit
+    ``decode_impl="pallas"`` raises this reason as an actionable error —
+    never a Pallas trace/allocation failure mid-decode. ``interpret``
+    mode has no constraints (the interpreter imposes no tiling or SMEM)."""
+    if d_head % LANE_TILE:
+        return (f"d_head {d_head} is not a multiple of the {LANE_TILE}-lane "
+                f"tile the compiled kernel's VMEM blocks need")
+    sublane = kernel_sublane_tile(kv_itemsize)
+    if block_size % sublane:
+        return (f"block_size {block_size} is not a multiple of the "
+                f"{sublane}-sublane tile the compiled kernel's KV blocks "
+                f"need at a {kv_itemsize}-byte pool element")
+    # tables + positions; positions are (slots, q_width) — the widest
+    # program is the spec_k+1 scoring step.
+    prefetch = 4 * (slots * max_blocks + slots * max(1, q_width))
+    if quantized:
+        prefetch += 2 * 4 * n_blocks * kv_heads        # k_scale + v_scale
+    if prefetch > PREFETCH_SMEM_BUDGET:
+        return (f"scalar-prefetch operands need {prefetch} bytes of SMEM "
+                f"(tables + positions{' + int8 scale sidecars' if quantized else ''}), "
+                f"over the {PREFETCH_SMEM_BUDGET}-byte budget — shrink "
+                f"n_blocks/max_len or use decode_impl='xla'")
+    return None
+
+
+# -- the kernel ---------------------------------------------------------------
+
+def _paged_decode_kernel(tables_ref, pos_ref, *rest, bs: int, w: int,
+                         group: int, num_blocks: int, quantized: bool):
+    """One (slot, kv_head, block) grid cell: fold one physical KV block
+    into the running online softmax of the slot's whole query group.
+
+    ``tables_ref`` (slots, max_blocks) and ``pos_ref`` (slots, w) are
+    scalar-prefetch SMEM refs — the table entry already indexed this
+    cell's KV DMA via the BlockSpec index_map; the kernel re-reads it only
+    for the scale lookup and the liveness test. q_ref: (w, group, d);
+    k_ref/v_ref: (bs, d) — ONE physical block, the VMEM residency is
+    O(block) whatever the sequence length. The (m, l, acc) state carries
+    across the block walk in VMEM scratch, exactly the flash forward's
+    discipline (``_flash_fwd_kernel``)."""
+    from jax.experimental import pallas as pl
+
+    if quantized:
+        ks_ref, vs_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref = rest
+        ks_ref = vs_ref = None
+    s = pl.program_id(0)
+    kh = pl.program_id(1)
+    b = pl.program_id(2)
+    d = q_ref.shape[-1]
+    rows = w * group
+
+    @pl.when(b == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # Blocks wholly past the row's deepest query position hold nothing the
+    # position mask would keep (their table entries are the scratch
+    # sentinel anyway) — skip their compute. ``w`` is static and small, so
+    # the max unrolls to scalar SMEM reads.
+    max_pos = pos_ref[s, 0]
+    for i in range(1, w):
+        max_pos = jnp.maximum(max_pos, pos_ref[s, i])
+    live = b * bs <= max_pos
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[...].reshape(rows, d).astype(jnp.float32) / math.sqrt(d)
+        k_blk = k_ref[...].astype(jnp.float32)
+        sm = lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        if quantized:
+            # Per-(block, kv_head) scale is constant over this grid cell:
+            # dequantization factors out of the dot products entirely.
+            sm = sm * ks_ref[tables_ref[s, b], kh]
+        cols = b * bs + lax.broadcasted_iota(jnp.int32, (rows, bs), 1)
+        rpos = jnp.repeat(jnp.stack([pos_ref[s, i] for i in range(w)]),
+                          group)
+        mask = cols <= rpos[:, None]
+        sm = jnp.where(mask, sm, NEG_INF)
+        m = m_ref[...][:, 0]
+        l = l_ref[...][:, 0]
+        m_new = jnp.maximum(m, sm.max(axis=-1))
+        shift = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+        p = jnp.exp(sm - shift[:, None])
+        p = jnp.where(mask, p, 0.0)
+        corr = jnp.exp(jnp.where(m <= NEG_INF / 2, NEG_INF, m) - shift)
+        m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(
+            (l * corr + p.sum(axis=-1))[:, None], l_ref.shape)
+        v_blk = v_ref[...].astype(jnp.float32)
+        pv = lax.dot_general(p, v_blk, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        if quantized:
+            pv = pv * vs_ref[tables_ref[s, b], kh]
+        acc_ref[...] = acc_ref[...] * corr[:, None] + pv
+
+    @pl.when(b == num_blocks - 1)
+    def _finalize():
+        l = l_ref[...][:, 0]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[...] = (acc_ref[...] / l_safe[:, None]).reshape(
+            o_ref.shape).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q, k_pool, v_pool, block_tables, q_positions,
+                           k_scale=None, v_scale=None, *,
+                           interpret: bool = False):
+    """Block-table-aware paged GQA decode attention — the kernel analogue
+    of ``gather_kv`` + ``gqa_cached_attention`` that never materializes
+    the gathered dense buffer.
+
+    q: (slots, w, h, d) — w = 1 for plain decode, w = spec_k + 1 for the
+    speculative scoring step (the engine's one fused multi-token shape).
+    k_pool/v_pool: (n_blocks, block_size, kv, d) PHYSICAL pools in their
+    storage dtype (fp32/bf16, or int8 when the scale sidecars are given).
+    block_tables: (slots, max_blocks) int32; q_positions: (slots, w) int32
+    absolute positions (invalid rows carry 0, same contract as the XLA
+    path — their outputs are garbage the host discards).
+    k_scale/v_scale: (n_blocks, kv) float32 per-(block, kv_head) sidecars;
+    both or neither. Returns (slots, w, h, d) in q.dtype.
+
+    Semantics match the reference exactly: cache slot j participates iff
+    ``j <= q_pos`` (masked scores pin to NEG_INF → exact 0.0 weight), so
+    scratch/unallocated garbage never reaches an output bit at fp32."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    slots, w, h, d = q.shape
+    n_blocks, bs, kv, _ = k_pool.shape
+    if h % kv:
+        raise ValueError(f"n_heads {h} not divisible by kv_heads {kv}")
+    group = h // kv
+    max_blocks = block_tables.shape[1]
+    quantized = k_scale is not None
+    if quantized != (v_scale is not None):
+        raise ValueError("pass both k_scale and v_scale or neither")
+    if q_positions.ndim != 2 or q_positions.shape != (slots, w):
+        raise ValueError(
+            f"q_positions must be (slots, w) = ({slots}, {w}), got "
+            f"{q_positions.shape}")
+
+    kernel = functools.partial(
+        _paged_decode_kernel, bs=bs, w=w, group=group,
+        num_blocks=max_blocks, quantized=quantized)
+    n_prefetch = 4 if quantized else 2
+
+    def idx_q(s, kh, b, *refs):
+        return (s, 0, kh, 0)
+
+    def idx_kv(s, kh, b, *refs):
+        return (refs[0][s, b], 0, kh, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=n_prefetch,
+        grid=(slots, kv, max_blocks),
+        in_specs=[
+            pl.BlockSpec((None, w, group, d), idx_q),
+            pl.BlockSpec((None, bs, None, d), idx_kv),
+            pl.BlockSpec((None, bs, None, d), idx_kv),
+        ],
+        out_specs=pl.BlockSpec((None, w, group, d), idx_q),
+        scratch_shapes=[
+            pltpu.VMEM((w * group, LANES), jnp.float32),  # running max
+            pltpu.VMEM((w * group, LANES), jnp.float32),  # running sum
+            pltpu.VMEM((w * group, d), jnp.float32),      # out accumulator
+        ],
+    )
+    vma = _vma(q, k_pool, v_pool)
+    call = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=_out_struct((slots, w, h, d), q.dtype, vma),
+        interpret=interpret,
+    )
+    scalars = (block_tables, q_positions)
+    if quantized:
+        scalars += (k_scale, v_scale)
+    return call(*scalars, q, k_pool, v_pool)
+
+
+# -- dispatch (XLA reference / kernel / tp-sharded kernel) --------------------
+
+def paged_reference_attention(q, k_pool, v_pool, block_tables, q_positions,
+                              k_scale=None, v_scale=None):
+    """The XLA gather+dense reference the kernel is pinned against: gather
+    the logical (slots, L, kv, d) view through the block tables (dequantize
+    it when the pool is int8) and run the ONE shared dense core. This IS
+    the pre-kernel serving decode path, spelled over the same argument
+    layout as :func:`paged_decode_attention` so parity tests and the
+    engine fallback call one function."""
+    from tpu_task.ml.serving.cache import flat_pool, gather_kv
+
+    bs = k_pool.shape[1]
+    k_view = gather_kv(flat_pool(k_pool), block_tables, bs)
+    v_view = gather_kv(flat_pool(v_pool), block_tables, bs)
+    if k_scale is not None:
+        k_view = dequantize_view(k_view, k_scale, block_tables, bs, q.dtype)
+        v_view = dequantize_view(v_view, v_scale, block_tables, bs, q.dtype)
+    return gqa_cached_attention(q, k_view, v_view, q_positions)
+
+
+def dequantize_view(view, scale, block_tables, block_size: int, dtype):
+    """(slots, L, kv, d) int8 gathered view × its per-(block, kv_head)
+    scales → dense values in ``dtype``. The scale gathers through the same
+    block tables and broadcasts over each block's ``block_size`` tokens."""
+    s_view = jnp.repeat(scale[block_tables], block_size, axis=1)
+    return (view.astype(jnp.float32) * s_view[..., None]).astype(dtype)
+
+
+@functools.lru_cache(maxsize=None)
+def _tp_kernel(mesh, axis_name: str, interpret: bool, quantized: bool):
+    """shard_map wrapper of the kernel over the kv-head axis — one memo
+    per (mesh, axis, mode) so repeated traces reuse the closure. The
+    kv-head axis is already LOCAL per shard (pools shard it, q's head axis
+    shards with it, tables/positions replicate) and the kernel has no
+    cross-shard reduction — per-kv-head independence makes the sharded
+    call bit-exact against running the kernel on each head slice."""
+    from jax.sharding import PartitionSpec
+
+    from tpu_task.ml.parallel.mesh import shard_map
+
+    heads4 = PartitionSpec(None, None, axis_name, None)
+    heads_scale = PartitionSpec(None, axis_name)
+    rep = PartitionSpec()
+
+    if quantized:
+        def fn(q, kp, vp, tables, pos, ks, vs):
+            return paged_decode_attention(q, kp, vp, tables, pos, ks, vs,
+                                          interpret=interpret)
+        in_specs = (heads4, heads4, heads4, rep, rep, heads_scale,
+                    heads_scale)
+    else:
+        def fn(q, kp, vp, tables, pos):
+            return paged_decode_attention(q, kp, vp, tables, pos,
+                                          interpret=interpret)
+        in_specs = (heads4, heads4, heads4, rep, rep)
+    return shard_map(fn, mesh, in_specs=in_specs, out_specs=heads4,
+                     check_vma=False)
+
+
+def paged_attention(q, k_pool, v_pool, block_tables, q_positions,
+                    k_scale=None, v_scale=None, *, impl: str = "xla",
+                    mesh=None, axis_name: str = "tp"):
+    """The ONE paged-attention entry the serving programs call.
+
+    ``impl``: ``"xla"`` = gather+dense reference (the CPU fallback and the
+    bit-exact fp32 path), ``"pallas"`` = compiled kernel, ``"interpret"``
+    = the same kernel through the Pallas interpreter (any backend — the
+    parity suite and CPU engine smokes). With ``mesh`` the kernel modes
+    run under ``shard_map`` with the kv-head axis sharded over
+    ``axis_name`` (the XLA mode needs no wrapper — SPMD partitions the
+    gather+einsum exactly as before this kernel existed)."""
+    if impl not in ("xla", "pallas", "interpret"):
+        raise ValueError(f"unknown paged-attention impl {impl!r}")
+    if q_positions.ndim == 1:
+        q_positions = q_positions[:, None]
+    if impl == "xla":
+        return paged_reference_attention(
+            q, k_pool, v_pool, block_tables, q_positions, k_scale, v_scale)
+    interpret = impl == "interpret"
+    if mesh is None:
+        return paged_decode_attention(
+            q, k_pool, v_pool, block_tables, q_positions, k_scale, v_scale,
+            interpret=interpret)
+    fn = _tp_kernel(mesh, axis_name, interpret, k_scale is not None)
+    args = (q, k_pool, v_pool, block_tables, q_positions)
+    if k_scale is not None:
+        args += (k_scale, v_scale)
+    return fn(*args)

@@ -32,15 +32,25 @@ from tpu_task.ml.serving.cache import (
     BlockAllocator,
     PrefixCache,
     ServingConfig,
+    blocks_in_budget,
     chain_block_hashes,
     dense_cache_bytes,
+    dequantize_blocks,
     init_pools,
+    kv_block_bytes,
     kv_shard_bytes,
     kv_token_bytes,
     paged_cache_bytes,
     pool_pspecs,
+    quantize_blocks,
+    quantized_append,
 )
-from tpu_task.ml.serving.engine import DrainTimeout, Request, ServingEngine
+from tpu_task.ml.serving.engine import (
+    DrainTimeout,
+    Request,
+    ServingEngine,
+    resolve_decode_impl,
+)
 from tpu_task.ml.serving.model import (
     greedy_decode_step,
     paged_decode_step,
@@ -58,15 +68,21 @@ __all__ = [
     "Request",
     "ServingConfig",
     "ServingEngine",
+    "blocks_in_budget",
     "chain_block_hashes",
     "dense_cache_bytes",
+    "dequantize_blocks",
     "greedy_decode_step",
     "init_pools",
+    "kv_block_bytes",
     "kv_shard_bytes",
     "kv_token_bytes",
     "paged_cache_bytes",
     "paged_decode_step",
     "paged_prefill",
     "pool_pspecs",
+    "quantize_blocks",
+    "quantized_append",
+    "resolve_decode_impl",
     "sample_tokens",
 ]

@@ -23,6 +23,15 @@ unallocated table entries therefore read scratch garbage — which the
 positional mask pins to a score of NEG_INF, an exact softmax weight of
 0.0 at fp32, so the garbage never reaches an output bit (the paged/dense
 parity contract in docs/parity.md).
+
+``ServingConfig(kv_dtype="int8")`` stores the pools as int8 codes with a
+per-(block, kv-head) float32 scale sidecar: the same HBM budget holds
+~2× the blocks (``blocks_in_budget``), writes quantize at append/COW
+time (:func:`quantized_append` — a vectorized dequantize→modify→
+requantize over the step's touched blocks), and the attention paths
+dequantize on read (in-register inside the Pallas paged kernel). The
+fp32 bit-exactness contract demotes to a documented tolerance contract
+for quantized pools only (docs/parity.md "Decode kernel + quantized KV").
 """
 
 from __future__ import annotations
@@ -39,6 +48,11 @@ from tpu_task.ml.models.transformer import TransformerConfig
 #: Physical block index reserved for masked writes / the "unallocated"
 #: block-table sentinel. Never handed out by the allocator.
 SCRATCH_BLOCK = 0
+
+#: Floor for the per-(block, kv-head) quantization scale: an all-zero
+#: block quantizes to zero codes at this scale and dequantizes back to
+#: exact zeros, so fresh pools read the same values int8 as fp32.
+INT8_SCALE_EPS = 1e-8
 
 
 @dataclass(frozen=True)
@@ -68,6 +82,23 @@ class ServingConfig:
     - ``spec_k``: speculative decoding — a draft model (passed to the
       engine) proposes ``spec_k`` tokens per slot per step and ONE fused
       target step scores all ``spec_k + 1`` positions. 0 disables.
+
+    Raw-decode-speed knobs (ROADMAP item 3):
+
+    - ``decode_impl``: which paged attention the fused steps run.
+      ``"auto"`` (default) selects the Pallas paged-decode kernel on a
+      TPU backend when the pool geometry satisfies its tile constraints
+      (falling back to XLA with a one-time warning when it doesn't) and
+      the XLA gather+dense path everywhere else; ``"xla"`` forces the
+      gather path (the bit-exact fp32 reference); ``"pallas"`` demands
+      the compiled kernel (raises an actionable error off-TPU or on bad
+      geometry); ``"interpret"`` runs the same kernel through the Pallas
+      interpreter on any backend (parity tests, CPU smokes — slow).
+    - ``kv_dtype``: ``None`` stores KV in the model dtype (the bit-exact
+      paged≡dense contract); ``"int8"`` stores int8 codes plus a
+      per-(block, kv-head) fp32 scale sidecar — ~2× the blocks in the
+      same bytes, under a documented tolerance contract
+      (docs/parity.md "Decode kernel + quantized KV").
     """
 
     slots: int = 8
@@ -79,6 +110,8 @@ class ServingConfig:
     chunk_tokens: int = 16
     prefix_cache: bool = True
     spec_k: int = 0
+    decode_impl: str = "auto"
+    kv_dtype: Optional[str] = None
 
     def __post_init__(self):
         if self.slots < 1:
@@ -114,6 +147,14 @@ class ServingConfig:
                 "admission prefills only the tail, which is a chunk step")
         if self.spec_k < 0:
             raise ValueError(f"spec_k must be >= 0, got {self.spec_k}")
+        if self.decode_impl not in ("auto", "xla", "pallas", "interpret"):
+            raise ValueError(
+                f"decode_impl must be one of 'auto', 'xla', 'pallas', "
+                f"'interpret', got {self.decode_impl!r}")
+        if self.kv_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_dtype must be None (model dtype) or 'int8', got "
+                f"{self.kv_dtype!r}")
 
     @property
     def max_blocks_per_slot(self) -> int:
@@ -133,10 +174,41 @@ class ServingConfig:
         return -(-n_tokens // self.block_size)
 
 
-def kv_token_bytes(cfg: TransformerConfig) -> int:
-    """KV bytes one token occupies across all layers (k + v)."""
-    return (2 * cfg.n_layers * cfg.kv_heads * cfg.d_head
-            * jnp.dtype(cfg.dtype).itemsize)
+def kv_token_bytes(cfg: TransformerConfig,
+                   scfg: Optional[ServingConfig] = None) -> int:
+    """KV bytes one token occupies across all layers (k + v) — DTYPE-AWARE:
+    without ``scfg`` (or with ``kv_dtype=None``) the storage dtype is the
+    model dtype; with ``kv_dtype="int8"`` each element is one byte plus
+    the amortized per-(block, kv-head) fp32 scale sidecar
+    (``2 · n_layers · kv_heads · 4 / block_size`` bytes per token)."""
+    per_channel = 2 * cfg.n_layers * cfg.kv_heads
+    if scfg is None or scfg.kv_dtype is None:
+        return per_channel * cfg.d_head * jnp.dtype(cfg.dtype).itemsize
+    # int8 codes (1 byte/element) + the scale sidecar amortized over the
+    # block's tokens.
+    return (per_channel * cfg.d_head
+            + -(-per_channel * 4 // scfg.block_size))
+
+
+def kv_block_bytes(cfg: TransformerConfig, scfg: ServingConfig) -> int:
+    """Exact bytes ONE physical block costs (codes + its scale sidecar) —
+    the unit ``blocks_in_budget`` divides an HBM budget by."""
+    elem = (1 if scfg.kv_dtype == "int8"
+            else jnp.dtype(cfg.dtype).itemsize)
+    per_block = 2 * cfg.n_layers * cfg.kv_heads * (
+        scfg.block_size * cfg.d_head * elem)
+    if scfg.kv_dtype == "int8":
+        per_block += 2 * cfg.n_layers * cfg.kv_heads * 4
+    return per_block
+
+
+def blocks_in_budget(cfg: TransformerConfig, scfg: ServingConfig,
+                     budget_bytes: int) -> int:
+    """How many physical blocks (scratch included) fit ``budget_bytes``
+    under this config's KV dtype — the int8 density claim in one number:
+    the same budget admits ~2× the fp32 ``n_blocks`` (minus the scale
+    sidecar overhead), tracked by ``bench.py serving``."""
+    return budget_bytes // kv_block_bytes(cfg, scfg)
 
 
 def dense_cache_bytes(cfg: TransformerConfig, slots: int,
@@ -148,14 +220,30 @@ def dense_cache_bytes(cfg: TransformerConfig, slots: int,
 def paged_cache_bytes(cfg: TransformerConfig, scfg: ServingConfig,
                       n_blocks: int) -> int:
     """Bytes of ``n_blocks`` physical blocks (e.g. the allocator's
-    high-water mark — what a right-sized pool would have needed)."""
-    return n_blocks * scfg.block_size * kv_token_bytes(cfg)
+    high-water mark — what a right-sized pool would have needed),
+    scale sidecars included when the pool is quantized."""
+    return n_blocks * kv_block_bytes(cfg, scfg)
 
 
 def init_pools(cfg: TransformerConfig, scfg: ServingConfig) -> List[dict]:
     """Per-layer k/v physical pools, same narrow KV-head layout (and the
-    same per-layer list-of-dicts pytree) as the dense cache."""
+    same per-layer list-of-dicts pytree) as the dense cache. With
+    ``kv_dtype="int8"`` each layer additionally carries ``k_scale``/
+    ``v_scale`` sidecars of shape (n_blocks, kv_heads) float32; zero
+    codes at the epsilon scale dequantize to exact zeros, so a fresh
+    quantized pool reads identically to a fresh fp32 one."""
     shape = (scfg.n_blocks, scfg.block_size, cfg.kv_heads, cfg.d_head)
+    if scfg.kv_dtype == "int8":
+        # Distinct arrays per leaf: the engine DONATES the pool pytree,
+        # and XLA rejects the same buffer donated twice.
+        def scale():
+            return jnp.full((scfg.n_blocks, cfg.kv_heads), INT8_SCALE_EPS,
+                            jnp.float32)
+
+        return [{"k": jnp.zeros(shape, jnp.int8),
+                 "v": jnp.zeros(shape, jnp.int8),
+                 "k_scale": scale(), "v_scale": scale()}
+                for _ in range(cfg.n_layers)]
     return [{"k": jnp.zeros(shape, cfg.dtype),
              "v": jnp.zeros(shape, cfg.dtype)}
             for _ in range(cfg.n_layers)]
@@ -168,7 +256,11 @@ def init_pools(cfg: TransformerConfig, scfg: ServingConfig) -> List[dict]:
 #: shards its KV-HEAD axis wherever the "heads" logical axis goes (tp).
 #: Paging stays along the token axis, so block accounting — tables,
 #: allocator, scratch block — is identical at every tp width.
+#: Scale sidecars are (n_blocks, kv_heads): the kv-head axis shards with
+#: the pool it scales. Listed first only for clarity — ``[kv]$`` cannot
+#: match a ``*_scale`` path anyway.
 SERVING_POOL_RULES = (
+    (r"(^|/)[kv]_scale$", (None, "heads")),
     (r"(^|/)[kv]$", (None, None, "heads", None)),
 )
 
@@ -216,9 +308,12 @@ def copy_block(pools: List[dict], src, dst) -> List[dict]:
     the device half of copy-on-write: a slot about to write into a block it
     shares with the prefix cache gets a private copy first, so the donor
     block's bytes (and every other reader's view) stay untouched. ``src``/
-    ``dst`` may be traced scalars: one compiled program covers every COW."""
-    return [{"k": pool["k"].at[dst].set(pool["k"][src]),
-             "v": pool["v"].at[dst].set(pool["v"][src])}
+    ``dst`` may be traced scalars: one compiled program covers every COW.
+    Generic over the pool layout: a quantized layer's scale sidecars copy
+    with its codes (COW-time "quantization" is a byte copy — the donor's
+    codes are already exact for the shared prefix)."""
+    return [{name: arr.at[dst].set(arr[src])
+             for name, arr in pool.items()}
             for pool in pools]
 
 
@@ -230,6 +325,81 @@ def gather_kv(pool_flat, block_table, block_size: int):
     idx = (block_table[:, :, None] * block_size
            + jnp.arange(block_size)[None, None, :])
     return pool_flat[idx.reshape(block_table.shape[0], -1)]
+
+
+# -- int8 KV block quantization ----------------------------------------------
+
+def quantize_blocks(x):
+    """(n, block_size, kv, d) float values → (int8 codes, (n, kv) float32
+    scales): symmetric per-(block, kv-head) quantization at
+    ``scale = amax / 127`` (floored at :data:`INT8_SCALE_EPS`). Round-trip
+    error is ≤ ``scale / 2`` per element (round-to-nearest; the amax
+    element maps to exactly ±127, so nothing clips) — the property pinned
+    in tests/test_paged_attention.py."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=(1, 3))
+    scale = jnp.maximum(amax / 127.0, INT8_SCALE_EPS)
+    codes = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / scale[:, None, :, None]),
+        -127, 127).astype(jnp.int8)
+    return codes, scale
+
+
+def dequantize_blocks(codes, scale, dtype=jnp.float32):
+    """Inverse of :func:`quantize_blocks` (up to the ≤ scale/2 rounding)."""
+    return (codes.astype(jnp.float32)
+            * scale[:, None, :, None]).astype(dtype)
+
+
+def quantized_append(pool: dict, new_k, new_v, touched, filled, wt, wo,
+                     measure_error: bool = False):
+    """Append this step's tokens into an int8 pool layer, requantizing the
+    written blocks — the device half of "writes quantize at append time".
+
+    A per-(block, kv-head) scale cannot absorb a new token in place (the
+    block's amax may grow), so the write is a dequantize→modify→requantize
+    at BLOCK granularity over the step's touched blocks, fully vectorized:
+
+    - ``touched``: (T,) physical block ids this step writes (host-deduped
+      — packed chunk rows share a block; padded with the scratch sentinel,
+      whose rewrite-to-zeros is harmless by definition);
+    - ``filled``: (T,) valid tokens in each touched block AFTER the step —
+      rows at or past it are garbage (stale frees, rejected speculative
+      writes) and are zeroed rather than letting them inflate the scale;
+    - ``wt``/``wo``: per new token, the touched-index and in-block offset
+      (invalid tokens point at the pad entry, whose ``filled`` is 0).
+
+    Only EXCLUSIVELY-OWNED blocks are ever written (copy-on-write gives a
+    slot a private copy before it touches a shared block), so
+    requantization never perturbs bytes another slot or the prefix cache
+    can read. Per-token drift from repeated requantization of a hot block
+    is bounded by the documented tolerance contract (docs/parity.md).
+
+    Returns the updated layer dict plus the max absolute quantization
+    error over this step's live rows — computed only when
+    ``measure_error`` (the engine's debug mode; it is an extra dequantize
+    + abs + max over every touched block, and as a program OUTPUT it
+    could never be dead-code-eliminated, so the hot path must not carry
+    it), else an exact 0.0 scalar."""
+    bs = pool["k"].shape[1]
+    T = touched.shape[0]
+    rows_live = (jnp.arange(bs)[None, :] < filled[:, None])[..., None, None]
+    out = {}
+    qerr = jnp.float32(0.0)
+    for name, new in (("k", new_k), ("v", new_v)):
+        codes, scale = pool[name], pool[name + "_scale"]
+        staged = dequantize_blocks(codes[touched], scale[touched])
+        flat = staged.reshape(T * bs, *staged.shape[2:])
+        flat = flat.at[wt * bs + wo].set(new.astype(jnp.float32))
+        staged = jnp.where(rows_live, flat.reshape(staged.shape), 0.0)
+        q_codes, q_scale = quantize_blocks(staged)
+        if measure_error:
+            qerr = jnp.maximum(qerr, jnp.max(jnp.where(
+                rows_live,
+                jnp.abs(staged - dequantize_blocks(q_codes, q_scale)),
+                0.0)))
+        out[name] = codes.at[touched].set(q_codes)
+        out[name + "_scale"] = scale.at[touched].set(q_scale)
+    return out, qerr
 
 
 class BlockAllocator:

@@ -42,9 +42,23 @@ Admission takes a request when a slot is free and the pool holds its
 (uncached) prompt blocks plus one spare; growth past that is lazy. If the
 pool is exhausted mid-decode the engine first evicts refcount-0 cached
 blocks, then preempts the youngest running request back to the queue head
-(recompute-style): its blocks free immediately and its token stream is
-reproduced exactly on re-admission because sampling keys derive from the
-request key alone (fold_in per token index), never from the schedule.
+(recompute-style): its blocks free immediately and — at model-dtype pools
+— its token stream is reproduced exactly on re-admission because sampling
+keys derive from the request key alone (fold_in per token index), never
+from the schedule. int8 pools demote replay to the same tolerance class
+as everything else quantized: the recompute requantizes whole blocks in
+one pass where the original stream appended incrementally, so the
+rebuilt codes (and a near-tie argmax) can differ (docs/parity.md).
+
+Raw decode speed (ROADMAP item 3) rides two static knobs resolved at
+construction: ``ServingConfig.decode_impl`` selects the paged attention
+inside every fused step — the XLA gather+dense reference, or the Pallas
+block-table-walking kernel (``ml.ops.paged_attention``) that streams KV
+straight from the physical pools — and ``kv_dtype="int8"`` stores the
+pools as int8 codes + per-(block, kv-head) scales (~2× the blocks in the
+same HBM), with writes requantizing the touched blocks per step
+(host-computed ``_quant_layout``) and attention dequantizing on read.
+``stats()["decode_impl"]`` records which path actually compiled.
 
 Host/device split: the scheduler (allocator, prefix cache, slot table,
 queues, timing) is plain Python/numpy; the device sees only static-shape
@@ -57,8 +71,9 @@ from __future__ import annotations
 import collections
 import os
 import time
+import warnings
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -67,6 +82,7 @@ from jax.sharding import PartitionSpec
 
 from tpu_task.ml.models import transformer
 from tpu_task.ml.models.transformer import Params, TransformerConfig
+from tpu_task.ml.ops import paged_attention as pa
 from tpu_task.ml.parallel.sharding import (
     PartitionPlan,
     compile_step,
@@ -80,6 +96,7 @@ from tpu_task.ml.serving.cache import (
     copy_block,
     init_pools,
     kv_shard_bytes,
+    kv_token_bytes,
     paged_cache_bytes,
     pool_pspecs,
 )
@@ -94,6 +111,56 @@ from tpu_task.ml.serving.model import (
 )
 
 QUEUED, RUNNING, DONE = "queued", "running", "done"
+
+
+def _kv_itemsize(scfg: ServingConfig, cfg) -> int:
+    """Bytes per KV POOL element — what sets the kernel's sublane tile."""
+    return 1 if scfg.kv_dtype == "int8" else jnp.dtype(cfg.dtype).itemsize
+
+
+def resolve_decode_impl(scfg: ServingConfig, cfg, tp: int = 1) -> str:
+    """Pick the paged-attention implementation the fused steps compile
+    with (ROADMAP item 3). ``"xla"``/``"interpret"`` pass through;
+    ``"pallas"`` validates the backend and the pool geometry against the
+    kernel's tile constraints AND scalar-prefetch SMEM budget, raising an
+    ACTIONABLE error (never a Pallas trace failure mid-decode);
+    ``"auto"`` selects the compiled kernel on a TPU backend when the
+    geometry satisfies the constraints, falling back to the XLA gather
+    path with a one-time warning when it does not, and picks XLA
+    everywhere else. ``tp``: kv-head shard width — per-shard SMEM holds
+    only the local heads' scale sidecars."""
+    want = scfg.decode_impl
+    if want in ("xla", "interpret"):
+        return want
+    viol = pa.kernel_constraint_violation(
+        scfg.block_size, cfg.d_head, _kv_itemsize(scfg, cfg),
+        n_blocks=scfg.n_blocks, kv_heads=cfg.kv_heads // max(1, tp),
+        slots=scfg.slots + (scfg.chunk_tokens
+                            if scfg.prefill == "chunked" else 0),
+        max_blocks=scfg.max_blocks_per_slot,
+        q_width=scfg.spec_k + 1,
+        quantized=scfg.kv_dtype == "int8")
+    if want == "pallas":
+        if not pa.use_pallas_paged():
+            raise ValueError(
+                "decode_impl='pallas' needs a TPU backend for the "
+                "compiled kernel; use decode_impl='interpret' to emulate "
+                "it elsewhere, or 'xla'")
+        if viol:
+            raise ValueError(
+                f"decode_impl='pallas' rejected: {viol} — adjust the "
+                "ServingConfig/model geometry or use decode_impl='xla'")
+        return "pallas"
+    if pa.use_pallas_paged():
+        if viol:
+            warnings.warn(
+                f"paged-decode kernel unavailable for this pool geometry "
+                f"({viol}); serving falls back to the XLA gather path — "
+                "stats()['decode_impl'] records which path ran",
+                RuntimeWarning)
+            return "xla"
+        return "pallas"
+    return "xla"
 
 #: Salt folded into a request's key before deriving per-position uniforms
 #: for speculative rejection sampling — keeps the spec stream disjoint from
@@ -184,6 +251,16 @@ class ServingEngine:
         self._pcache = (PrefixCache(self.allocator, scfg.block_size)
                         if scfg.prefix_cache else None)
         self.debug = os.environ.get("TPU_TASK_CHECKIFY", "") == "1"
+        #: Which paged attention the fused steps actually compiled with —
+        #: resolved ONCE here (auto-fallback warns), recorded in stats()
+        #: so a silent fallback to the gather path is visible in benches
+        #: and soaks.
+        self.decode_impl = resolve_decode_impl(scfg, cfg, tp=self.tp)
+        #: The DRAFT programs' impl (None without speculative decoding) —
+        #: may differ from decode_impl when the draft's geometry forces
+        #: the XLA fallback; recorded in stats() like the target's.
+        self.draft_decode_impl: Optional[str] = None
+        self._quantized = scfg.kv_dtype == "int8"
 
         # Speculative decoding: validate the draft triple together.
         self._spec_on = scfg.spec_k > 0
@@ -227,6 +304,8 @@ class ServingEngine:
         self.spec_rounds = 0
         self.spec_proposed = 0
         self.spec_accepted = 0
+        self.quantized_block_writes = 0
+        self.max_quant_error = 0.0       # debug mode only (readback cost)
 
         # Draft-model state: its "dense" cache is a paged pool with a
         # STATIC identity block layout — slot s owns blocks
@@ -252,40 +331,69 @@ class ServingEngine:
         # plans pin weight/pool shardings and keep the donation — the same
         # seam the train-step builders use.
         rep = PartitionSpec()
+        impl = self.decode_impl
+        quant = self._quantized
+        dbg = self.debug        # static: only debug engines pay for the
+                                # in-program quant-error measurement
 
         def plan(arg_specs, donate, out=None):
             if mesh is None:
                 return PartitionPlan(donate=donate)
+            if out is None:
+                out = (rep, self._pool_specs)
+                if quant:
+                    out = out + (rep,)       # the max-quant-error scalar
             return PartitionPlan(
-                mesh=mesh, in_specs=arg_specs,
-                out_specs=(rep, self._pool_specs) if out is None else out,
+                mesh=mesh, in_specs=arg_specs, out_specs=out,
                 donate=donate)
 
         p_specs = getattr(self, "_param_specs", None)
         k_specs = getattr(self, "_pool_specs", None)
         self._prefill_fn = self._wrap(compile_step(
             lambda params, tokens, length, table, pools: paged_prefill(
-                params, cfg, tokens, length, table, pools),
+                params, cfg, tokens, length, table, pools,
+                measure_qerr=dbg),
             plan((p_specs, rep, rep, rep, k_specs), (4,))))
         # One fused program per decode iteration: forward + in-program key
         # fold + sampler — per-step dispatch overhead is the engine's whole
-        # tax over generate's scan, so it is kept to a single call.
-        self._decode_fn = self._wrap(compile_step(
-            lambda params, tokens, positions, tables, active, temps, tops,
-            keys, ngen, pools: decode_and_sample(
-                params, cfg, tokens, positions, tables, active, temps,
-                tops, keys, ngen, pools),
-            plan((p_specs, rep, rep, rep, rep, rep, rep, rep, rep,
-                  k_specs), (9,))))
-        # Greedy fast path: when every active slot decodes at temperature 0
-        # (the common serving default and the whole bench), the sampler
-        # reduces to argmax — no sort/cumsum/categorical/key-fold in the
-        # step program.
-        self._decode_greedy_fn = self._wrap(compile_step(
-            lambda params, tokens, positions, tables, active, pools:
-            greedy_decode_step(params, cfg, tokens, positions, tables,
-                               active, pools),
-            plan((p_specs, rep, rep, rep, rep, k_specs), (5,))))
+        # tax over generate's scan, so it is kept to a single call. The
+        # paged-attention impl and (for int8 pools) the quantized-append
+        # `qa` write layout thread through statically/as one extra arg;
+        # the fp32+xla signatures stay EXACTLY the pre-kernel ones, which
+        # is what keeps the bit-exact greedy-stream pins checkable.
+        if quant:
+            self._decode_fn = self._wrap(compile_step(
+                lambda params, tokens, positions, tables, active, temps,
+                tops, keys, ngen, qa, pools: decode_and_sample(
+                    params, cfg, tokens, positions, tables, active, temps,
+                    tops, keys, ngen, pools, qa, attn_impl=impl, mesh=mesh,
+                    measure_qerr=dbg),
+                plan((p_specs, rep, rep, rep, rep, rep, rep, rep, rep,
+                      rep, k_specs), (10,))))
+            self._decode_greedy_fn = self._wrap(compile_step(
+                lambda params, tokens, positions, tables, active, qa,
+                pools: greedy_decode_step(
+                    params, cfg, tokens, positions, tables, active, pools,
+                    qa, attn_impl=impl, mesh=mesh, measure_qerr=dbg),
+                plan((p_specs, rep, rep, rep, rep, rep, k_specs), (6,))))
+        else:
+            self._decode_fn = self._wrap(compile_step(
+                lambda params, tokens, positions, tables, active, temps,
+                tops, keys, ngen, pools: decode_and_sample(
+                    params, cfg, tokens, positions, tables, active, temps,
+                    tops, keys, ngen, pools, attn_impl=impl, mesh=mesh),
+                plan((p_specs, rep, rep, rep, rep, rep, rep, rep, rep,
+                      k_specs), (9,))))
+            # Greedy fast path: when every active slot decodes at
+            # temperature 0 (the common serving default and the whole
+            # bench), the sampler reduces to argmax — no sort/cumsum/
+            # categorical/key-fold in the step program.
+            self._decode_greedy_fn = self._wrap(compile_step(
+                lambda params, tokens, positions, tables, active, pools:
+                greedy_decode_step(params, cfg, tokens, positions, tables,
+                                   active, pools, attn_impl=impl,
+                                   mesh=mesh),
+                plan((p_specs, rep, rep, rep, rep, k_specs), (5,))))
         self._prefill_sample_fn = self._wrap(jax.jit(
             lambda logits, temp, top, key, n: sample_tokens(
                 logits, temp, top, jax.random.fold_in(key, n)[None])))
@@ -293,36 +401,74 @@ class ServingEngine:
         # step is the decode program above, specialized at the packed
         # batch slots + chunk_tokens (see _chunk_step).
         # Copy-on-write: one compiled program copies a physical block in
-        # every layer (traced src/dst — a single compile covers all COWs).
+        # every layer (traced src/dst — a single compile covers all COWs;
+        # for int8 pools the scale sidecars ride the same generic copy).
         self._copy_block_fn = self._wrap(compile_step(
             lambda pools, src, dst: copy_block(pools, src, dst),
             plan((k_specs, rep, rep), (0,),
                  out=k_specs if mesh is not None else None)))
         if self._spec_on:
             # Target scoring: the chunked multi-token step at width k+1.
-            self._spec_greedy_fn = self._wrap(compile_step(
-                lambda params, tokens, positions, valid, tables, pools:
-                spec_score_greedy(params, cfg, tokens, positions, valid,
-                                  tables, pools),
-                PartitionPlan(donate=(5,))))
-            self._spec_probs_fn = self._wrap(compile_step(
-                lambda params, tokens, positions, valid, tables, temps,
-                tops, pools: spec_score_probs(
-                    params, cfg, tokens, positions, valid, tables, temps,
-                    tops, pools),
-                PartitionPlan(donate=(7,))))
+            if quant:
+                self._spec_greedy_fn = self._wrap(compile_step(
+                    lambda params, tokens, positions, valid, tables, qa,
+                    pools: spec_score_greedy(
+                        params, cfg, tokens, positions, valid, tables,
+                        pools, qa, attn_impl=impl, measure_qerr=dbg),
+                    PartitionPlan(donate=(6,))))
+                self._spec_probs_fn = self._wrap(compile_step(
+                    lambda params, tokens, positions, valid, tables,
+                    temps, tops, qa, pools: spec_score_probs(
+                        params, cfg, tokens, positions, valid, tables,
+                        temps, tops, pools, qa, attn_impl=impl,
+                        measure_qerr=dbg),
+                    PartitionPlan(donate=(8,))))
+            else:
+                self._spec_greedy_fn = self._wrap(compile_step(
+                    lambda params, tokens, positions, valid, tables,
+                    pools: spec_score_greedy(
+                        params, cfg, tokens, positions, valid, tables,
+                        pools, attn_impl=impl),
+                    PartitionPlan(donate=(5,))))
+                self._spec_probs_fn = self._wrap(compile_step(
+                    lambda params, tokens, positions, valid, tables,
+                    temps, tops, pools: spec_score_probs(
+                        params, cfg, tokens, positions, valid, tables,
+                        temps, tops, pools, attn_impl=impl),
+                    PartitionPlan(donate=(7,))))
             # Draft programs: plain decode step (proposals) + multi-token
             # chunk (prompt ingestion / catch-up), compiled on draft_cfg.
+            # The draft pool stays in the model dtype (it is small — the
+            # density win is the target pool's) and rides the same
+            # attention impl — UNLESS the draft's own geometry violates
+            # the compiled kernel's constraints (resolve_decode_impl only
+            # vets the TARGET's d_head): a typical half-width draft then
+            # takes the XLA gather path rather than hitting the Mosaic
+            # trace failure mid-round the resolver exists to prevent.
+            draft_impl = impl
+            # Draft pools always store the draft model's dtype.
+            draft_viol = pa.kernel_constraint_violation(
+                scfg.block_size, draft_cfg.d_head,
+                jnp.dtype(draft_cfg.dtype).itemsize)
+            if impl == "pallas" and draft_viol:
+                warnings.warn(
+                    f"paged-decode kernel unavailable for the DRAFT model "
+                    f"({draft_viol}); draft programs fall back to the XLA "
+                    "gather path (target programs keep the kernel)",
+                    RuntimeWarning)
+                draft_impl = "xla"
+            self.draft_decode_impl = draft_impl
             self._draft_decode_fn = self._wrap(compile_step(
                 lambda params, tokens, positions, tables, active, pools:
                 greedy_decode_step(params, draft_cfg, tokens, positions,
-                                   tables, active, pools),
+                                   tables, active, pools,
+                                   attn_impl=draft_impl),
                 PartitionPlan(donate=(5,))))
             self._draft_chunk_fn = self._wrap(compile_step(
                 lambda params, tokens, positions, valid, last_idx, tables,
                 pools: chunked_step_greedy(
                     params, draft_cfg, tokens, positions, valid, last_idx,
-                    tables, pools),
+                    tables, pools, attn_impl=draft_impl),
                 PartitionPlan(donate=(6,))))
             # Rejection-sampling uniforms for a WHOLE round in one call:
             # (slots, k+1, 2) — two uniforms per (request, absolute
@@ -576,9 +722,11 @@ class ServingEngine:
             table[:need] = blocks
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :len(req.prompt)] = req.prompt
-            logits, self.pools = self._prefill_fn(
-                self.params, jnp.asarray(padded),
-                jnp.int32(len(req.prompt)), jnp.asarray(table), self.pools)
+            logits = self._run_program(
+                self._prefill_fn, self.params, jnp.asarray(padded),
+                jnp.int32(len(req.prompt)), jnp.asarray(table))
+            if self._quantized:
+                self.quantized_block_writes += need
             self.prefills += 1
             first = self._sample_one(req, logits)
             now = time.monotonic()
@@ -657,6 +805,67 @@ class ServingEngine:
 
     # -- fused steps ---------------------------------------------------------
 
+    def _quant_layout(self, tables: np.ndarray, positions: np.ndarray,
+                      valid: np.ndarray) -> Tuple:
+        """Host half of int8 append for one fused step: the deduped list
+        of physical blocks the step writes (``touched``), each block's
+        valid-token count after the step (``filled`` — rows past it are
+        garbage the requantize zeroes), and every token's (touched-index,
+        in-block offset) pair. Dedup matters: packed-chunk rows share one
+        slot's table, so several rows append into the SAME block — the
+        staging scatter in :func:`quantized_append` lands them at
+        distinct offsets of one staged copy, which a per-row write could
+        not do. Invalid tokens point at the trailing pad entry (scratch,
+        ``filled`` 0). ``positions``/``valid``: (rows, w); ``tables``:
+        (rows, max_blocks)."""
+        bs = self.scfg.block_size
+        rows, w = positions.shape
+        T = rows * w + 1
+        pos = np.asarray(positions, np.int64).reshape(-1)
+        val = np.asarray(valid, bool).reshape(-1)
+        # Physical block each token writes (invalid rows index harmlessly
+        # through position 0; the `val` mask drops them below). Fully
+        # vectorized — this runs before EVERY quantized fused step, so a
+        # Python per-token loop would sit on the latency path the kernel
+        # exists to shorten.
+        blocks = np.asarray(tables)[np.arange(rows).repeat(w), pos // bs]
+        uniq, inv = np.unique(blocks[val], return_inverse=True)
+        touched = np.zeros(T, np.int32)
+        touched[:len(uniq)] = uniq
+        filled = np.zeros(T, np.int32)
+        np.maximum.at(filled, inv, pos[val] % bs + 1)
+        wt = np.full(rows * w, T - 1, np.int32)
+        wt[val] = inv
+        wo = np.zeros(rows * w, np.int32)
+        wo[val] = pos[val] % bs
+        self.quantized_block_writes += len(uniq)
+        return (jnp.asarray(touched), jnp.asarray(filled),
+                jnp.asarray(wt), jnp.asarray(wo))
+
+    def _note_qerr(self, qerr) -> None:
+        """Debug mode tracks the worst per-element write-quantization
+        error actually observed (an extra scalar readback per step —
+        debug-only on purpose); outside debug the device value is simply
+        never read back."""
+        if self.debug:
+            self.max_quant_error = max(self.max_quant_error, float(qerr))
+
+    def _run_program(self, fn, *args, qa=None):
+        """Dispatch one fused step program against the engine pools: the
+        ONE place that splices the int8 write layout (``qa``; None for
+        programs that derive it in-program, like bucketed prefill) before
+        the donated pools and peels the quantized variants' extra
+        max-quant-error output. Returns the program's leading output."""
+        if self._quantized:
+            if qa is not None:
+                out, self.pools, qerr = fn(*args, qa, self.pools)
+            else:
+                out, self.pools, qerr = fn(*args, self.pools)
+            self._note_qerr(qerr)
+        else:
+            out, self.pools = fn(*args, self.pools)
+        return out
+
     def _all_greedy(self) -> bool:
         return all(r is None or r.temperature == 0 for r in self._slots)
 
@@ -674,21 +883,25 @@ class ServingEngine:
         active = np.array([r is not None for r in self._slots])
         if not active.any():
             return
+        positions = np.where(active, self._positions, 0)
+        qa = (self._quant_layout(self._tables, positions[:, None],
+                                 active[:, None])
+              if self._quantized else None)
         if self._all_greedy():
-            toks, self.pools = self._decode_greedy_fn(
-                self.params, jnp.asarray(self._last_token),
-                jnp.asarray(np.where(active, self._positions, 0)),
-                jnp.asarray(self._tables), jnp.asarray(active), self.pools)
+            toks = self._run_program(
+                self._decode_greedy_fn, self.params,
+                jnp.asarray(self._last_token), jnp.asarray(positions),
+                jnp.asarray(self._tables), jnp.asarray(active), qa=qa)
         else:
             temps, tops = self._temps_tops()
             ngen = np.array([len(r.tokens) if r else 0 for r in self._slots],
                             np.int32)
-            toks, self.pools = self._decode_fn(
-                self.params, jnp.asarray(self._last_token),
-                jnp.asarray(np.where(active, self._positions, 0)),
+            toks = self._run_program(
+                self._decode_fn, self.params,
+                jnp.asarray(self._last_token), jnp.asarray(positions),
                 jnp.asarray(self._tables), jnp.asarray(active),
                 jnp.asarray(temps), jnp.asarray(tops),
-                jnp.asarray(self._slot_keys), jnp.asarray(ngen), self.pools)
+                jnp.asarray(self._slot_keys), jnp.asarray(ngen), qa=qa)
         self.decode_steps += 1
         toks = np.asarray(toks)
         now = time.monotonic()
@@ -775,18 +988,22 @@ class ServingEngine:
             temps[n:n + c], tops[n:n + c] = req.temperature, req.top_p
             keys[n:n + c] = self._slot_keys[pre]   # ngen 0: first token rides
             # the same fold_in(key, 0) draw a bucketed admission makes.
+        pos_masked = np.where(active, positions, 0)
+        qa = (self._quant_layout(tables, pos_masked[:, None],
+                                 active[:, None])
+              if self._quantized else None)
         if self._all_greedy():
-            toks, self.pools = self._decode_greedy_fn(
-                self.params, jnp.asarray(tokens),
-                jnp.asarray(np.where(active, positions, 0)),
-                jnp.asarray(tables), jnp.asarray(active), self.pools)
+            toks = self._run_program(
+                self._decode_greedy_fn, self.params, jnp.asarray(tokens),
+                jnp.asarray(pos_masked), jnp.asarray(tables),
+                jnp.asarray(active), qa=qa)
         else:
-            toks, self.pools = self._decode_fn(
-                self.params, jnp.asarray(tokens),
-                jnp.asarray(np.where(active, positions, 0)),
-                jnp.asarray(tables), jnp.asarray(active),
-                jnp.asarray(temps), jnp.asarray(tops), jnp.asarray(keys),
-                jnp.asarray(ngen), self.pools)
+            toks = self._run_program(
+                self._decode_fn, self.params, jnp.asarray(tokens),
+                jnp.asarray(pos_masked), jnp.asarray(tables),
+                jnp.asarray(active), jnp.asarray(temps),
+                jnp.asarray(tops), jnp.asarray(keys),
+                jnp.asarray(ngen), qa=qa)
         self.chunk_steps += 1
         toks = np.asarray(toks)
         now = time.monotonic()
@@ -864,18 +1081,23 @@ class ServingEngine:
             tokens[i, 1:ke + 1] = proposals[i, :ke]
             positions[i, :ke + 1] = np.arange(pos, pos + ke + 1)
             valid[i, :ke + 1] = True
+        qa = (self._quant_layout(self._tables,
+                                 np.where(valid, positions, 0), valid)
+              if self._quantized else None)
         if self._all_greedy():
-            scored, self.pools = self._spec_greedy_fn(
-                self.params, jnp.asarray(tokens), jnp.asarray(positions),
-                jnp.asarray(valid), jnp.asarray(self._tables), self.pools)
+            scored = self._run_program(
+                self._spec_greedy_fn, self.params, jnp.asarray(tokens),
+                jnp.asarray(positions), jnp.asarray(valid),
+                jnp.asarray(self._tables), qa=qa)
             probs = None
             scored = np.asarray(scored)
         else:
             temps, tops = self._temps_tops()
-            probs, self.pools = self._spec_probs_fn(
-                self.params, jnp.asarray(tokens), jnp.asarray(positions),
-                jnp.asarray(valid), jnp.asarray(self._tables),
-                jnp.asarray(temps), jnp.asarray(tops), self.pools)
+            probs = self._run_program(
+                self._spec_probs_fn, self.params, jnp.asarray(tokens),
+                jnp.asarray(positions), jnp.asarray(valid),
+                jnp.asarray(self._tables), jnp.asarray(temps),
+                jnp.asarray(tops), qa=qa)
             probs = np.asarray(probs)
             scored = None
             uniforms = np.asarray(self._spec_uniform_fn(
@@ -1052,6 +1274,22 @@ class ServingEngine:
             "prefill_chunks": self.prefill_chunks,
             "recompute_preemptions": self.preemption_count,
             "tp": self.tp,
+            # Which paged attention the fused steps COMPILED with — a
+            # silent auto-fallback to the gather path is visible here, so
+            # benches and soaks record which path actually ran.
+            "decode_impl": self.decode_impl,
+            "draft_decode_impl": self.draft_decode_impl,
+            "kv_quant": {
+                "kv_dtype": self.scfg.kv_dtype
+                or str(jnp.dtype(self.cfg.dtype)),
+                "quantized_block_writes": self.quantized_block_writes,
+                # Worst per-element |dequant - value| actually observed;
+                # tracked only in debug mode (TPU_TASK_CHECKIFY=1 — the
+                # per-step scalar readback is the cost), None otherwise.
+                "max_quant_error_observed":
+                    self.max_quant_error if self.debug else None,
+            },
+            "kv_bytes_per_token": kv_token_bytes(self.cfg, self.scfg),
             "kv_blocks_high_water": self.allocator.high_water,
             "kv_high_water_bytes": paged_cache_bytes(
                 self.cfg, self.scfg, self.allocator.high_water),

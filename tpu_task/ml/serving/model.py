@@ -15,11 +15,28 @@ Shapes are static everywhere: prefill compiles once per
 ``(bucket, max_blocks)`` and decode once per ``(slots, max_blocks)`` — a
 handful of programs serve every request mix, the serving-side analogue of
 ``generate``'s one-compiled-program discipline.
+
+Two static knobs thread through every fused step (ROADMAP item 3), both
+chosen by the engine at construction, never per call:
+
+- ``attn_impl``: ``"xla"`` keeps the gather+dense decode attention above
+  byte-for-byte (the bit-exact fp32 reference); ``"pallas"``/
+  ``"interpret"`` route the SAME scatter-then-attend contract through the
+  block-table-walking kernel (``ml.ops.paged_attention``) that never
+  materializes the gathered buffer.
+- quantized pools (``kv_dtype="int8"`` — detected from the pool layout):
+  the scatter becomes :func:`~tpu_task.ml.serving.cache.quantized_append`
+  (per-block requantization driven by the host-computed ``qa`` arrays)
+  and every step additionally returns the max quantization error of its
+  writes — computed only when the engine's debug mode sets the static
+  ``measure_qerr`` flag (otherwise the output is a constant 0.0, so the
+  hot path never pays for the measurement).
 """
 
 from __future__ import annotations
 
-from typing import List, Tuple
+import functools
+from typing import List
 
 import jax
 import jax.numpy as jnp
@@ -33,11 +50,28 @@ from tpu_task.ml.models.transformer import (
     embed_lookup,
 )
 from tpu_task.ml.ops.attention import gqa_cached_attention
-from tpu_task.ml.serving.cache import flat_pool, gather_kv, token_slots
+from tpu_task.ml.ops.paged_attention import paged_attention
+from tpu_task.ml.serving.cache import (
+    flat_pool,
+    gather_kv,
+    quantized_append,
+    token_slots,
+)
+
+
+def pool_is_quantized(pools: List[dict]) -> bool:
+    """Whether the pool pytree carries int8 scale sidecars."""
+    return "k_scale" in pools[0]
+
+
+def _fold_qerr(qerrs: List[jax.Array]) -> jax.Array:
+    """Max write-quantization error across a step's layers."""
+    return functools.reduce(jnp.maximum, qerrs)
 
 
 def paged_prefill(params: Params, cfg: TransformerConfig, tokens, length,
-                  block_table, pools: List[dict]) -> Tuple[jax.Array, List[dict]]:
+                  block_table, pools: List[dict], *,
+                  measure_qerr: bool = False):
     """One request's prompt through the model, writing its k/v into the
     paged pool. ``tokens``: (1, bucket) right-padded to a prefill bucket;
     ``length``: the real prompt length (may be traced — one compile per
@@ -52,9 +86,16 @@ def paged_prefill(params: Params, cfg: TransformerConfig, tokens, length,
     real token before any unmasked read — decode writes position p before
     attending it) or, beyond the allocated region, in the scratch block;
     their attention rows are never read (logits are gathered at
-    length - 1, and pads sit at positions > every real row's mask)."""
+    length - 1, and pads sit at positions > every real row's mask).
+
+    A quantized pool changes only the WRITE: the prompt's blocks quantize
+    in one :func:`quantized_append` per layer (the write layout —
+    touched/filled/offsets — is derivable in-program from ``length``, no
+    host arrays needed), the prompt still attends its own exact
+    activations, and the step returns (logits, pools, max quant error)."""
     b, s = tokens.shape
     block_size = pools[0]["k"].shape[1]
+    quantized = pool_is_quantized(pools)
     bounds_guard(length <= block_table.shape[0] * block_size,
                  "prefill overflow: length {length} exceeds the slot's "
                  "block-table capacity {cap}",
@@ -64,26 +105,45 @@ def paged_prefill(params: Params, cfg: TransformerConfig, tokens, length,
     write_idx = token_slots(block_table, positions, block_size)
     x = embed_lookup(params["embed"].astype(cfg.dtype), tokens)
     new_pools: List[dict] = []
+    qerrs: List[jax.Array] = []
     for layer, pool in zip(params["layers"], pools):
         updated: dict = {}
 
         def attn_fn(q, k, v, pool=pool, updated=updated):
-            updated["k"] = flat_pool(pool["k"]).at[write_idx].set(
-                k[0]).reshape(pool["k"].shape)
-            updated["v"] = flat_pool(pool["v"]).at[write_idx].set(
-                v[0]).reshape(pool["v"].shape)
+            if quantized:
+                # Rows past `length` land at offsets >= their block's
+                # filled count (or in wholly-dead scratch entries) and are
+                # zeroed by the requantize, so prompt padding cannot
+                # inflate a block's scale.
+                filled = jnp.clip(
+                    length - jnp.arange(block_table.shape[0]) * block_size,
+                    0, block_size)
+                upd, err = quantized_append(
+                    pool, k[0], v[0], block_table,
+                    filled, positions // block_size,
+                    positions % block_size, measure_error=measure_qerr)
+                updated.update(upd)
+                qerrs.append(err)
+            else:
+                updated["k"] = flat_pool(pool["k"]).at[write_idx].set(
+                    k[0]).reshape(pool["k"].shape)
+                updated["v"] = flat_pool(pool["v"]).at[write_idx].set(
+                    v[0]).reshape(pool["v"].shape)
             return gqa_cached_attention(q, k, v, positions)
 
         x, _aux = _block(x, layer, cfg, attn_fn, positions=positions)
         new_pools.append(updated)
     x = _rmsnorm(x, params["final_norm"])
     logits = x[:, length - 1] @ params["unembed"].astype(cfg.dtype)
+    if quantized:
+        return logits.astype(jnp.float32), new_pools, _fold_qerr(qerrs)
     return logits.astype(jnp.float32), new_pools
 
 
 def paged_decode_step(params: Params, cfg: TransformerConfig, tokens,
-                      positions, block_tables, active,
-                      pools: List[dict]) -> Tuple[jax.Array, List[dict]]:
+                      positions, block_tables, active, pools: List[dict],
+                      qa=None, *, attn_impl: str = "xla", mesh=None,
+                      measure_qerr: bool = False):
     """ONE decode step across every slot: each slot's last token in, each
     slot's next-token logits out. ``tokens``: (slots,) int32; ``positions``:
     (slots,) — the absolute position each new token occupies (per-slot: no
@@ -91,10 +151,19 @@ def paged_decode_step(params: Params, cfg: TransformerConfig, tokens,
     ``block_tables``: (slots, max_blocks) int32; ``active``: (slots,) bool —
     inactive slots still compute (static shapes) but write only scratch and
     their outputs are discarded by the host scheduler. Returns
-    ((slots, vocab) float32 logits, updated pools)."""
+    ((slots, vocab) float32 logits, updated pools) — plus the max write
+    quantization error when the pool is int8 (``qa`` carries the
+    host-computed write layout; see :func:`quantized_append`)."""
     slots = tokens.shape[0]
     block_size = pools[0]["k"].shape[1]
+    quantized = pool_is_quantized(pools)
     capacity = block_tables.shape[1] * block_size
+    if quantized and qa is None:
+        raise ValueError(
+            "quantized (int8) pools need the host-computed `qa` write "
+            "layout (touched, filled, wt, wo) — see "
+            "cache.quantized_append; ServingEngine derives it per step "
+            "(_quant_layout)")
     bounds_guard(jnp.all(jnp.where(active, positions, 0) < capacity),
                  "decode overflow: a slot position reached the block-table "
                  "capacity {cap}", cap=jnp.asarray(capacity))
@@ -103,16 +172,30 @@ def paged_decode_step(params: Params, cfg: TransformerConfig, tokens,
         active, token_slots(block_tables, positions, block_size), 0)
     x = embed_lookup(params["embed"].astype(cfg.dtype), tokens[:, None])
     new_pools: List[dict] = []
+    qerrs: List[jax.Array] = []
     for layer, pool in zip(params["layers"], pools):
         updated: dict = {}
 
         def attn_fn(q, k, v, pool=pool, updated=updated):
-            # Scatter this step's k/v (slots, 1, kv, d), THEN gather — the
+            # Scatter this step's k/v (slots, 1, kv, d), THEN attend — the
             # new token must attend itself, same order as the dense path.
+            if quantized:
+                upd, err = quantized_append(pool, k[:, 0], v[:, 0], *qa,
+                                            measure_error=measure_qerr)
+                updated.update(upd)
+                qerrs.append(err)
+                return paged_attention(
+                    q, upd["k"], upd["v"], block_tables, pos2d,
+                    upd["k_scale"], upd["v_scale"], impl=attn_impl,
+                    mesh=mesh)
             kf = flat_pool(pool["k"]).at[write_idx].set(k[:, 0])
             vf = flat_pool(pool["v"]).at[write_idx].set(v[:, 0])
             updated["k"] = kf.reshape(pool["k"].shape)
             updated["v"] = vf.reshape(pool["v"].shape)
+            if attn_impl != "xla":
+                return paged_attention(
+                    q, updated["k"], updated["v"], block_tables, pos2d,
+                    impl=attn_impl, mesh=mesh)
             k_view = gather_kv(kf, block_tables, block_size)
             v_view = gather_kv(vf, block_tables, block_size)
             return gqa_cached_attention(q, k_view, v_view, pos2d)
@@ -121,39 +204,52 @@ def paged_decode_step(params: Params, cfg: TransformerConfig, tokens,
         new_pools.append(updated)
     x = _rmsnorm(x, params["final_norm"])
     logits = x[:, -1] @ params["unembed"].astype(cfg.dtype)
+    if quantized:
+        return logits.astype(jnp.float32), new_pools, _fold_qerr(qerrs)
     return logits.astype(jnp.float32), new_pools
 
 
 def greedy_decode_step(params: Params, cfg: TransformerConfig, tokens,
-                       positions, block_tables, active, pools):
+                       positions, block_tables, active, pools, qa=None, *,
+                       attn_impl: str = "xla", mesh=None,
+                       measure_qerr: bool = False):
     """Fused decode + argmax: the greedy fast path of the engine — when
     every active slot decodes at temperature 0 the sampler reduces to one
     argmax and the step program carries no sort/cumsum/key-fold. Returns
-    ((slots,) int32 next tokens, pools)."""
-    logits, new_pools = paged_decode_step(
-        params, cfg, tokens, positions, block_tables, active, pools)
-    return jnp.argmax(logits, axis=-1).astype(jnp.int32), new_pools
+    ((slots,) int32 next tokens, pools[, max quant error])."""
+    out = paged_decode_step(
+        params, cfg, tokens, positions, block_tables, active, pools, qa,
+        attn_impl=attn_impl, mesh=mesh, measure_qerr=measure_qerr)
+    toks = jnp.argmax(out[0], axis=-1).astype(jnp.int32)
+    return (toks,) + tuple(out[1:])
 
 
 def decode_and_sample(params: Params, cfg: TransformerConfig, tokens,
                       positions, block_tables, active, temperature, top_p,
-                      slot_keys, n_generated, pools):
+                      slot_keys, n_generated, pools, qa=None, *,
+                      attn_impl: str = "xla", mesh=None,
+                      measure_qerr: bool = False):
     """Fused decode step + sampler: ONE program (one dispatch, one (slots,)
     readback) per engine iteration — the serving analogue of ``generate``
     folding its sampler into the scan body. Per-token sampling keys are
     derived in-program: ``fold_in(slot_keys[i], n_generated[i])``, so a
     request's stream still depends only on its own key and token index,
-    never on co-scheduling. Returns ((slots,) int32 next tokens, pools)."""
-    logits, new_pools = paged_decode_step(
-        params, cfg, tokens, positions, block_tables, active, pools)
+    never on co-scheduling. Returns ((slots,) int32 next tokens,
+    pools[, max quant error])."""
+    out = paged_decode_step(
+        params, cfg, tokens, positions, block_tables, active, pools, qa,
+        attn_impl=attn_impl, mesh=mesh, measure_qerr=measure_qerr)
     keys = jax.vmap(jax.random.fold_in)(slot_keys, n_generated)
-    return sample_tokens(logits, temperature, top_p, keys), new_pools
+    toks = sample_tokens(out[0], temperature, top_p, keys)
+    return (toks,) + tuple(out[1:])
 
 
 # -- multi-token step: chunked prefill + speculative scoring -----------------
 
 def _multitoken_features(params: Params, cfg: TransformerConfig, tokens,
-                         positions, valid, block_tables, pools):
+                         positions, valid, block_tables, pools, qa=None, *,
+                         attn_impl: str = "xla", mesh=None,
+                         measure_qerr: bool = False):
     """The width-``w`` generalization of ``paged_decode_step``: run
     ``tokens`` (slots, w) through the model with PER-TOKEN absolute
     ``positions`` (slots, w) and a ``valid`` mask (slots, w), scattering
@@ -171,7 +267,14 @@ def _multitoken_features(params: Params, cfg: TransformerConfig, tokens,
     softmax weight at fp32) — which is why chunked-vs-bucketed greedy
     bit-identity is a checkable contract, not a hope (docs/parity.md)."""
     block_size = pools[0]["k"].shape[1]
+    quantized = pool_is_quantized(pools)
     capacity = block_tables.shape[1] * block_size
+    if quantized and qa is None:
+        raise ValueError(
+            "quantized (int8) pools need the host-computed `qa` write "
+            "layout (touched, filled, wt, wo) — see "
+            "cache.quantized_append; ServingEngine derives it per step "
+            "(_quant_layout)")
     bounds_guard(jnp.all(jnp.where(valid, positions, 0) < capacity),
                  "multitoken overflow: a position reached the block-table "
                  "capacity {cap}", cap=jnp.asarray(capacity))
@@ -183,54 +286,80 @@ def _multitoken_features(params: Params, cfg: TransformerConfig, tokens,
         valid, phys * block_size + qpos % block_size, 0).reshape(-1)
     x = embed_lookup(params["embed"].astype(cfg.dtype), tokens)
     new_pools: List[dict] = []
+    qerrs: List[jax.Array] = []
     for layer, pool in zip(params["layers"], pools):
         updated: dict = {}
 
         def attn_fn(q, k, v, pool=pool, updated=updated):
-            # Scatter every valid token's k/v, THEN gather: a chunk token
+            # Scatter every valid token's k/v, THEN attend: a chunk token
             # must attend its in-chunk predecessors (written this call) as
             # well as the cached prefix — the position mask provides the
             # causal cut, exactly as in the bucketed program.
             kv_heads, d_head = k.shape[2], k.shape[3]
+            if quantized:
+                upd, err = quantized_append(
+                    pool, k.reshape(-1, kv_heads, d_head),
+                    v.reshape(-1, kv_heads, d_head), *qa,
+                    measure_error=measure_qerr)
+                updated.update(upd)
+                qerrs.append(err)
+                return paged_attention(
+                    q, upd["k"], upd["v"], block_tables, qpos,
+                    upd["k_scale"], upd["v_scale"], impl=attn_impl,
+                    mesh=mesh)
             kf = flat_pool(pool["k"]).at[write_idx].set(
                 k.reshape(-1, kv_heads, d_head))
             vf = flat_pool(pool["v"]).at[write_idx].set(
                 v.reshape(-1, kv_heads, d_head))
             updated["k"] = kf.reshape(pool["k"].shape)
             updated["v"] = vf.reshape(pool["v"].shape)
+            if attn_impl != "xla":
+                return paged_attention(
+                    q, updated["k"], updated["v"], block_tables, qpos,
+                    impl=attn_impl, mesh=mesh)
             k_view = gather_kv(kf, block_tables, block_size)
             v_view = gather_kv(vf, block_tables, block_size)
             return gqa_cached_attention(q, k_view, v_view, qpos)
 
         x, _aux = _block(x, layer, cfg, attn_fn, positions=qpos)
         new_pools.append(updated)
-    return _rmsnorm(x, params["final_norm"]), new_pools
+    feats = _rmsnorm(x, params["final_norm"])
+    if quantized:
+        return feats, new_pools, _fold_qerr(qerrs)
+    return feats, new_pools
 
 
 def paged_multitoken_logits(params: Params, cfg: TransformerConfig, tokens,
-                            positions, valid, block_tables, pools):
+                            positions, valid, block_tables, pools, qa=None,
+                            *, attn_impl: str = "xla", mesh=None,
+                            measure_qerr: bool = False):
     """Full-width logits (slots, w, vocab) float32 — the speculative
     scoring step: ONE fused target pass scores all k+1 positions of every
     slot's [last_token, draft_1..draft_k] row against the paged cache."""
-    x, new_pools = _multitoken_features(
-        params, cfg, tokens, positions, valid, block_tables, pools)
-    logits = x @ params["unembed"].astype(cfg.dtype)
-    return logits.astype(jnp.float32), new_pools
+    out = _multitoken_features(
+        params, cfg, tokens, positions, valid, block_tables, pools, qa,
+        attn_impl=attn_impl, mesh=mesh, measure_qerr=measure_qerr)
+    logits = out[0] @ params["unembed"].astype(cfg.dtype)
+    return (logits.astype(jnp.float32),) + tuple(out[1:])
 
 
 def spec_score_greedy(params: Params, cfg: TransformerConfig, tokens,
-                      positions, valid, block_tables, pools):
+                      positions, valid, block_tables, pools, qa=None, *,
+                      attn_impl: str = "xla", mesh=None,
+                      measure_qerr: bool = False):
     """Fused speculative scoring + argmax: (slots, w) int32 target tokens
     — the greedy accept rule (longest agreeing prefix + bonus token) runs
     on these host-side and is bit-identical to non-speculative decoding."""
-    logits, new_pools = paged_multitoken_logits(
-        params, cfg, tokens, positions, valid, block_tables, pools)
-    return jnp.argmax(logits, axis=-1).astype(jnp.int32), new_pools
+    out = paged_multitoken_logits(
+        params, cfg, tokens, positions, valid, block_tables, pools, qa,
+        attn_impl=attn_impl, mesh=mesh, measure_qerr=measure_qerr)
+    return (jnp.argmax(out[0], axis=-1).astype(jnp.int32),) + tuple(out[1:])
 
 
 def spec_score_probs(params: Params, cfg: TransformerConfig, tokens,
                      positions, valid, block_tables, temperature, top_p,
-                     pools):
+                     pools, qa=None, *, attn_impl: str = "xla", mesh=None,
+                     measure_qerr: bool = False):
     """Fused speculative scoring for SAMPLED requests: per-position target
     probabilities (slots, w, vocab) float32 after the SAME temper-then-
     top_p filter ``sample_tokens`` applies — so host-side rejection
@@ -238,18 +367,22 @@ def spec_score_probs(params: Params, cfg: TransformerConfig, tokens,
     samples from (the distribution-exactness contract). Greedy rows
     (temperature 0) run at temp 1 and the host takes argmax(probs), which
     equals argmax(logits) — softmax is monotonic."""
-    logits, new_pools = paged_multitoken_logits(
-        params, cfg, tokens, positions, valid, block_tables, pools)
+    out = paged_multitoken_logits(
+        params, cfg, tokens, positions, valid, block_tables, pools, qa,
+        attn_impl=attn_impl, mesh=mesh, measure_qerr=measure_qerr)
+    logits = out[0]
     safe_t = jnp.where(temperature > 0, temperature, 1.0)
     filtered = _top_p_filter(
         (logits / safe_t[:, None, None]).reshape(-1, logits.shape[-1]),
         jnp.repeat(top_p, logits.shape[1]))
     probs = jax.nn.softmax(filtered, axis=-1).reshape(logits.shape)
-    return probs, new_pools
+    return (probs,) + tuple(out[1:])
 
 
 def chunked_step_greedy(params: Params, cfg: TransformerConfig, tokens,
-                        positions, valid, last_idx, block_tables, pools):
+                        positions, valid, last_idx, block_tables, pools,
+                        qa=None, *, attn_impl: str = "xla", mesh=None,
+                        measure_qerr: bool = False):
     """Fused multi-row chunk ingestion: every row advances by its own
     ``valid`` span and emits the argmax at its LAST valid position
     (``last_idx``: (slots,)); mid-prompt rows' outputs are discarded by
@@ -257,13 +390,14 @@ def chunked_step_greedy(params: Params, cfg: TransformerConfig, tokens,
     step instead (engine._chunk_step — slots + chunk rows of width 1);
     this (slots, w) layout remains for the DRAFT cache catch-up, where
     several slots may need multi-token ingestion in one call. Returns
-    ((slots,) int32, pools)."""
-    x, new_pools = _multitoken_features(
-        params, cfg, tokens, positions, valid, block_tables, pools)
+    ((slots,) int32, pools[, max quant error])."""
+    out = _multitoken_features(
+        params, cfg, tokens, positions, valid, block_tables, pools, qa,
+        attn_impl=attn_impl, mesh=mesh, measure_qerr=measure_qerr)
     slots = tokens.shape[0]
-    last = x[jnp.arange(slots), last_idx]           # (slots, d_model)
+    last = out[0][jnp.arange(slots), last_idx]      # (slots, d_model)
     logits = (last @ params["unembed"].astype(cfg.dtype)).astype(jnp.float32)
-    return jnp.argmax(logits, axis=-1).astype(jnp.int32), new_pools
+    return (jnp.argmax(logits, axis=-1).astype(jnp.int32),) + tuple(out[1:])
 
 
 def sample_tokens(logits, temperature, top_p, keys):
