@@ -8,7 +8,7 @@ PYTHON ?= python3
 # Seed for the chaos soak: any run is replayable by pinning this.
 TPU_TASK_CHAOS_SEED ?= 20260804
 
-.PHONY: test smoke sweep bench bench-steady bench-serving bench-sched bench-decode sched sched-soak chaos wheel multichip kernels-tpu clean
+.PHONY: test smoke sweep bench bench-steady bench-serving bench-sched bench-decode bench-fleet sched sched-soak chaos fleet serve-soak wheel multichip kernels-tpu clean
 
 # Hermetic suite (the reference's `make test`, 30 s budget there; ours spans
 # the fake control planes, sharded-compute CPU checks, and the loopback GCS
@@ -62,6 +62,15 @@ bench-sched:
 bench-decode:
 	$(PYTHON) bench.py generation --decode-kernel
 
+# Fleet-serving cost model only: aggregate tok/s + TTFT percentiles vs
+# replica count {1,2,4} through the WHOLE serve subsystem (scheduler-
+# admitted replica gangs, session-affine router, loopback HTTP), plus the
+# preempt-one-replica leg (failover + capacity-restore times). CPU note:
+# replicas share one host's cores, so throughput does not scale like
+# chips — the tracked signals are queue wait and the recovery legs.
+bench-fleet:
+	$(PYTHON) bench.py fleet
+
 # Tier-1-speed gang-scheduler tests: queue/quota/pool model, fair-share
 # ordering, victim-order properties, CLI, bench smoke (all virtual-time).
 sched:
@@ -80,6 +89,21 @@ sched-soak:
 chaos:
 	TPU_TASK_CHAOS_SEED=$(TPU_TASK_CHAOS_SEED) \
 		$(PYTHON) -m pytest tests/ -m chaos -q
+
+# Fleet-serving tests (serve as a first-class task): replica front end,
+# session-affine router, re-dispatch under chaos transport, autoscale,
+# serve gangs through the scheduler — all in-process loopback HTTP.
+fleet:
+	$(PYTHON) -m pytest tests/ -m fleet -q
+
+# Serve-as-a-task chaos soak: replica gangs as REAL fake-mode TPU tasks,
+# a seeded mid-stream replica preemption (SIGTERM → drain → export →
+# requeue through the PR 3 governor), router failover to the sibling,
+# greedy streams pinned bit-identical to an unpreempted run. Replayable
+# from the seed.
+serve-soak:
+	TPU_TASK_CHAOS_SEED=$(TPU_TASK_CHAOS_SEED) \
+		$(PYTHON) -m pytest tests/ -m "fleet and slow" -q
 
 # Build the agent wheel the worker bootstrap installs.
 wheel:

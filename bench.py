@@ -1810,6 +1810,156 @@ def bench_scheduler(n_tasks: int = 400, seed: int = 0, dt: float = 0.5,
     }
 
 
+def bench_serving_fleet(replica_counts=(1, 2, 4), n_requests: int = 24,
+                        seed: int = 0) -> dict:
+    """Fleet-serving leg (ROADMAP item 5): the SAME Poisson workload
+    through the whole serve subsystem — replica gangs admitted by the
+    GangScheduler, engines behind loopback HTTP replicas, the
+    session-affine router dispatching/streaming over the pooled keep-alive
+    transport — at replica count ∈ ``replica_counts``, plus a
+    preempt-one-replica leg reporting recovery times.
+
+    CPU caveat (same as the spec-decode bench): all replicas share one
+    host's cores, so aggregate tok/s does NOT scale like real chips would
+    — the tracked signals are queue-wait (TTFT percentiles falling as
+    replicas absorb the backlog), dispatch overhead, and the recovery
+    legs. Half the prompts share one 16-token prefix (affinity traffic).
+
+    The preempt leg kills one of two replicas gracefully mid-run: the
+    router takes the drained suffix and re-dispatches to the sibling;
+    ``failover_s`` is kill → every affected stream producing tokens again
+    (client-visible recovery), ``replica_restored_s`` is kill → the gang
+    re-placed by the scheduler's requeue governor and its fresh endpoint
+    rejoining membership (capacity recovery)."""
+    import numpy as np
+
+    from tpu_task.scheduler import CapacityPool, GangScheduler, TenantQuota
+    from tpu_task.serve import (
+        InProcessServeDriver, Router, ServeFleet, ServeSpec, wait_until,
+    )
+
+    rng = np.random.default_rng(seed)
+    shared_head = rng.integers(0, 256, size=16)
+    work, t = [], 0.0
+    for i in range(n_requests):
+        t += float(rng.exponential(0.01))
+        prompt = (np.concatenate([shared_head,
+                                  rng.integers(0, 256, size=4)])
+                  if i % 2 == 0 else rng.integers(0, 256, size=12))
+        work.append({"arrival": t, "prompt": prompt,
+                     "max_new": 8 if rng.random() < 2 / 3 else 32})
+    useful = sum(w["max_new"] for w in work)
+
+    def build(replicas: int):
+        driver = InProcessServeDriver()
+        scheduler = GangScheduler(
+            CapacityPool([4 * max(replica_counts)]),
+            {"bench": TenantQuota(chips=4 * max(replica_counts),
+                                  weight=1.0)}, driver)
+        router = Router(seed=seed)
+        fleet = ServeFleet(
+            scheduler,
+            ServeSpec(service="bench", tenant="bench", replicas=replicas,
+                      preset="tiny", serving={"slots": 4}),
+            router)
+        fleet.launch()
+        assert wait_until(lambda: len(fleet.refresh_endpoints()) == replicas,
+                          60, tick=fleet.tick, period=0.05)
+        fleet.tick()
+        # Warm every replica's compiled programs off the timeline.
+        warm = [router.submit(np.zeros(4, np.int32), 2)
+                for _ in range(replicas * 4)]
+        router.drain(deadline_s=120, on_idle=fleet.tick)
+        del warm
+        return driver, scheduler, router, fleet
+
+    def teardown(driver):
+        for task_id in list(driver.running_ids()):
+            driver._stop(task_id, graceful=False)
+
+    def run_leg(replicas: int, preempt: bool = False) -> dict:
+        driver, scheduler, router, fleet = build(replicas)
+        try:
+            t0 = time.monotonic()
+            fids, i = {}, 0
+            killed_at = None
+            affected = []
+            failover_done_at = None
+            restored_at = None
+            victim = None
+            while True:
+                now = time.monotonic() - t0
+                while i < len(work) and work[i]["arrival"] <= now:
+                    fids[i] = router.submit(work[i]["prompt"],
+                                            work[i]["max_new"])
+                    i += 1
+                open_count = router.pump(wait_ms=5)
+                fleet.tick()
+                done = len(work) - (open_count + (len(work) - len(fids)))
+                if preempt and killed_at is None and done >= len(work) // 3:
+                    live = [fid for fid in fids.values()
+                            if router.request(fid).status != "done"
+                            and router.request(fid).replica]
+                    if live:
+                        victim = router.request(live[0]).replica
+                        affected = [fid for fid in live
+                                    if router.request(fid).replica == victim]
+                        marks = {fid: len(router.request(fid).tokens)
+                                 for fid in affected}
+                        driver.kill(victim, graceful=True)
+                        killed_at = time.monotonic()
+                if killed_at and failover_done_at is None and all(
+                        router.request(fid).status == "done"
+                        or len(router.request(fid).tokens) > marks[fid]
+                        for fid in affected):
+                    failover_done_at = time.monotonic()
+                if killed_at and restored_at is None and victim in \
+                        fleet.refresh_endpoints():
+                    restored_at = time.monotonic()
+                if i == len(work) and open_count == 0 and (
+                        not preempt or restored_at is not None):
+                    break
+                if time.monotonic() - t0 > 600:
+                    raise RuntimeError("fleet bench leg did not converge")
+            makespan = time.monotonic() - t0
+            ttft = [router.request(fid).first_token_t
+                    - (t0 + work[j]["arrival"])
+                    for j, fid in fids.items()]
+            result = {
+                "replicas": replicas,
+                "decode_tokens_per_s": round(useful / makespan, 1),
+                "makespan_s": round(makespan, 3),
+                "ttft_p50_ms": round(
+                    float(np.percentile(np.asarray(ttft) * 1e3, 50)), 1),
+                "ttft_p99_ms": round(
+                    float(np.percentile(np.asarray(ttft) * 1e3, 99)), 1),
+                "redispatches": router.redispatches,
+            }
+            if preempt:
+                result.update({
+                    "preempted_replica_open_streams": len(affected),
+                    "failover_s": round(failover_done_at - killed_at, 3)
+                    if failover_done_at else None,
+                    "replica_restored_s": round(restored_at - killed_at, 3)
+                    if restored_at else None,
+                })
+            return result
+        finally:
+            teardown(driver)
+
+    legs = [run_leg(r) for r in replica_counts]
+    recovery = run_leg(2, preempt=True)
+    return {
+        "workload": {"n_requests": n_requests, "useful_tokens": useful,
+                     "shared_prefix_fraction": 0.5,
+                     "poisson_mean_interarrival_ms": 10},
+        "by_replica_count": legs,
+        "preempt_one_of_two": recovery,
+        "ttft_p99_speedup_1_to_max": round(
+            legs[0]["ttft_p99_ms"] / max(legs[-1]["ttft_p99_ms"], 1e-9), 2),
+    }
+
+
 def main() -> int:
     import jax
 
@@ -1836,6 +1986,10 @@ def main() -> int:
     serving["shared_prefix"] = bench_serving_shared_prefix()
     serving["long_prompt_under_load"] = bench_serving_long_prompt()
     serving["accept_rate_sweep"] = bench_serving_spec()
+    # Fleet serving (ROADMAP item 5): the serve subsystem end to end —
+    # replica gangs on the scheduler, session-affine router, preempt-one
+    # recovery legs — at replica count 1/2/4 on loopback HTTP.
+    fleet = bench_serving_fleet()
     transport = bench_transport()
     data_plane = bench_data_plane()
     steady_state = bench_steady_state()
@@ -1851,6 +2005,7 @@ def main() -> int:
         "ring_schedule": ring,
         "generation": generation,
         "serving": serving,
+        "fleet": fleet,
         "transport": transport,
         "data_plane": data_plane,
         "steady_state": steady_state,
@@ -1953,6 +2108,16 @@ def _parse_args(argv):
         help="skip the production-traffic scenarios (shared-prefix prefix "
              "cache, long-prompt-under-load chunked prefill, speculative "
              "accept-rate sweep)")
+    fleet_cmd = sub.add_parser(
+        "fleet",
+        help="fleet-serving section only (also `make bench-fleet`): "
+             "aggregate tok/s + TTFT percentiles vs replica count through "
+             "scheduler + router + loopback HTTP replicas, plus the "
+             "preempt-one-replica recovery leg")
+    fleet_cmd.add_argument("--replicas", default="1,2,4", metavar="N[,N...]",
+                           help="replica counts to sweep (default 1,2,4)")
+    fleet_cmd.add_argument("--requests", type=int, default=24)
+    fleet_cmd.add_argument("--seed", type=int, default=0)
     return parser.parse_args(argv)
 
 
@@ -1975,6 +2140,13 @@ if __name__ == "__main__":
         result["decode_kernel"] = bench_generation_decode_kernel(
             batches=batches)
         print(json.dumps({"generation": result}))
+        raise SystemExit(0)
+    if args.section == "fleet":
+        counts = tuple(int(c) for c in str(args.replicas).split(",")
+                       if c.strip())
+        print(json.dumps({"fleet": bench_serving_fleet(
+            replica_counts=counts, n_requests=args.requests,
+            seed=args.seed)}))
         raise SystemExit(0)
     if args.section == "serving":
         tps = tuple(int(t) for t in str(args.tp or "1,8").split(",")

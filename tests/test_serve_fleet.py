@@ -1,0 +1,493 @@
+"""Fleet serving tests: replica front end, session-affine router,
+retry-with-re-dispatch under chaos, autoscale, and the serve-gang loop
+through the real GangScheduler — all in-process (loopback HTTP replicas),
+seconds per test. The real-task chaos soak is tests/test_serve_soak.py.
+
+The exactness spine everything here leans on: a request's stream is a
+pure function of (context, sampling key, token index) — never of which
+replica ran it, when it was re-dispatched, or who else shared the batch.
+That is what lets the router treat ANY replica as a continuation point.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_task.scheduler import CapacityPool, GangScheduler, TenantQuota
+from tpu_task.serve import (
+    InProcessServeDriver,
+    NoReplicaAvailable,
+    QueueDepthAutoscaler,
+    ReplicaServer,
+    Router,
+    ServeFleet,
+    ServeSpec,
+    replica_script,
+    wait_until,
+)
+from tpu_task.serve.replica import build_engine
+from tpu_task.testing.chaos import ChaosSchedule, ChaosTransport
+
+pytestmark = pytest.mark.fleet
+
+RNG = np.random.default_rng(1234)
+
+
+@pytest.fixture
+def replicas():
+    """Two started micro replicas, torn down hard at test end."""
+    servers = [ReplicaServer(preset="micro").start() for _ in range(2)]
+    try:
+        yield servers
+    finally:
+        for server in servers:
+            server.stop()
+
+
+def _router_for(servers, **kwargs):
+    router = Router(seed=0, **kwargs)
+    router.set_replicas({
+        f"r{i}": {"url": server.url, "boot_id": server.boot_id}
+        for i, server in enumerate(servers)})
+    return router
+
+
+def _reference_streams(router, fids, preset="micro"):
+    """What a single uninterrupted engine produces for the same requests
+    (same prompts, same router-derived keys)."""
+    import jax.numpy as jnp
+
+    engine = build_engine(preset)
+    rids = {}
+    for fid in fids:
+        request = router.request(fid)
+        rids[fid] = engine.submit(
+            request.prompt, request.max_new_tokens,
+            temperature=request.temperature, top_p=request.top_p,
+            eos_token=request.eos_token,
+            key=jnp.asarray(np.asarray(request.key, np.uint32)))
+    out = engine.drain()
+    return {fid: out[rid] for fid, rid in rids.items()}
+
+
+# -- replica HTTP front end ---------------------------------------------------
+
+
+def test_replica_front_end_submit_stream_stats(replicas):
+    replica = replicas[0]
+    router = _router_for([replica])
+    fid = router.submit(RNG.integers(0, 64, size=6), 8,
+                        temperature=0.6, top_p=0.9)
+    out = router.drain(deadline_s=60)
+    assert len(out[fid]) == 8
+    stats = replica.stats()
+    assert stats["slots"] >= 1 and stats["draining"] is False
+    assert stats["boot_id"] == replica.boot_id
+    # Offset-based stream: re-fetching an old offset returns the same
+    # suffix (at-least-once transport → exactly-once token delivery).
+    rid = router.request(fid).rid
+    again = replica.stream(rid, 0, wait_ms=0)
+    assert again["tokens"] == out[fid]
+    assert replica.stream(rid, 5, wait_ms=0)["tokens"] == out[fid][5:]
+
+
+def test_replica_rejects_malformed_key_at_the_400_boundary(replicas):
+    """A wrong-shape sampling key must be rejected at submission (400),
+    never stored to detonate later inside the step-loop thread; and a
+    step-loop failure drains the replica instead of wedging it silently."""
+    replica = replicas[0]
+    with pytest.raises(ValueError, match="2 uint32 words"):
+        replica.submit({"prompt": [1], "max_new_tokens": 2,
+                        "key": [1, 2, 3]})
+    with pytest.raises(ValueError):
+        replica.submit({"prompt": [1], "max_new_tokens": 2,
+                        "key": "not-a-key"})
+    assert not replica.draining                  # rejected at the boundary
+
+    # Step-loop failure → drain, not a silent wedge: healthz/stream
+    # advertise draining so the router fails over.
+    broken = ReplicaServer(preset="micro").start()
+    try:
+        rid = broken.submit({"prompt": [1, 2], "max_new_tokens": 4})
+        broken.engine.step = None                # next loop iteration dies
+        assert wait_until(lambda: broken.draining, 10)
+        assert broken.stream(rid, 0, wait_ms=0)["draining"] is True
+    finally:
+        broken.stop()
+
+
+def test_replica_draining_rejects_submit_with_409(replicas):
+    """A draining replica answers /submit with 409 (outside the transport
+    retry set); the router quarantines it for dispatch and the request
+    queues instead of burning the backoff ladder against it."""
+    replica = replicas[0]
+    replica.begin_drain()
+    router = _router_for([replica])
+    fid = router.submit([1, 2, 3], 4)
+    router.pump()
+    assert router.request(fid).status == "queued"
+    assert router.replicas()["r0"]["healthy"] is False
+    assert router.transport_faults == 0       # draining is policy, not fault
+    with pytest.raises(NoReplicaAvailable):
+        router.pick([1, 2, 3])
+
+
+# -- router dispatch policy ---------------------------------------------------
+
+
+def test_affinity_same_prefix_lands_on_same_replica_until_drain(replicas):
+    """Same-prefix requests pin to one replica (the prefix cache's hit
+    condition); once that replica drains, new dispatch moves off it."""
+    router = _router_for(replicas, affinity_tokens=16)
+    head = RNG.integers(0, 64, size=16)
+
+    def prompt():
+        return np.concatenate([head, RNG.integers(0, 64, size=2)])
+
+    fids = [router.submit(prompt(), 4) for _ in range(4)]
+    router.pump()
+    homes = {router.request(fid).replica for fid in fids}
+    assert len(homes) == 1
+    home = homes.pop()
+    router.drain(deadline_s=60)
+
+    victim = replicas[int(home[1:])]
+    victim.begin_drain()
+    late = [router.submit(prompt(), 4) for _ in range(2)]
+    router.pump()
+    new_homes = {router.request(fid).replica for fid in late}
+    assert new_homes and home not in new_homes
+    router.drain(deadline_s=60)
+
+
+@pytest.mark.slow
+def test_dispatch_spills_to_least_loaded_past_threshold(replicas):
+    router = _router_for(replicas, affinity_tokens=16, spill_load=2)
+    head = RNG.integers(0, 64, size=16)
+    # Enough same-prefix long requests to pass the spill threshold: the
+    # overflow must land on the other replica instead of queueing forever
+    # behind the affinity choice.
+    fids = [router.submit(np.concatenate([head, [i]]), 24)
+            for i in range(6)]
+    router.pump()
+    homes = [router.request(fid).replica for fid in fids]
+    assert len(set(homes)) == 2
+    # ... but the FIRST requests (below threshold) stayed on affinity.
+    assert len({homes[0], homes[1]}) == 1
+    router.drain(deadline_s=120)
+
+
+# -- failover: exactness across re-dispatch -----------------------------------
+
+
+@pytest.mark.perf
+def test_hard_kill_mid_stream_sampled_streams_identical(replicas):
+    """Kill a replica's socket mid-generation: every stream completes on
+    the sibling and every SAMPLED stream is token-identical to an
+    uninterrupted single-engine run — the serve-subsystem extension of
+    the PR 8 preemption-replay pin."""
+    router = _router_for(replicas, retries=0, timeout=5.0)
+    fids = [router.submit(RNG.integers(0, 64, size=8), 40,
+                          temperature=0.8, top_p=0.9) for _ in range(4)]
+    # Wait until every request has first tokens, then kill the replica of
+    # a request that is provably mid-stream.
+    assert wait_until(
+        lambda: all(router.request(fid).tokens for fid in fids),
+        30, tick=router.pump, period=0)
+    open_fids = [fid for fid in fids
+                 if len(router.request(fid).tokens) < 40]
+    assert open_fids, "every stream already finished — nothing mid-stream"
+    victim = router.request(open_fids[0]).replica
+    replicas[int(victim[1:])].stop()          # hard: connection refused
+    out = router.drain(deadline_s=120)
+    assert all(len(out[fid]) == 40 for fid in fids)
+    assert router.request(open_fids[0]).dispatches >= 2
+    assert router.redispatches > 0
+    assert out == _reference_streams(router, fids)
+
+
+@pytest.mark.slow
+def test_graceful_drain_serves_suffix_then_fails_over(replicas):
+    """begin_drain (the SIGTERM path): the draining replica still answers
+    /stream with what it emitted, the router takes that suffix and
+    re-dispatches the remainder — no token recomputed twice, stream
+    identical to an uninterrupted run."""
+    router = _router_for(replicas)
+    fid = router.submit(RNG.integers(0, 64, size=8), 24,
+                        temperature=0.7, top_p=0.95)
+    assert wait_until(lambda: len(router.request(fid).tokens) >= 2,
+                      30, tick=router.pump, period=0)
+    victim = replicas[int(router.request(fid).replica[1:])]
+    exported = victim.begin_drain()
+    assert any(record["tokens"] for record in exported)
+    record = next(r for r in exported if r["tokens"])
+    assert record["key"] is not None and record["prompt"]
+    out = router.drain(deadline_s=120)
+    assert len(out[fid]) == 24
+    assert router.request(fid).dispatches == 2
+    assert out == _reference_streams(router, [fid])
+
+
+@pytest.mark.slow
+def test_chaos_transport_resets_and_timeouts_no_dup_no_drop(replicas):
+    """Seeded connection resets + timeouts on EVERY router HTTP call:
+    requests all complete with streams identical to the fault-free
+    reference — offset-based pulls make the at-least-once transport
+    deliver each token exactly once, and quarantined replicas rejoin via
+    membership refresh instead of staying lost."""
+    schedule = ChaosSchedule(seed=20260804)
+    chaos = ChaosTransport(schedule, reset_rate=0.08, timeout_rate=0.05)
+    router = _router_for(replicas, urlopen=chaos, retries=1, timeout=5.0,
+                         quarantine_s=0.01)
+    endpoints = {f"r{i}": {"url": s.url, "boot_id": s.boot_id}
+                 for i, s in enumerate(replicas)}
+    fids = [router.submit(RNG.integers(0, 64, size=6), 10,
+                          temperature=0.5, top_p=0.9) for _ in range(6)]
+
+    # Chaos quarantines replicas; the fleet's membership refresh (the
+    # same set_replicas call ServeFleet.tick makes) heals a lapsed
+    # quarantine — same boot id, same record, health restored.
+    deadline_rounds = 3000
+    while router.pump(wait_ms=5) and deadline_rounds:
+        router.set_replicas(endpoints)
+        deadline_rounds -= 1
+    assert deadline_rounds, "requests did not complete under chaos"
+    out = {fid: router.result(fid) for fid in fids}
+    assert all(len(stream) == 10 for stream in out.values())
+    assert schedule.injected, "chaos never fired — rates too low"
+    assert out == _reference_streams(router, fids)
+
+
+@pytest.mark.slow
+def test_all_replicas_down_requests_queue_then_recover(replicas):
+    router = _router_for(replicas, retries=0, timeout=2.0)
+    for replica in replicas:
+        replica.begin_drain()
+    fid = router.submit(RNG.integers(0, 64, size=4), 4)
+    router.pump()
+    assert router.request(fid).status != "done"
+    # A fresh replica joins (new boot id): the queued request dispatches.
+    from tpu_task.serve import probe_healthy
+
+    fresh = ReplicaServer(preset="micro").start()
+    try:
+        assert wait_until(lambda: probe_healthy(fresh.url), 30)
+        router.set_replicas({"r9": {"url": fresh.url,
+                                    "boot_id": fresh.boot_id}})
+        out = router.drain(deadline_s=120)
+        assert len(out[fid]) == 4
+    finally:
+        fresh.stop()
+
+
+def test_malformed_request_fails_terminally_without_poisoning_fleet(replicas):
+    """A replica's 4xx indicts the REQUEST, not the replica: the bad
+    submission fails terminally with the rejection surfaced, every
+    replica stays healthy, and later valid requests flow normally."""
+    router = _router_for(replicas)
+    bad = router.submit([1, 2, 3], 4, top_p=0.9)   # top_p needs temp > 0
+    router.pump()
+    assert router.request(bad).status == "failed"
+    with pytest.raises(RuntimeError, match="rejected"):
+        router.result(bad)
+    assert all(info["healthy"] for info in router.replicas().values())
+    good = router.submit([1, 2, 3], 4)
+    out = router.drain(deadline_s=60)
+    assert len(out[good]) == 4
+
+    # Same rejection reached from pump()'s dispatch path (request queued
+    # first): the failure must be terminal there too — a FAILED request
+    # must never resurrect to QUEUED and re-POST forever.
+    router2 = Router(seed=1)
+    bad2 = router2.submit([7], 4, top_p=0.5)       # queues: no replicas yet
+    router2.set_replicas({name: {"url": s.url, "boot_id": s.boot_id}
+                          for name, s in zip(("r0", "r1"), replicas)})
+    router2.drain(deadline_s=30)                   # must terminate
+    assert router2.request(bad2).status == "failed"
+    for _ in range(3):
+        router2.pump()
+    assert router2.request(bad2).status == "failed"
+
+
+# -- autoscale ----------------------------------------------------------------
+
+
+def test_autoscaler_hysteresis_and_bounds():
+    scaler = QueueDepthAutoscaler(min_replicas=1, max_replicas=3,
+                                  high=2.0, low=0.25, patience=2)
+    # Two over-threshold samples → +1; counter resets after the decision.
+    assert scaler.observe(8, 2) == 2
+    assert scaler.observe(8, 2) == 3
+    assert scaler.observe(8, 3) == 3
+    assert scaler.observe(8, 3) == 3          # capped at max_replicas
+    # Idle samples → -1 after patience, never below the floor.
+    assert scaler.observe(0, 3) == 3
+    assert scaler.observe(0, 3) == 2
+    assert scaler.observe(0, 2) == 2
+    assert scaler.observe(0, 2) == 1
+    assert scaler.observe(0, 1) == 1
+    assert scaler.observe(0, 1) == 1          # floored at min_replicas
+    # A mid-pressure sample resets both streaks.
+    scaler2 = QueueDepthAutoscaler(patience=2, high=2.0, low=0.25)
+    scaler2.observe(8, 2)
+    scaler2.observe(1, 2)                     # between low and high
+    assert scaler2.observe(8, 2) == 2         # streak restarted
+    # Exactly at capacity is NOT idle: zero backlog but a busy fleet must
+    # never scale down (it would shed replicas mid-stream and flap).
+    scaler3 = QueueDepthAutoscaler(patience=1, high=2.0, low=0.25)
+    for _ in range(3):
+        assert scaler3.observe(0, 2, busy=8) == 2
+    assert scaler3.observe(0, 2, busy=0) == 1  # genuinely idle → down
+    with pytest.raises(ValueError):
+        QueueDepthAutoscaler(min_replicas=0)
+    with pytest.raises(ValueError):
+        QueueDepthAutoscaler(low=3.0, high=2.0)
+
+
+# -- the serve-gang loop through the real scheduler ---------------------------
+
+
+def _fleet(monkeypatch, replicas=2, autoscaler=None, quota_chips=32):
+    monkeypatch.setenv("TPU_TASK_REQUEUE_BACKOFF_BASE", "0.05")
+    monkeypatch.setenv("TPU_TASK_REQUEUE_BACKOFF_CAP", "0.2")
+    driver = InProcessServeDriver()
+    scheduler = GangScheduler(
+        CapacityPool([quota_chips]),
+        {"svc": TenantQuota(chips=quota_chips, weight=1.0)}, driver)
+    router = Router(seed=3)
+    spec = ServeSpec(service="chat", tenant="svc", replicas=replicas,
+                     preset="micro")
+    fleet = ServeFleet(scheduler, spec, router, autoscaler=autoscaler)
+    return fleet, driver, scheduler, router
+
+
+@pytest.fixture
+def torn_down():
+    fleets = []
+    yield fleets
+    for fleet in fleets:
+        for task_id in list(fleet.scheduler.driver.running_ids()):
+            fleet.scheduler.driver._stop(task_id, graceful=False)
+
+
+@pytest.mark.slow
+def test_serve_gangs_requeue_through_scheduler_governor(
+        monkeypatch, torn_down):
+    """The in-process twin of the chaos soak: replica gangs placed by the
+    scheduler, a chaos hard-kill mid-stream, router failover to the
+    sibling, and the killed gang requeued through the scheduler's backoff
+    governor — back in membership with a NEW boot id."""
+    fleet, driver, scheduler, router = _fleet(monkeypatch)
+    torn_down.append(fleet)
+    fleet.launch()
+    fleet.tick()
+    assert len(router.replicas()) == 2
+    for task_id in fleet._gangs:
+        assert scheduler.queue.tasks[task_id].payload["kind"] == "serve"
+
+    fids = [router.submit(RNG.integers(0, 64, size=8), 16) for _ in range(4)]
+    assert wait_until(
+        lambda: all(router.request(fid).tokens for fid in fids),
+        30, tick=router.pump, period=0)
+    victim = next(router.request(fid).replica for fid in fids)
+    old_boot = router.replicas()[victim]["boot_id"]
+    driver.kill(victim, graceful=False)
+
+    out = router.drain(deadline_s=120, on_idle=fleet.tick)
+    assert all(len(out[fid]) == 16 for fid in fids)
+    assert out == _reference_streams(router, fids)
+
+    # The scheduler may not have observed the kill yet (the sibling can
+    # absorb every stream between ticks) — tick until the governor does,
+    # then until the backoff gate re-places the gang.
+    task = scheduler.queue.tasks[victim]
+    assert wait_until(lambda: task.preemptions >= 1, 30,
+                      tick=fleet.tick, period=0.02)
+    assert task.attempts >= 1                 # chaos charges the budget
+    assert wait_until(lambda: task.state == "placed", 30,
+                      tick=fleet.tick, period=0.02)
+    fleet.tick()
+    assert router.replicas()[victim]["boot_id"] != old_boot
+    # The recovered replica serves again.
+    late = router.submit(RNG.integers(0, 64, size=4), 4)
+    assert len(router.drain(deadline_s=60, on_idle=fleet.tick)[late]) == 4
+
+
+@pytest.mark.slow
+def test_fleet_autoscales_up_under_backlog_and_down_when_idle(
+        monkeypatch, torn_down):
+    scaler = QueueDepthAutoscaler(min_replicas=1, max_replicas=3,
+                                  high=1.0, low=0.25, patience=1)
+    fleet, driver, scheduler, router = _fleet(
+        monkeypatch, replicas=1, autoscaler=scaler)
+    torn_down.append(fleet)
+    fleet.launch()
+    fleet.tick()
+    assert fleet.live_replicas() == 1
+
+    # Backlog far past one replica's slots → scale up through the
+    # scheduler (new serve gang admitted, endpoint joins the router).
+    fids = [router.submit(RNG.integers(0, 64, size=6), 12)
+            for _ in range(12)]
+    fleet.tick()
+    assert fleet.live_replicas() >= 2
+    assert wait_until(lambda: len(router.replicas()) >= 2, 30,
+                      tick=fleet.tick, period=0.02)
+    out = router.drain(deadline_s=180, on_idle=fleet.tick)
+    assert all(len(out[fid]) == 12 for fid in fids)
+
+    # Idle ticks → scale back down to the floor; retired gangs leave the
+    # scheduler terminally instead of lingering as running batch tasks.
+    assert wait_until(lambda: fleet.live_replicas() == 1, 30,
+                      tick=fleet.tick, period=0.02)
+    retired = [task for task in scheduler.queue.tasks.values()
+               if task.failure == "retired"]
+    assert retired and all(task.state == "succeeded" for task in retired)
+    assert scaler.decisions and scaler.decisions[0].startswith("up:")
+
+
+def test_cli_sched_status_renders_serve_kind(tmp_path, capsys, monkeypatch,
+                                             torn_down):
+    """`sched status` shows serve gangs as service replicas (KIND column),
+    not perpetually-running batch tasks — the PR's CLI satellite."""
+    from tpu_task.cli.main import main as cli_main
+
+    monkeypatch.setenv("TPU_TASK_REQUEUE_BACKOFF_BASE", "0.05")
+    remote = str(tmp_path / "sched")
+    driver = InProcessServeDriver()
+    scheduler = GangScheduler(
+        CapacityPool([32]),
+        {"svc": TenantQuota(chips=32, weight=1.0),
+         "lab": TenantQuota(chips=16, weight=1.0)}, driver, remote=remote)
+    router = Router(seed=0)
+    fleet = ServeFleet(scheduler, ServeSpec(
+        service="chat", tenant="svc", replicas=2, preset="micro"),
+        router)
+    torn_down.append(fleet)
+    scheduler.submit("lab", "v4-8", work=100.0, task_id="batch-0")
+    fleet.launch()
+    fleet.tick()
+
+    assert cli_main(["sched", "status", "--remote", remote]) == 0
+    out = capsys.readouterr().out
+    lines = out.strip().splitlines()
+    header = lines[0].split()
+    assert header[:5] == ["TENANT", "KIND", "QUEUED", "RUNNING", "CHIPS"]
+    serve_rows = [line for line in lines if " serve " in f" {line} "]
+    assert len(serve_rows) == 1
+    assert "2 replicas" in serve_rows[0]
+    assert "serve: chat (svc) — 2 replicas placed" in out
+    batch_rows = [line.split() for line in lines[1:]
+                  if len(line.split()) > 1 and line.split()[1] == "batch"]
+    assert {row[0] for row in batch_rows} == {"lab"}
+
+
+def test_serve_spec_script_and_payload():
+    spec = ServeSpec(service="chat", tenant="svc", replicas=2,
+                     preset="tiny", serving={"slots": 2})
+    script = replica_script(spec, python="python3.11")
+    assert script.startswith("#!/bin/bash\n")
+    assert "-m tpu_task.serve.replica" in script
+    assert "--preset tiny" in script and '"slots": 2' in script
+    payload = spec.payload(3)
+    assert payload == {"kind": "serve", "service": "chat", "replica": "3",
+                       "preset": "tiny"}

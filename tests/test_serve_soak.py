@@ -1,0 +1,197 @@
+"""Serve-as-a-task chaos soak (`make serve-soak`): replica gangs as REAL
+fake-mode TPU tasks, a seeded mid-stream replica preemption through the
+chaos plane, and the full recovery loop — drain/export on SIGTERM, router
+re-dispatch to the sibling, requeue through the PR 3 governor (durable
+events), re-announce, rejoin.
+
+This is the ROADMAP item 5 exit criterion end to end: the engine fleet is
+scheduled like any training gang (PR 7), each replica machine is the
+paper's one-script unit where the script happens to be
+``python -m tpu_task.serve.replica`` (PR 5/8/9 engine behind HTTP on the
+PR 2 pooled transport), preemption recovery is the unchanged PR 3
+machinery, and the client-visible contract is: every request completes
+and every greedy stream is BIT-IDENTICAL to an unpreempted single-engine
+run. Replayable via TPU_TASK_CHAOS_SEED.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from tpu_task.scheduler import CapacityPool, GangScheduler, TenantQuota
+from tpu_task.scheduler.driver import TpuTaskDriver
+from tpu_task.serve import (
+    Router,
+    ServeFleet,
+    ServeSpec,
+    bucket_endpoint_source,
+    replica_script,
+    wait_until,
+)
+from tpu_task.serve.replica import build_engine
+from tpu_task.testing.chaos import ChaosSchedule, ChaosTpuClient
+
+pytestmark = [pytest.mark.fleet, pytest.mark.chaos, pytest.mark.slow]
+
+SEED = int(os.environ.get("TPU_TASK_CHAOS_SEED", "20260804"))
+MAX_NEW = 40     # long streams: the preemption must land mid-generation
+
+
+def test_serve_fleet_survives_midstream_replica_preemption(tmp_path,
+                                                           monkeypatch):
+    monkeypatch.setenv("TPU_TASK_FAKE_TPU_ROOT", str(tmp_path / "fake-tpu"))
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("TPU_TASK_LOCAL_LOG_PERIOD", "0.1")
+    monkeypatch.setenv("TPU_TASK_LOCAL_DATA_PERIOD", "0.1")
+    monkeypatch.setenv("TPU_TASK_LOCAL_HEARTBEAT_PERIOD", "0.5")
+    monkeypatch.setenv("TPU_TASK_HEARTBEAT_STALE_AFTER", "0")  # liveness off
+    monkeypatch.setenv("TPU_TASK_REQUEUE_BACKOFF_BASE", "0.2")
+    monkeypatch.setenv("TPU_TASK_REQUEUE_BACKOFF_CAP", "1.0")
+    monkeypatch.setenv("TPU_TASK_RECOVERY_BUDGET", "10")
+
+    from tpu_task import task as task_factory
+    from tpu_task.common.cloud import Cloud, Provider
+    from tpu_task.common.identifier import Identifier
+    from tpu_task.common.values import (
+        SPOT_ENABLED, Environment, Size, Task as TaskSpec,
+    )
+
+    spec = ServeSpec(service="chat", tenant="serve", replicas=2,
+                     accelerator="v4-8", preset="micro")
+    script = replica_script(spec, python=sys.executable)
+    cloud = Cloud(provider=Provider.TPU, region="us-central2")
+    backends = {}
+
+    def factory(task):
+        backend = task_factory.new(
+            cloud, Identifier.deterministic(task.task_id),
+            TaskSpec(size=Size(machine=task.gang.accelerator),
+                     environment=Environment(script=script),
+                     spot=SPOT_ENABLED))
+        backends[task.task_id] = backend
+        return backend
+
+    driver = TpuTaskDriver(factory, delete_on_release=False)
+    scheduler = GangScheduler(
+        CapacityPool([8]), {"serve": TenantQuota(chips=8, weight=1.0)},
+        driver)
+    router = Router(seed=SEED, retries=0, timeout=5.0)
+    fleet = ServeFleet(
+        scheduler, spec, router,
+        endpoint_source=bucket_endpoint_source(
+            lambda task_id: backends[task_id]._bucket_dir
+            if task_id in backends else str(tmp_path / "nowhere")))
+
+    schedule = ChaosSchedule(seed=SEED)
+    rng = np.random.default_rng(SEED)
+
+    try:
+        fleet.launch()
+        # Replica machines bootstrap (subprocess jax import + engine
+        # build) and announce endpoints through their task buckets.
+        assert wait_until(lambda: len(fleet.refresh_endpoints()) == 2,
+                          240, tick=fleet.tick, period=0.2), \
+            "replica endpoints never announced"
+        fleet.tick()
+        assert len(router.replicas()) == 2
+
+        # Mixed greedy workload, shared prefixes included (the affinity +
+        # prefix-cache shape), long streams so preemption lands mid-way.
+        head = rng.integers(0, 64, size=6)
+        prompts = [np.concatenate([head, rng.integers(0, 64, size=2)])
+                   if i % 2 == 0 else rng.integers(0, 64, size=8)
+                   for i in range(10)]
+        fids = [router.submit(p, MAX_NEW) for p in prompts]
+
+        # First tokens everywhere = compiles done, streams in flight.
+        assert wait_until(
+            lambda: all(router.request(fid).tokens for fid in fids),
+            240, tick=lambda: (router.pump(), fleet.tick()), period=0)
+        open_fids = [fid for fid in fids
+                     if len(router.request(fid).tokens) < MAX_NEW]
+        assert open_fids, "streams finished before the chaos window"
+
+        # Seeded victim: preempt a replica with open streams, THROUGH the
+        # chaos plane (graceful = the cloud's SIGTERM reclaim notice).
+        candidates = sorted({router.request(fid).replica
+                             for fid in open_fids})
+        victim = schedule.derive("serve-soak").choice(candidates)
+        victim_backend = backends[victim]
+        chaos = ChaosTpuClient(victim_backend.client, schedule)
+        victim_backend.client = chaos
+        node = victim_backend._qr_name(0)
+        old_boot = router.replicas()[victim]["boot_id"]
+        chaos.preempt_at(0.0, node, graceful=True)
+
+        # Drain the workload while the preemption fires: the router takes
+        # the draining replica's suffix, re-dispatches to the sibling, and
+        # the reconciler requeues the gang underneath.
+        out = router.drain(deadline_s=240, on_idle=fleet.tick)
+        assert all(len(out[fid]) == MAX_NEW for fid in fids)
+        assert any(kind == "preempt" for kind in
+                   (fault.kind for fault in schedule.injected)), \
+            "chaos preemption never fired"
+        redispatched = [fid for fid in fids
+                        if router.request(fid).dispatches > 1]
+        assert redispatched, "no stream survived a mid-flight preemption"
+
+        # Bit-identical to an unpreempted run: one local engine, same
+        # preset, same prompts (greedy = pure function of context).
+        engine = build_engine(spec.preset)
+        ref = {}
+        for fid in fids:
+            ref[fid] = engine.submit(router.request(fid).prompt, MAX_NEW)
+        ref_out = engine.drain()
+        for fid in fids:
+            assert out[fid] == ref_out[ref[fid]], fid
+
+        # The drained replica exported its in-flight state durably (the
+        # agent's final sync shipped it): prompt + tokens + sampling key.
+        drain_path = os.path.join(
+            victim_backend._bucket_dir, "data", "inflight.json")
+        assert wait_until(lambda: os.path.exists(drain_path), 30,
+                          tick=fleet.tick)
+        exported = json.load(open(drain_path))
+        assert exported["boot_id"] == old_boot
+        assert any(record["tokens"] and record["key"]
+                   for record in exported["inflight"]), \
+            "drain export carries no mid-stream request"
+
+        # Recovery rode the PR 3 governor: durable requeue/recover events
+        # in the task mailbox, and the replica re-announced with a new
+        # boot id and serves again.
+        assert wait_until(
+            lambda: router.replicas().get(victim, {}).get("boot_id",
+                                                          old_boot)
+            != old_boot, 240, tick=fleet.tick, period=0.2), \
+            "preempted replica never rejoined"
+        codes = [event.code for event in victim_backend.events()]
+        assert "recover" in codes, codes
+
+        late = router.submit(rng.integers(0, 64, size=8), 8)
+        late_out = router.drain(deadline_s=120, on_idle=fleet.tick)
+        assert len(late_out[late]) == 8
+        # Replayability record: the injected-fault flight log is seeded.
+        assert schedule.injected[0].kind == "preempt"
+    finally:
+        # Stop the replica processes BEFORE deleting: task teardown
+        # SIGKILLs only the agents' process groups, and the replicas run
+        # in their own sessions (they also self-drain when orphaned, but
+        # an explicit TERM makes teardown immediate and deterministic).
+        import signal as signal_module
+
+        for backend in backends.values():
+            try:
+                endpoint = json.load(open(os.path.join(
+                    backend._bucket_dir, "data", "endpoint.json")))
+                os.kill(int(endpoint.get("pid", 0)), signal_module.SIGTERM)
+            except (OSError, ValueError):
+                pass
+        for backend in backends.values():
+            try:
+                backend.delete()
+            except Exception:
+                pass

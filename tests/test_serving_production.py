@@ -216,6 +216,86 @@ def test_preemption_replays_identical_sampled_stream(params):
     assert tight == roomy
 
 
+@pytest.mark.perf
+def test_exported_inflight_resumes_identical_sampled_stream(params):
+    """export_inflight → JSON → resume_inflight in a FRESH engine must
+    continue every sampled stream token-identically to the uninterrupted
+    run: the PR 8 preemption-replay pin extended across process
+    boundaries (the serve subsystem's graceful-drain contract — a drained
+    replica's in-flight requests complete on a sibling with no visible
+    seam). The export is round-tripped through json to pin
+    serializability, and one request is re-preempted AFTER resume to pin
+    that recompute rolls back to the imported prefix, never through it."""
+    import json
+
+    rng = np.random.default_rng(23)
+    prompts = [rng.integers(0, 64, size=7) for _ in range(3)]
+
+    def mk(n_blocks=64):
+        scfg = ServingConfig(slots=3, block_size=4, n_blocks=n_blocks,
+                             max_len=32)
+        return ServingEngine(params, TINY, scfg, rng=jax.random.PRNGKey(5))
+
+    reference = mk()
+    ref_rids = [reference.submit(p, 14, temperature=0.9, top_p=0.85)
+                for p in prompts]
+    ref_out = reference.drain()
+
+    first = mk()
+    rids = [first.submit(p, 14, temperature=0.9, top_p=0.85)
+            for p in prompts]
+    for _ in range(6):                       # partway through every stream
+        first.step()
+    records = json.loads(json.dumps(first.export_inflight()))
+    assert records and all(r["key"] and len(r["key"]) >= 2 for r in records)
+    assert any(0 < len(r["tokens"]) < 14 for r in records), \
+        "export caught nothing mid-stream"
+
+    second = mk(n_blocks=12)                 # tight pool: forces recompute
+    mapping = second.resume_inflight(records)
+    out = second.drain()
+    resumed_preempts = sum(
+        second.request(mapping[r]).preemptions for r in mapping)
+    for i, rid in enumerate(rids):
+        if rid in mapping:
+            full = out[mapping[rid]]
+        else:                                # finished before the export
+            full = first.poll(rid)["tokens"]
+        assert full == ref_out[ref_rids[i]], i
+    # The tight pool really did preempt a resumed slot (rolling back to
+    # the imported prefix) and the streams STILL match — resume_from held.
+    assert resumed_preempts > 0
+
+
+def test_bucketed_resume_outgrowing_buckets_recomputes_identically(params):
+    """Bucketed engines pad prompt + resumed prefix into ONE bucket; a
+    context that outgrew every bucket must fall back to recomputing from
+    the prompt (the keyed streams regenerate the identical prefix) rather
+    than rejecting a request that was valid at submit time — a rejection
+    would terminally fail the fleet router's failover."""
+    prompt = np.random.default_rng(31).integers(0, 64, size=14)
+
+    def mk():
+        scfg = ServingConfig(slots=2, block_size=4, n_blocks=64, max_len=32,
+                             prefill="bucketed", prefill_buckets=(8, 16),
+                             prefix_cache=False)
+        return ServingEngine(params, TINY, scfg, rng=jax.random.PRNGKey(9))
+
+    reference = mk()
+    ref_rid = reference.submit(prompt, 10, temperature=0.7, top_p=0.9)
+    ref_out = reference.drain()[ref_rid]
+
+    first = mk()
+    rid = first.submit(prompt, 10, temperature=0.7, top_p=0.9)
+    for _ in range(5):
+        first.step()
+    records = first.export_inflight()
+    assert records and len(records[0]["tokens"]) >= 3  # 14 + 3 > bucket 16
+    second = mk()
+    mapping = second.resume_inflight(records)
+    assert second.drain()[mapping[rid]] == ref_out
+
+
 # -- satellite: refcounted-allocator property tests --------------------------
 
 def _check_invariants(alloc: BlockAllocator):

@@ -187,8 +187,24 @@ class FakeTpuControlPlane:
         if node_name and os.path.exists(self._node_path(node_name)):
             if not force:
                 raise RuntimeError("queued resource has an active node; use force")
-            self.delete_node(node_name)
+            self._reap_node(node_name)
         os.remove(path)
+
+    def _reap_node(self, name: str) -> None:
+        """Tear a node down on the reclaim/requeue path, honoring the
+        graceful-preemption grace: ``preempt_node(graceful=True)`` forgot
+        the agent pids precisely so they could final-sync after SIGTERM —
+        rmtree'ing their exec directory here would revoke that grace on
+        the filesystem side (the drain export a serve replica writes, the
+        last checkpoint sync of a batch task). A gracefully-reclaimed
+        node therefore loses its record but keeps its "disk" until the
+        re-granted incarnation (same name) overlays it; every other path
+        keeps full fresh-disk deletion."""
+        payload = self._load(self._node_path(name))
+        if payload.get("graceful_reclaim"):
+            os.remove(self._node_path(name))
+            return
+        self.delete_node(name)
 
     def list_queued_resources(self) -> List[str]:
         directory = os.path.join(self.root, "queued_resources")
@@ -417,17 +433,24 @@ class FakeTpuControlPlane:
         for worker in payload["workers"]:
             worker["pid"] = 0
         payload["state"] = NODE_PREEMPTED
+        # Honored by requeue(): a graceful reclaim's agents are still
+        # final-syncing on their "disk" — reclaiming the capacity must not
+        # also reclaim the directory they are draining into.
+        payload["graceful_reclaim"] = bool(graceful)
         self._store(self._node_path(name), payload)
 
     def requeue(self, qr_name: str) -> None:
         """Re-queue a SUSPENDED queued resource (delete node, back to WAITING).
 
         This is the operation the orchestrator's recovery reconciler performs —
-        the TPU equivalent of the ASG respawning a spot instance."""
+        the TPU equivalent of the ASG respawning a spot instance.
+
+        Node teardown rides :meth:`_reap_node`, so a gracefully-reclaimed
+        node's still-draining agents keep their exec directory."""
         payload = self._load(self._qr_path(qr_name))
         node_name = payload.get("node_name", "")
         if node_name and os.path.exists(self._node_path(node_name)):
-            self.delete_node(node_name)
+            self._reap_node(node_name)
         payload["state"] = QR_WAITING
         payload["ticks"] = 0
         payload["events"].append(self._event("REQUEUE", "re-queued after preemption"))

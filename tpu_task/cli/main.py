@@ -307,26 +307,80 @@ def cmd_sched(args) -> int:
         print(f"no scheduler state at {remote}")
         return 1
 
-    columns = ("TENANT", "QUEUED", "RUNNING", "CHIPS", "QUOTA", "SHARE",
-               "DEFICIT", "REQUEUES", "DONE", "FAILED")
+    # One row per (tenant, kind): long-running `serve` replica gangs
+    # (ServeFleet submissions, payload kind=serve) render as replicas of a
+    # service, never as perpetually-running batch tasks. Tenant-level
+    # columns (QUOTA/SHARE/DEFICIT/REQUEUES) print on the tenant's first
+    # row only.
+    columns = ("TENANT", "KIND", "QUEUED", "RUNNING", "CHIPS", "QUOTA",
+               "SHARE", "DEFICIT", "REQUEUES", "DONE", "FAILED")
     rows = []
+    services = []     # (service, tenant, replicas) footer lines
+
+    def tenant_rows(tenant, batch, serve, tenant_cols, svc_map):
+        out = []
+        b_queued, b_running, b_chips, b_done, b_failed = batch
+        s_queued, s_replicas, s_chips, s_done, s_failed = serve
+        if b_queued or b_running or b_done or b_failed or not (
+                s_queued or s_replicas or s_done or s_failed):
+            out.append((tenant, "batch", b_queued, b_running, f"{b_chips}",
+                        *tenant_cols, b_done, b_failed))
+        if s_queued or s_replicas or s_done or s_failed or svc_map:
+            blanks = tenant_cols if not out else ("-",) * len(tenant_cols)
+            out.append((tenant, "serve", s_queued,
+                        f"{s_replicas} replica" + ("s" if s_replicas != 1
+                                                   else ""),
+                        f"{s_chips}", *blanks, s_done, s_failed))
+            for service, replicas in sorted(svc_map.items()):
+                services.append((service, tenant, replicas))
+        return out
+
     if snapshot is not None:
         for tenant, info in sorted(snapshot.get("tenants", {}).items()):
-            rows.append((tenant, info["queued"], info["running_gangs"],
-                         f"{info['running_chips']}", f"{info['quota_chips']}",
-                         f"{info['share_chips']}", f"{info['deficit_chips']}",
-                         info["requeues"], info["succeeded"], info["failed"]))
+            serve = info.get("serve") or {}
+            serve = {**{"queued": 0, "replicas": 0, "chips": 0,
+                        "succeeded": 0, "failed": 0, "services": {}},
+                     **serve}
+            rows += tenant_rows(
+                tenant,
+                (info["queued"] - serve["queued"],
+                 info["running_gangs"] - serve["replicas"],
+                 info["running_chips"] - serve["chips"],
+                 info["succeeded"] - serve["succeeded"],
+                 info["failed"] - serve["failed"]),
+                (serve["queued"], serve["replicas"], serve["chips"],
+                 serve["succeeded"], serve["failed"]),
+                (f"{info['quota_chips']}", f"{info['share_chips']}",
+                 f"{info['deficit_chips']}", info["requeues"]),
+                serve.get("services", {}))
     else:
         # No snapshot (scheduler never ticked): fold the queue records.
         for tenant, tasks in sorted(queue.by_tenant().items()):
-            rows.append((
+            batch = [task for task in tasks
+                     if task.payload.get("kind") != "serve"]
+            serve = [task for task in tasks
+                     if task.payload.get("kind") == "serve"]
+            svc_map = {}
+            for task in serve:
+                if task.state == "placed":
+                    name = task.payload.get("service", "?")
+                    svc_map[name] = svc_map.get(name, 0) + 1
+            rows += tenant_rows(
                 tenant,
-                sum(1 for task in tasks if task.schedulable),
-                sum(1 for task in tasks if task.state == "placed"),
-                f"{queue.running_chips(tenant)}", "-", "-", "-",
-                sum(task.preemptions for task in tasks),
-                sum(1 for task in tasks if task.state == "succeeded"),
-                sum(1 for task in tasks if task.state == "failed")))
+                (sum(1 for task in batch if task.schedulable),
+                 sum(1 for task in batch if task.state == "placed"),
+                 sum(task.gang.total_chips for task in batch
+                     if task.state == "placed"),
+                 sum(1 for task in batch if task.state == "succeeded"),
+                 sum(1 for task in batch if task.state == "failed")),
+                (sum(1 for task in serve if task.schedulable),
+                 sum(1 for task in serve if task.state == "placed"),
+                 sum(task.gang.total_chips for task in serve
+                     if task.state == "placed"),
+                 sum(1 for task in serve if task.state == "succeeded"),
+                 sum(1 for task in serve if task.state == "failed")),
+                ("-", "-", "-", sum(task.preemptions for task in tasks)),
+                svc_map)
     widths = [max(len(str(column)), *(len(str(row[i])) for row in rows))
               if rows else len(str(column))
               for i, column in enumerate(columns)]
@@ -335,6 +389,9 @@ def cmd_sched(args) -> int:
     for row in rows:
         print("  ".join(str(cell).ljust(widths[i])
                         for i, cell in enumerate(row)))
+    for service, tenant, replicas in services:
+        print(f"serve: {service} ({tenant}) — {replicas} replica"
+              f"{'s' if replicas != 1 else ''} placed")
     if snapshot is not None:
         pool = snapshot.get("pool", {})
         print(f"pool: {pool.get('used_chips', 0)}/"

@@ -168,6 +168,21 @@ def resolve_decode_impl(scfg: ServingConfig, cfg, tp: int = 1) -> str:
 _SPEC_SALT = 0x5BEC
 
 
+def _check_key(key) -> jax.Array:
+    """Normalize a caller-supplied per-request PRNG key to the raw
+    two-word uint32 form the slot table stores — raising HERE (the
+    validated submission boundary), not later inside a fused step when
+    the malformed key hits the slot array."""
+    try:
+        raw = np.asarray(key, np.uint32).reshape(-1)
+    except (TypeError, ValueError) as error:
+        raise ValueError(f"request key is not uint32 words: {error}")
+    if raw.shape != (2,):
+        raise ValueError(
+            f"request key must be 2 uint32 words, got shape {raw.shape}")
+    return jnp.asarray(raw)
+
+
 class DrainTimeout(RuntimeError):
     """:meth:`ServingEngine.drain` ran out of steps with work in flight.
     Carries the ids of every request not yet done so callers can requeue
@@ -198,6 +213,11 @@ class Request:
     first_token_t: Optional[float] = None
     finish_t: Optional[float] = None
     preemptions: int = 0
+    #: tokens that existed when this request entered THIS engine — nonzero
+    #: only for :meth:`ServingEngine.resume_inflight` imports, where the
+    #: resumed prefix is context to re-ingest, never to regenerate. A
+    #: recompute preemption rolls ``tokens`` back to this floor, not to 0.
+    resume_from: int = 0
 
     @property
     def finished(self) -> bool:
@@ -284,6 +304,11 @@ class ServingEngine:
         self._admit_counter = 0
         self._tables = np.zeros((n, m), np.int32)
         self._positions = np.zeros((n,), np.int32)
+        # Prefill target per slot: the CONTEXT length (prompt + any resumed
+        # tokens) captured at admission — a slot is prefilling while its
+        # position sits below it. Static per admission on purpose: the
+        # context keeps growing after prefill, the target must not.
+        self._prefill_target = np.zeros((n,), np.int32)
         self._last_token = np.zeros((n,), np.int32)
         self._slot_keys = np.zeros((n, 2), np.uint32)
         self._queue: collections.deque = collections.deque()
@@ -502,10 +527,15 @@ class ServingEngine:
 
     def submit(self, prompt, max_new_tokens: int, *, temperature: float = 0.0,
                top_p: Optional[float] = None,
-               eos_token: Optional[int] = None) -> int:
+               eos_token: Optional[int] = None,
+               key: Optional[jax.Array] = None) -> int:
         """Queue a generation request; returns its id. Same sampling
         contract as ``generate``: temperature 0 is greedy, ``top_p`` needs
-        temperature > 0."""
+        temperature > 0. ``key`` overrides the engine-derived per-request
+        PRNG key (``fold_in(base, rid)``) — a fleet router passes one so
+        the SAME request dispatched to any replica (or re-dispatched after
+        a preemption) draws the identical sampled stream regardless of the
+        replica-local request id."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) < 1:
             raise ValueError("prompt must hold at least one token")
@@ -529,14 +559,108 @@ class ServingEngine:
                 f"pool holds {self.scfg.n_blocks - 1}")
         rid = self._next_rid
         self._next_rid += 1
+        if key is None:
+            key = jax.random.fold_in(self._base_key, rid)
+        else:
+            key = _check_key(key)
         req = Request(
             rid=rid, prompt=prompt, max_new_tokens=max_new_tokens,
             temperature=temperature, top_p=1.0 if top_p is None else top_p,
-            eos_token=eos_token, key=jax.random.fold_in(self._base_key, rid),
+            eos_token=eos_token, key=key,
             submit_t=time.monotonic())
         self._requests[rid] = req
         self._queue.append(req)
         return rid
+
+    def export_inflight(self) -> List[dict]:
+        """Every not-yet-done request as a JSON-serializable record:
+        original prompt, tokens emitted so far, the per-request sampling
+        key (raw uint32 words), and the sampling params — everything a
+        sibling engine needs to continue the stream token-identically via
+        :meth:`resume_inflight`. The graceful-drain half of the serve
+        subsystem's preemption contract (docs/parity.md "Serve as a
+        task"); the engine itself is left untouched."""
+        records = []
+        for req in self._requests.values():
+            if req.status == DONE:
+                continue
+            records.append({
+                "rid": req.rid,
+                "prompt": [int(t) for t in np.asarray(req.prompt)],
+                "tokens": [int(t) for t in req.tokens],
+                "key": np.asarray(req.key, np.uint32).reshape(-1).tolist(),
+                "max_new_tokens": req.max_new_tokens,
+                "temperature": req.temperature,
+                "top_p": req.top_p,
+                "eos_token": req.eos_token,
+            })
+        return records
+
+    def resume_inflight(self, records: List[dict]) -> Dict[int, int]:
+        """Import :meth:`export_inflight` records (possibly from another
+        process); returns {exported rid: local rid}. A resumed request
+        re-ingests prompt + emitted tokens as context (prefilled, never
+        regenerated) and continues generating at token index
+        ``len(tokens)`` — with the exported key, the continued stream is
+        token-identical to the uninterrupted one (greedy trivially so;
+        sampled because every draw is keyed by ``fold_in(key, index)`` or
+        absolute position, never by schedule). A record that already
+        satisfied its stopping condition imports as done."""
+        mapping: Dict[int, int] = {}
+        for record in records:
+            prompt = np.asarray(record["prompt"], np.int32).reshape(-1)
+            tokens = [int(t) for t in record.get("tokens", ())]
+            max_new = int(record["max_new_tokens"])
+            eos = record.get("eos_token")
+            if len(prompt) < 1:
+                raise ValueError("prompt must hold at least one token")
+            if max_new < 1:
+                raise ValueError(
+                    f"max_new_tokens must be >= 1, got {max_new}")
+            if len(tokens) > max_new:
+                raise ValueError(
+                    f"resume record carries {len(tokens)} tokens but "
+                    f"max_new_tokens is {max_new}")
+            total = len(prompt) + max_new
+            if total > self.scfg.max_len:
+                raise ValueError(
+                    f"resumed context {len(prompt)} + max_new_tokens "
+                    f"{max_new} exceeds max_len {self.scfg.max_len}")
+            if self.scfg.blocks_for(total) > self.scfg.n_blocks - 1:
+                raise ValueError(
+                    f"resumed request needs {self.scfg.blocks_for(total)} "
+                    f"blocks but the pool holds {self.scfg.n_blocks - 1}")
+            if self.scfg.prefill == "bucketed" and tokens:
+                # Bucketed prefill must ingest prompt + resumed prefix in
+                # ONE padded program, so the context needs a bucket even
+                # though only the prompt did at original submit time. When
+                # it has outgrown every bucket, fall back to recomputing
+                # from the prompt alone: the keyed samplers (and greedy's
+                # context purity) regenerate the identical prefix, so the
+                # stream — and any offset-based reader — is unaffected; a
+                # valid in-flight request must never become unresumable.
+                try:
+                    self.scfg.bucket_for(len(prompt) + len(tokens))
+                except ValueError:
+                    tokens = []
+            rid = self._next_rid
+            self._next_rid += 1
+            key = _check_key(record["key"])
+            req = Request(
+                rid=rid, prompt=prompt, max_new_tokens=max_new,
+                temperature=float(record.get("temperature", 0.0)),
+                top_p=float(record.get("top_p", 1.0)),
+                eos_token=None if eos is None else int(eos), key=key,
+                submit_t=time.monotonic(), tokens=tokens,
+                resume_from=len(tokens))
+            self._requests[rid] = req
+            if req.finished:
+                req.status = DONE
+                req.finish_t = time.monotonic()
+            else:
+                self._queue.append(req)
+            mapping[int(record.get("rid", rid))] = rid
+        return mapping
 
     def poll(self, rid: int) -> dict:
         req = self._requests[rid]
@@ -556,6 +680,12 @@ class ServingEngine:
     @property
     def n_active(self) -> int:
         return sum(r is not None for r in self._slots)
+
+    @property
+    def queue_depth(self) -> int:
+        """Requests admitted to the engine but not yet holding a slot —
+        the router's autoscale signal."""
+        return len(self._queue)
 
     @property
     def has_work(self) -> bool:
@@ -607,7 +737,7 @@ class ServingEngine:
     def _prefilling(self, slot: int) -> bool:
         req = self._slots[slot]
         return req is not None and \
-            int(self._positions[slot]) < len(req.prompt)
+            int(self._positions[slot]) < int(self._prefill_target[slot])
 
     def _context_ids(self, req: Request) -> np.ndarray:
         return np.concatenate(
@@ -650,10 +780,14 @@ class ServingEngine:
             if slot is None:
                 return
             req = self._queue[0]
-            plen = len(req.prompt)
+            # A resumed request's already-emitted tokens are CONTEXT here:
+            # ingested through the same chunk programs as the prompt, then
+            # generation continues at token index len(req.tokens).
+            ctx = self._context_ids(req)
+            plen = len(ctx)
             cached: List[int] = []
             if self._pcache is not None:
-                cached = self._pcache.lookup(req.prompt)   # increfs
+                cached = self._pcache.lookup(ctx)          # increfs
             # The last prompt token is ALWAYS recomputed (its logits seed
             # the first sample), so a whole-prompt hit caps at plen - 1 —
             # and that one write lands inside the final shared block, the
@@ -695,20 +829,23 @@ class ServingEngine:
             self._slot_keys[slot] = np.asarray(req.key, np.uint32)
             self._tables[slot] = table
             self._positions[slot] = cached_len
+            self._prefill_target[slot] = plen
             self._last_token[slot] = 0
             self._draft_pos[slot] = 0
             admitted.append(req.rid)
 
     def _admit_bucketed(self, admitted: list, finished: list) -> None:
-        """Legacy PR 5 admission: the whole prompt through one padded
-        prefill program, first token sampled immediately."""
+        """Legacy PR 5 admission: the whole prompt (plus any resumed-token
+        context) through one padded prefill program, first token sampled
+        immediately."""
         while self._queue:
             slot = next(
                 (i for i, r in enumerate(self._slots) if r is None), None)
             if slot is None:
                 return
             req = self._queue[0]
-            need = self.scfg.blocks_for(len(req.prompt))
+            ctx = self._context_ids(req)
+            need = self.scfg.blocks_for(len(ctx))
             # Keep one spare so the running set can cross its next block
             # boundary without an instant preemption; an idle engine admits
             # with no spare (a solo request can always grow into the pool
@@ -717,14 +854,14 @@ class ServingEngine:
             if blocks is None:
                 return
             self._queue.popleft()
-            bucket = self.scfg.bucket_for(len(req.prompt))
+            bucket = self.scfg.bucket_for(len(ctx))
             table = np.zeros((self.scfg.max_blocks_per_slot,), np.int32)
             table[:need] = blocks
             padded = np.zeros((1, bucket), np.int32)
-            padded[0, :len(req.prompt)] = req.prompt
+            padded[0, :len(ctx)] = ctx
             logits = self._run_program(
                 self._prefill_fn, self.params, jnp.asarray(padded),
-                jnp.int32(len(req.prompt)), jnp.asarray(table))
+                jnp.int32(len(ctx)), jnp.asarray(table))
             if self._quantized:
                 self.quantized_block_writes += need
             self.prefills += 1
@@ -739,7 +876,8 @@ class ServingEngine:
             self._admit_seq[slot] = self._admit_counter
             self._slot_keys[slot] = np.asarray(req.key, np.uint32)
             self._tables[slot] = table
-            self._positions[slot] = len(req.prompt)
+            self._positions[slot] = len(ctx)
+            self._prefill_target[slot] = len(ctx)
             self._last_token[slot] = first
             self._draft_pos[slot] = 0
             admitted.append(req.rid)
@@ -797,9 +935,12 @@ class ServingEngine:
         # with the prefix cache under the ids that produced their KV
         # (prompt + generated so far), so the hash list and the block list
         # must line up. The keyed sampling stream reproduces the same
-        # tokens on re-admission; TTFT restarts honestly.
+        # tokens on re-admission; TTFT restarts honestly. A resumed
+        # request rolls back only to its imported prefix — those tokens
+        # are context from another engine's life, not this engine's to
+        # regenerate.
         self._release(slot)
-        req.tokens.clear()
+        del req.tokens[req.resume_from:]
         req.first_token_t = None
         self._queue.appendleft(req)
 
@@ -946,8 +1087,9 @@ class ServingEngine:
                 if req is None:
                     continue
                 pos = int(self._positions[i])
-                if pos < len(req.prompt):
-                    w[i] = min(W, len(req.prompt) - pos)
+                target = int(self._prefill_target[i])
+                if pos < target:
+                    w[i] = min(W, target - pos)
                 elif not self._spec_on:
                     w[i] = 1
             return w
@@ -981,13 +1123,17 @@ class ServingEngine:
         if pre is not None:
             req = self._slots[pre]
             pos, c = int(self._positions[pre]), int(widths[pre])
-            tokens[n:n + c] = req.prompt[pos:pos + c]
+            ctx = self._context_ids(req)       # prompt + any resumed prefix
+            tokens[n:n + c] = ctx[pos:pos + c]
             positions[n:n + c] = np.arange(pos, pos + c)
             tables[n:] = self._tables[pre]
             active[n:n + c] = True
             temps[n:n + c], tops[n:n + c] = req.temperature, req.top_p
-            keys[n:n + c] = self._slot_keys[pre]   # ngen 0: first token rides
-            # the same fold_in(key, 0) draw a bucketed admission makes.
+            keys[n:n + c] = self._slot_keys[pre]
+            # The post-prefill sample rides fold_in(key, len(tokens)) —
+            # 0 for a fresh admission (the same draw a bucketed admission
+            # makes), the resumed-token count for resume_inflight imports.
+            ngen[n:n + c] = len(req.tokens)
         pos_masked = np.where(active, positions, 0)
         qa = (self._quant_layout(tables, pos_masked[:, None],
                                  active[:, None])
@@ -1013,7 +1159,7 @@ class ServingEngine:
             if i == pre:                            # prefill rows
                 self._positions[i] = pos + c
                 self.prefill_chunks += 1
-                if pos + c < len(req.prompt):
+                if pos + c < int(self._prefill_target[i]):
                     continue                        # mid-prompt: no token
                 self.prefills += 1                  # prompt complete
                 tok = int(toks[n + c - 1])          # last chunk row's sample
@@ -1250,6 +1396,7 @@ class ServingEngine:
             self.allocator.decref(int(b))
         self._tables[slot] = 0
         self._positions[slot] = 0
+        self._prefill_target[slot] = 0
         self._last_token[slot] = 0
         self._draft_pos[slot] = 0
         self._slots[slot] = None

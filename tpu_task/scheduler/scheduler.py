@@ -37,6 +37,7 @@ from typing import Callable, Dict, List, Optional
 from tpu_task.scheduler import driver as driver_module
 from tpu_task.scheduler.pool import CapacityPool, select_victims
 from tpu_task.scheduler.queue import (
+    TERMINAL,
     DurableQueue,
     GangSpec,
     QueuedTask,
@@ -162,6 +163,19 @@ class GangScheduler:
         self.queue.update(task)
         self.driver.release(task)
 
+    def withdraw(self, task_id: str, failure: str = "withdrawn") -> None:
+        """Administratively remove a gang from service — the serve fleet's
+        replica retirement (long-running gangs never finish on their own).
+        A placed gang is reclaimed through the driver's graceful path
+        first; the terminal record is a ``succeeded`` with the withdrawal
+        reason in ``failure`` (forensics, not an error)."""
+        task = self.queue.tasks[task_id]
+        if task.state in TERMINAL:
+            return
+        if task.state == "placed":
+            self.driver.preempt(task, graceful=True)
+        self._finish(task, "succeeded", self.clock(), failure=failure)
+
     def _requeue(self, task: QueuedTask, now: float, charge_budget: bool) -> None:
         """Route a reclaimed gang through the requeue governor. Scheduler-
         initiated preemptions don't charge the recovery budget (the gang did
@@ -279,6 +293,17 @@ class GangScheduler:
         for tenant, quota in sorted(self.quotas.items()):
             backlog = [task for task in self.queue.tasks.values()
                        if task.tenant == tenant]
+            # Serve gangs (payload kind=serve — ServeFleet submissions) are
+            # long-running replicas, not batch work marching to terminal:
+            # split them out so observers (and the CLI) never read a
+            # serving fleet as a pile of perpetually-running batch tasks.
+            serve = [task for task in backlog
+                     if task.payload.get("kind") == "serve"]
+            services: Dict[str, int] = {}
+            for task in serve:
+                if task.state == "placed":
+                    name = task.payload.get("service", "?")
+                    services[name] = services.get(name, 0) + 1
             tenants[tenant] = {
                 "queued": sum(1 for task in backlog if task.schedulable),
                 "running_gangs": sum(1 for task in backlog
@@ -293,6 +318,21 @@ class GangScheduler:
                 "succeeded": sum(1 for task in backlog
                                  if task.state == "succeeded"),
                 "failed": sum(1 for task in backlog if task.state == "failed"),
+                "serve": {
+                    "queued": sum(1 for task in serve if task.schedulable),
+                    "replicas": sum(1 for task in serve
+                                    if task.state == "placed"),
+                    "chips": sum(task.gang.total_chips for task in serve
+                                 if task.state == "placed"),
+                    # Terminal serve gangs (retired replicas, budget-
+                    # exhausted ones) — split out so the CLI's batch row
+                    # never counts them as finished batch work.
+                    "succeeded": sum(1 for task in serve
+                                     if task.state == "succeeded"),
+                    "failed": sum(1 for task in serve
+                                  if task.state == "failed"),
+                    "services": dict(sorted(services.items())),
+                },
             }
         return {
             "tenants": tenants,

@@ -1,0 +1,35 @@
+"""Serve as a first-class task (ROADMAP item 5): an orchestrated
+engine fleet — replica gangs on the PR 7 scheduler, a session-affine
+router on the PR 2 transport, graceful drain on the PR 3 preemption
+machinery, and token streams that survive a mid-stream replica
+preemption bit-identically."""
+
+from tpu_task.serve.autoscale import QueueDepthAutoscaler
+from tpu_task.serve.fleet import (
+    InProcessServeDriver,
+    ServeFleet,
+    ServeSpec,
+    bucket_endpoint_source,
+    probe_healthy,
+    replica_script,
+    wait_until,
+)
+from tpu_task.serve.replica import MODEL_PRESETS, ReplicaServer, build_engine
+from tpu_task.serve.router import FleetRequest, NoReplicaAvailable, Router
+
+__all__ = [
+    "FleetRequest",
+    "InProcessServeDriver",
+    "MODEL_PRESETS",
+    "NoReplicaAvailable",
+    "QueueDepthAutoscaler",
+    "ReplicaServer",
+    "Router",
+    "ServeFleet",
+    "ServeSpec",
+    "bucket_endpoint_source",
+    "build_engine",
+    "probe_healthy",
+    "replica_script",
+    "wait_until",
+]

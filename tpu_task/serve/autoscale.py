@@ -1,0 +1,78 @@
+"""Queue-depth-driven replica autoscaling (pure policy, no I/O).
+
+The fleet samples the router's queue depth each control tick and feeds it
+here; the policy answers "how many replicas should exist". Decisions are
+hysteretic on purpose — a serving replica is expensive to move (gang
+admission, engine compile, cache warmup), so the policy scales up only
+after ``patience`` consecutive over-threshold samples and down only after
+``patience`` consecutive idle ones, one step at a time. Deterministic:
+same sample sequence, same decisions (the fleet tests replay it).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+__all__ = ["QueueDepthAutoscaler"]
+
+
+@dataclass
+class QueueDepthAutoscaler:
+    """``observe(queued, replicas) -> desired replica count``.
+
+    ``high``: queued requests PER REPLICA that count as backlog pressure;
+    ``low``: the per-replica depth under which capacity is considered
+    idle. ``min_replicas`` is the availability floor (a fleet scaled to
+    zero cannot answer the request that would scale it back up).
+    """
+
+    min_replicas: int = 1
+    max_replicas: int = 8
+    high: float = 2.0
+    low: float = 0.25
+    patience: int = 3
+    _over: int = field(default=0, repr=False)
+    _under: int = field(default=0, repr=False)
+    decisions: List[str] = field(default_factory=list, repr=False)
+
+    def __post_init__(self):
+        if self.min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError("max_replicas must be >= min_replicas")
+        if self.low >= self.high:
+            raise ValueError("low watermark must sit below high")
+
+    def observe(self, queued: int, replicas: int,
+                busy: Optional[int] = None) -> int:
+        """One control-tick sample → desired replica count.
+
+        ``queued`` is backlog beyond capacity (pressure — drives UP);
+        ``busy`` is total open requests (utilization — gates DOWN). The
+        split matters: a fleet running exactly at capacity has zero
+        backlog but is NOT idle, and scaling it down would shed replicas
+        mid-stream only to re-add them a few ticks later. ``busy``
+        defaults to ``queued`` for callers without a utilization signal.
+        """
+        replicas = max(1, replicas)
+        per_replica = queued / replicas
+        per_busy = (queued if busy is None else busy) / replicas
+        if per_replica >= self.high:
+            self._over += 1
+            self._under = 0
+        elif per_busy <= self.low:
+            self._under += 1
+            self._over = 0
+        else:
+            self._over = self._under = 0
+        desired = replicas
+        if self._over >= self.patience and replicas < self.max_replicas:
+            desired = replicas + 1
+            self._over = 0
+            self.decisions.append(f"up:{replicas}->{desired}")
+        elif self._under >= self.patience and replicas > self.min_replicas:
+            desired = replicas - 1
+            self._under = 0
+            self.decisions.append(f"down:{replicas}->{desired}")
+        return desired

@@ -1,0 +1,295 @@
+"""Serve as a first-class task: replica gangs on the PR 7 scheduler.
+
+``ServeSpec`` describes a service the way a batch submission describes a
+gang — tenant, accelerator, slices — plus what the replicas run (model
+preset, serving knobs) and how many of them there should be.
+``ServeFleet`` submits one gang PER REPLICA to a :class:`GangScheduler`
+(payload ``{"kind": "serve", ...}`` — the CLI renders these distinctly
+from batch gangs), discovers replica endpoints, reconciles the router's
+membership, and applies autoscale decisions by submitting/retiring
+replica gangs through the same scheduler every other tenant shares.
+
+The point of the design is what it does NOT add: replicas recover from
+preemption through whatever machinery their driver already has — the
+in-process driver requeues through the scheduler's own governor, real
+tpu_task replicas ride the PR 3 reconciler (SIGTERM → drain/export →
+requeue → restart → re-announce) — and the fleet just watches endpoints
+come and go. A serve gang is long-running by definition: it leaves the
+scheduler only by :meth:`ServeFleet.scale_to` retirement (recorded as a
+terminal ``retired``-failure success) or by exhausting its recovery
+budget like any repeatedly-dying task.
+
+``InProcessServeDriver`` is the hermetic driver (threads, loopback HTTP):
+the whole subsystem — scheduler admission, chaos preemption, router
+failover, autoscale — runs in one test process in seconds. The chaos
+seam (:meth:`InProcessServeDriver.kill`) matches ``SimGangDriver.kill``
+so ``preemption_wave_at`` and friends drive serve fleets unchanged.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.error
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from tpu_task.scheduler import driver as driver_module
+from tpu_task.serve.router import Router
+
+__all__ = [
+    "InProcessServeDriver",
+    "ServeFleet",
+    "ServeSpec",
+    "replica_script",
+]
+
+
+@dataclass(frozen=True)
+class ServeSpec:
+    """What one serving fleet is made of."""
+
+    service: str
+    tenant: str
+    replicas: int = 2
+    accelerator: str = "v4-8"
+    slices: int = 1
+    priority: int = 1
+    preset: str = "tiny"
+    serving: Dict = field(default_factory=dict)
+
+    def payload(self, replica_index: int) -> Dict[str, str]:
+        """The durable queue payload a replica gang carries — `kind` is
+        what the CLI and status snapshot key the serve/batch split on."""
+        return {"kind": "serve", "service": self.service,
+                "replica": str(replica_index), "preset": self.preset}
+
+
+def replica_script(spec: ServeSpec, python: str = "python3") -> str:
+    """The task script a REAL replica machine runs — the paper's
+    one-script-per-machine unit, where the script is the serving engine.
+    The endpoint announcement and the graceful-drain export both land in
+    the working directory, which the agent's data sync mirrors to the
+    task bucket (that is the discovery plane — no new channel)."""
+    serving = json.dumps(spec.serving) if spec.serving else "{}"
+    return (
+        "#!/bin/bash\n"
+        f"exec {python} -m tpu_task.serve.replica "
+        f"--preset {spec.preset} --serving '{serving}' "
+        "--endpoint-file endpoint.json --drain-file inflight.json\n")
+
+
+class InProcessServeDriver:
+    """GangDriver whose gangs are in-process :class:`ReplicaServer`
+    threads on loopback HTTP — the hermetic twin of running replicas as
+    real tpu_task machines. Not self-recovering: a killed replica rides
+    the SCHEDULER's requeue governor (backoff, budget, durable failure),
+    exactly like a SimGangDriver gang."""
+
+    self_recovering = False
+
+    def __init__(self, replica_factory: Optional[Callable] = None):
+        #: task -> started ReplicaServer; default builds from the payload.
+        self._factory = replica_factory or self._default_factory
+        self._servers: Dict[str, object] = {}
+        self._killed: Dict[str, bool] = {}
+        self.endpoints: Dict[str, dict] = {}
+
+    @staticmethod
+    def _default_factory(task):
+        from tpu_task.serve.replica import ReplicaServer
+
+        return ReplicaServer(preset=task.payload.get("preset", "tiny"))
+
+    # -- GangDriver protocol ---------------------------------------------------
+    def launch(self, task) -> None:
+        server = self._factory(task)
+        server.start()
+        self._servers[task.task_id] = server
+        self._killed.pop(task.task_id, None)
+        self.endpoints[task.task_id] = {
+            "url": server.url, "boot_id": server.boot_id}
+
+    def poll(self, task) -> str:
+        if task.task_id in self._killed:
+            self._killed.pop(task.task_id)
+            return driver_module.PREEMPTED
+        if task.task_id not in self._servers:
+            return driver_module.PREEMPTED
+        return driver_module.RUNNING
+
+    def preempt(self, task, graceful: bool = True) -> None:
+        self._stop(task.task_id, graceful=graceful)
+
+    def release(self, task) -> None:
+        self._stop(task.task_id, graceful=False)
+        self._killed.pop(task.task_id, None)
+
+    def failure_reason(self, task) -> str:
+        return "task-failed"
+
+    # -- chaos seam (SimGangDriver.kill contract) ------------------------------
+    def kill(self, task_id: str, graceful: bool = False) -> bool:
+        """A spot reclaim: graceful = SIGTERM-shaped (drain + export first),
+        hard = the socket just dies. Returns False when not running."""
+        if task_id not in self._servers:
+            return False
+        self._stop(task_id, graceful=graceful)
+        self._killed[task_id] = graceful
+        return True
+
+    def running_ids(self) -> List[str]:
+        return sorted(self._servers)
+
+    def _stop(self, task_id: str, graceful: bool) -> None:
+        server = self._servers.pop(task_id, None)
+        self.endpoints.pop(task_id, None)
+        if server is None:
+            return
+        if graceful:
+            server.begin_drain()
+        server.stop()
+
+
+class ServeFleet:
+    """One service's control loop over scheduler + router.
+
+    :meth:`tick` is the whole algorithm: tick the scheduler (admission,
+    chaos observation, requeue governor), discover endpoints for placed
+    replica gangs, reconcile router membership, and — when an autoscaler
+    is attached — turn queue depth into gang submissions/retirements.
+    """
+
+    def __init__(self, scheduler, spec: ServeSpec, router: Router,
+                 endpoint_source: Optional[Callable[[str], Optional[dict]]] = None,
+                 autoscaler=None):
+        self.scheduler = scheduler
+        self.spec = spec
+        self.router = router
+        self.autoscaler = autoscaler
+        #: task_id -> {url, boot_id} | None. Defaults to the driver's
+        #: in-process registry; real-task fleets pass a bucket reader.
+        self._endpoint_source = endpoint_source or (
+            lambda task_id: getattr(
+                self.scheduler.driver, "endpoints", {}).get(task_id))
+        self._next_replica = 0
+        self._gangs: List[str] = []      # live replica task ids, oldest first
+
+    # -- replica gang management ----------------------------------------------
+    def launch(self) -> List[str]:
+        """Submit the initial ``spec.replicas`` replica gangs."""
+        for _ in range(self.spec.replicas):
+            self._submit_replica()
+        return list(self._gangs)
+
+    def _submit_replica(self) -> str:
+        index = self._next_replica
+        self._next_replica += 1
+        task_id = f"{self.spec.service}-r{index}"
+        task = self.scheduler.submit(
+            self.spec.tenant, self.spec.accelerator,
+            slices=self.spec.slices, priority=self.spec.priority,
+            task_id=task_id)
+        task.payload.update(self.spec.payload(index))
+        self.scheduler.queue.update(task)
+        self._gangs.append(task_id)
+        return task_id
+
+    def _retire_replica(self) -> Optional[str]:
+        """Retire the NEWEST replica gang (oldest ones hold the warmest
+        caches) through the scheduler's administrative withdrawal —
+        graceful drain, capacity release, terminal ``retired`` record."""
+        for task_id in reversed(self._gangs):
+            task = self.scheduler.queue.tasks[task_id]
+            if task.state in ("succeeded", "failed"):
+                continue
+            self._gangs.remove(task_id)
+            self.scheduler.withdraw(task_id, failure="retired")
+            return task_id
+        return None
+
+    def scale_to(self, desired: int) -> None:
+        desired = max(0, desired)
+        while self.live_replicas() < desired:
+            self._submit_replica()
+        while self.live_replicas() > desired:
+            if self._retire_replica() is None:
+                break
+
+    def live_replicas(self) -> int:
+        return sum(
+            1 for task_id in self._gangs
+            if self.scheduler.queue.tasks[task_id].state
+            not in ("succeeded", "failed"))
+
+    # -- control tick ----------------------------------------------------------
+    def refresh_endpoints(self) -> Dict[str, dict]:
+        """Endpoint map for PLACED replica gangs. A gang that is queued,
+        preempted, or backoff-parked contributes nothing — its old
+        endpoint (if any) drops out of membership, which is what makes
+        the router re-dispatch that replica's streams."""
+        endpoints: Dict[str, dict] = {}
+        for task_id in self._gangs:
+            task = self.scheduler.queue.tasks[task_id]
+            if task.state != "placed":
+                continue
+            info = self._endpoint_source(task_id)
+            if info and info.get("url"):
+                endpoints[task_id] = info
+        return endpoints
+
+    def tick(self) -> None:
+        self.scheduler.tick()
+        self.router.set_replicas(self.refresh_endpoints())
+        if self.autoscaler is not None:
+            stats = self.router.stats()
+            desired = self.autoscaler.observe(
+                stats["queue_depth"], max(1, self.live_replicas()),
+                busy=stats["open"])
+            if desired != self.live_replicas():
+                self.scale_to(desired)
+
+
+def bucket_endpoint_source(bucket_dir_of: Callable[[str], str]):
+    """Endpoint source for REAL replica tasks: read
+    ``<bucket>/data/endpoint.json``, the file the replica writes to its
+    working directory and the agent's data sync ships (same discovery
+    plane as checkpoints and logs — no side channel)."""
+
+    def read(task_id: str) -> Optional[dict]:
+        path = os.path.join(bucket_dir_of(task_id), "data", "endpoint.json")
+        try:
+            with open(path) as handle:
+                return json.load(handle)
+        except (OSError, ValueError):
+            return None
+
+    return read
+
+
+def probe_healthy(url: str, timeout: float = 1.0, urlopen=None) -> bool:
+    """One bounded /healthz probe (fleet warmup helper)."""
+    from tpu_task.storage.http_util import send
+
+    try:
+        return bool(json.loads(send(
+            "GET", url + "/healthz", timeout=timeout, retries=0,
+            urlopen=urlopen)).get("ok"))
+    except (urllib.error.URLError, OSError, ValueError):
+        return False
+
+
+def wait_until(predicate: Callable[[], bool], deadline_s: float,
+               tick: Optional[Callable[[], None]] = None,
+               period: float = 0.1) -> bool:
+    """Poll ``predicate`` (running ``tick`` between probes) until true or
+    the deadline lapses — the fleet tests' one wait loop."""
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        if tick is not None:
+            tick()
+        time.sleep(period)
+    return predicate()

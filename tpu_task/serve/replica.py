@@ -1,0 +1,425 @@
+"""One serving replica: a :class:`~tpu_task.ml.serving.ServingEngine`
+behind a small HTTP front end, runnable as the SCRIPT of an ordinary
+tpu_task machine (``python -m tpu_task.serve.replica``).
+
+This is the serve-task worker half of ROADMAP item 5: the paper's unit of
+work is "one ephemeral machine running one script under systemd with
+scaling-group auto-recovery"; here the script happens to be a serving
+engine, and every lifecycle property — bootstrap, data sync, heartbeats,
+SIGTERM-as-preemption-notice, requeue through the PR 3 governor — comes
+from the machinery that already runs training tasks, unchanged.
+
+The front end speaks plain JSON over HTTP/1.1 keep-alive (the router sits
+on the pooled transport of ``storage/http_util.py``):
+
+* ``POST /submit`` — ``{prompt, max_new_tokens, temperature?, top_p?,
+  eos_token?, key?, tokens?}``. ``key`` is the raw uint32 per-request
+  sampling key the ROUTER derives, so the same request produces the
+  identical sampled stream on any replica; ``tokens`` is an
+  already-emitted prefix (a re-dispatch after a sibling's preemption) that
+  is re-ingested as context via ``ServingEngine.resume_inflight``. A
+  draining replica answers 409 (NOT a retryable 5xx — the router must
+  re-pick, not re-try).
+* ``GET /stream?rid=&offset=&wait_ms=`` — token streaming as incremental
+  long-poll: blocks up to ``wait_ms`` for tokens past ``offset``, returns
+  ``{tokens: suffix, status, draining}``. Offset-based delivery is what
+  makes router retry/re-dispatch exactly-once over an at-least-once
+  transport: a lost response re-fetches the same suffix, a re-dispatched
+  stream continues from the router's own high-water mark.
+* ``GET /poll?rid=`` · ``GET /stats`` · ``GET /healthz`` ·
+  ``GET /export`` · ``POST /drain``.
+
+Graceful drain (SIGTERM, the cloud preemption notice): stop admitting →
+finish the in-flight engine step → export every unfinished request
+(prompt + emitted tokens + sampling key + params) to ``--drain-file`` in
+the working directory — the agent's final data sync makes it durable in
+the task bucket — then keep answering ``/stream`` with ``draining: true``
+(and the already-emitted suffix) until the process exits, so the router
+re-dispatches mid-stream requests to a sibling with zero token loss.
+Because sampled streams are keyed by (request key, token index) and
+greedy streams by context alone, the sibling's continuation is
+token-identical to the stream the preempted replica would have produced.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+from urllib.parse import parse_qs, urlsplit
+
+__all__ = [
+    "MODEL_PRESETS",
+    "ReplicaServer",
+    "build_engine",
+    "main",
+]
+
+#: Deterministic (TransformerConfig kwargs, init seed) registry: a replica
+#: SUBPROCESS and the reference engine in a test/bench process must build
+#: byte-identical weights from nothing but a preset name (CPU, fixed seed).
+MODEL_PRESETS: Dict[str, dict] = {
+    # The production-traffic bench model (bench.py _production_serving_model).
+    "tiny": dict(seed=0, vocab_size=256, d_model=128, n_layers=2, n_heads=8,
+                 d_head=16, d_ff=256, n_kv_heads=4),
+    # The serving-test model (tests/test_serving*.py TINY): smallest thing
+    # that still exercises GQA + paging.
+    "micro": dict(seed=0, vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+                  d_head=8, d_ff=64, n_kv_heads=2),
+}
+
+#: ServingConfig defaults per preset — overridable via --serving / serving=.
+SERVING_PRESETS: Dict[str, dict] = {
+    "tiny": dict(slots=4, block_size=8, n_blocks=96, max_len=128),
+    "micro": dict(slots=4, block_size=4, n_blocks=64, max_len=48),
+}
+
+
+def build_engine(preset: str = "tiny", serving: Optional[dict] = None,
+                 rng_seed: int = 0):
+    """A ServingEngine from a preset name: same name → same weights, same
+    config, same streams, in any process."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_task.ml.models import transformer
+    from tpu_task.ml.serving import ServingConfig, ServingEngine
+
+    if preset not in MODEL_PRESETS:
+        raise ValueError(
+            f"unknown model preset {preset!r}; have {sorted(MODEL_PRESETS)}")
+    spec = dict(MODEL_PRESETS[preset])
+    seed = spec.pop("seed")
+    cfg = transformer.TransformerConfig(dtype=jnp.float32, **spec)
+    params = transformer.init(jax.random.PRNGKey(seed), cfg)
+    knobs = dict(SERVING_PRESETS.get(preset, {}))
+    knobs.update(serving or {})
+    return ServingEngine(params, cfg, ServingConfig(**knobs),
+                         rng=jax.random.PRNGKey(rng_seed))
+
+
+class _JSONHandler(BaseHTTPRequestHandler):
+    """Keep-alive JSON endpoints over the replica's engine."""
+
+    protocol_version = "HTTP/1.1"
+    # Nagle + delayed-ACK costs ~40 ms per request on kept-alive sockets
+    # (the PR 2 emulator lesson); token streaming would feel every ms.
+    disable_nagle_algorithm = True
+    server: "ReplicaServer"
+
+    def log_message(self, *args) -> None:  # keep pytest output clean
+        pass
+
+    def _reply(self, payload: dict, status: int = 200) -> None:
+        body = json.dumps(payload).encode()
+        try:
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        except OSError:
+            # The client (or this server, mid-teardown) dropped the socket
+            # while a long-poll was in flight — the router's offset-based
+            # pull makes a lost response free to lose.
+            self.close_connection = True
+
+    def _query(self) -> dict:
+        return {k: v[-1] for k, v in
+                parse_qs(urlsplit(self.path).query).items()}
+
+    def do_GET(self) -> None:  # noqa: N802 (http.server contract)
+        replica = self.server.replica
+        path = urlsplit(self.path).path
+        try:
+            if path == "/healthz":
+                self._reply({"ok": True, "boot_id": replica.boot_id,
+                             "draining": replica.draining})
+            elif path == "/stats":
+                self._reply(replica.stats())
+            elif path == "/poll":
+                self._reply(replica.poll(int(self._query()["rid"])))
+            elif path == "/export":
+                self._reply({"inflight": replica.exported()})
+            elif path == "/stream":
+                query = self._query()
+                self._reply(replica.stream(
+                    int(query["rid"]), int(query.get("offset", 0)),
+                    wait_ms=min(int(query.get("wait_ms", 0)), 2000)))
+            else:
+                self._reply({"error": f"no such path {path!r}"}, 404)
+        except KeyError as error:
+            self._reply({"error": f"unknown rid {error}"}, 404)
+        except Exception as error:  # surface, never hang the socket
+            self._reply({"error": repr(error)}, 500)
+
+    def do_POST(self) -> None:  # noqa: N802
+        replica = self.server.replica
+        path = urlsplit(self.path).path
+        length = int(self.headers.get("Content-Length") or 0)
+        try:
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            if path == "/submit":
+                if replica.draining:
+                    # 409, deliberately outside send()'s RETRY_STATUSES:
+                    # retrying a draining replica cannot succeed — the
+                    # router must re-dispatch to a sibling instead.
+                    self._reply({"error": "draining", "draining": True}, 409)
+                    return
+                self._reply({"rid": replica.submit(payload)})
+            elif path == "/drain":
+                replica.begin_drain()
+                self._reply({"ok": True, "draining": True})
+            else:
+                self._reply({"error": f"no such path {path!r}"}, 404)
+        except (KeyError, ValueError, TypeError) as error:
+            # Malformed request (missing field, bad value): 400 — a client
+            # error must indict the request, never read as a replica
+            # fault that would quarantine a healthy server.
+            self._reply({"error": repr(error)}, 400)
+        except Exception as error:
+            self._reply({"error": repr(error)}, 500)
+
+
+class _Server(ThreadingHTTPServer):
+    daemon_threads = True
+    replica: "ReplicaServer"
+
+
+class ReplicaServer:
+    """Engine + step loop + HTTP front end, one lock around the engine.
+
+    The engine is single-threaded by design (host-side scheduler state);
+    every front-end operation and every step-loop iteration runs under
+    ``_lock``, so HTTP handlers see consistent request records and the
+    fused-step programs never race their own donated pools."""
+
+    def __init__(self, engine=None, *, preset: str = "tiny",
+                 serving: Optional[dict] = None, host: str = "127.0.0.1",
+                 port: int = 0, drain_file: Optional[str] = None):
+        self.engine = engine if engine is not None else build_engine(
+            preset, serving)
+        self.boot_id = uuid.uuid4().hex[:12]
+        self.draining = False
+        self.drain_file = drain_file
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._exported: Optional[list] = None
+        self._server = _Server((host, port), _JSONHandler)
+        self._server.replica = self
+        self.port = self._server.server_address[1]
+        self.url = f"http://{host}:{self.port}"
+        self._threads = [
+            threading.Thread(target=self._server.serve_forever,
+                             kwargs={"poll_interval": 0.05}, daemon=True),
+            threading.Thread(target=self._step_loop, daemon=True),
+        ]
+        self._started = False
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "ReplicaServer":
+        if not self._started:
+            self._started = True
+            for thread in self._threads:
+                thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Tear the replica down (hard unless :meth:`begin_drain` ran
+        first). Purges this port's parked keep-alive sockets from the
+        process-wide pool so a later server on a reused ephemeral port
+        never inherits a stale connection (the PR 2 emulator contract)."""
+        self._stop.set()
+        self._server.shutdown()
+        self._server.server_close()
+        from tpu_task.storage.http_util import default_pool
+
+        default_pool().purge(port=self.port)
+
+    def _step_loop(self) -> None:
+        while not self._stop.is_set():
+            stepped = False
+            try:
+                with self._lock:
+                    if not self.draining and self.engine.has_work:
+                        self.engine.step()
+                        stepped = True
+            except Exception:
+                # A dying step loop must never wedge the replica silently
+                # (healthz green, streams empty forever): drain instead —
+                # admissions 409, /stream reports draining with whatever
+                # was emitted, and the router fails the open streams over
+                # to a sibling. The request records the export reads are
+                # plain host state, intact even when a device step blew up.
+                import traceback
+
+                traceback.print_exc()
+                self.begin_drain()
+                return
+            if not stepped:
+                time.sleep(0.002)
+
+    # -- front-end operations (handler-called, self-locking) ------------------
+    def submit(self, payload: dict) -> int:
+        prompt = [int(t) for t in payload["prompt"]]
+        kwargs = dict(
+            temperature=float(payload.get("temperature", 0.0)),
+            top_p=payload.get("top_p"),
+            eos_token=payload.get("eos_token"))
+        if kwargs["top_p"] is not None:
+            kwargs["top_p"] = float(kwargs["top_p"])
+        if kwargs["eos_token"] is not None:
+            kwargs["eos_token"] = int(kwargs["eos_token"])
+        key = payload.get("key")
+        tokens = [int(t) for t in payload.get("tokens") or ()]
+        with self._lock:
+            if tokens:
+                # Re-dispatch after a sibling's preemption: the emitted
+                # prefix is context to re-ingest, and the ORIGINAL key is
+                # what keeps the continuation token-identical.
+                if key is None:
+                    raise ValueError("a resumed dispatch (tokens) needs "
+                                     "its original sampling key")
+                record = {
+                    "prompt": prompt, "tokens": tokens, "key": list(key),
+                    "max_new_tokens": int(payload["max_new_tokens"]),
+                    "temperature": kwargs["temperature"],
+                    "top_p": 1.0 if kwargs["top_p"] is None
+                    else kwargs["top_p"],
+                    "eos_token": kwargs["eos_token"],
+                }
+                return next(iter(
+                    self.engine.resume_inflight([record]).values()))
+            # Fresh dispatch goes through submit (and ALL its argument
+            # validation, key shape included — a malformed request must
+            # 400, never detonate later inside the step loop); a
+            # router-derived key rides the key= override.
+            if key is not None:
+                kwargs["key"] = key
+            return self.engine.submit(
+                prompt, int(payload["max_new_tokens"]), **kwargs)
+
+    def poll(self, rid: int) -> dict:
+        with self._lock:
+            out = self.engine.poll(rid)
+        out["draining"] = self.draining
+        return out
+
+    def stream(self, rid: int, offset: int, wait_ms: int = 0) -> dict:
+        """Tokens past ``offset`` (long-polling up to ``wait_ms`` for the
+        first new one). Returns whatever is available once draining starts
+        — the router's re-dispatch prefix should be as long as possible."""
+        deadline = time.monotonic() + wait_ms / 1000.0
+        while True:
+            with self._lock:
+                out = self.engine.poll(rid)
+            if len(out["tokens"]) > offset or out["status"] == "done" \
+                    or self.draining or time.monotonic() >= deadline:
+                return {"tokens": out["tokens"][offset:],
+                        "offset": offset, "status": out["status"],
+                        "draining": self.draining}
+            time.sleep(0.002)
+
+    def stats(self) -> dict:
+        with self._lock:
+            stats = self.engine.stats()
+            stats.update({
+                "slots": self.engine.scfg.slots,
+                "active": self.engine.n_active,
+                "queued": self.engine.queue_depth,
+                "draining": self.draining,
+                "boot_id": self.boot_id,
+            })
+        return stats
+
+    # -- graceful drain ------------------------------------------------------
+    def begin_drain(self) -> list:
+        """SIGTERM half of the preemption contract: stop admitting, let
+        the in-flight step finish (the step loop checks ``draining`` under
+        the lock), export every unfinished request, and make the export
+        durable (``drain_file``) for the agent's final sync. Idempotent —
+        the export is frozen on first call."""
+        with self._lock:
+            if self._exported is None:
+                self.draining = True
+                self._exported = self.engine.export_inflight()
+                if self.drain_file:
+                    tmp = f"{self.drain_file}.tmp"
+                    with open(tmp, "w") as handle:
+                        json.dump({"boot_id": self.boot_id,
+                                   "inflight": self._exported}, handle)
+                    os.replace(tmp, self.drain_file)
+            return list(self._exported)
+
+    def exported(self) -> list:
+        with self._lock:
+            return list(self._exported or [])
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--preset", default="tiny",
+                        choices=sorted(MODEL_PRESETS))
+    parser.add_argument("--serving", default="{}",
+                        help="JSON ServingConfig overrides")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--endpoint-file", default="endpoint.json",
+                        help="where to announce {url, boot_id} (cwd-"
+                             "relative: the agent's data sync ships it to "
+                             "the task bucket for router discovery)")
+    parser.add_argument("--drain-file", default="inflight.json",
+                        help="graceful-drain export destination")
+    args = parser.parse_args(argv)
+
+    replica = ReplicaServer(
+        preset=args.preset, serving=json.loads(args.serving),
+        host=args.host, port=args.port,
+        drain_file=os.path.abspath(args.drain_file))
+    replica.start()
+
+    done = threading.Event()
+
+    def on_sigterm(_signum, _frame):
+        # Preemption notice: drain + export, then exit 0 — the agent's
+        # terminal path (final data sync incl. the drain file, `preempted`
+        # report) and the reconciler's requeue do the rest.
+        replica.begin_drain()
+        done.set()
+
+    signal.signal(signal.SIGTERM, on_sigterm)
+    signal.signal(signal.SIGINT, on_sigterm)
+
+    with open(args.endpoint_file + ".tmp", "w") as handle:
+        json.dump({"url": replica.url, "boot_id": replica.boot_id,
+                   "preset": args.preset, "pid": os.getpid()}, handle)
+    os.replace(args.endpoint_file + ".tmp", args.endpoint_file)
+    print(f"replica serving on {replica.url} (boot {replica.boot_id})",
+          flush=True)
+
+    parent = os.getppid()
+    while not done.wait(0.2):
+        # Self-supervision: the agent (our "machine") supervises us while
+        # it lives — if it is SIGKILLed (hard teardown kills only ITS
+        # process group; we run in our own session), we are orphaned to
+        # init and nothing will ever reap us. Drain and exit instead of
+        # serving forever as a leak.
+        if os.getppid() != parent:
+            replica.begin_drain()
+            break
+    # Brief linger so the router can fetch the draining suffix/export
+    # before the socket disappears; the agent's SIGTERM grace is 10 s.
+    time.sleep(float(os.environ.get("TPU_TASK_SERVE_LINGER", "1.0")))
+    replica.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,418 @@
+"""Session-affine fleet router over replica HTTP endpoints.
+
+The client-facing half of "serve as a task" (ROADMAP item 5): callers
+submit once to the router; the router owns dispatch, streaming, and every
+failure mode a preemptible fleet has. Three policies, all deliberately
+boring and deterministic:
+
+* **Dispatch** — session affinity + least-loaded-slot. The replica choice
+  is keyed by a stable hash of the request's prompt PREFIX (the first
+  ``affinity_tokens`` ids — the shared-system-prompt part of production
+  traffic), so same-prefix requests keep landing on the same replica and
+  the PR 8 content-hash prefix cache keeps hitting. Affinity yields to
+  load only when the preferred replica is ``spill_load`` requests deeper
+  than the least-loaded one — cache locality is worth a bounded queue
+  imbalance, not an unbounded one.
+* **Streaming** — offset-based pulls (``/stream?rid=&offset=``) driven by
+  :meth:`Router.pump`. The router's own token high-water mark is the one
+  source of truth; a replica answer only ever APPENDS past it, so lost
+  responses, retried requests, and re-dispatches can neither duplicate
+  nor drop tokens.
+* **Failure** — retry-with-re-dispatch. A connection fault (reset,
+  timeout, refused — after the transport's own bounded retries) or a
+  ``draining`` answer re-dispatches the request to a sibling, resubmitting
+  prompt + received-token prefix + the ORIGINAL sampling key; the sibling
+  re-ingests the prefix as context and continues the stream
+  token-identically (the engine's ``resume_inflight`` contract). A
+  replica that faults is quarantined until its endpoint re-announces with
+  a new boot id (the fleet's membership refresh).
+
+The router computes each request's sampling key ONCE (``fold_in(seed
+key, fleet rid)``) and ships it raw — replicas never key sampled streams
+off replica-local ids, which is exactly what makes mid-stream failover
+invisible to the client.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+import urllib.error
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from tpu_task.storage.http_util import send
+
+__all__ = ["FleetRequest", "NoReplicaAvailable", "Router"]
+
+QUEUED, RUNNING, DONE, FAILED = "queued", "running", "done", "failed"
+
+
+class NoReplicaAvailable(RuntimeError):
+    """Every replica is dead or draining; requests stay queued in the
+    router and re-dispatch when membership recovers."""
+
+
+@dataclass
+class _Replica:
+    name: str
+    url: str
+    boot_id: str = ""
+    healthy: bool = True
+    load: int = 0               # open fleet requests assigned here
+    faults: int = 0
+    #: monotonic stamp after which a fault quarantine may heal (inf for a
+    #: draining replica — it only returns by rebooting under a new boot id)
+    quarantined_until: float = 0.0
+
+
+@dataclass
+class FleetRequest:
+    """One client request's router-side record — the failover source of
+    truth (prompt + params + key + received tokens)."""
+
+    fid: int
+    prompt: List[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_p: Optional[float] = None
+    eos_token: Optional[int] = None
+    key: Optional[List[int]] = None      # raw uint32 sampling key words
+    status: str = QUEUED
+    error: str = ""                      # terminal rejection (status=failed)
+    tokens: List[int] = field(default_factory=list)
+    replica: Optional[str] = None        # current assignment
+    rid: Optional[int] = None            # replica-local id
+    dispatches: int = 0                  # 1 = never re-dispatched
+    submit_t: float = 0.0
+    first_token_t: Optional[float] = None
+    finish_t: Optional[float] = None
+
+
+class Router:
+    """See module docstring. ``urlopen`` injects the transport (the
+    pooled keep-alive default, or a seeded :class:`ChaosTransport` in
+    tests); ``retries`` is the per-HTTP-call transport retry budget —
+    kept small because the router's real recovery is re-dispatch, not
+    backoff against a dead socket."""
+
+    def __init__(self, *, seed: int = 0, affinity_tokens: int = 16,
+                 spill_load: int = 4, retries: int = 1,
+                 timeout: float = 10.0, quarantine_s: float = 2.0,
+                 urlopen=None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.seed = seed
+        self.affinity_tokens = affinity_tokens
+        self.spill_load = spill_load
+        self.retries = retries
+        self.timeout = timeout
+        self.quarantine_s = quarantine_s
+        self.urlopen = urlopen
+        self.clock = clock
+        self._replicas: Dict[str, _Replica] = {}
+        self._requests: Dict[int, FleetRequest] = {}
+        self._next_fid = 0
+        self._base_key = None            # lazy: jax import off the init path
+        self.redispatches = 0
+        self.transport_faults = 0
+
+    # -- membership ------------------------------------------------------------
+    def set_replicas(self, endpoints: Dict[str, dict]) -> None:
+        """Reconcile membership with ``{name: {url, boot_id}}`` (what the
+        fleet discovered from the task buckets / in-process registry). A
+        replica whose boot id changed is a REBOOT: fresh health, fresh
+        load — its old sockets and rids are gone with the old process. A
+        fault-quarantined replica whose quarantine lapsed heals here (the
+        membership refresh is the fleet's retry cadence); a DRAINING
+        replica never heals — it returns only under a new boot id."""
+        now = self.clock()
+        for name in list(self._replicas):
+            if name not in endpoints:
+                self._drop_replica(name)
+        for name, info in endpoints.items():
+            known = self._replicas.get(name)
+            boot = info.get("boot_id", "")
+            if known is None or known.url != info["url"] \
+                    or known.boot_id != boot:
+                if known is not None:
+                    # Unassigns the old incarnation's open requests too —
+                    # the fresh record always starts at load 0.
+                    self._drop_replica(name)
+                self._replicas[name] = _Replica(
+                    name=name, url=info["url"], boot_id=boot)
+            elif not known.healthy and now >= known.quarantined_until:
+                known.healthy = True
+
+    def _drop_replica(self, name: str) -> None:
+        self._replicas.pop(name, None)
+        for request in self._requests.values():
+            if request.replica == name and request.status not in (DONE,
+                                                                  FAILED):
+                request.replica = None
+                request.rid = None
+                request.status = QUEUED
+
+    def replicas(self) -> Dict[str, dict]:
+        return {name: {"url": r.url, "boot_id": r.boot_id,
+                       "healthy": r.healthy, "load": r.load}
+                for name, r in sorted(self._replicas.items())}
+
+    # -- dispatch policy -------------------------------------------------------
+    def _affinity_hash(self, prompt: List[int]) -> int:
+        prefix = ",".join(str(t) for t in prompt[:self.affinity_tokens])
+        return int.from_bytes(
+            hashlib.blake2b(prefix.encode(), digest_size=8).digest(), "big")
+
+    def pick(self, prompt: List[int],
+             exclude: Optional[set] = None) -> _Replica:
+        """Affinity-preferred, least-loaded-spill replica choice."""
+        exclude = exclude or set()
+        healthy = [r for name, r in sorted(self._replicas.items())
+                   if r.healthy and name not in exclude]
+        if not healthy:
+            raise NoReplicaAvailable(
+                f"no healthy replica (of {len(self._replicas)}) to dispatch to")
+        preferred = healthy[self._affinity_hash(prompt) % len(healthy)]
+        least = min(healthy, key=lambda r: (r.load, r.name))
+        if preferred.load - least.load >= self.spill_load:
+            return least
+        return preferred
+
+    # -- submission ------------------------------------------------------------
+    def _derive_key(self, fid: int) -> List[int]:
+        import jax
+        import numpy as np
+
+        if self._base_key is None:
+            self._base_key = jax.random.PRNGKey(self.seed)
+        return np.asarray(jax.random.fold_in(self._base_key, fid),
+                          np.uint32).reshape(-1).tolist()
+
+    def submit(self, prompt, max_new_tokens: int, *,
+               temperature: float = 0.0, top_p: Optional[float] = None,
+               eos_token: Optional[int] = None) -> int:
+        """Queue a fleet request; returns its fleet id. Dispatch happens
+        here when a replica is available, else on the next :meth:`pump`."""
+        fid = self._next_fid
+        self._next_fid += 1
+        request = FleetRequest(
+            fid=fid, prompt=[int(t) for t in prompt],
+            max_new_tokens=int(max_new_tokens),
+            temperature=float(temperature), top_p=top_p,
+            eos_token=eos_token, key=self._derive_key(fid),
+            submit_t=self.clock())
+        self._requests[fid] = request
+        try:
+            self._dispatch(request)
+        except NoReplicaAvailable:
+            pass                          # stays QUEUED; pump retries
+        return fid
+
+    def _dispatch(self, request: FleetRequest,
+                  exclude: Optional[set] = None) -> None:
+        replica = self.pick(request.prompt, exclude=exclude)
+        payload = {
+            "prompt": request.prompt,
+            "max_new_tokens": request.max_new_tokens,
+            "temperature": request.temperature,
+            "top_p": request.top_p,
+            "eos_token": request.eos_token,
+            "key": request.key,
+        }
+        if request.tokens:
+            # Re-dispatch: the received prefix is re-ingested as context
+            # by the sibling; the continuation is token-identical.
+            payload["tokens"] = list(request.tokens)
+        try:
+            body = self._call(replica, "POST", "/submit", data=payload)
+        except (urllib.error.URLError, OSError, ValueError) as error:
+            if isinstance(error, urllib.error.HTTPError) \
+                    and error.code == 409:
+                # Draining, not faulty: no new admissions, but its open
+                # streams still answer — only dispatch routes around it,
+                # and it returns only by rebooting (new boot id).
+                replica.healthy = False
+                replica.quarantined_until = float("inf")
+            elif isinstance(error, urllib.error.HTTPError) \
+                    and 400 <= error.code < 500:
+                # A client error indicts the REQUEST, not the replica: a
+                # malformed submission must fail terminally instead of
+                # quarantining every healthy replica in turn.
+                request.status = FAILED
+                request.error = (
+                    f"replica {replica.name} rejected the request "
+                    f"({error.code}): {error.read().decode(errors='replace')}")
+                request.finish_t = self.clock()
+                return
+            else:
+                self._note_fault(replica, error)
+            retry_exclude = (exclude or set()) | {replica.name}
+            self._dispatch(request, exclude=retry_exclude)  # try siblings
+            return
+        request.replica = replica.name
+        request.rid = int(body["rid"])
+        request.status = RUNNING
+        request.dispatches += 1
+        if request.dispatches > 1:
+            self.redispatches += 1
+        replica.load += 1
+
+    # -- transport -------------------------------------------------------------
+    def _call(self, replica: _Replica, method: str, path: str,
+              data: Optional[dict] = None) -> dict:
+        raw = send(method, replica.url + path,
+                   data=None if data is None else json.dumps(data).encode(),
+                   headers={"Content-Type": "application/json"},
+                   timeout=self.timeout, retries=self.retries,
+                   urlopen=self.urlopen)
+        return json.loads(raw)
+
+    def _note_fault(self, replica: _Replica, error: Exception) -> None:
+        """Quarantine after any post-retry fault: re-dispatch is cheap and
+        exact, waiting on a dead socket is neither. The quarantine is
+        TIME-BOUNDED (``quarantine_s``) — a transient fault heals on a
+        later membership refresh; a dead replica just re-quarantines on
+        the next attempt; a rebooted one returns early via its new boot
+        id."""
+        self.transport_faults += 1
+        replica.faults += 1
+        replica.healthy = False
+        replica.quarantined_until = self.clock() + self.quarantine_s
+
+    def _unassign(self, request: FleetRequest) -> None:
+        replica = self._replicas.get(request.replica or "")
+        if replica is not None and replica.load > 0:
+            replica.load -= 1
+        request.replica = None
+        request.rid = None
+        if request.status != FAILED:      # terminal rejections stay terminal
+            request.status = QUEUED
+
+    # -- streaming -------------------------------------------------------------
+    def pump(self, wait_ms: int = 20) -> int:
+        """One round over every open request: re-dispatch the unassigned,
+        pull each assigned stream once past the router's high-water mark.
+        Returns the number of still-open requests — callers loop
+        ``while router.pump():``. Single-threaded and deterministic given
+        deterministic replicas/transport (the chaos tests rely on it)."""
+        open_requests = [r for r in self._requests.values()
+                         if r.status not in (DONE, FAILED)]
+        for request in open_requests:
+            if request.replica is None:
+                try:
+                    self._dispatch(request)
+                except NoReplicaAvailable:
+                    continue
+                if request.status == FAILED:  # terminally rejected (4xx)
+                    continue
+            replica = self._replicas.get(request.replica or "")
+            if replica is None:
+                self._unassign(request)
+                continue
+            try:
+                body = self._call(
+                    replica, "GET",
+                    f"/stream?rid={request.rid}"
+                    f"&offset={len(request.tokens)}&wait_ms={wait_ms}")
+            except urllib.error.HTTPError as error:
+                if error.code == 404:
+                    # The replica restarted (same url, new engine) and lost
+                    # the rid — re-dispatch with the received prefix.
+                    self._unassign(request)
+                    continue
+                self._note_fault(replica, error)
+                self._unassign(request)
+                continue
+            except (urllib.error.URLError, OSError, ValueError) as error:
+                self._note_fault(replica, error)
+                self._unassign(request)
+                continue
+            suffix = [int(t) for t in body.get("tokens", ())]
+            if suffix:
+                if request.first_token_t is None:
+                    request.first_token_t = self.clock()
+                request.tokens.extend(suffix)
+            if len(request.tokens) >= request.max_new_tokens or (
+                    request.eos_token is not None and request.tokens
+                    and request.tokens[-1] == request.eos_token):
+                request.status = DONE
+                request.finish_t = self.clock()
+                if replica.load > 0:
+                    replica.load -= 1
+            elif body.get("draining"):
+                # Graceful preemption notice: take the suffix it still
+                # served, then fail over.
+                replica.healthy = False
+                replica.quarantined_until = float("inf")
+                self._unassign(request)
+        return sum(1 for r in self._requests.values()
+                   if r.status not in (DONE, FAILED))
+
+    def drain(self, deadline_s: float = 120.0, wait_ms: int = 20,
+              on_idle: Optional[Callable[[], None]] = None) -> Dict[int, List[int]]:
+        """Pump until every submitted request is done (or raise with the
+        stragglers). ``on_idle`` runs between rounds — the fleet hooks
+        membership refresh / scheduler ticks here."""
+        deadline = time.monotonic() + deadline_s
+        while True:
+            remaining = self.pump(wait_ms=wait_ms)
+            if not remaining:
+                return {fid: list(r.tokens)
+                        for fid, r in self._requests.items()}
+            if on_idle is not None:
+                on_idle()
+            if time.monotonic() > deadline:
+                stuck = sorted(fid for fid, r in self._requests.items()
+                               if r.status not in (DONE, FAILED))
+                raise TimeoutError(
+                    f"router drain exceeded {deadline_s}s with "
+                    f"{len(stuck)} open request(s): {stuck}")
+
+    # -- observation -----------------------------------------------------------
+    def request(self, fid: int) -> FleetRequest:
+        return self._requests[fid]
+
+    def result(self, fid: int) -> List[int]:
+        request = self._requests[fid]
+        if request.status == FAILED:
+            raise RuntimeError(
+                f"request {fid} was rejected: {request.error}")
+        if request.status != DONE:
+            raise RuntimeError(f"request {fid} is {request.status}, not done")
+        return list(request.tokens)
+
+    @property
+    def queue_depth(self) -> int:
+        """Open requests beyond what the fleet's slots could be running —
+        the autoscaler's signal (0 when capacity covers the backlog)."""
+        open_count = sum(1 for r in self._requests.values()
+                         if r.status not in (DONE, FAILED))
+        return max(0, open_count - self.fleet_slots())
+
+    def fleet_slots(self) -> int:
+        return sum(self._slots_of(r) for r in self._replicas.values()
+                   if r.healthy)
+
+    def _slots_of(self, replica: _Replica) -> int:
+        # Slot counts come along on membership refresh via /stats at most
+        # once per replica (cached on the record).
+        if not hasattr(replica, "_slots"):
+            try:
+                replica._slots = int(
+                    self._call(replica, "GET", "/stats")["slots"])
+            except Exception:
+                return 0
+        return replica._slots
+
+    def stats(self) -> dict:
+        states = [r.status for r in self._requests.values()]
+        return {
+            "replicas": self.replicas(),
+            "requests": len(self._requests),
+            "open": sum(1 for s in states if s not in (DONE, FAILED)),
+            "failed": states.count(FAILED),
+            "queue_depth": self.queue_depth,
+            "redispatches": self.redispatches,
+            "transport_faults": self.transport_faults,
+        }
