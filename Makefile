@@ -8,7 +8,7 @@ PYTHON ?= python3
 # Seed for the chaos soak: any run is replayable by pinning this.
 TPU_TASK_CHAOS_SEED ?= 20260804
 
-.PHONY: test smoke sweep bench bench-steady bench-serving bench-sched bench-decode bench-fleet sched sched-soak chaos fleet serve-soak wheel multichip kernels-tpu clean
+.PHONY: test smoke sweep bench bench-steady bench-serving bench-sched bench-decode bench-fleet bench-obs sched sched-soak chaos fleet serve-soak obs wheel multichip kernels-tpu clean
 
 # Hermetic suite (the reference's `make test`, 30 s budget there; ours spans
 # the fake control planes, sharded-compute CPU checks, and the loopback GCS
@@ -104,6 +104,19 @@ fleet:
 serve-soak:
 	TPU_TASK_CHAOS_SEED=$(TPU_TASK_CHAOS_SEED) \
 		$(PYTHON) -m pytest tests/ -m "fleet and slow" -q
+
+# Observability-plane tests (tier-1 speed): metrics registry + histogram
+# math (the shared-quantile pin against numpy), tracer/ring/header, span
+# export + chrome-trace validity, engine spans with the obs-off
+# zero-overhead path, scheduler queue-latency surfacing, obs CLI.
+obs:
+	$(PYTHON) -m pytest tests/ -m "obs and not slow" -q
+
+# Observability overhead leg: engine tok/s with tracing/metrics on vs off
+# (adjacent-pair median — the <= 5% contract; obs off is a code-path
+# guard, so that leg pays exactly zero).
+bench-obs:
+	$(PYTHON) bench.py obs
 
 # Build the agent wheel the worker bootstrap installs.
 wheel:

@@ -38,6 +38,20 @@ sys.path.insert(0, str(REPO))
 BASELINE_SECONDS = 900.0  # reference default create timeout budget
 TARGET_MFU = 0.40
 
+
+def _hist_pct_ms(samples_s, q: float, ndigits: int = 2) -> float:
+    """Latency percentile (ms) through the SHARED obs histogram type —
+    the same deterministic log-bucket math live ``/stats``, the scheduler
+    snapshot, and ``obs top`` report, so bench numbers and production
+    numbers are one quantile implementation (PR 11; the tier-1 pin in
+    tests/test_obs.py holds the two within one bucket of exact)."""
+    from tpu_task.obs import Histogram
+
+    hist = Histogram("bench")
+    for x in samples_s:
+        hist.observe(float(x))
+    return round(hist.quantile(q / 100.0) * 1e3, ndigits)
+
 # Peak dense bf16 FLOP/s per chip by device kind (public spec sheets).
 PEAK_FLOPS = {
     "TPU v4": 275e12,
@@ -732,7 +746,7 @@ def bench_serving(n_requests: int = 36, seed: int = 0) -> dict:
     b1_ttft, b1_makespan, _ = baseline_leg(1)
 
     def pct(xs, q) -> float:
-        return round(float(np.percentile(np.asarray(xs) * 1e3, q)), 1)
+        return _hist_pct_ms(xs, q, ndigits=1)
 
     return {
         "workload": {
@@ -1041,7 +1055,7 @@ def bench_serving_long_prompt(n_long: int = 6, seed: int = 0) -> dict:
         return gaps, ttft, time.perf_counter() - t0
 
     def pct(xs, q) -> float:
-        return round(float(np.percentile(np.asarray(xs) * 1e3, q)), 2)
+        return _hist_pct_ms(xs, q)
 
     c_gaps, c_ttft, c_wall = leg("chunked")
     b_gaps, b_ttft, b_wall = leg("bucketed")
@@ -1929,10 +1943,8 @@ def bench_serving_fleet(replica_counts=(1, 2, 4), n_requests: int = 24,
                 "replicas": replicas,
                 "decode_tokens_per_s": round(useful / makespan, 1),
                 "makespan_s": round(makespan, 3),
-                "ttft_p50_ms": round(
-                    float(np.percentile(np.asarray(ttft) * 1e3, 50)), 1),
-                "ttft_p99_ms": round(
-                    float(np.percentile(np.asarray(ttft) * 1e3, 99)), 1),
+                "ttft_p50_ms": _hist_pct_ms(ttft, 50, ndigits=1),
+                "ttft_p99_ms": _hist_pct_ms(ttft, 99, ndigits=1),
                 "redispatches": router.redispatches,
             }
             if preempt:
@@ -1957,6 +1969,100 @@ def bench_serving_fleet(replica_counts=(1, 2, 4), n_requests: int = 24,
         "preempt_one_of_two": recovery,
         "ttft_p99_speedup_1_to_max": round(
             legs[0]["ttft_p99_ms"] / max(legs[-1]["ttft_p99_ms"], 1e-9), 2),
+    }
+
+
+def bench_obs(n_requests: int = 8, max_new: int = 16, seed: int = 0,
+              repeats: int = 25) -> dict:
+    """Observability overhead leg (PR 11 acceptance): the SAME greedy
+    workload through two engines — ``obs=None`` (the zero-overhead path:
+    no tracer exists, every recording site short-circuits) and a full
+    ``Obs`` handle (per-step wall histogram, TTFT/inter-token histograms,
+    one span per request phase) — reporting engine tok/s for each and the
+    overhead fraction. Everything obs records is host-side at dispatch
+    boundaries, so the contract is ≤ 5% on an engine whose step is
+    dispatch-dominated. Measurement shape matters more than the cost
+    being measured (~1.5 µs/step against ~1 ms steps): rounds run as
+    adjacent (off, on) PAIRS and the reported overhead is the MEDIAN
+    per-pair wall ratio — adjacent rounds share machine state, so drift
+    cancels inside a pair, and the median drops outlier rounds (r11: a
+    sequential A-then-B layout or unpaired best-of-N both swing ±8-15%
+    either direction from scheduler noise alone). Streams are asserted
+    identical — obs must observe, never perturb."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_task.ml.models import transformer
+    from tpu_task.ml.serving import ServingConfig, ServingEngine
+    from tpu_task.obs import Obs
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=256, d_model=128, n_layers=2, n_heads=8, d_head=16,
+        d_ff=256, dtype=jnp.float32, n_kv_heads=4)
+    # prefix_cache off: rounds repeat the same prompts, and cross-round
+    # cache hits would make round k ≠ round 1 (equally in both arms, but
+    # stable rounds make best-of-N meaningful).
+    scfg = ServingConfig(slots=4, block_size=8, n_blocks=96, max_len=64,
+                         prefix_cache=False)
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, cfg.vocab_size, size=8)
+               for _ in range(n_requests)]
+    useful = n_requests * max_new
+
+    obs = Obs.create("bench-obs")
+    engines = {"off": ServingEngine(params, cfg, scfg),
+               "on": ServingEngine(params, cfg, scfg, obs=obs)}
+    for eng in engines.values():          # compile off the clock
+        eng.submit(prompts[0], 2)
+        eng.drain()
+
+    def round_of(eng):
+        t0 = time.perf_counter()
+        rids = [eng.submit(p, max_new) for p in prompts]
+        eng.drain()
+        wall = time.perf_counter() - t0
+        return wall, [eng.result(rid) for rid in rids]
+
+    ratios, walls_off, walls_on = [], [], []
+    streams_off = streams_on = None
+    for pair in range(repeats):
+        # Alternate order inside the pair (off-first, then on-first):
+        # whichever arm runs first in a pair sees slightly different
+        # cache/scheduler state, and alternating cancels that bias.
+        if pair % 2 == 0:
+            wall_off, streams_off = round_of(engines["off"])
+            wall_on, streams_on = round_of(engines["on"])
+        else:
+            wall_on, streams_on = round_of(engines["on"])
+            wall_off, streams_off = round_of(engines["off"])
+        walls_off.append(wall_off)
+        walls_on.append(wall_on)
+        ratios.append(wall_on / wall_off)
+    assert streams_on == streams_off, "obs perturbed the token streams"
+    ratios.sort()
+    median_ratio = ratios[len(ratios) // 2]
+    tok_s_off = useful / min(walls_off)
+    tok_s_on = useful / min(walls_on)
+    snapshot = obs.metrics.snapshot()
+    return {
+        "workload": {"n_requests": n_requests, "max_new": max_new,
+                     "useful_tokens": useful, "repeats": repeats},
+        "tokens_per_s_obs_off": round(tok_s_off, 1),
+        "tokens_per_s_obs_on": round(tok_s_on, 1),
+        # Negative = noise floor (obs-on ran faster): the recording cost
+        # is below scheduler jitter on this engine.
+        "overhead_pct": round((median_ratio - 1.0) * 100, 2),
+        "pair_ratio_spread": [round((r - 1.0) * 100, 2) for r in ratios],
+        "spans_recorded": len(obs.tracer.finished()),
+        "step_wall_ms_p50": round(
+            obs.metrics.histogram("engine.step_s").quantile(0.5) * 1e3, 3),
+        "metrics_exported": len(snapshot),
+        "streams_identical": True,
+        "note": ("obs=None is a code-path guard (no tracer object "
+                 "exists), so the off leg pays zero; the contract is "
+                 "overhead_pct <= 5 with tracing on"),
     }
 
 
@@ -1990,6 +2096,9 @@ def main() -> int:
     # replica gangs on the scheduler, session-affine router, preempt-one
     # recovery legs — at replica count 1/2/4 on loopback HTTP.
     fleet = bench_serving_fleet()
+    # Observability overhead (PR 11): engine tok/s with the obs plane on
+    # vs off — the ≤ 5% tracing-overhead contract, tracked per capture.
+    obs = bench_obs()
     transport = bench_transport()
     data_plane = bench_data_plane()
     steady_state = bench_steady_state()
@@ -2006,6 +2115,7 @@ def main() -> int:
         "generation": generation,
         "serving": serving,
         "fleet": fleet,
+        "obs": obs,
         "transport": transport,
         "data_plane": data_plane,
         "steady_state": steady_state,
@@ -2118,6 +2228,18 @@ def _parse_args(argv):
                            help="replica counts to sweep (default 1,2,4)")
     fleet_cmd.add_argument("--requests", type=int, default=24)
     fleet_cmd.add_argument("--seed", type=int, default=0)
+    obs_cmd = sub.add_parser(
+        "obs",
+        help="observability overhead section only (also `make bench-obs`): "
+             "engine tok/s with tracing/metrics on vs off — the <= 5% "
+             "overhead contract (0%% code path with obs off)")
+    obs_cmd.add_argument("--requests", type=int, default=8)
+    obs_cmd.add_argument("--max-new", type=int, default=16, dest="max_new")
+    obs_cmd.add_argument("--repeats", type=int, default=25,
+                         help="(off, on) round pairs (order alternating); "
+                              "the reported overhead is the median "
+                              "per-pair ratio")
+    obs_cmd.add_argument("--seed", type=int, default=0)
     return parser.parse_args(argv)
 
 
@@ -2147,6 +2269,11 @@ if __name__ == "__main__":
         print(json.dumps({"fleet": bench_serving_fleet(
             replica_counts=counts, n_requests=args.requests,
             seed=args.seed)}))
+        raise SystemExit(0)
+    if args.section == "obs":
+        print(json.dumps({"obs": bench_obs(
+            n_requests=args.requests, max_new=args.max_new,
+            seed=args.seed, repeats=args.repeats)}))
         raise SystemExit(0)
     if args.section == "serving":
         tps = tuple(int(t) for t in str(args.tp or "1,8").split(",")

@@ -527,12 +527,13 @@ def test_cli_sched_status_reads_durable_state(tmp_path, capsys):
     lines = out.strip().splitlines()
     assert lines[0].split() == [
         "TENANT", "KIND", "QUEUED", "RUNNING", "CHIPS", "QUOTA", "SHARE",
-        "DEFICIT", "REQUEUES", "DONE", "FAILED"]
+        "DEFICIT", "REQUEUES", "QLAT-P50", "QLAT-P99", "DONE", "FAILED"]
     rows = {line.split()[0]: line.split() for line in lines[1:-1]}
     assert rows["prod"][1] == "batch"
     assert rows["prod"][3] == "1"        # running gangs
     assert rows["prod"][5] == "24"       # quota chips
     assert rows["batch"][2] == "1"       # b1 still queued
+    assert rows["prod"][9].endswith("s")  # queue-latency p50 (placed gang)
     assert "pool:" in lines[-1]
 
 

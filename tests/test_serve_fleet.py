@@ -51,6 +51,42 @@ def _router_for(servers, **kwargs):
     return router
 
 
+def _assert_trace_continuity(router, replicas, fid, n_tokens):
+    """PR 11 pin: a re-dispatched stream is ONE trace end to end —
+
+    * every dispatch span is a child of the request's root span, in the
+      same trace;
+    * the dispatch spans' [token_start, token_end) ranges tile
+      [0, n_tokens) exactly once (no token delivered twice or dropped
+      across the failover);
+    * every replica-side engine span of the trace parent-links to one of
+      the router's dispatch spans (the cross-process header hop).
+    """
+    request = router.request(fid)
+    trace_id = request.trace.trace_id
+    dispatches = [span for span in router.obs.tracer.finished()
+                  if span.name == "dispatch"
+                  and span.attrs.get("fid") == fid]
+    assert len(dispatches) >= 2, "no re-dispatch recorded"
+    assert {span.trace_id for span in dispatches} == {trace_id}
+    assert {span.parent_id for span in dispatches} == \
+        {request.trace.span_id}
+    delivered = [span for span in dispatches if "token_end" in span.attrs]
+    covered = []
+    for span in sorted(delivered,
+                       key=lambda span: span.attrs["token_start"]):
+        covered.extend(range(span.attrs["token_start"],
+                             span.attrs["token_end"]))
+    assert covered == list(range(n_tokens))
+    dispatch_ids = {span.span_id for span in dispatches}
+    engine_spans = [span for server in replicas
+                    for span in server.obs.tracer.finished()
+                    if span.trace_id == trace_id]
+    assert engine_spans, "no replica-side spans joined the trace"
+    assert all(span.parent_id in dispatch_ids for span in engine_spans)
+    return dispatches, engine_spans
+
+
 def _reference_streams(router, fids, preset="micro"):
     """What a single uninterrupted engine produces for the same requests
     (same prompts, same router-derived keys)."""
@@ -111,8 +147,57 @@ def test_replica_rejects_malformed_key_at_the_400_boundary(replicas):
         broken.engine.step = None                # next loop iteration dies
         assert wait_until(lambda: broken.draining, 10)
         assert broken.stream(rid, 0, wait_ms=0)["draining"] is True
+        # The step-loop failure is a STRUCTURED error event (exception
+        # type + message on the registry/tracer), not only a stderr
+        # traceback nobody syncs.
+        errors = [span for span in broken.obs.tracer.finished()
+                  if span.status == "error"]
+        assert errors and errors[0].attrs["path"] == "step_loop"
+        assert errors[0].attrs["exc_type"] == "TypeError"
+        assert broken.stats()["obs"]["replica.errors"]["value"] >= 1
     finally:
         broken.stop()
+
+
+def test_request_handler_failure_records_error_span_and_500(replicas):
+    """The PR 11 bugfix satellite: a request-handler failure answers 500
+    WITH the message (unchanged contract) and additionally lands a
+    structured error span — exception type/message, linked to the
+    request's trace via the propagated header — on the replica's ring,
+    so `obs trace` and the durable export see the failed request."""
+    import urllib.error
+    import urllib.request
+
+    from tpu_task.obs import TRACE_HEADER, Tracer
+
+    replica = replicas[0]
+
+    def boom(payload, trace=None):
+        raise RuntimeError("pool corrupted")
+
+    replica.submit = boom
+    tracer = Tracer("client")
+    root = tracer.start("request", fid=0)
+    request = urllib.request.Request(
+        replica.url + "/submit",
+        data=b'{"prompt": [1], "max_new_tokens": 2}',
+        headers={"Content-Type": "application/json",
+                 TRACE_HEADER: root.ctx.to_header()})
+    with pytest.raises(urllib.error.HTTPError) as info:
+        urllib.request.urlopen(request, timeout=10)
+    assert info.value.code == 500
+    assert "pool corrupted" in info.value.read().decode()
+
+    errors = [span for span in replica.obs.tracer.finished()
+              if span.status == "error"]
+    assert len(errors) == 1
+    span = errors[0]
+    assert span.attrs["exc_type"] == "RuntimeError"
+    assert span.attrs["error"] == "pool corrupted"
+    assert span.attrs["path"] == "/submit"
+    assert span.trace_id == root.trace_id        # joined the caller's trace
+    assert span.parent_id == root.span_id
+    assert replica.stats()["obs"]["replica.errors"]["value"] == 1
 
 
 def test_replica_draining_rejects_submit_with_409(replicas):
@@ -203,6 +288,10 @@ def test_hard_kill_mid_stream_sampled_streams_identical(replicas):
     assert router.request(open_fids[0]).dispatches >= 2
     assert router.redispatches > 0
     assert out == _reference_streams(router, fids)
+    # The failover is one trace: dispatch spans tile every delivered
+    # token index exactly once, and both replicas' engine spans (the
+    # hard-killed one's finished phases included) link under them.
+    _assert_trace_continuity(router, replicas, open_fids[0], 40)
 
 
 @pytest.mark.slow
@@ -225,6 +314,20 @@ def test_graceful_drain_serves_suffix_then_fails_over(replicas):
     assert len(out[fid]) == 24
     assert router.request(fid).dispatches == 2
     assert out == _reference_streams(router, [fid])
+    # Graceful-drain trace continuity: additionally, the victim's decode
+    # span ended as "exported" at the drain boundary and the sibling's
+    # decode span picks up at exactly that token index — the engine-side
+    # halves of the stream tile [0, 24) with no overlap.
+    _, engine_spans = _assert_trace_continuity(router, replicas, fid, 24)
+    decodes = sorted(
+        (span for span in engine_spans if span.name == "engine.decode"),
+        key=lambda span: span.attrs["token_start"])
+    assert [span.status for span in decodes] == ["exported", "ok"]
+    assert decodes[0].attrs["token_start"] == 0
+    assert decodes[0].attrs["token_end"] == \
+        decodes[1].attrs["token_start"]
+    assert decodes[1].attrs["token_end"] == 24
+    assert len({span.source for span in decodes}) == 2  # two replicas
 
 
 @pytest.mark.slow

@@ -176,6 +176,51 @@ def test_serve_fleet_survives_midstream_replica_preemption(tmp_path,
         assert len(late_out[late]) == 8
         # Replayability record: the injected-fault flight log is seeded.
         assert schedule.injected[0].kind == "preempt"
+
+        # PR 11 acceptance: the seeded mid-stream preemption renders ONE
+        # waterfall — submit/dispatch (router, in-process), queue/prefill/
+        # decode (replica subprocesses, spans shipped to the task buckets
+        # by the SAME data sync that carried inflight.json), the victim's
+        # drain/export leg (status=exported), and the sibling's
+        # re-dispatch as a child span of the same trace — and the whole
+        # thing exports as valid Chrome-trace JSON.
+        from tpu_task.obs import chrome_trace, read_spans, render_waterfall
+        from tpu_task.storage.backends import open_backend
+
+        fid = redispatched[0]
+        trace = router.request(fid).trace
+
+        def trace_spans():
+            spans = [span for span in router.obs.tracer.finished()
+                     if span.trace_id == trace.trace_id]
+            for backend in backends.values():
+                data_backend, _ = open_backend(
+                    os.path.join(backend._bucket_dir, "data"))
+                spans += [span for span in read_spans(data_backend)
+                          if span.trace_id == trace.trace_id]
+            return spans
+
+        assert wait_until(
+            lambda: any(span.status == "exported"
+                        for span in trace_spans())
+            and any(span.name == "engine.decode" and span.status == "ok"
+                    for span in trace_spans()),
+            60, tick=fleet.tick, period=0.5), \
+            "replica spans never reached the buckets"
+        spans = trace_spans()
+        names = {span.name for span in spans}
+        assert {"request", "dispatch", "engine.queue", "engine.prefill",
+                "engine.decode"} <= names
+        dispatches = [span for span in spans if span.name == "dispatch"]
+        assert len(dispatches) >= 2          # re-dispatch on the sibling
+        assert {span.parent_id for span in dispatches} == {trace.span_id}
+        assert len({span.source for span in spans
+                    if span.name.startswith("engine.")}) >= 2
+        waterfall = render_waterfall(spans)
+        assert "engine.decode" in waterfall and "[exported]" in waterfall
+        blob = json.dumps(chrome_trace(spans))   # valid Chrome-trace JSON
+        events = json.loads(blob)["traceEvents"]
+        assert events and all(event["ph"] == "X" for event in events)
     finally:
         # Stop the replica processes BEFORE deleting: task teardown
         # SIGKILLs only the agents' process groups, and the replicas run

@@ -283,6 +283,16 @@ def cmd_exec(args) -> int:
     return worst
 
 
+def _print_table(columns, rows) -> None:
+    """Column-aligned table (sched status, obs top share it)."""
+    widths = [max(len(str(column)), *(len(str(row[i])) for row in rows))
+              if rows else len(str(column))
+              for i, column in enumerate(columns)]
+    for row in (columns, *rows):
+        print("  ".join(str(cell).ljust(widths[i])
+                        for i, cell in enumerate(row)))
+
+
 def cmd_sched(args) -> int:
     """Fleet-scheduler observability: per-tenant queue depth, running gangs,
     quota usage, and fair-share deficit, read from the durable scheduler
@@ -310,10 +320,12 @@ def cmd_sched(args) -> int:
     # One row per (tenant, kind): long-running `serve` replica gangs
     # (ServeFleet submissions, payload kind=serve) render as replicas of a
     # service, never as perpetually-running batch tasks. Tenant-level
-    # columns (QUOTA/SHARE/DEFICIT/REQUEUES) print on the tenant's first
-    # row only.
+    # columns (QUOTA/SHARE/DEFICIT/REQUEUES/QLAT-*) print on the tenant's
+    # first row only; QLAT is the per-tenant queue-latency histogram the
+    # status snapshot aggregates (submit → first placement, seconds).
     columns = ("TENANT", "KIND", "QUEUED", "RUNNING", "CHIPS", "QUOTA",
-               "SHARE", "DEFICIT", "REQUEUES", "DONE", "FAILED")
+               "SHARE", "DEFICIT", "REQUEUES", "QLAT-P50", "QLAT-P99",
+               "DONE", "FAILED")
     rows = []
     services = []     # (service, tenant, replicas) footer lines
 
@@ -351,7 +363,10 @@ def cmd_sched(args) -> int:
                 (serve["queued"], serve["replicas"], serve["chips"],
                  serve["succeeded"], serve["failed"]),
                 (f"{info['quota_chips']}", f"{info['share_chips']}",
-                 f"{info['deficit_chips']}", info["requeues"]),
+                 f"{info['deficit_chips']}", info["requeues"],
+                 *(("%gs" % latency["p50_s"], "%gs" % latency["p99_s"])
+                   if (latency := info.get("queue_latency") or {}).get(
+                       "count") else ("-", "-"))),
                 serve.get("services", {}))
     else:
         # No snapshot (scheduler never ticked): fold the queue records.
@@ -379,16 +394,10 @@ def cmd_sched(args) -> int:
                      if task.state == "placed"),
                  sum(1 for task in serve if task.state == "succeeded"),
                  sum(1 for task in serve if task.state == "failed")),
-                ("-", "-", "-", sum(task.preemptions for task in tasks)),
+                ("-", "-", "-", sum(task.preemptions for task in tasks),
+                 "-", "-"),
                 svc_map)
-    widths = [max(len(str(column)), *(len(str(row[i])) for row in rows))
-              if rows else len(str(column))
-              for i, column in enumerate(columns)]
-    print("  ".join(str(column).ljust(widths[i])
-                    for i, column in enumerate(columns)))
-    for row in rows:
-        print("  ".join(str(cell).ljust(widths[i])
-                        for i, cell in enumerate(row)))
+    _print_table(columns, rows)
     for service, tenant, replicas in services:
         print(f"serve: {service} ({tenant}) — {replicas} replica"
               f"{'s' if replicas != 1 else ''} placed")
@@ -397,6 +406,92 @@ def cmd_sched(args) -> int:
         print(f"pool: {pool.get('used_chips', 0)}/"
               f"{pool.get('capacity_chips', 0)} chips in use "
               f"(utilization {pool.get('utilization', 0.0)})")
+    return 0
+
+
+def _obs_backend(remote: str):
+    import os as _os
+
+    from tpu_task.storage.backends import open_backend
+
+    remote = remote or _os.environ.get("TPU_TASK_OBS_REMOTE") or \
+        _os.environ.get("TPU_TASK_SCHED_REMOTE") or \
+        _os.path.join(_os.path.expanduser("~/.tpu-task"), "scheduler")
+    backend, _ = open_backend(remote)
+    return backend, remote
+
+
+def cmd_obs_trace(args) -> int:
+    """Render one trace's waterfall from the durable span export
+    (``obs/spans/`` under the same state root the scheduler uses), and
+    optionally write Chrome-trace/Perfetto JSON for `chrome://tracing` /
+    https://ui.perfetto.dev."""
+    import json as json_module
+
+    from tpu_task.obs import chrome_trace, read_spans, render_waterfall
+
+    backend, remote = _obs_backend(args.remote)
+    spans = read_spans(backend)
+    if not spans:
+        print(f"no spans under {remote}/obs/spans/")
+        return 1
+    # Select by trace id, or by an id a span carries — tiered (trace id,
+    # then fleet fid, then gang task id, then engine rid) so `obs trace
+    # 3` means fleet request 3, never some replica's LOCAL rid 3 that
+    # happens to collide.
+    wanted = str(args.trace)
+    trace_ids: list = []
+    for match in (lambda span: span.trace_id == wanted,
+                  lambda span: str(span.attrs.get("fid")) == wanted,
+                  lambda span: str(span.attrs.get("task_id")) == wanted,
+                  lambda span: str(span.attrs.get("rid")) == wanted):
+        trace_ids = sorted({span.trace_id for span in spans
+                            if match(span)})
+        if trace_ids:
+            break
+    if not trace_ids:
+        roots = [span for span in spans if span.parent_id is None]
+        print(f"no trace matching {wanted!r}; {len(spans)} spans in "
+              f"{len({span.trace_id for span in spans})} traces, e.g.:")
+        for span in roots[:10]:
+            print(f"  {span.trace_id}  {span.name}  "
+                  + " ".join(f"{key}={value}" for key, value
+                             in sorted(span.attrs.items())))
+        return 1
+    selected = [span for span in spans if span.trace_id in trace_ids]
+    for trace_id in trace_ids:
+        print(render_waterfall(
+            [span for span in selected if span.trace_id == trace_id]))
+    if args.chrome:
+        with open(args.chrome, "w") as handle:
+            json_module.dump(chrome_trace(selected), handle)
+        print(f"chrome trace: {args.chrome} "
+              "(load in chrome://tracing or ui.perfetto.dev)")
+    return 0
+
+
+def cmd_obs_top(args) -> int:
+    """Fleet-wide metric summary: every source's registry snapshot under
+    ``obs/metrics/`` merged (counters add, histograms bucket-wise)."""
+    from tpu_task.obs import Histogram, read_metrics
+
+    backend, remote = _obs_backend(args.remote)
+    merged = read_metrics(backend)
+    if not merged:
+        print(f"no metrics under {remote}/obs/metrics/")
+        return 1
+    columns = ("METRIC", "TYPE", "COUNT", "VALUE/MEAN", "P50", "P99")
+    rows = []
+    for name, entry in sorted(merged.items())[:args.limit]:
+        if entry["type"] == "histogram":
+            hist = Histogram.from_snapshot(entry, name)
+            rows.append((name, "histogram", hist.count,
+                         f"{hist.mean:.6g}", f"{hist.quantile(0.5):.6g}",
+                         f"{hist.quantile(0.99):.6g}"))
+        else:
+            rows.append((name, entry["type"], "-",
+                         f"{entry['value']:.6g}", "-", "-"))
+    _print_table(columns, rows)
     return 0
 
 
@@ -630,6 +725,28 @@ def make_parser(defaults: Optional[dict] = None) -> argparse.ArgumentParser:
         help="scheduler state root (connection string or path; default "
              "$TPU_TASK_SCHED_REMOTE or ~/.tpu-task/scheduler)")
     sched_status.set_defaults(func=cmd_sched)
+
+    obs = sub.add_parser(
+        "obs", help="observability plane: request traces + fleet metrics")
+    obs_sub = obs.add_subparsers(dest="obs_command", required=True)
+    obs_trace = obs_sub.add_parser(
+        "trace", help="render a trace's waterfall (by trace id, fleet "
+                      "fid, engine rid, or gang task id)")
+    obs_trace.add_argument("trace", help="trace id or request/gang id")
+    obs_trace.add_argument(
+        "--remote", default="",
+        help="obs state root (default $TPU_TASK_OBS_REMOTE, "
+             "$TPU_TASK_SCHED_REMOTE, or ~/.tpu-task/scheduler)")
+    obs_trace.add_argument(
+        "--chrome", default="", metavar="PATH",
+        help="also write Chrome-trace/Perfetto JSON to PATH")
+    obs_trace.set_defaults(func=cmd_obs_trace)
+    obs_top = obs_sub.add_parser(
+        "top", help="merged fleet metrics (counters summed, histograms "
+                    "bucket-wise) with p50/p99 columns")
+    obs_top.add_argument("--remote", default="")
+    obs_top.add_argument("--limit", type=int, default=60)
+    obs_top.set_defaults(func=cmd_obs_top)
 
     storage = sub.add_parser("storage", help="data-plane operations (used on workers)")
     storage_sub = storage.add_subparsers(dest="storage_command", required=True)

@@ -83,6 +83,8 @@ from jax.sharding import PartitionSpec
 from tpu_task.ml.models import transformer
 from tpu_task.ml.models.transformer import Params, TransformerConfig
 from tpu_task.ml.ops import paged_attention as pa
+from tpu_task.obs import Obs
+from tpu_task.obs.trace import Span, TraceContext
 from tpu_task.ml.parallel.sharding import (
     PartitionPlan,
     compile_step,
@@ -218,6 +220,10 @@ class Request:
     #: resumed prefix is context to re-ingest, never to regenerate. A
     #: recompute preemption rolls ``tokens`` back to this floor, not to 0.
     resume_from: int = 0
+    #: incoming trace context (the router's dispatch span, off the HTTP
+    #: header) — the parent every engine-side span of this request links
+    #: to. None when tracing is off or the caller sent no context.
+    trace: Optional[TraceContext] = None
 
     @property
     def finished(self) -> bool:
@@ -240,7 +246,8 @@ class ServingEngine:
                  scfg: Optional[ServingConfig] = None,
                  rng: Optional[jax.Array] = None, mesh=None,
                  draft_params: Optional[Params] = None,
-                 draft_cfg: Optional[TransformerConfig] = None):
+                 draft_cfg: Optional[TransformerConfig] = None,
+                 obs: Optional[Obs] = None):
         self.cfg = cfg
         self.scfg = scfg = scfg or ServingConfig()
         self.mesh = mesh
@@ -331,6 +338,38 @@ class ServingEngine:
         self.spec_accepted = 0
         self.quantized_block_writes = 0
         self.max_quant_error = 0.0       # debug mode only (readback cost)
+
+        # Observability (the PR 11 plane). obs=None is the ZERO-OVERHEAD
+        # path: every recording site below guards on `self._obs is not
+        # None` and nothing else runs — no timestamps, no spans, no
+        # histogram bumps. With obs on, everything recorded is HOST-side
+        # at dispatch boundaries (never inside a traced program): one
+        # perf_counter pair per step, one span per request phase
+        # (queue → prefill → decode), and the latency histograms the SLA
+        # plane needs (step wall, TTFT, inter-token).
+        self._obs = obs
+        self._phase_spans: Dict[int, Span] = {}
+        if obs is not None:
+            metrics = obs.metrics
+            self._h_step = metrics.histogram("engine.step_s")
+            self._h_ttft = metrics.histogram("engine.ttft_s")
+            self._h_intertok = metrics.histogram("engine.intertoken_s")
+            self._h_e2e = metrics.histogram("engine.e2e_s")
+            # Existing plain counters join the one export path lazily —
+            # mutation sites (and bench's resets) unchanged. Monotonic
+            # totals register as counters (they SUM in the fleet merge);
+            # instantaneous values as gauges (last-write-wins).
+            for stat in ("steps", "decode_steps", "chunk_steps", "prefills",
+                         "prefill_chunks", "preemption_count", "cow_copies",
+                         "prefix_hit_requests", "prefix_tokens_saved",
+                         "spec_rounds", "spec_accepted"):
+                metrics.counter_fn(f"engine.{stat}",
+                                   lambda self=self, stat=stat:
+                                   float(getattr(self, stat)))
+            for stat in ("n_active", "queue_depth"):
+                metrics.gauge_fn(f"engine.{stat}",
+                                 lambda self=self, stat=stat:
+                                 float(getattr(self, stat)))
 
         # Draft-model state: its "dense" cache is a paged pool with a
         # STATIC identity block layout — slot s owns blocks
@@ -523,12 +562,87 @@ class ServingEngine:
 
         return run
 
+    # -- observability hooks (every site guards on obs=None) -----------------
+
+    def _obs_queue(self, req: Request, requeued: bool = False) -> None:
+        """Open the queue-phase span (fresh submit, resume import, or a
+        recompute preemption sending the request back to the head)."""
+        if self._obs is None:
+            return
+        if req.trace is None:
+            # No upstream context (engine driven directly): mint ONE
+            # trace here so queue/prefill/decode share it — three
+            # parentless starts would fragment the request across three
+            # unrelated traces.
+            req.trace = TraceContext.mint()
+        self._phase_spans[req.rid] = self._obs.tracer.start(
+            "engine.queue", parent=req.trace, rid=req.rid,
+            requeued=requeued)
+
+    def _obs_admit(self, req: Request, cached_tokens: int = 0) -> None:
+        if self._obs is None:
+            return
+        span = self._phase_spans.pop(req.rid, None)
+        if span is not None:
+            self._obs.tracer.end(span)
+        prefill = self._obs.tracer.start(
+            "engine.prefill", parent=req.trace, rid=req.rid,
+            prompt_tokens=len(req.prompt) + len(req.tokens),
+            cached_tokens=cached_tokens)
+        # Engine-lifetime counter snapshot: the span's `chunks` attr must
+        # be THIS request's chunk count (the delta), not the total.
+        prefill._chunk_base = self.prefill_chunks
+        self._phase_spans[req.rid] = prefill
+
+    def _obs_first_token(self, req: Request) -> None:
+        """Called exactly when ``first_token_t`` is stamped: close the
+        prefill span (its duration IS the engine-side TTFT) and open the
+        decode span, which records which token indices THIS engine's
+        life emitted (``token_start``; resumed imports start past their
+        re-ingested prefix — that is what makes cross-replica coverage
+        checkable from spans alone)."""
+        if self._obs is None:
+            return
+        self._h_ttft.observe(req.first_token_t - req.submit_t)
+        span = self._phase_spans.pop(req.rid, None)
+        if span is not None:
+            self._obs.tracer.end(
+                span, chunks=self.prefill_chunks
+                - getattr(span, "_chunk_base", self.prefill_chunks))
+        self._phase_spans[req.rid] = self._obs.tracer.start(
+            "engine.decode", parent=req.trace, rid=req.rid,
+            token_start=len(req.tokens) - 1)
+
+    def _obs_interrupt(self, req: Request, status: str) -> None:
+        """A request leaving its slot without finishing (recompute
+        preemption, drain export): close the open phase span with the
+        interruption recorded and the token range it actually covered."""
+        if self._obs is None:
+            return
+        span = self._phase_spans.pop(req.rid, None)
+        if span is not None:
+            self._obs.tracer.end(span, status=status,
+                                 token_end=len(req.tokens))
+
+    def _obs_retire(self, req: Request) -> None:
+        if self._obs is None:
+            return
+        span = self._phase_spans.pop(req.rid, None)
+        if span is not None:
+            self._obs.tracer.end(span, token_end=len(req.tokens))
+        self._h_e2e.observe(req.finish_t - req.submit_t)
+        emitted = len(req.tokens) - req.resume_from
+        if emitted > 1 and req.first_token_t is not None:
+            self._h_intertok.observe(
+                (req.finish_t - req.first_token_t) / (emitted - 1))
+
     # -- front end -----------------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: int, *, temperature: float = 0.0,
                top_p: Optional[float] = None,
                eos_token: Optional[int] = None,
-               key: Optional[jax.Array] = None) -> int:
+               key: Optional[jax.Array] = None,
+               trace: Optional[TraceContext] = None) -> int:
         """Queue a generation request; returns its id. Same sampling
         contract as ``generate``: temperature 0 is greedy, ``top_p`` needs
         temperature > 0. ``key`` overrides the engine-derived per-request
@@ -567,9 +681,10 @@ class ServingEngine:
             rid=rid, prompt=prompt, max_new_tokens=max_new_tokens,
             temperature=temperature, top_p=1.0 if top_p is None else top_p,
             eos_token=eos_token, key=key,
-            submit_t=time.monotonic())
+            submit_t=time.monotonic(), trace=trace)
         self._requests[rid] = req
         self._queue.append(req)
+        self._obs_queue(req)
         return rid
 
     def export_inflight(self) -> List[dict]:
@@ -594,9 +709,14 @@ class ServingEngine:
                 "top_p": req.top_p,
                 "eos_token": req.eos_token,
             })
+            # Close the open phase span as "exported" — the drain/export
+            # leg is part of the request's waterfall. Generation state is
+            # untouched; only the observability record is finalized.
+            self._obs_interrupt(req, "exported")
         return records
 
-    def resume_inflight(self, records: List[dict]) -> Dict[int, int]:
+    def resume_inflight(self, records: List[dict],
+                        trace: Optional[TraceContext] = None) -> Dict[int, int]:
         """Import :meth:`export_inflight` records (possibly from another
         process); returns {exported rid: local rid}. A resumed request
         re-ingests prompt + emitted tokens as context (prefilled, never
@@ -652,13 +772,14 @@ class ServingEngine:
                 top_p=float(record.get("top_p", 1.0)),
                 eos_token=None if eos is None else int(eos), key=key,
                 submit_t=time.monotonic(), tokens=tokens,
-                resume_from=len(tokens))
+                resume_from=len(tokens), trace=trace)
             self._requests[rid] = req
             if req.finished:
                 req.status = DONE
                 req.finish_t = time.monotonic()
             else:
                 self._queue.append(req)
+                self._obs_queue(req)
             mapping[int(record.get("rid", rid))] = rid
         return mapping
 
@@ -694,6 +815,7 @@ class ServingEngine:
     def step(self) -> dict:
         """One scheduler iteration: admit → (chunk|spec|decode) → retire.
         Returns what happened (request ids admitted/finished, active)."""
+        t0 = time.perf_counter() if self._obs is not None else 0.0
         self.steps += 1
         admitted, finished = [], []
         self._admit(admitted, finished)
@@ -713,6 +835,8 @@ class ServingEngine:
                 self._spec_step(finished)
             elif not prefilling:
                 self._decode(finished)
+        if self._obs is not None:
+            self._h_step.observe(time.perf_counter() - t0)
         return {"admitted": admitted, "finished": finished,
                 "active": self.n_active, "queued": len(self._queue)}
 
@@ -833,6 +957,7 @@ class ServingEngine:
             self._last_token[slot] = 0
             self._draft_pos[slot] = 0
             admitted.append(req.rid)
+            self._obs_admit(req, cached_tokens=cached_len)
 
     def _admit_bucketed(self, admitted: list, finished: list) -> None:
         """Legacy PR 5 admission: the whole prompt (plus any resumed-token
@@ -854,6 +979,7 @@ class ServingEngine:
             if blocks is None:
                 return
             self._queue.popleft()
+            self._obs_admit(req)
             bucket = self.scfg.bucket_for(len(ctx))
             table = np.zeros((self.scfg.max_blocks_per_slot,), np.int32)
             table[:need] = blocks
@@ -871,6 +997,7 @@ class ServingEngine:
             req.tokens.append(first)
             if req.first_token_t is None:
                 req.first_token_t = now
+                self._obs_first_token(req)
             self._slots[slot] = req
             self._admit_counter += 1
             self._admit_seq[slot] = self._admit_counter
@@ -931,6 +1058,7 @@ class ServingEngine:
         req.preemptions += 1
         self.preemption_count += 1
         req.status = QUEUED
+        self._obs_interrupt(req, "preempted")
         # Release BEFORE clearing tokens: _release registers full blocks
         # with the prefix cache under the ids that produced their KV
         # (prompt + generated so far), so the hash list and the block list
@@ -943,6 +1071,7 @@ class ServingEngine:
         del req.tokens[req.resume_from:]
         req.first_token_t = None
         self._queue.appendleft(req)
+        self._obs_queue(req, requeued=True)
 
     # -- fused steps ---------------------------------------------------------
 
@@ -1053,6 +1182,7 @@ class ServingEngine:
             req.tokens.append(tok)
             if req.first_token_t is None:
                 req.first_token_t = now
+                self._obs_first_token(req)
             self._positions[slot] += 1
             self._last_token[slot] = tok
             if req.finished:
@@ -1169,6 +1299,7 @@ class ServingEngine:
             req.tokens.append(tok)
             if req.first_token_t is None:
                 req.first_token_t = now
+                self._obs_first_token(req)
             self._last_token[i] = tok
             if req.finished:
                 self._retire(i)
@@ -1276,6 +1407,7 @@ class ServingEngine:
             req.tokens.extend(emitted)
             if req.first_token_t is None:
                 req.first_token_t = now
+                self._obs_first_token(req)
             self._positions[i] = pos + m
             self._last_token[i] = emitted[-1]
             # Draft KV is valid through position pos + min(m, ke) - 1; a
@@ -1406,6 +1538,7 @@ class ServingEngine:
         req.status = DONE
         req.finish_t = time.monotonic()
         self._release(slot)
+        self._obs_retire(req)
 
     # -- observability -------------------------------------------------------
 
@@ -1470,4 +1603,9 @@ class ServingEngine:
                 if self.spec_proposed else 0.0,
             },
         }
+        if self._obs is not None:
+            # The registry IS the export path (PR 11): step wall / TTFT /
+            # inter-token histograms plus every counter above as lazy
+            # gauges, one name and one type each.
+            out["obs"] = self._obs.metrics.snapshot()
         return out

@@ -1,0 +1,79 @@
+"""One observability plane for the whole stack (router → kernel hosts).
+
+Two halves, threaded through every layer behind one tiny handle
+(:class:`Obs` = tracer + metrics registry):
+
+* **Distributed tracing** (``trace``): a trace minted at
+  ``Router.submit`` rides the :data:`TRACE_HEADER` HTTP header into the
+  replica and down into the engine's per-request state; spans land in a
+  bounded ring and export durably through the storage ``Backend`` seam
+  (``obs/spans/``), renderable as a terminal waterfall or Chrome-trace/
+  Perfetto JSON.
+* **Metrics registry** (``metrics``): counters, gauges, and
+  deterministic log-bucketed histograms (mergeable across replicas by
+  bucket-wise add) behind one :class:`MetricsRegistry` per component —
+  the single name/type/export path for every number the layer publishes.
+
+Overhead contract: layers accept ``obs=None`` and skip every recording
+call when unset — the zero-overhead path. With obs on, recording is
+host-side only (dispatch boundaries, never inside traced programs):
+one ``perf_counter`` pair + histogram bump per fused step, one span per
+request phase. ``bench.py obs`` holds the engine to ≤ 5% tok/s overhead.
+"""
+
+from dataclasses import dataclass
+
+from tpu_task.obs.export import (
+    METRICS_PREFIX,
+    SPAN_PREFIX,
+    SpanExporter,
+    chrome_trace,
+    export_metrics,
+    read_metrics,
+    read_spans,
+    render_waterfall,
+)
+from tpu_task.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    merge_snapshots,
+)
+from tpu_task.obs.trace import TRACE_HEADER, Span, TraceContext, Tracer
+
+__all__ = [
+    "METRICS_PREFIX",
+    "SPAN_PREFIX",
+    "TRACE_HEADER",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "Obs",
+    "Span",
+    "SpanExporter",
+    "TraceContext",
+    "Tracer",
+    "chrome_trace",
+    "export_metrics",
+    "merge_snapshots",
+    "read_metrics",
+    "read_spans",
+    "render_waterfall",
+]
+
+
+@dataclass
+class Obs:
+    """The handle a component threads through: one tracer (its spans) +
+    one registry (its numbers). ``None`` everywhere means obs off —
+    layers guard every recording site on it."""
+
+    tracer: Tracer
+    metrics: MetricsRegistry
+
+    @classmethod
+    def create(cls, source: str = "", capacity: int = 4096) -> "Obs":
+        return cls(tracer=Tracer(source=source, capacity=capacity),
+                   metrics=MetricsRegistry())

@@ -1,0 +1,168 @@
+"""Durable span/metric export and the human-facing renderers.
+
+Export rides the storage ``Backend`` seam — the SAME durability plane
+checkpoints, drain files, and scheduler state already use (a replica
+writes ``obs/`` into its working directory and the agent's delta sync
+ships it; an in-process fleet writes straight into the scheduler's
+backend). Zero new transport.
+
+Two output formats:
+
+* :func:`chrome_trace` — the Chrome trace-event JSON Perfetto and
+  ``chrome://tracing`` load directly (``ph="X"`` complete events, µs
+  timestamps, span attrs as ``args``).
+* :func:`render_waterfall` — the terminal view behind
+  ``tpu-task obs trace <id>``: one line per span, parent-indented, with
+  a proportional timeline bar.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import uuid
+from typing import Dict, Iterable, List, Optional
+
+from tpu_task.obs.metrics import merge_snapshots
+from tpu_task.obs.trace import Span
+
+__all__ = [
+    "SPAN_PREFIX",
+    "METRICS_PREFIX",
+    "SpanExporter",
+    "chrome_trace",
+    "export_metrics",
+    "read_metrics",
+    "read_spans",
+    "render_waterfall",
+]
+
+SPAN_PREFIX = "obs/spans/"
+METRICS_PREFIX = "obs/metrics/"
+
+
+class SpanExporter:
+    """Append-only span batches under ``obs/spans/`` of any Backend.
+
+    Keys are ``<source>-<run>-<seq>.json`` — ``run`` is per-exporter
+    random so a restarted process never overwrites its predecessor's
+    batches, ``seq`` keeps one process's batches ordered."""
+
+    def __init__(self, backend, prefix: str = SPAN_PREFIX):
+        self._backend = backend
+        self._prefix = prefix
+        self._run = uuid.uuid4().hex[:8]
+        self._seq = itertools.count()
+
+    def export(self, spans: List[Span], source: str = "") -> Optional[str]:
+        if not spans:
+            return None
+        key = (f"{self._prefix}{source or spans[0].source or 'spans'}"
+               f"-{self._run}-{next(self._seq):06d}.json")
+        self._backend.write(
+            key, json.dumps([span.to_json() for span in spans]).encode())
+        return key
+
+
+def read_spans(backend, prefix: str = SPAN_PREFIX) -> List[Span]:
+    """Every exported span under ``prefix``, start-ordered. Unreadable
+    batches are skipped — a torn write must not take the viewer down."""
+    spans: List[Span] = []
+    for key in sorted(backend.list(prefix)):
+        if not key.endswith(".json"):
+            continue
+        try:
+            spans.extend(Span.from_json(record)
+                         for record in json.loads(backend.read(key)))
+        except (ValueError, KeyError, OSError):
+            continue
+    spans.sort(key=lambda span: (span.start, span.span_id))
+    return spans
+
+
+def export_metrics(backend, snapshot: dict, source: str,
+                   prefix: str = METRICS_PREFIX) -> str:
+    """One registry snapshot per source, last-write-wins — snapshots are
+    cumulative, so overwrite IS the correct merge within a source."""
+    key = f"{prefix}{source}.json"
+    backend.write(key, json.dumps(snapshot).encode())
+    return key
+
+
+def read_metrics(backend, prefix: str = METRICS_PREFIX) -> dict:
+    """All sources' snapshots merged (counters add, histograms
+    bucket-wise) — the fleet-wide view ``tpu-task obs top`` renders."""
+    snapshots = []
+    for key in sorted(backend.list(prefix)):
+        if not key.endswith(".json"):
+            continue
+        try:
+            snapshots.append(json.loads(backend.read(key)))
+        except (ValueError, OSError):
+            continue
+    return merge_snapshots(snapshots)
+
+
+def chrome_trace(spans: Iterable[Span]) -> dict:
+    """Chrome trace-event JSON (Perfetto / chrome://tracing / speedscope).
+
+    Complete events (``ph="X"``) with µs timestamps; the process lane is
+    the trace, the thread lane the emitting component, so one request's
+    waterfall reads top-to-bottom across router → replica → engine."""
+    events = []
+    for span in spans:
+        events.append({
+            "name": span.name,
+            "cat": span.status,
+            "ph": "X",
+            "ts": span.start * 1e6,
+            "dur": max(span.duration, 0.0) * 1e6,
+            "pid": span.trace_id,
+            "tid": span.source or "-",
+            "args": {**span.attrs, "span_id": span.span_id,
+                     "parent_id": span.parent_id, "status": span.status},
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def render_waterfall(spans: List[Span], width: int = 40) -> str:
+    """One trace as an aligned text waterfall: parent-indented span tree
+    (orphan parents — e.g. a hard-killed replica whose open spans died
+    with it — root at depth 0) over a proportional timeline."""
+    if not spans:
+        return "(no spans)"
+    by_id: Dict[str, Span] = {span.span_id: span for span in spans}
+
+    def depth(span: Span) -> int:
+        d, seen = 0, set()
+        while span.parent_id in by_id and span.span_id not in seen:
+            seen.add(span.span_id)
+            span = by_id[span.parent_id]
+            d += 1
+        return d
+
+    t0 = min(span.start for span in spans)
+    t1 = max(span.end if span.end is not None else span.start
+             for span in spans)
+    total = max(t1 - t0, 1e-9)
+    # Stable display order: parents before children, then by start time.
+    ordered = sorted(spans, key=lambda span: (span.start, depth(span),
+                                              span.span_id))
+    label_w = max(len("  " * depth(span) + span.name) for span in ordered)
+    lines = [f"trace {spans[0].trace_id}  "
+             f"({len(spans)} spans, {total * 1e3:.1f} ms)"]
+    for span in ordered:
+        off = int((span.start - t0) / total * width)
+        bar_w = max(1, int(max(span.duration, 0.0) / total * width))
+        bar = " " * off + "▇" * min(bar_w, width - off)
+        label = ("  " * depth(span) + span.name).ljust(label_w)
+        extras = " ".join(
+            f"{key}={value}" for key, value in sorted(span.attrs.items())
+            if key in ("rid", "fid", "replica", "token_start", "token_end",
+                       "exc_type", "error", "tenant", "task_id"))
+        status = "" if span.status == "ok" else f" [{span.status}]"
+        lines.append(
+            f"{label}  |{bar.ljust(width)}| "
+            f"{span.duration * 1e3:8.2f} ms  {span.source or '-'}"
+            f"{status}{('  ' + extras) if extras else ''}")
+    return "\n".join(lines)

@@ -34,6 +34,7 @@ import time
 import uuid
 from typing import Callable, Dict, List, Optional
 
+from tpu_task.obs import Obs, TraceContext
 from tpu_task.scheduler import driver as driver_module
 from tpu_task.scheduler.pool import CapacityPool, select_victims
 from tpu_task.scheduler.queue import (
@@ -58,12 +59,19 @@ class GangScheduler:
                  quotas: Dict[str, TenantQuota],
                  driver,
                  remote: Optional[str] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 obs: Optional[Obs] = None):
         self.pool = pool
         self.quotas = dict(quotas)
         self.driver = driver
         self.clock = clock
         self.queue = DurableQueue(remote)
+        # Observability plane: gang lifecycle transitions become events
+        # on the tracer (one trace per gang, ``gang:<task_id>``) and
+        # queue latency becomes per-tenant histograms on the registry —
+        # surfaced in the status snapshot / `sched status` and mergeable
+        # fleet-wide. Host-side control-plane bookkeeping: always on.
+        self.obs = obs if obs is not None else Obs.create("scheduler")
         # Same governor knobs as the per-task reconciler (PR 3): one
         # environment contract for both layers.
         self.recovery_budget = int(os.environ.get("TPU_TASK_RECOVERY_BUDGET", "5"))
@@ -103,7 +111,28 @@ class GangScheduler:
             task_id=task_id or uuid.uuid4().hex[:12], tenant=tenant,
             gang=gang, priority=priority, work=work,
             submitted_at=self.clock())
-        return self.queue.submit(task)
+        task = self.queue.submit(task)
+        self._gang_event("gang.submitted", task,
+                         chips=gang.total_chips, priority=priority)
+        return task
+
+    # -- observability ---------------------------------------------------------
+    def _gang_event(self, name: str, task: QueuedTask, **attrs) -> None:
+        """Stamp one lifecycle transition on the tracer. Every event of a
+        gang shares the deterministic trace ``gang-<task_id>``, so `obs
+        trace gang-<id>` shows a gang's whole life — submit → place →
+        [preempt → requeue]* → finish — on one waterfall."""
+        self.obs.tracer.event(
+            name, parent=TraceContext(trace_id=f"gang-{task.task_id}",
+                                      span_id="gang"),
+            task_id=task.task_id, tenant=task.tenant, state=task.state,
+            **attrs)
+
+    def _tenant_latency(self, tenant: str):
+        """Per-tenant queue-latency histogram (submit → first placement,
+        scheduler-clock seconds) — bucket-wise mergeable across
+        schedulers like every registry histogram."""
+        return self.obs.metrics.histogram(f"sched.queue_latency_s.{tenant}")
 
     # -- quota / fair-share accounting ----------------------------------------
     def _demand_chips(self) -> Dict[str, float]:
@@ -144,6 +173,11 @@ class GangScheduler:
         if task.first_placed_at < 0:
             task.first_placed_at = now
             self.queue_latency.append(now - task.submitted_at)
+            self._tenant_latency(task.tenant).observe(
+                now - task.submitted_at)
+        self._gang_event("gang.placed", task,
+                         attempt=task.attempts,
+                         chips=task.gang.total_chips)
         quota = self.quotas[task.tenant]
         running = self.queue.running_chips(task.tenant)
         if running > quota.chips:
@@ -162,6 +196,8 @@ class GangScheduler:
         task.finished_at = now
         self.queue.update(task)
         self.driver.release(task)
+        self._gang_event("gang.finished", task, failure=failure,
+                         status="error" if state == "failed" else "ok")
 
     def withdraw(self, task_id: str, failure: str = "withdrawn") -> None:
         """Administratively remove a gang from service — the serve fleet's
@@ -193,6 +229,8 @@ class GangScheduler:
                 task.finished_at = now
                 self.queue.update(task)
                 self.driver.release(task)
+                self._gang_event("gang.finished", task, status="error",
+                                 failure=task.failure)
                 return
             task.next_eligible_at = now + min(
                 self.backoff_base * (2 ** (task.attempts - 1)),
@@ -201,6 +239,9 @@ class GangScheduler:
             task.next_eligible_at = now
         task.state = "preempted"
         self.queue.update(task)
+        self._gang_event("gang.requeued", task,
+                         charged=charge_budget, attempt=task.attempts,
+                         next_eligible_at=task.next_eligible_at)
 
     # -- the tick --------------------------------------------------------------
     def tick(self) -> None:
@@ -318,6 +359,17 @@ class GangScheduler:
                 "succeeded": sum(1 for task in backlog
                                  if task.state == "succeeded"),
                 "failed": sum(1 for task in backlog if task.state == "failed"),
+                # Per-tenant queue latency (submit → FIRST placement):
+                # p50/p99 off the registry histogram, plus the mergeable
+                # histogram snapshot itself for fleet-wide aggregation.
+                # first_placed_at has recorded this since PR 7; the
+                # histogram finally aggregates it.
+                "queue_latency": (lambda hist: {
+                    "count": hist.count,
+                    "p50_s": round(hist.quantile(0.50), 3),
+                    "p99_s": round(hist.quantile(0.99), 3),
+                    "hist": hist.snapshot(),
+                })(self._tenant_latency(tenant)),
                 "serve": {
                     "queued": sum(1 for task in serve if task.schedulable),
                     "replicas": sum(1 for task in serve
@@ -351,6 +403,19 @@ class GangScheduler:
         snapshot = self.status()
         snapshot["tick_at"] = now
         backend.write(STATUS_KEY, json.dumps(snapshot, indent=2).encode())
+        # Durable obs export rides the same backend: gang lifecycle
+        # events under obs/spans/, the registry under obs/metrics/.
+        if not hasattr(self, "_obs_exporter"):
+            from tpu_task.obs import SpanExporter
+
+            self._obs_exporter = SpanExporter(backend)
+        spans = self.obs.tracer.drain()
+        if spans:
+            self._obs_exporter.export(spans, source="scheduler")
+            from tpu_task.obs import export_metrics
+
+            export_metrics(backend, self.obs.metrics.snapshot(),
+                           source="scheduler")
 
     def idle(self) -> bool:
         """No schedulable or placed work left (every submission terminal)."""

@@ -163,11 +163,25 @@ class ServeFleet:
 
     def __init__(self, scheduler, spec: ServeSpec, router: Router,
                  endpoint_source: Optional[Callable[[str], Optional[dict]]] = None,
-                 autoscaler=None):
+                 autoscaler=None, obs_flush_every: int = 25):
         self.scheduler = scheduler
         self.spec = spec
         self.router = router
         self.autoscaler = autoscaler
+        # Durable observability export: when the scheduler has a durable
+        # backend, router spans/metrics and each replica's /obs pull land
+        # under obs/ of the SAME backend every `obs_flush_every` ticks —
+        # `tpu-task obs trace/top` read from there. No backend → spans
+        # stay in the in-process rings (tests read those directly).
+        self._obs_exporter = None
+        self._obs_backend = getattr(scheduler.queue, "_backend", None)
+        if self._obs_backend is not None:
+            from tpu_task.obs import SpanExporter
+
+            self._obs_exporter = SpanExporter(self._obs_backend)
+        self._obs_flush_every = max(1, obs_flush_every)
+        self._obs_pending: List[tuple] = []   # drained-but-unwritten batches
+        self._ticks = 0
         #: task_id -> {url, boot_id} | None. Defaults to the driver's
         #: in-process registry; real-task fleets pass a bucket reader.
         self._endpoint_source = endpoint_source or (
@@ -249,6 +263,78 @@ class ServeFleet:
                 busy=stats["open"])
             if desired != self.live_replicas():
                 self.scale_to(desired)
+        self._ticks += 1
+        if self._obs_exporter is not None \
+                and self._ticks % self._obs_flush_every == 0:
+            self.flush_obs()
+
+    def flush_obs(self) -> int:
+        """Export the router's finished spans + registry snapshot into
+        the durable backend (``obs/spans/``, ``obs/metrics/``); for
+        IN-PROCESS replicas (the hermetic driver) additionally pull each
+        placed replica's ``/obs?drain=1``. Real-task replicas are never
+        pulled: their own process already drains the ring into its
+        workdir for the agent's data sync, and a second drainer would
+        split one request's trace nondeterministically across two
+        durable roots. Returns the number of spans exported.
+        Best-effort by design: a full backend or a torn /obs answer
+        skips a batch, never takes the control loop down."""
+        if self._obs_exporter is None:
+            return 0
+        import urllib.error
+
+        from tpu_task.obs import Span, export_metrics
+        from tpu_task.storage.http_util import send
+
+        exported = 0
+        obs = self.router.obs
+        spans = obs.tracer.finished()
+        try:
+            self._obs_exporter.export(spans, source="router")
+        except OSError:
+            return exported               # ring kept: retried next flush
+        # Drain ONLY after the span write landed (a failed metrics write
+        # below must not leave exported spans in the ring, or every later
+        # flush re-exports them and the durable store grows duplicates).
+        obs.tracer.drain()
+        exported += len(spans)
+        try:
+            export_metrics(self._obs_backend, obs.metrics.snapshot(),
+                           source="router")
+        except OSError:
+            pass                          # snapshots are cumulative: next
+            #                               flush writes a superset anyway
+        # In-process replicas have no agent/data sync — the fleet is
+        # their only durable path. (InProcessServeDriver's endpoint
+        # registry is the discriminator; real drivers lack it.) A pull
+        # DRAINS the replica ring, so batches that then fail to write are
+        # parked in _obs_pending and retried first on the next flush —
+        # never silently dropped.
+        if getattr(self.scheduler.driver, "endpoints", None) is None:
+            return exported
+        batches = self._obs_pending
+        self._obs_pending = []
+        for task_id, info in self.refresh_endpoints().items():
+            try:
+                body = json.loads(send(
+                    "GET", info["url"] + "/obs?drain=1", timeout=2.0,
+                    retries=0))
+                spans = [Span.from_json(record)
+                         for record in body.get("spans", ())]
+            except (urllib.error.URLError, OSError, ValueError, KeyError):
+                continue
+            source = body.get("source", task_id)
+            batches.append((spans, source, body.get("metrics")))
+        for spans, source, metrics in batches:
+            try:
+                self._obs_exporter.export(spans, source=source)
+                exported += len(spans)
+                if metrics:
+                    export_metrics(self._obs_backend, metrics,
+                                   source=source)
+            except OSError:
+                self._obs_pending.append((spans, source, metrics))
+        return exported
 
 
 def bucket_endpoint_source(bucket_dir_of: Callable[[str], str]):

@@ -55,6 +55,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 from urllib.parse import parse_qs, urlsplit
 
+from tpu_task.obs import TRACE_HEADER, Obs, TraceContext
+
 __all__ = [
     "MODEL_PRESETS",
     "ReplicaServer",
@@ -83,9 +85,10 @@ SERVING_PRESETS: Dict[str, dict] = {
 
 
 def build_engine(preset: str = "tiny", serving: Optional[dict] = None,
-                 rng_seed: int = 0):
+                 rng_seed: int = 0, obs: Optional[Obs] = None):
     """A ServingEngine from a preset name: same name → same weights, same
-    config, same streams, in any process."""
+    config, same streams, in any process. ``obs`` threads the PR 11
+    observability handle through (None = the zero-overhead path)."""
     import jax
     import jax.numpy as jnp
 
@@ -102,7 +105,7 @@ def build_engine(preset: str = "tiny", serving: Optional[dict] = None,
     knobs = dict(SERVING_PRESETS.get(preset, {}))
     knobs.update(serving or {})
     return ServingEngine(params, cfg, ServingConfig(**knobs),
-                         rng=jax.random.PRNGKey(rng_seed))
+                         rng=jax.random.PRNGKey(rng_seed), obs=obs)
 
 
 class _JSONHandler(BaseHTTPRequestHandler):
@@ -148,6 +151,9 @@ class _JSONHandler(BaseHTTPRequestHandler):
                 self._reply(replica.poll(int(self._query()["rid"])))
             elif path == "/export":
                 self._reply({"inflight": replica.exported()})
+            elif path == "/obs":
+                self._reply(replica.obs_snapshot(
+                    drain=self._query().get("drain") == "1"))
             elif path == "/stream":
                 query = self._query()
                 self._reply(replica.stream(
@@ -158,12 +164,16 @@ class _JSONHandler(BaseHTTPRequestHandler):
         except KeyError as error:
             self._reply({"error": f"unknown rid {error}"}, 404)
         except Exception as error:  # surface, never hang the socket
+            replica.note_error(path, error)
             self._reply({"error": repr(error)}, 500)
 
     def do_POST(self) -> None:  # noqa: N802
         replica = self.server.replica
         path = urlsplit(self.path).path
         length = int(self.headers.get("Content-Length") or 0)
+        # The one propagation header: the router's dispatch-span context,
+        # parent of every engine-side span this request produces here.
+        trace = TraceContext.from_header(self.headers.get(TRACE_HEADER))
         try:
             payload = json.loads(self.rfile.read(length) or b"{}")
             if path == "/submit":
@@ -173,7 +183,7 @@ class _JSONHandler(BaseHTTPRequestHandler):
                     # router must re-dispatch to a sibling instead.
                     self._reply({"error": "draining", "draining": True}, 409)
                     return
-                self._reply({"rid": replica.submit(payload)})
+                self._reply({"rid": replica.submit(payload, trace=trace)})
             elif path == "/drain":
                 replica.begin_drain()
                 self._reply({"ok": True, "draining": True})
@@ -185,6 +195,11 @@ class _JSONHandler(BaseHTTPRequestHandler):
             # fault that would quarantine a healthy server.
             self._reply({"error": repr(error)}, 400)
         except Exception as error:
+            # A 500 is a REPLICA fault: besides carrying the message back
+            # to the caller, record a structured error span on this
+            # request's trace so the failure is visible in `obs trace`
+            # and the durable export, not just a stderr log nobody syncs.
+            replica.note_error(path, error, trace=trace)
             self._reply({"error": repr(error)}, 500)
 
 
@@ -203,10 +218,18 @@ class ReplicaServer:
 
     def __init__(self, engine=None, *, preset: str = "tiny",
                  serving: Optional[dict] = None, host: str = "127.0.0.1",
-                 port: int = 0, drain_file: Optional[str] = None):
-        self.engine = engine if engine is not None else build_engine(
-            preset, serving)
+                 port: int = 0, drain_file: Optional[str] = None,
+                 obs_enabled: bool = True):
         self.boot_id = uuid.uuid4().hex[:12]
+        #: One tracer + registry for the whole replica (front end AND
+        #: engine — the engine records into the same registry, so /stats
+        #: and /obs serve one coherent snapshot). obs_enabled=False is the
+        #: documented zero-overhead path: no tracer exists, every
+        #: recording site below short-circuits on None.
+        self.obs = Obs.create(f"replica:{self.boot_id[:6]}") \
+            if obs_enabled else None
+        self.engine = engine if engine is not None else build_engine(
+            preset, serving, obs=self.obs)
         self.draining = False
         self.drain_file = drain_file
         self._lock = threading.Lock()
@@ -251,23 +274,54 @@ class ReplicaServer:
                     if not self.draining and self.engine.has_work:
                         self.engine.step()
                         stepped = True
-            except Exception:
+            except Exception as error:
                 # A dying step loop must never wedge the replica silently
                 # (healthz green, streams empty forever): drain instead —
                 # admissions 409, /stream reports draining with whatever
                 # was emitted, and the router fails the open streams over
                 # to a sibling. The request records the export reads are
                 # plain host state, intact even when a device step blew up.
+                # The failure is a structured error event on the registry
+                # (exception type + message, durable via the obs export),
+                # not only a stderr traceback nobody syncs.
                 import traceback
 
                 traceback.print_exc()
+                self.note_error("step_loop", error)
                 self.begin_drain()
                 return
             if not stepped:
                 time.sleep(0.002)
 
+    # -- observability ---------------------------------------------------------
+    def note_error(self, where: str, error: Exception,
+                   trace: Optional[TraceContext] = None) -> None:
+        """Structured failure record: an ``status=error`` span (exception
+        type + message) on the request's trace when one came in, plus the
+        ``replica.errors`` counter — what makes a failed request visible
+        in ``obs trace`` and the durable export."""
+        if self.obs is None:
+            return
+        self.obs.metrics.counter("replica.errors").inc()
+        self.obs.metrics.counter(f"replica.errors.{where.strip('/')}").inc()
+        self.obs.tracer.error("replica.error", error, parent=trace,
+                              path=where, boot_id=self.boot_id)
+
+    def obs_snapshot(self, drain: bool = False) -> dict:
+        """The ``/obs`` endpoint: finished spans (``drain=1`` clears the
+        ring — the fleet flusher's read-once pull) + the registry
+        snapshot. Empty when obs is off."""
+        if self.obs is None:
+            return {"spans": [], "metrics": {}, "source": self.boot_id}
+        spans = self.obs.tracer.drain() if drain \
+            else self.obs.tracer.finished()
+        return {"spans": [span.to_json() for span in spans],
+                "metrics": self.obs.metrics.snapshot(),
+                "source": self.boot_id}
+
     # -- front-end operations (handler-called, self-locking) ------------------
-    def submit(self, payload: dict) -> int:
+    def submit(self, payload: dict,
+               trace: Optional[TraceContext] = None) -> int:
         prompt = [int(t) for t in payload["prompt"]]
         kwargs = dict(
             temperature=float(payload.get("temperature", 0.0)),
@@ -295,8 +349,8 @@ class ReplicaServer:
                     else kwargs["top_p"],
                     "eos_token": kwargs["eos_token"],
                 }
-                return next(iter(
-                    self.engine.resume_inflight([record]).values()))
+                return next(iter(self.engine.resume_inflight(
+                    [record], trace=trace).values()))
             # Fresh dispatch goes through submit (and ALL its argument
             # validation, key shape included — a malformed request must
             # 400, never detonate later inside the step loop); a
@@ -304,7 +358,8 @@ class ReplicaServer:
             if key is not None:
                 kwargs["key"] = key
             return self.engine.submit(
-                prompt, int(payload["max_new_tokens"]), **kwargs)
+                prompt, int(payload["max_new_tokens"]), trace=trace,
+                **kwargs)
 
     def poll(self, rid: int) -> dict:
         with self._lock:
@@ -377,13 +432,46 @@ def main(argv=None) -> int:
                              "the task bucket for router discovery)")
     parser.add_argument("--drain-file", default="inflight.json",
                         help="graceful-drain export destination")
+    parser.add_argument("--no-obs", action="store_true",
+                        help="disable tracing/metrics (the documented "
+                             "zero-overhead path)")
     args = parser.parse_args(argv)
 
     replica = ReplicaServer(
         preset=args.preset, serving=json.loads(args.serving),
         host=args.host, port=args.port,
-        drain_file=os.path.abspath(args.drain_file))
+        drain_file=os.path.abspath(args.drain_file),
+        obs_enabled=not args.no_obs)
     replica.start()
+
+    # Durable observability export: spans/metrics land under obs/ in the
+    # working directory, which the agent's delta sync already ships to the
+    # task bucket — the same durability plane as checkpoints and the drain
+    # file, zero new transport.
+    exporter = None
+    if replica.obs is not None:
+        from tpu_task.obs import SpanExporter, export_metrics
+        from tpu_task.storage.backends import open_backend
+
+        obs_backend, _ = open_backend(os.getcwd())
+        exporter = SpanExporter(obs_backend)
+    pending: list = []                    # drained-but-unwritten spans
+
+    def flush_obs() -> None:
+        if exporter is None:
+            return
+        # Drain into a local batch BEFORE writing: a full disk must not
+        # take the serving loop down, and a failed write must not lose
+        # the drained spans — they retry on the next beat.
+        pending.extend(replica.obs.tracer.drain())
+        try:
+            if pending:
+                exporter.export(list(pending), source=replica.boot_id)
+                pending.clear()
+            export_metrics(obs_backend, replica.obs.metrics.snapshot(),
+                           source=replica.boot_id)
+        except OSError:
+            pass
 
     done = threading.Event()
 
@@ -405,6 +493,7 @@ def main(argv=None) -> int:
           flush=True)
 
     parent = os.getppid()
+    beats = 0
     while not done.wait(0.2):
         # Self-supervision: the agent (our "machine") supervises us while
         # it lives — if it is SIGKILLed (hard teardown kills only ITS
@@ -414,9 +503,13 @@ def main(argv=None) -> int:
         if os.getppid() != parent:
             replica.begin_drain()
             break
+        beats += 1
+        if beats % 10 == 0:               # ~every 2 s
+            flush_obs()
     # Brief linger so the router can fetch the draining suffix/export
     # before the socket disappears; the agent's SIGTERM grace is 10 s.
     time.sleep(float(os.environ.get("TPU_TASK_SERVE_LINGER", "1.0")))
+    flush_obs()                           # drain/export spans included
     replica.stop()
     return 0
 

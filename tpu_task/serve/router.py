@@ -42,6 +42,8 @@ import urllib.error
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
+from tpu_task.obs import TRACE_HEADER, Obs
+from tpu_task.obs.trace import Span, TraceContext
 from tpu_task.storage.http_util import send
 
 __all__ = ["FleetRequest", "NoReplicaAvailable", "Router"]
@@ -88,6 +90,18 @@ class FleetRequest:
     submit_t: float = 0.0
     first_token_t: Optional[float] = None
     finish_t: Optional[float] = None
+    #: the request's trace (minted at submit — the root span's context);
+    #: every dispatch span and every replica-side span links under it.
+    trace: Optional[TraceContext] = None
+    root_span: Optional[Span] = field(default=None, repr=False,
+                                      compare=False)
+    #: the OPEN span of the current dispatch. Its token_start/token_end
+    #: attrs record exactly which token indices the router received from
+    #: this assignment — consecutive dispatch spans tile [0, n) with no
+    #: gap or overlap (the high-water mark guarantees it), which is what
+    #: the preemption trace-continuity tests pin.
+    dispatch_span: Optional[Span] = field(default=None, repr=False,
+                                          compare=False)
 
 
 class Router:
@@ -101,7 +115,8 @@ class Router:
                  spill_load: int = 4, retries: int = 1,
                  timeout: float = 10.0, quarantine_s: float = 2.0,
                  urlopen=None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 obs: Optional[Obs] = None):
         self.seed = seed
         self.affinity_tokens = affinity_tokens
         self.spill_load = spill_load
@@ -116,6 +131,22 @@ class Router:
         self._base_key = None            # lazy: jax import off the init path
         self.redispatches = 0
         self.transport_faults = 0
+        # Observability: the router is where traces are MINTED (one per
+        # fleet request at submit) and where the fleet-level latency
+        # histograms live. Tracing here is host-side bookkeeping around
+        # HTTP calls — negligible next to the transport — so it defaults
+        # ON; pass a shared Obs to aggregate several routers.
+        self.obs = obs if obs is not None else Obs.create("router")
+        metrics = self.obs.metrics
+        self._h_ttft = metrics.histogram("router.ttft_s")
+        self._h_e2e = metrics.histogram("router.e2e_s")
+        self._h_queue_wait = metrics.histogram("router.queue_wait_s")
+        for stat in ("redispatches", "transport_faults"):
+            metrics.counter_fn(f"router.{stat}",
+                               lambda self=self, stat=stat:
+                               float(getattr(self, stat)))
+        metrics.gauge_fn("router.queue_depth",
+                         lambda self=self: float(self.queue_depth))
 
     # -- membership ------------------------------------------------------------
     def set_replicas(self, endpoints: Dict[str, dict]) -> None:
@@ -149,6 +180,7 @@ class Router:
         for request in self._requests.values():
             if request.replica == name and request.status not in (DONE,
                                                                   FAILED):
+                self._end_dispatch(request, status="redispatched")
                 request.replica = None
                 request.rid = None
                 request.status = QUEUED
@@ -202,6 +234,12 @@ class Router:
             temperature=float(temperature), top_p=top_p,
             eos_token=eos_token, key=self._derive_key(fid),
             submit_t=self.clock())
+        # The trace is minted HERE, once per fleet request: everything
+        # downstream (dispatches, replica engines, re-dispatches after a
+        # preemption) links under this root via the propagated header.
+        request.root_span = self.obs.tracer.start(
+            "request", fid=fid, max_new_tokens=request.max_new_tokens)
+        request.trace = request.root_span.ctx
         self._requests[fid] = request
         try:
             self._dispatch(request)
@@ -224,14 +262,26 @@ class Router:
             # Re-dispatch: the received prefix is re-ingested as context
             # by the sibling; the continuation is token-identical.
             payload["tokens"] = list(request.tokens)
+        # One span per dispatch ATTEMPT, child of the request's root —
+        # its context rides the trace header so the replica's engine
+        # spans (queue/prefill/decode, possibly in another process) link
+        # under it. token_start marks where this assignment picks up the
+        # stream; a re-dispatch after a preemption is therefore a sibling
+        # child span of the SAME trace, starting at the high-water mark.
+        span = self.obs.tracer.start(
+            "dispatch", parent=request.root_span, fid=request.fid,
+            replica=replica.name, attempt=request.dispatches + 1,
+            token_start=len(request.tokens))
         try:
-            body = self._call(replica, "POST", "/submit", data=payload)
+            body = self._call(replica, "POST", "/submit", data=payload,
+                              headers={TRACE_HEADER: span.ctx.to_header()})
         except (urllib.error.URLError, OSError, ValueError) as error:
             if isinstance(error, urllib.error.HTTPError) \
                     and error.code == 409:
                 # Draining, not faulty: no new admissions, but its open
                 # streams still answer — only dispatch routes around it,
                 # and it returns only by rebooting (new boot id).
+                self.obs.tracer.end(span, status="draining")
                 replica.healthy = False
                 replica.quarantined_until = float("inf")
             elif isinstance(error, urllib.error.HTTPError) \
@@ -244,8 +294,15 @@ class Router:
                     f"replica {replica.name} rejected the request "
                     f"({error.code}): {error.read().decode(errors='replace')}")
                 request.finish_t = self.clock()
+                self.obs.tracer.end(span, status="error",
+                                    error=request.error)
+                self._end_root(request, status="error",
+                               error=request.error)
                 return
             else:
+                self.obs.tracer.end(span, status="fault",
+                                    exc_type=type(error).__name__,
+                                    error=str(error) or repr(error))
                 self._note_fault(replica, error)
             retry_exclude = (exclude or set()) | {replica.name}
             self._dispatch(request, exclude=retry_exclude)  # try siblings
@@ -254,16 +311,21 @@ class Router:
         request.rid = int(body["rid"])
         request.status = RUNNING
         request.dispatches += 1
+        request.dispatch_span = span
+        if request.dispatches == 1:
+            self._h_queue_wait.observe(self.clock() - request.submit_t)
         if request.dispatches > 1:
             self.redispatches += 1
         replica.load += 1
 
     # -- transport -------------------------------------------------------------
     def _call(self, replica: _Replica, method: str, path: str,
-              data: Optional[dict] = None) -> dict:
+              data: Optional[dict] = None,
+              headers: Optional[dict] = None) -> dict:
         raw = send(method, replica.url + path,
                    data=None if data is None else json.dumps(data).encode(),
-                   headers={"Content-Type": "application/json"},
+                   headers={"Content-Type": "application/json",
+                            **(headers or {})},
                    timeout=self.timeout, retries=self.retries,
                    urlopen=self.urlopen)
         return json.loads(raw)
@@ -279,8 +341,29 @@ class Router:
         replica.faults += 1
         replica.healthy = False
         replica.quarantined_until = self.clock() + self.quarantine_s
+        self.obs.tracer.error("router.transport_fault", error,
+                              replica=replica.name)
+
+    def _end_dispatch(self, request: FleetRequest,
+                      status: str = "ok") -> None:
+        """Close the current dispatch span with the token range this
+        assignment actually delivered ([token_start, token_end))."""
+        span = request.dispatch_span
+        if span is not None:
+            request.dispatch_span = None
+            self.obs.tracer.end(span, status=status,
+                                token_end=len(request.tokens))
+
+    def _end_root(self, request: FleetRequest, status: str = "ok",
+                  **attrs) -> None:
+        span = request.root_span
+        if span is not None:
+            request.root_span = None
+            self.obs.tracer.end(span, status=status,
+                                tokens=len(request.tokens), **attrs)
 
     def _unassign(self, request: FleetRequest) -> None:
+        self._end_dispatch(request, status="redispatched")
         replica = self._replicas.get(request.replica or "")
         if replica is not None and replica.load > 0:
             replica.load -= 1
@@ -332,12 +415,17 @@ class Router:
             if suffix:
                 if request.first_token_t is None:
                     request.first_token_t = self.clock()
+                    self._h_ttft.observe(
+                        request.first_token_t - request.submit_t)
                 request.tokens.extend(suffix)
             if len(request.tokens) >= request.max_new_tokens or (
                     request.eos_token is not None and request.tokens
                     and request.tokens[-1] == request.eos_token):
                 request.status = DONE
                 request.finish_t = self.clock()
+                self._h_e2e.observe(request.finish_t - request.submit_t)
+                self._end_dispatch(request)
+                self._end_root(request, dispatches=request.dispatches)
                 if replica.load > 0:
                     replica.load -= 1
             elif body.get("draining"):
@@ -415,4 +503,7 @@ class Router:
             "queue_depth": self.queue_depth,
             "redispatches": self.redispatches,
             "transport_faults": self.transport_faults,
+            # One export path: the counters above ride the registry as
+            # lazy gauges; TTFT / queue-wait / e2e live there natively.
+            "obs": self.obs.metrics.snapshot(),
         }
