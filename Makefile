@@ -8,7 +8,7 @@ PYTHON ?= python3
 # Seed for the chaos soak: any run is replayable by pinning this.
 TPU_TASK_CHAOS_SEED ?= 20260804
 
-.PHONY: test smoke sweep bench bench-steady bench-serving bench-sched bench-decode bench-fleet bench-obs sched sched-soak chaos fleet serve-soak obs wheel multichip kernels-tpu clean
+.PHONY: test smoke sweep bench bench-steady bench-serving bench-sched bench-decode bench-fleet bench-obs bench-goodput sched sched-soak chaos fleet serve-soak obs watch wheel multichip kernels-tpu clean
 
 # Hermetic suite (the reference's `make test`, 30 s budget there; ours spans
 # the fake control planes, sharded-compute CPU checks, and the loopback GCS
@@ -117,6 +117,19 @@ obs:
 # guard, so that leg pays exactly zero).
 bench-obs:
 	$(PYTHON) bench.py obs
+
+# Goodput/MFU/dispatch-overhead leg: in-program vs host-gap wall split
+# (the ROADMAP-4 "dispatches dominate" gauge), goodput ratio, and the
+# static-FLOP-model MFU gauge at batch {1,8,32}, cross-checked against
+# XLA cost_analysis where the backend provides one.
+bench-goodput:
+	$(PYTHON) bench.py goodput
+
+# One-shot `obs watch` frame against the default state root — the render
+# smoke for the live dashboard (tok/s, goodput, MFU, queue depth, QLAT,
+# burn-rate alerts). Run the real thing without --once.
+watch:
+	$(PYTHON) -m tpu_task.cli.main obs watch --once
 
 # Build the agent wheel the worker bootstrap installs.
 wheel:

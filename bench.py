@@ -2066,6 +2066,90 @@ def bench_obs(n_requests: int = 8, max_new: int = 16, seed: int = 0,
     }
 
 
+def bench_goodput(batches=(1, 8, 32), max_new: int = 24,
+                  seed: int = 0) -> dict:
+    """Goodput/MFU/dispatch-overhead accounting (PR 12): the engine's
+    always-on split of step wall into in-program vs host-gap time — the
+    direct measurement of ROADMAP 4's "dispatches dominate" claim — plus
+    the goodput ratio and the static-FLOP-model MFU gauge, at batch
+    (= slots) ∈ {1, 8, 32} on a greedy workload. The static model is
+    cross-checked against ``jax.jit(...).lower().cost_analysis()`` where
+    the backend provides one. Compile warmup runs before the meter is
+    reset, so compile seconds never read as host gap."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_task.ml.models import transformer
+    from tpu_task.ml.serving import ServingConfig, ServingEngine
+    from tpu_task.obs import Obs
+    from tpu_task.obs.goodput import (
+        decode_step_cost_analysis_flops,
+        flops_for_positions,
+    )
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=256, d_model=128, n_layers=2, n_heads=8, d_head=16,
+        d_ff=256, dtype=jnp.float32, n_kv_heads=4)
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(seed)
+    per_batch = {}
+    xcheck = None
+    for batch in batches:
+        scfg = ServingConfig(slots=batch, block_size=8,
+                             n_blocks=max(96, 12 * batch), max_len=64,
+                             prefix_cache=False)
+        obs = Obs.create(f"goodput-b{batch}")
+        engine = ServingEngine(params, cfg, scfg, obs=obs)
+        prompts = [rng.integers(0, cfg.vocab_size, size=8)
+                   for _ in range(batch)]
+        engine.submit(prompts[0], 2)
+        engine.drain()                    # compile off the books
+        engine._goodput.reset()
+        t0 = time.perf_counter()
+        for prompt in prompts:
+            engine.submit(prompt, max_new)
+        engine.drain()
+        wall = time.perf_counter() - t0
+        gp = engine.stats()["goodput"]
+        emitted = max(1, gp["tokens"]["emitted"])
+        per_batch[str(batch)] = {
+            "tokens_per_s": round(batch * max_new / wall, 1),
+            "goodput_ratio": gp["ratio"],
+            "mfu": gp["mfu"],
+            "in_program_frac": gp["in_program_frac"],
+            "host_gap_frac": gp["host_gap_frac"],
+            "dispatches_per_token": gp["dispatches_per_token"],
+            "program_ms_per_token": round(
+                gp["program_s"] / emitted * 1e3, 4),
+            "host_ms_per_token": round(gp["host_s"] / emitted * 1e3, 4),
+        }
+        if xcheck is None:
+            xla_flops = decode_step_cost_analysis_flops(cfg, scfg)
+            model_flops = flops_for_positions(cfg, np.zeros(batch))
+            xcheck = {
+                "model_flops_per_step": model_flops,
+                "xla_cost_analysis_flops_per_step": xla_flops,
+                "model_over_xla": (round(model_flops / xla_flops, 3)
+                                   if xla_flops else None),
+                "note": ("one fused greedy decode step at position 0; "
+                         "the static model counts matmuls + attention "
+                         "only, XLA counts every op — ratios near 1 "
+                         "validate the model's magnitude"),
+            }
+    return {
+        "workload": {"batches": list(batches), "max_new": max_new,
+                     "prompt_tokens": 8},
+        "per_batch": per_batch,
+        "flop_model_cross_check": xcheck,
+        "note": ("host_gap_frac is the ROADMAP-4 dispatch-overhead "
+                 "gauge (CPU ms-scale steps: expect a large host share; "
+                 "the multi-token micro-step work must shrink it); MFU "
+                 "off-TPU runs on the documented nominal peak — a "
+                 "relative gauge, not an absolute one"),
+    }
+
+
 def main() -> int:
     import jax
 
@@ -2099,6 +2183,9 @@ def main() -> int:
     # Observability overhead (PR 11): engine tok/s with the obs plane on
     # vs off — the ≤ 5% tracing-overhead contract, tracked per capture.
     obs = bench_obs()
+    # Goodput/MFU + dispatch-overhead accounting (PR 12): in-program vs
+    # host-gap split, goodput ratio, MFU gauge at batch ∈ {1, 8, 32}.
+    goodput = bench_goodput()
     transport = bench_transport()
     data_plane = bench_data_plane()
     steady_state = bench_steady_state()
@@ -2116,6 +2203,7 @@ def main() -> int:
         "serving": serving,
         "fleet": fleet,
         "obs": obs,
+        "goodput": goodput,
         "transport": transport,
         "data_plane": data_plane,
         "steady_state": steady_state,
@@ -2240,6 +2328,17 @@ def _parse_args(argv):
                               "the reported overhead is the median "
                               "per-pair ratio")
     obs_cmd.add_argument("--seed", type=int, default=0)
+    goodput_cmd = sub.add_parser(
+        "goodput",
+        help="goodput/MFU/dispatch-overhead section only (also `make "
+             "bench-goodput`): in-program vs host-gap wall split, "
+             "goodput ratio, and the static-FLOP-model MFU gauge at "
+             "batch in {1,8,32}")
+    goodput_cmd.add_argument("--batches", default="1,8,32",
+                             metavar="B[,B...]")
+    goodput_cmd.add_argument("--max-new", type=int, default=24,
+                             dest="max_new")
+    goodput_cmd.add_argument("--seed", type=int, default=0)
     return parser.parse_args(argv)
 
 
@@ -2274,6 +2373,12 @@ if __name__ == "__main__":
         print(json.dumps({"obs": bench_obs(
             n_requests=args.requests, max_new=args.max_new,
             seed=args.seed, repeats=args.repeats)}))
+        raise SystemExit(0)
+    if args.section == "goodput":
+        batches = tuple(int(b) for b in str(args.batches).split(",")
+                        if b.strip())
+        print(json.dumps({"goodput": bench_goodput(
+            batches=batches, max_new=args.max_new, seed=args.seed)}))
         raise SystemExit(0)
     if args.section == "serving":
         tps = tuple(int(t) for t in str(args.tp or "1,8").split(",")

@@ -201,6 +201,18 @@ def test_engine_spans_cover_phases_and_off_path_records_nothing():
     assert stats["obs"]["engine.ttft_s"]["count"] == 1
     assert stats["obs"]["engine.step_s"]["count"] == stats["steps"]
     assert stats["obs"]["engine.steps"]["value"] == stats["steps"]
+    # Goodput/MFU accounting (PR 12) rides the same obs handle: all 6
+    # tokens emitted with zero waste, the wall split sums to the busy
+    # time, and the gauges export through the registry.
+    goodput = stats["goodput"]
+    assert goodput["tokens"]["emitted"] == 6
+    assert goodput["ratio"] == 1.0
+    assert goodput["dispatches"] > 0
+    assert goodput["program_s"] > 0
+    assert 0.0 <= goodput["host_gap_frac"] <= 1.0
+    assert goodput["mfu"] > 0
+    assert stats["obs"]["goodput.tokens_emitted"]["value"] == 6
+    assert stats["obs"]["goodput.ratio"]["value"] == 1.0
 
     # obs=None: identical stream, no obs section, no span machinery —
     # the documented zero-overhead path.
@@ -208,7 +220,8 @@ def test_engine_spans_cover_phases_and_off_path_records_nothing():
     rid_off = off.submit([1, 2, 3, 4], 6)
     assert off.drain()[rid_off] == tokens
     assert off._obs is None and not off._phase_spans
-    assert "obs" not in off.stats()
+    assert off._goodput is None
+    assert "obs" not in off.stats() and "goodput" not in off.stats()
 
 
 def test_engine_export_closes_spans_as_exported():

@@ -406,6 +406,14 @@ def cmd_sched(args) -> int:
         print(f"pool: {pool.get('used_chips', 0)}/"
               f"{pool.get('capacity_chips', 0)} chips in use "
               f"(utilization {pool.get('utilization', 0.0)})")
+        # SLO plane (PR 12): firing burn-rate alerts the scheduler's tick
+        # evaluated — the at-a-glance "is someone's budget on fire" line.
+        for alert in (snapshot.get("slo") or {}).get("alerts", ()):
+            print(f"SLO ALERT: {alert['slo']}/{alert['objective']} "
+                  f"{alert['metric']} burn fast={alert['burn_fast']} "
+                  f"slow={alert['burn_slow']} "
+                  f"(target {alert['target']}, "
+                  f"attainment {alert['attainment']})")
     return 0
 
 
@@ -492,7 +500,119 @@ def cmd_obs_top(args) -> int:
             rows.append((name, entry["type"], "-",
                          f"{entry['value']:.6g}", "-", "-"))
     _print_table(columns, rows)
+    dropped = (merged.get("obs.spans_dropped") or {}).get("value", 0)
+    if dropped:
+        # The tracer ring dropped oldest spans: waterfalls may be missing
+        # their earliest legs — visible here instead of silent.
+        print(f"WARNING: {int(dropped)} span(s) dropped from tracer "
+              "rings (drop-oldest overflow) — waterfalls may be "
+              "incomplete; raise the tracer capacity or flush more often")
     return 0
+
+
+def cmd_obs_alerts(args) -> int:
+    """Durable SLO burn-rate breach records (``obs/alerts/``) — what the
+    scheduler tick and ``ServeFleet.flush_obs`` evaluated and persisted."""
+    from tpu_task.obs import read_alerts
+
+    backend, remote = _obs_backend(args.remote)
+    alerts = read_alerts(backend)
+    if not alerts:
+        print(f"no SLO alerts under {remote}/obs/alerts/")
+        return 0
+    columns = ("STARTED", "SLO", "OBJECTIVE", "METRIC", "TARGET",
+               "BURN-FAST", "BURN-SLOW", "ATTAIN")
+    rows = [(f"{alert.started_at:.1f}", alert.slo, alert.objective,
+             alert.metric, alert.target, alert.burn_fast, alert.burn_slow,
+             f"{alert.attainment:.4f}") for alert in alerts[-args.limit:]]
+    _print_table(columns, rows)
+    return 0
+
+
+def _watch_frame(merged, alerts, remote: str) -> str:
+    """One ``obs watch`` frame over the fleet-merged registry: headline
+    gauges (goodput, MFU, host gap, queue depth), the latency table, and
+    any firing alerts."""
+    from tpu_task.obs import Histogram
+
+    def value(name, default=0.0):
+        return (merged.get(name) or {}).get("value", default)
+
+    lines = [f"tpu-task obs watch — {remote}"]
+    head = [f"tokens {int(value('goodput.tokens_emitted'))}"]
+    if "goodput.ratio" in merged:
+        head += [f"goodput {value('goodput.ratio'):.3f}",
+                 f"mfu {value('goodput.mfu'):.3g}",
+                 f"host-gap {value('goodput.host_gap_frac') * 100:.1f}%",
+                 f"dispatch/tok "
+                 f"{value('goodput.dispatches_per_token'):.2f}"]
+    depth = value("router.queue_depth") + value("engine.queue_depth")
+    head.append(f"queue {int(depth)}")
+    lines.append("  ".join(head))
+    rows = []
+    for name, entry in sorted(merged.items()):
+        if entry.get("type") != "histogram" or not entry.get("count"):
+            continue
+        hist = Histogram.from_snapshot(entry, name)
+        rows.append((name, hist.count, f"{hist.quantile(0.5) * 1e3:.2f}",
+                     f"{hist.quantile(0.99) * 1e3:.2f}"))
+    if rows:
+        widths = [max(len(str(row[i])) for row in
+                      [("METRIC", "COUNT", "P50-MS", "P99-MS"), *rows])
+                  for i in range(4)]
+        for row in [("METRIC", "COUNT", "P50-MS", "P99-MS"), *rows]:
+            lines.append("  ".join(
+                str(cell).ljust(widths[i]) for i, cell in enumerate(row)))
+    dropped = value("obs.spans_dropped")
+    if dropped:
+        lines.append(f"WARNING: {int(dropped)} span(s) dropped from "
+                     "tracer rings — waterfalls may be incomplete")
+    for alert in alerts[-5:]:
+        lines.append(
+            f"SLO ALERT: {alert.slo}/{alert.objective} {alert.metric} "
+            f"burn fast={alert.burn_fast} slow={alert.burn_slow} "
+            f"(target {alert.target})")
+    if not alerts:
+        lines.append("slo: no alerts")
+    return "\n".join(lines)
+
+
+def cmd_obs_watch(args) -> int:
+    """Live-refresh terminal dashboard over the merged registry + the
+    durable alert records — tok/s, goodput, MFU, host gap, queue depth,
+    latency percentiles, burn-rate alerts. ``--once`` renders a single
+    frame (the `make watch` smoke)."""
+    import time as _time
+
+    from tpu_task.obs import read_alerts, read_metrics
+
+    backend, remote = _obs_backend(args.remote)
+    iterations = 1 if args.once else args.iterations
+    frame = 0
+    prev_tokens = prev_at = None
+    while True:
+        merged = read_metrics(backend)
+        alerts = read_alerts(backend)
+        now = _time.monotonic()
+        if not args.once:
+            print("\x1b[2J\x1b[H", end="")
+        if not merged:
+            print(f"(no metrics yet under {remote}/obs/metrics/)")
+        else:
+            body = _watch_frame(merged, alerts, remote)
+            tokens = (merged.get("goodput.tokens_emitted")
+                      or {}).get("value")
+            if None not in (tokens, prev_tokens, prev_at) \
+                    and now > prev_at:
+                rate = (tokens - prev_tokens) / (now - prev_at)
+                body = body.replace("\n", f"  tok/s {rate:.1f}\n", 1)
+            prev_tokens, prev_at = tokens, now
+        if merged:
+            print(body)
+        frame += 1
+        if iterations and frame >= iterations:
+            return 0
+        _time.sleep(args.interval)
 
 
 def cmd_storage(args) -> int:
@@ -747,6 +867,24 @@ def make_parser(defaults: Optional[dict] = None) -> argparse.ArgumentParser:
     obs_top.add_argument("--remote", default="")
     obs_top.add_argument("--limit", type=int, default=60)
     obs_top.set_defaults(func=cmd_obs_top)
+    obs_alerts = obs_sub.add_parser(
+        "alerts", help="durable SLO burn-rate breach records "
+                       "(obs/alerts/ under the state root)")
+    obs_alerts.add_argument("--remote", default="")
+    obs_alerts.add_argument("--limit", type=int, default=40)
+    obs_alerts.set_defaults(func=cmd_obs_alerts)
+    obs_watch = obs_sub.add_parser(
+        "watch", help="live terminal dashboard over the merged registry: "
+                      "tok/s, goodput, MFU, host gap, queue depth, "
+                      "latency percentiles, SLO alerts")
+    obs_watch.add_argument("--remote", default="")
+    obs_watch.add_argument("--interval", type=float, default=2.0,
+                           help="refresh period in seconds")
+    obs_watch.add_argument("--iterations", type=int, default=0,
+                           help="stop after N frames (0 = until ^C)")
+    obs_watch.add_argument("--once", action="store_true",
+                           help="render one frame and exit (CI smoke)")
+    obs_watch.set_defaults(func=cmd_obs_watch)
 
     storage = sub.add_parser("storage", help="data-plane operations (used on workers)")
     storage_sub = storage.add_subparsers(dest="storage_command", required=True)

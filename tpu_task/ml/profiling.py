@@ -30,8 +30,64 @@ Usage in a task script::
 from __future__ import annotations
 
 import os
+import threading
+import time
 from contextlib import contextmanager, nullcontext
 from typing import Optional
+
+#: One capture at a time: the XLA profiler is process-global state.
+_capture_lock = threading.Lock()
+
+
+def busy() -> bool:
+    """Whether a :func:`capture` is currently recording."""
+    return _capture_lock.locked()
+
+
+def capture(log_dir: str, duration_s: float) -> str:
+    """Blocking on-demand XLA profiler capture: record ``duration_s``
+    seconds of whatever the process is doing into ``log_dir`` (the
+    TensorBoard profile-plugin layout). The replica's ``/profile?ms=``
+    endpoint runs this on a worker thread with ``log_dir`` under the
+    task WORKDIR, so the agent's data sync ships the trace home — the
+    same free export path :func:`trace` documents. Raises RuntimeError
+    when a capture is already running (the profiler is process-global).
+
+    Best-effort by design: the capture directory always lands, but the
+    CPU host tracer has been observed to emit an empty trace in deeply
+    nested child processes (a TSL quirk; the device tracer on a real
+    TPU backend is the actual target) — readers must treat an empty
+    capture as "nothing recorded", never as an error."""
+    if not acquire_capture():
+        raise RuntimeError("a profiler capture is already running")
+    return capture_reserved(log_dir, duration_s)
+
+
+def acquire_capture() -> bool:
+    """Reserve the process-global profiler for a caller that will run
+    :func:`capture_reserved` (possibly on another thread). Returns False
+    when a capture is already running — callers that must answer a
+    concurrent request (the replica's 409) take the reservation HERE,
+    synchronously, so two racing requests can never both win."""
+    return _capture_lock.acquire(blocking=False)
+
+
+def capture_reserved(log_dir: str, duration_s: float) -> str:
+    """Run one capture under a reservation taken with
+    :func:`acquire_capture`; the reservation is released on completion
+    (success or failure)."""
+    import jax
+
+    try:
+        os.makedirs(log_dir, exist_ok=True)
+        jax.profiler.start_trace(log_dir)
+        try:
+            time.sleep(duration_s)
+        finally:
+            jax.profiler.stop_trace()
+    finally:
+        _capture_lock.release()
+    return log_dir
 
 
 @contextmanager

@@ -84,6 +84,7 @@ from tpu_task.ml.models import transformer
 from tpu_task.ml.models.transformer import Params, TransformerConfig
 from tpu_task.ml.ops import paged_attention as pa
 from tpu_task.obs import Obs
+from tpu_task.obs.goodput import GoodputMeter
 from tpu_task.obs.trace import Span, TraceContext
 from tpu_task.ml.parallel.sharding import (
     PartitionPlan,
@@ -349,8 +350,15 @@ class ServingEngine:
         # plane needs (step wall, TTFT, inter-token).
         self._obs = obs
         self._phase_spans: Dict[int, Span] = {}
+        #: Goodput/MFU/dispatch accounting (PR 12) — exists only when obs
+        #: does (the obs=None zero-overhead contract is one guard for
+        #: both): splits step wall into in-program vs host-gap time,
+        #: discounts wasted token-work into a goodput ratio, and runs the
+        #: static FLOP cost model into an MFU gauge, all on the registry.
+        self._goodput: Optional[GoodputMeter] = None
         if obs is not None:
             metrics = obs.metrics
+            self._goodput = GoodputMeter(cfg, metrics)
             self._h_step = metrics.histogram("engine.step_s")
             self._h_ttft = metrics.histogram("engine.ttft_s")
             self._h_intertok = metrics.histogram("engine.intertoken_s")
@@ -546,6 +554,18 @@ class ServingEngine:
                             jax.random.fold_in(k_, _SPEC_SALT), p_), (2,))
                 )(jnp.repeat(keys, positions.shape[1], axis=0),
                   positions.reshape(-1)).reshape(*positions.shape, 2))
+
+    def _gp_timed(self, fn, *args):
+        """Dispatch one device program with its wall charged to the
+        goodput meter's in-program bucket (no meter: a plain call). Used
+        by the call sites that bypass :meth:`_run_program` — COW copies,
+        draft programs, the prefill/spec samplers."""
+        if self._goodput is None:
+            return fn(*args)
+        t0 = time.perf_counter()
+        out = fn(*args)
+        self._goodput.program(time.perf_counter() - t0)
+        return out
 
     def _wrap(self, fn):
         """Debug mode: functionalize the bounds guards and throw on them."""
@@ -778,6 +798,11 @@ class ServingEngine:
                 req.status = DONE
                 req.finish_t = time.monotonic()
             else:
+                if self._goodput is not None and tokens:
+                    # The imported prefix is re-ingested context another
+                    # engine already produced — work the goodput ratio
+                    # discounts as re-dispatch waste.
+                    self._goodput.wasted_reingest(len(tokens))
                 self._queue.append(req)
                 self._obs_queue(req)
             mapping[int(record.get("rid", rid))] = rid
@@ -816,6 +841,8 @@ class ServingEngine:
         """One scheduler iteration: admit → (chunk|spec|decode) → retire.
         Returns what happened (request ids admitted/finished, active)."""
         t0 = time.perf_counter() if self._obs is not None else 0.0
+        if self._goodput is not None:
+            self._goodput.begin_step()
         self.steps += 1
         admitted, finished = [], []
         self._admit(admitted, finished)
@@ -836,7 +863,12 @@ class ServingEngine:
             elif not prefilling:
                 self._decode(finished)
         if self._obs is not None:
-            self._h_step.observe(time.perf_counter() - t0)
+            wall = time.perf_counter() - t0
+            self._h_step.observe(wall)
+            if self._goodput is not None:
+                # Whatever the step's wall spent outside its program
+                # dispatches is host gap — the ROADMAP-4 overhead gauge.
+                self._goodput.end_step(wall)
         return {"admitted": admitted, "finished": finished,
                 "active": self.n_active, "queued": len(self._queue)}
 
@@ -868,7 +900,8 @@ class ServingEngine:
             [req.prompt, np.asarray(req.tokens, np.int32)])
 
     def _sample_one(self, req: Request, logits) -> int:
-        tok = self._prefill_sample_fn(
+        tok = self._gp_timed(
+            self._prefill_sample_fn,
             logits, jnp.asarray([req.temperature], jnp.float32),
             jnp.asarray([req.top_p], jnp.float32), req.key,
             jnp.int32(len(req.tokens)))
@@ -936,7 +969,8 @@ class ServingEngine:
                 # bytes untouched — pinned by the property test).
                 src = int(table[cached_len // bs])
                 dst = got[need]
-                self.pools = self._copy_block_fn(
+                self.pools = self._gp_timed(
+                    self._copy_block_fn,
                     self.pools, jnp.int32(src), jnp.int32(dst))
                 table[cached_len // bs] = dst
                 self.allocator.decref(src)
@@ -990,6 +1024,9 @@ class ServingEngine:
                 jnp.int32(len(ctx)), jnp.asarray(table))
             if self._quantized:
                 self.quantized_block_writes += need
+            if self._goodput is not None:
+                self._goodput.work_span(len(ctx))
+                self._goodput.emitted(1)
             self.prefills += 1
             first = self._sample_one(req, logits)
             now = time.monotonic()
@@ -1059,6 +1096,10 @@ class ServingEngine:
         self.preemption_count += 1
         req.status = QUEUED
         self._obs_interrupt(req, "preempted")
+        if self._goodput is not None:
+            # The rolled-back tokens were emitted work the recompute
+            # repeats — the goodput ratio's preemption discount.
+            self._goodput.wasted_preempt(len(req.tokens) - req.resume_from)
         # Release BEFORE clearing tokens: _release registers full blocks
         # with the prefix cache under the ids that produced their KV
         # (prompt + generated so far), so the hash list and the block list
@@ -1126,6 +1167,7 @@ class ServingEngine:
         programs that derive it in-program, like bucketed prefill) before
         the donated pools and peels the quantized variants' extra
         max-quant-error output. Returns the program's leading output."""
+        t0 = time.perf_counter() if self._goodput is not None else 0.0
         if self._quantized:
             if qa is not None:
                 out, self.pools, qerr = fn(*args, qa, self.pools)
@@ -1134,6 +1176,8 @@ class ServingEngine:
             self._note_qerr(qerr)
         else:
             out, self.pools = fn(*args, self.pools)
+        if self._goodput is not None:
+            self._goodput.program(time.perf_counter() - t0)
         return out
 
     def _all_greedy(self) -> bool:
@@ -1173,6 +1217,12 @@ class ServingEngine:
                 jnp.asarray(temps), jnp.asarray(tops),
                 jnp.asarray(self._slot_keys), jnp.asarray(ngen), qa=qa)
         self.decode_steps += 1
+        if self._goodput is not None:
+            # positions is masked to 0 at inactive rows, so its plain sum
+            # is the active rows' position sum.
+            n_act = int(active.sum())
+            self._goodput.work_counts(n_act, float(positions.sum()))
+            self._goodput.emitted(n_act)
         toks = np.asarray(toks)
         now = time.monotonic()
         for slot, req in enumerate(self._slots):
@@ -1281,6 +1331,9 @@ class ServingEngine:
                 jnp.asarray(tops), jnp.asarray(keys),
                 jnp.asarray(ngen), qa=qa)
         self.chunk_steps += 1
+        if self._goodput is not None:
+            self._goodput.work_counts(int(active.sum()),
+                                      float(pos_masked.sum()))
         toks = np.asarray(toks)
         now = time.monotonic()
         for i, req in enumerate(self._slots):
@@ -1297,6 +1350,8 @@ class ServingEngine:
                 self._positions[i] = int(self._positions[i]) + 1
                 tok = int(toks[i])
             req.tokens.append(tok)
+            if self._goodput is not None:
+                self._goodput.emitted(1)
             if req.first_token_t is None:
                 req.first_token_t = now
                 self._obs_first_token(req)
@@ -1377,9 +1432,15 @@ class ServingEngine:
                 jnp.asarray(tops), qa=qa)
             probs = np.asarray(probs)
             scored = None
-            uniforms = np.asarray(self._spec_uniform_fn(
+            uniforms = np.asarray(self._gp_timed(
+                self._spec_uniform_fn,
                 jnp.asarray(self._slot_keys), jnp.asarray(positions)))
         self.spec_rounds += 1
+        if self._goodput is not None:
+            # positions is 0 outside the valid mask, so the plain sum is
+            # the valid entries' position sum.
+            self._goodput.work_counts(int(valid.sum()),
+                                      float(positions.sum()))
         now = time.monotonic()
         for i, req in enumerate(self._slots):
             if not live(i):
@@ -1405,6 +1466,9 @@ class ServingEngine:
                 emitted = emitted[:emitted.index(req.eos_token) + 1]
             m = len(emitted)
             req.tokens.extend(emitted)
+            if self._goodput is not None:
+                self._goodput.emitted(m)
+                self._goodput.wasted_spec(ke - a)
             if req.first_token_t is None:
                 req.first_token_t = now
                 self._obs_first_token(req)
@@ -1478,7 +1542,8 @@ class ServingEngine:
                 valid[i, :c] = True
                 last_idx[i] = c - 1
                 self._draft_pos[i] = dp + c
-            _, self._draft_pools = self._draft_chunk_fn(
+            _, self._draft_pools = self._gp_timed(
+                self._draft_chunk_fn,
                 self.draft_params, jnp.asarray(tokens),
                 jnp.asarray(positions), jnp.asarray(valid),
                 jnp.asarray(last_idx), self._draft_tables,
@@ -1495,7 +1560,8 @@ class ServingEngine:
         for j in range(kmax):
             act = np.array([self._slots[i] is not None and k_eff[i] > j
                             for i in range(n)])
-            toks, self._draft_pools = self._draft_decode_fn(
+            toks, self._draft_pools = self._gp_timed(
+                self._draft_decode_fn,
                 self.draft_params, jnp.asarray(cur),
                 jnp.asarray(np.where(act, dpos, 0)), self._draft_tables,
                 jnp.asarray(act), self._draft_pools)
@@ -1608,4 +1674,8 @@ class ServingEngine:
             # inter-token histograms plus every counter above as lazy
             # gauges, one name and one type each.
             out["obs"] = self._obs.metrics.snapshot()
+        if self._goodput is not None:
+            # Convenience view of the goodput.* registry names (PR 12):
+            # goodput ratio, MFU, and the in-program vs host-gap split.
+            out["goodput"] = self._goodput.snapshot()
         return out

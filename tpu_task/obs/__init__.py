@@ -14,6 +14,13 @@ Two halves, threaded through every layer behind one tiny handle
   bucket-wise add) behind one :class:`MetricsRegistry` per component —
   the single name/type/export path for every number the layer publishes.
 
+On top of the substrate sits the OPERATIONS plane: declarative SLOs with
+multi-window error-budget burn-rate alerting (``slo`` — durable breach
+records under ``obs/alerts/``), goodput/MFU/dispatch-overhead accounting
+(``goodput``), Prometheus text exposition (:func:`prometheus_text` — a
+replica's ``GET /metrics``), and the ``tpu-task obs watch``/``alerts``
+terminal views.
+
 Overhead contract: layers accept ``obs=None`` and skip every recording
 call when unset — the zero-overhead path. With obs on, recording is
 host-side only (dispatch boundaries, never inside traced programs):
@@ -29,10 +36,12 @@ from tpu_task.obs.export import (
     SpanExporter,
     chrome_trace,
     export_metrics,
+    prometheus_text,
     read_metrics,
     read_spans,
     render_waterfall,
 )
+from tpu_task.obs.goodput import GoodputMeter
 from tpu_task.obs.metrics import (
     Counter,
     Gauge,
@@ -40,17 +49,34 @@ from tpu_task.obs.metrics import (
     MetricsRegistry,
     merge_snapshots,
 )
+from tpu_task.obs.slo import (
+    ALERT_PREFIX,
+    Alert,
+    BurnWindow,
+    SloClass,
+    SloEvaluator,
+    SloObjective,
+    read_alerts,
+    write_alert,
+)
 from tpu_task.obs.trace import TRACE_HEADER, Span, TraceContext, Tracer
 
 __all__ = [
+    "ALERT_PREFIX",
     "METRICS_PREFIX",
     "SPAN_PREFIX",
     "TRACE_HEADER",
+    "Alert",
+    "BurnWindow",
     "Counter",
     "Gauge",
+    "GoodputMeter",
     "Histogram",
     "MetricsRegistry",
     "Obs",
+    "SloClass",
+    "SloEvaluator",
+    "SloObjective",
     "Span",
     "SpanExporter",
     "TraceContext",
@@ -58,9 +84,12 @@ __all__ = [
     "chrome_trace",
     "export_metrics",
     "merge_snapshots",
+    "prometheus_text",
+    "read_alerts",
     "read_metrics",
     "read_spans",
     "render_waterfall",
+    "write_alert",
 ]
 
 
@@ -75,5 +104,12 @@ class Obs:
 
     @classmethod
     def create(cls, source: str = "", capacity: int = 4096) -> "Obs":
-        return cls(tracer=Tracer(source=source, capacity=capacity),
-                   metrics=MetricsRegistry())
+        obs = cls(tracer=Tracer(source=source, capacity=capacity),
+                  metrics=MetricsRegistry())
+        # The tracer's drop-oldest ring is silent on its own — surface
+        # overflow on the one export path so `obs top`/`obs watch` can
+        # warn that waterfalls may be missing their oldest spans.
+        obs.metrics.counter_fn(
+            "obs.spans_dropped",
+            lambda tracer=obs.tracer: float(tracer.dropped))
+        return obs

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import itertools
 import json
+import re
 import uuid
 from typing import Dict, Iterable, List, Optional
 
@@ -32,6 +33,7 @@ __all__ = [
     "SpanExporter",
     "chrome_trace",
     "export_metrics",
+    "prometheus_text",
     "read_metrics",
     "read_spans",
     "render_waterfall",
@@ -101,6 +103,63 @@ def read_metrics(backend, prefix: str = METRICS_PREFIX) -> dict:
         except (ValueError, OSError):
             continue
     return merge_snapshots(snapshots)
+
+
+def _prom_name(name: str, prefix: str) -> str:
+    """Registry name → a legal Prometheus metric name
+    (``[a-zA-Z_:][a-zA-Z0-9_:]*``): dots and every other illegal
+    character become underscores."""
+    out = prefix + re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    if not re.match(r"[a-zA-Z_:]", out):
+        out = "_" + out
+    return out
+
+
+def _prom_num(value) -> str:
+    if value is None:
+        return "NaN"
+    value = float(value)
+    if value == int(value) and abs(value) < 1e15:
+        return str(int(value))
+    return format(value, ".10g")
+
+
+def prometheus_text(snapshot: dict, prefix: str = "tpu_task_") -> str:
+    """One registry (or fleet-merged) snapshot in Prometheus text
+    exposition format — what a replica's ``GET /metrics`` serves and any
+    standard scraper ingests.
+
+    Counters and gauges map directly; histograms emit the standard
+    cumulative ``_bucket{le="..."}`` series (one line per bucket
+    boundary where the cumulative count changes, plus the mandatory
+    ``le="+Inf"``), ``_sum``, and ``_count``. Bucket boundaries come
+    from the deterministic log grid, so a fleet of replicas scrapes
+    onto identical ``le`` label sets."""
+    lines: List[str] = []
+    for name, entry in sorted(snapshot.items()):
+        kind = entry.get("type")
+        pname = _prom_name(name, prefix)
+        if kind in ("counter", "gauge"):
+            lines.append(f"# TYPE {pname} {kind}")
+            lines.append(f"{pname} {_prom_num(entry.get('value', 0.0))}")
+        elif kind == "histogram":
+            lines.append(f"# TYPE {pname} histogram")
+            lo, per_decade = entry["lo"], entry["per_decade"]
+            growth = 10.0 ** (1.0 / per_decade)
+            counts = {int(i): c for i, c in entry.get("counts", {}).items()}
+            cum = 0
+            for i in range(entry["n"] - 1):   # overflow folds into +Inf
+                bucket = counts.get(i, 0)
+                if not bucket:
+                    continue
+                cum += bucket
+                upper = lo if i == 0 else lo * growth ** i
+                lines.append(
+                    f'{pname}_bucket{{le="{_prom_num(upper)}"}} {cum}')
+            lines.append(f'{pname}_bucket{{le="+Inf"}} {entry["count"]}')
+            lines.append(f"{pname}_sum {_prom_num(entry.get('sum', 0.0))}")
+            lines.append(f"{pname}_count {entry['count']}")
+    return "\n".join(lines) + "\n" if lines else "# no metrics\n"
 
 
 def chrome_trace(spans: Iterable[Span]) -> dict:

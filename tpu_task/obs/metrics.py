@@ -281,7 +281,10 @@ class MetricsRegistry:
 def merge_snapshots(snapshots: List[dict]) -> dict:
     """Fleet-wide aggregation of per-process registry snapshots:
     counters add, histograms merge bucket-wise, gauges keep the last
-    writer (they are instantaneous by definition)."""
+    writer (they are instantaneous by definition). A TYPE CONFLICT
+    (two sources registered one name as different kinds — a version
+    skew across a rolling fleet) keeps the first writer deterministically
+    instead of corrupting the merge or taking the export path down."""
     merged: dict = {}
     for snap in snapshots:
         for name, entry in snap.items():
@@ -289,6 +292,8 @@ def merge_snapshots(snapshots: List[dict]) -> dict:
             have = merged.get(name)
             if have is None:
                 merged[name] = dict(entry)
+            elif have.get("type") != kind:
+                continue                  # type conflict: first writer wins
             elif kind == "counter":
                 have["value"] += entry["value"]
             elif kind == "histogram":

@@ -1,0 +1,412 @@
+"""SLO plane: declarative objectives, multi-window error-budget burn
+rates, and durable breach alerts.
+
+PR 11 built the measurement substrate (mergeable registry snapshots);
+this module *interprets* it. An :class:`SloObjective` names a metric and
+what "good" means — a latency histogram with a threshold (good = sample
+at or under the threshold) or an availability counter pair (good =
+total − bad) — and an :class:`SloClass` bundles objectives with the
+Google-SRE-workbook multi-window burn-rate policy: alert only when BOTH
+a fast window (catches cliff-edge regressions in minutes) and a slow
+window (filters one-bucket blips) burn error budget faster than their
+thresholds.
+
+The math is deliberately exact and unit-pinnable. Registry snapshots are
+cumulative, so a window is a DELTA between the snapshot nearest the
+window start and the newest one; histogram deltas subtract bucket-wise
+(the same monotone grid :meth:`Histogram.merge` adds), counter deltas
+subtract values. With budget ``1 − target``::
+
+    error_rate(window)  = bad_delta / (good_delta + bad_delta)
+    burn_rate(window)   = error_rate / budget
+
+A burn rate of 1.0 spends exactly the error budget over the SLO period;
+14.4 (the workbook's fast default) exhausts a 30-day budget in 2 days.
+``tests/test_operations.py`` pins a synthetic histogram to a known burn
+rate on both windows.
+
+Breaches are DURABLE: :func:`write_alert` lands one JSON record per
+breach occurrence under ``obs/alerts/`` of any storage ``Backend`` — the
+same per-occurrence-key contract as the PR 3 governor events (the key
+embeds the breach start stamp, so re-evaluating an ongoing breach
+overwrites its own record instead of growing the store). The scheduler
+tick and ``ServeFleet.flush_obs`` are the two evaluation points;
+``tpu-task obs alerts`` and ``obs watch`` read the records back.
+
+Plain Python on the host, like everything in ``obs/`` — this module
+never imports jax, storage, or serving code.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "ALERT_PREFIX",
+    "Alert",
+    "BurnWindow",
+    "ObjectiveStatus",
+    "SloClass",
+    "SloEvaluator",
+    "SloObjective",
+    "hist_good_bad",
+    "read_alerts",
+    "write_alert",
+]
+
+ALERT_PREFIX = "obs/alerts/"
+
+
+@dataclass(frozen=True)
+class BurnWindow:
+    """One evaluation window: seconds of history + the burn-rate level
+    above which it votes to alert."""
+
+    window_s: float
+    max_burn: float
+
+
+#: The SRE-workbook page-tier defaults: 5 min at 14.4× + 1 h at 6×.
+FAST_BURN = BurnWindow(300.0, 14.4)
+SLOW_BURN = BurnWindow(3600.0, 6.0)
+
+
+@dataclass(frozen=True)
+class SloObjective:
+    """What "good" means for one metric.
+
+    Two kinds, discriminated by ``threshold_s``:
+
+    * **latency** (``threshold_s`` set): ``metric`` names a histogram;
+      an event is good when its sample is at or under the threshold.
+      The threshold resolves at bucket resolution — a bucket is good iff
+      its upper bound is ≤ the threshold — so thresholds should sit on
+      or near a bucket boundary (~33% grid at the default 8/decade).
+    * **availability** (``threshold_s`` None): ``metric`` names the
+      bad-event counter and ``total_metric`` the total-event counter;
+      good = total − bad.
+
+    ``metric`` may end in ``.*``: the objective expands to one instance
+    per matching snapshot key (the per-tenant/per-service fan-out —
+    ``sched.queue_latency_s.*`` evaluates every tenant separately).
+    """
+
+    name: str
+    metric: str
+    target: float                          # good fraction, e.g. 0.99
+    threshold_s: Optional[float] = None
+    total_metric: Optional[str] = None
+
+    def __post_init__(self):
+        if not 0.0 < self.target < 1.0:
+            raise ValueError(f"target must be in (0, 1), got {self.target}")
+        if self.threshold_s is None and self.total_metric is None:
+            raise ValueError(
+                f"objective {self.name!r} needs threshold_s (latency over "
+                "a histogram) or total_metric (availability over counters)")
+
+    @property
+    def budget(self) -> float:
+        return 1.0 - self.target
+
+
+@dataclass(frozen=True)
+class SloClass:
+    """A service/tenant class: objectives + the multi-window policy."""
+
+    name: str
+    objectives: Tuple[SloObjective, ...]
+    fast: BurnWindow = FAST_BURN
+    slow: BurnWindow = SLOW_BURN
+
+    def __post_init__(self):
+        # Accept any sequence; store the tuple the frozen dataclass needs.
+        object.__setattr__(self, "objectives", tuple(self.objectives))
+
+
+# -- good/bad extraction -------------------------------------------------------
+
+
+def hist_good_bad(entry: dict, threshold_s: float) -> Tuple[float, float]:
+    """(good, bad) event counts of one histogram SNAPSHOT at a latency
+    threshold, at bucket resolution: bucket ``i`` is good iff its upper
+    bound ``lo·growth^i`` (``lo`` for the underflow bucket) is ≤ the
+    threshold; the overflow bucket is always bad. One-ulp tolerance so a
+    threshold ON a boundary counts that boundary's bucket as good."""
+    lo = entry["lo"]
+    growth = 10.0 ** (1.0 / entry["per_decade"])
+    n = entry["n"]
+    limit = threshold_s * (1.0 + 1e-9)
+    good = bad = 0.0
+    for index, count in entry.get("counts", {}).items():
+        i = int(index)
+        if i >= n - 1:                    # overflow: no finite upper bound
+            bad += count
+        elif (lo if i == 0 else lo * growth ** i) <= limit:
+            good += count
+        else:
+            bad += count
+    return good, bad
+
+
+def _hist_delta(new: dict, old: Optional[dict]) -> dict:
+    """Bucket-wise ``new − old`` (snapshots are cumulative). A negative
+    bucket means the source restarted its registry — clamp to the new
+    snapshot's count (the conservative reading: everything since the
+    restart is inside the window)."""
+    if old is None or old.get("type") != "histogram":
+        return new
+    out = dict(new)
+    old_counts = old.get("counts", {})
+    counts = {}
+    for index, count in new.get("counts", {}).items():
+        delta = count - old_counts.get(index, 0)
+        counts[index] = count if delta < 0 else delta
+    out["counts"] = {i: c for i, c in counts.items() if c}
+    out["count"] = sum(counts.values())
+    return out
+
+
+def _counter_delta(new: dict, old: Optional[dict]) -> float:
+    value = float(new.get("value", 0.0))
+    if old is None or old.get("type") != new.get("type"):
+        return value
+    delta = value - float(old.get("value", 0.0))
+    return value if delta < 0 else delta
+
+
+# -- evaluation ----------------------------------------------------------------
+
+
+@dataclass
+class ObjectiveStatus:
+    """One objective instance's current reading."""
+
+    slo: str
+    objective: str
+    metric: str
+    target: float
+    attainment: float                     # cumulative good fraction
+    burn_fast: float
+    burn_slow: float
+    breached: bool
+
+    def to_json(self) -> dict:
+        return {
+            "slo": self.slo, "objective": self.objective,
+            "metric": self.metric, "target": self.target,
+            "attainment": round(self.attainment, 6),
+            "burn_fast": round(self.burn_fast, 4),
+            "burn_slow": round(self.burn_slow, 4),
+            "breached": self.breached,
+        }
+
+
+@dataclass
+class Alert:
+    """A durable breach record. ``started_at`` is stable across
+    re-evaluations of one ongoing breach — it keys the durable record,
+    so persisting an ongoing alert is idempotent."""
+
+    slo: str
+    objective: str
+    metric: str
+    target: float
+    burn_fast: float
+    burn_slow: float
+    attainment: float
+    started_at: float
+    at: float
+    windows: Dict[str, float] = field(default_factory=dict)
+
+    def key(self) -> str:
+        metric = re.sub(r"[^A-Za-z0-9_.-]", "_", self.metric)
+        return (f"{ALERT_PREFIX}{self.slo}-{self.objective}-{metric}"
+                f"-{int(self.started_at * 1000):013d}.json")
+
+    def to_json(self) -> dict:
+        return {
+            "slo": self.slo, "objective": self.objective,
+            "metric": self.metric, "target": self.target,
+            "burn_fast": round(self.burn_fast, 4),
+            "burn_slow": round(self.burn_slow, 4),
+            "attainment": round(self.attainment, 6),
+            "started_at": self.started_at, "at": self.at,
+            "windows": self.windows,
+        }
+
+    @classmethod
+    def from_json(cls, record: dict) -> "Alert":
+        return cls(slo=record["slo"], objective=record["objective"],
+                   metric=record["metric"], target=record["target"],
+                   burn_fast=record["burn_fast"],
+                   burn_slow=record["burn_slow"],
+                   attainment=record.get("attainment", 0.0),
+                   started_at=record["started_at"], at=record["at"],
+                   windows=dict(record.get("windows", {})))
+
+
+class SloEvaluator:
+    """Window the cumulative registry snapshots and evaluate burn rates.
+
+    Callers :meth:`observe` a (merged) snapshot whenever they have a
+    fresh one — the scheduler every tick, the fleet every obs flush —
+    and :meth:`evaluate` reads burn rates off the retained ring. The
+    clock is injectable (the scheduler runs on a virtual clock in tests
+    and soaks); timestamps only ever come from it."""
+
+    def __init__(self, slos: Sequence[SloClass],
+                 clock: Callable[[], float] = time.monotonic,
+                 max_samples: int = 512):
+        self.slos = list(slos)
+        self.clock = clock
+        self._ring: List[Tuple[float, dict]] = []
+        self._max_samples = max_samples
+        horizon = max((max(slo.fast.window_s, slo.slow.window_s)
+                       for slo in self.slos), default=0.0)
+        self._horizon = 2.0 * horizon
+        #: (slo, objective, metric) -> breach start stamp; keys stable
+        #: while a breach is ongoing (the alert-record idempotency).
+        self._breach_started: Dict[tuple, float] = {}
+
+    # -- snapshot ring --------------------------------------------------------
+    def observe(self, snapshot: dict, now: Optional[float] = None) -> None:
+        now = self.clock() if now is None else now
+        self._ring.append((now, snapshot))
+        cutoff = now - self._horizon
+        while len(self._ring) > 2 and (self._ring[1][0] <= cutoff
+                                       or len(self._ring) > self._max_samples):
+            # Keep at least the newest baseline OUTSIDE the horizon so
+            # the slow window always has a subtrahend.
+            self._ring.pop(0)
+
+    def _baseline(self, now: float, window_s: float) -> Optional[dict]:
+        """The newest snapshot at or before the window start (falling
+        back to the oldest retained — a shorter-than-window history
+        reads as "since the beginning")."""
+        chosen = None
+        for stamp, snapshot in self._ring:
+            if stamp <= now - window_s:
+                chosen = snapshot
+            else:
+                break
+        if chosen is None and self._ring:
+            chosen = self._ring[0][1]
+        return chosen
+
+    # -- math -----------------------------------------------------------------
+    @staticmethod
+    def _good_bad(objective: SloObjective, metric: str, snapshot: dict,
+                  baseline: Optional[dict]) -> Tuple[float, float]:
+        entry = snapshot.get(metric)
+        if entry is None:
+            return 0.0, 0.0
+        base_entry = (baseline or {}).get(metric)
+        if objective.threshold_s is not None:
+            if entry.get("type") != "histogram":
+                return 0.0, 0.0
+            return hist_good_bad(_hist_delta(entry, base_entry),
+                                 objective.threshold_s)
+        total_entry = snapshot.get(objective.total_metric)
+        if total_entry is None:
+            return 0.0, 0.0
+        bad = _counter_delta(entry, base_entry)
+        total = _counter_delta(total_entry,
+                               (baseline or {}).get(objective.total_metric))
+        return max(0.0, total - bad), min(bad, total)
+
+    def _burn(self, objective: SloObjective, metric: str, snapshot: dict,
+              baseline: Optional[dict]) -> float:
+        good, bad = self._good_bad(objective, metric, snapshot, baseline)
+        total = good + bad
+        if total <= 0:
+            return 0.0
+        return (bad / total) / objective.budget
+
+    def _instances(self, objective: SloObjective,
+                   snapshot: dict) -> List[str]:
+        if not objective.metric.endswith(".*"):
+            return [objective.metric]
+        prefix = objective.metric[:-1]    # keep the trailing dot
+        return sorted(name for name in snapshot
+                      if name.startswith(prefix))
+
+    # -- the evaluation pass ---------------------------------------------------
+    def evaluate(self, now: Optional[float] = None
+                 ) -> Tuple[List[ObjectiveStatus], List[Alert]]:
+        """Burn rates for every objective instance over the retained
+        ring. Returns (statuses, alerts): ``alerts`` carries one record
+        per CURRENTLY-breached instance (stable ``started_at`` while the
+        breach persists — persist them all, the durable key dedups)."""
+        now = self.clock() if now is None else now
+        if not self._ring:
+            return [], []
+        snapshot = self._ring[-1][1]
+        statuses: List[ObjectiveStatus] = []
+        alerts: List[Alert] = []
+        for slo in self.slos:
+            fast_base = self._baseline(now, slo.fast.window_s)
+            slow_base = self._baseline(now, slo.slow.window_s)
+            for objective in slo.objectives:
+                for metric in self._instances(objective, snapshot):
+                    burn_fast = self._burn(objective, metric, snapshot,
+                                           fast_base)
+                    burn_slow = self._burn(objective, metric, snapshot,
+                                           slow_base)
+                    good, bad = self._good_bad(objective, metric,
+                                               snapshot, None)
+                    attainment = good / (good + bad) if good + bad else 1.0
+                    breached = (burn_fast > slo.fast.max_burn
+                                and burn_slow > slo.slow.max_burn)
+                    statuses.append(ObjectiveStatus(
+                        slo=slo.name, objective=objective.name,
+                        metric=metric, target=objective.target,
+                        attainment=attainment, burn_fast=burn_fast,
+                        burn_slow=burn_slow, breached=breached))
+                    key = (slo.name, objective.name, metric)
+                    if breached:
+                        started = self._breach_started.setdefault(key, now)
+                        alerts.append(Alert(
+                            slo=slo.name, objective=objective.name,
+                            metric=metric, target=objective.target,
+                            burn_fast=burn_fast, burn_slow=burn_slow,
+                            attainment=attainment, started_at=started,
+                            at=now,
+                            windows={"fast_s": slo.fast.window_s,
+                                     "slow_s": slo.slow.window_s}))
+                    else:
+                        self._breach_started.pop(key, None)
+        return statuses, alerts
+
+
+# -- durable alert records -----------------------------------------------------
+
+
+def write_alert(backend, alert: Alert) -> str:
+    """One JSON record per breach occurrence under ``obs/alerts/`` —
+    the durable event plane (same Backend seam as the PR 3 governor
+    events). The key embeds the breach start, so re-persisting an
+    ongoing breach overwrites its own record (idempotent)."""
+    key = alert.key()
+    backend.write(key, json.dumps(alert.to_json()).encode())
+    return key
+
+
+def read_alerts(backend, prefix: str = ALERT_PREFIX) -> List[Alert]:
+    """Every durable alert, newest last. Unreadable records are skipped
+    — a torn write must never take the viewer down."""
+    alerts: List[Alert] = []
+    for key in sorted(backend.list(prefix)):
+        if not key.endswith(".json"):
+            continue
+        try:
+            alerts.append(Alert.from_json(json.loads(backend.read(key))))
+        except (ValueError, KeyError, OSError):
+            continue
+    alerts.sort(key=lambda alert: (alert.started_at, alert.slo,
+                                   alert.objective, alert.metric))
+    return alerts

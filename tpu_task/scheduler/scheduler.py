@@ -60,7 +60,8 @@ class GangScheduler:
                  driver,
                  remote: Optional[str] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 obs: Optional[Obs] = None):
+                 obs: Optional[Obs] = None,
+                 slos=None):
         self.pool = pool
         self.quotas = dict(quotas)
         self.driver = driver
@@ -72,6 +73,18 @@ class GangScheduler:
         # surfaced in the status snapshot / `sched status` and mergeable
         # fleet-wide. Host-side control-plane bookkeeping: always on.
         self.obs = obs if obs is not None else Obs.create("scheduler")
+        # SLO plane (PR 12): objectives — typically per-tenant wildcards
+        # over `sched.queue_latency_s.*` — evaluated every tick on the
+        # scheduler clock (virtual clocks work); breaches surface in the
+        # status snapshot / `sched status` and land as durable records
+        # under obs/alerts/ of the queue backend.
+        self._slo = None
+        self._slo_statuses: list = []
+        self._slo_alerts: list = []
+        if slos:
+            from tpu_task.obs import SloEvaluator
+
+            self._slo = SloEvaluator(slos, clock=clock)
         # Same governor knobs as the per-task reconciler (PR 3): one
         # environment contract for both layers.
         self.recovery_budget = int(os.environ.get("TPU_TASK_RECOVERY_BUDGET", "5"))
@@ -320,11 +333,32 @@ class GangScheduler:
             if not placed_one:
                 break
 
-        # 3. Fairness accounting + durable status snapshot.
+        # 3. Fairness accounting + SLO evaluation + durable status
+        #    snapshot.
         for tenant, deficit in self.deficits().items():
             if deficit > self.max_deficit.get(tenant, 0.0):
                 self.max_deficit[tenant] = deficit
+        if self._slo is not None:
+            self._evaluate_slos(now)
         self._persist_status(now)
+
+    def _evaluate_slos(self, now: float) -> None:
+        """Per-tenant burn-rate evaluation over this scheduler's own
+        registry (queue-latency histograms); breaches become durable
+        alert records next to the queue state."""
+        self._slo.observe(self.obs.metrics.snapshot(), now=now)
+        self._slo_statuses, alerts = self._slo.evaluate(now=now)
+        self._slo_alerts = [alert.to_json() for alert in alerts]
+        backend = self.queue._backend
+        if backend is None:
+            return
+        from tpu_task.obs import write_alert
+
+        for alert in alerts:
+            try:
+                write_alert(backend, alert)
+            except OSError:
+                pass                      # re-persisted next tick
 
     # -- observation -----------------------------------------------------------
     def status(self) -> dict:
@@ -386,7 +420,7 @@ class GangScheduler:
                     "services": dict(sorted(services.items())),
                 },
             }
-        return {
+        out = {
             "tenants": tenants,
             "pool": {
                 "capacity_chips": self.pool.total_capacity,
@@ -395,6 +429,16 @@ class GangScheduler:
                 "free_by_domain": list(self.pool.free),
             },
         }
+        if self._slo is not None:
+            # Attainment + burn rates per objective instance, and the
+            # currently-firing alerts — what `sched status` renders and
+            # status.json persists each tick.
+            out["slo"] = {
+                "objectives": [status.to_json()
+                               for status in self._slo_statuses],
+                "alerts": list(self._slo_alerts),
+            }
+        return out
 
     def _persist_status(self, now: float) -> None:
         backend = self.queue._backend

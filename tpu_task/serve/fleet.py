@@ -163,11 +163,23 @@ class ServeFleet:
 
     def __init__(self, scheduler, spec: ServeSpec, router: Router,
                  endpoint_source: Optional[Callable[[str], Optional[dict]]] = None,
-                 autoscaler=None, obs_flush_every: int = 25):
+                 autoscaler=None, obs_flush_every: int = 25,
+                 slos=None, slo_clock: Callable[[], float] = time.monotonic):
         self.scheduler = scheduler
         self.spec = spec
         self.router = router
         self.autoscaler = autoscaler
+        # SLO plane (PR 12): objectives evaluated fleet-wide over the
+        # merged registry (router + every replica pulled this flush) in
+        # flush_obs; breaches land as durable alert records under
+        # obs/alerts/ of the scheduler backend — the same event plane
+        # the governor uses — and `obs alerts`/`obs watch` read them.
+        self._slo = None
+        self.slo_statuses: List = []
+        if slos:
+            from tpu_task.obs import SloEvaluator
+
+            self._slo = SloEvaluator(slos, clock=slo_clock)
         # Durable observability export: when the scheduler has a durable
         # backend, router spans/metrics and each replica's /obs pull land
         # under obs/ of the SAME backend every `obs_flush_every` ticks —
@@ -264,7 +276,7 @@ class ServeFleet:
             if desired != self.live_replicas():
                 self.scale_to(desired)
         self._ticks += 1
-        if self._obs_exporter is not None \
+        if (self._obs_exporter is not None or self._slo is not None) \
                 and self._ticks % self._obs_flush_every == 0:
             self.flush_obs()
 
@@ -276,11 +288,20 @@ class ServeFleet:
         pulled: their own process already drains the ring into its
         workdir for the agent's data sync, and a second drainer would
         split one request's trace nondeterministically across two
-        durable roots. Returns the number of spans exported.
-        Best-effort by design: a full backend or a torn /obs answer
-        skips a batch, never takes the control loop down."""
-        if self._obs_exporter is None:
-            return 0
+        durable roots. When SLOs are attached, the flush is also the
+        fleet evaluation point: the merged registry (router + every
+        replica pulled this flush) feeds the burn-rate evaluator and
+        breaches become durable ``obs/alerts/`` records. Returns the
+        number of spans exported. Best-effort by design: a full backend
+        or a torn /obs answer skips a batch, never takes the control
+        loop down."""
+        replica_snaps: List[dict] = []
+        exported = self._export_obs(replica_snaps)
+        if self._slo is not None:
+            self._evaluate_slos(replica_snaps)
+        return exported
+
+    def _export_obs(self, replica_snaps: List[dict]) -> int:
         import urllib.error
 
         from tpu_task.obs import Span, export_metrics
@@ -288,22 +309,24 @@ class ServeFleet:
 
         exported = 0
         obs = self.router.obs
-        spans = obs.tracer.finished()
-        try:
-            self._obs_exporter.export(spans, source="router")
-        except OSError:
-            return exported               # ring kept: retried next flush
-        # Drain ONLY after the span write landed (a failed metrics write
-        # below must not leave exported spans in the ring, or every later
-        # flush re-exports them and the durable store grows duplicates).
-        obs.tracer.drain()
-        exported += len(spans)
-        try:
-            export_metrics(self._obs_backend, obs.metrics.snapshot(),
-                           source="router")
-        except OSError:
-            pass                          # snapshots are cumulative: next
-            #                               flush writes a superset anyway
+        if self._obs_exporter is not None:
+            spans = obs.tracer.finished()
+            try:
+                self._obs_exporter.export(spans, source="router")
+            except OSError:
+                return exported           # ring kept: retried next flush
+            # Drain ONLY after the span write landed (a failed metrics
+            # write below must not leave exported spans in the ring, or
+            # every later flush re-exports them and the durable store
+            # grows duplicates).
+            obs.tracer.drain()
+            exported += len(spans)
+            try:
+                export_metrics(self._obs_backend, obs.metrics.snapshot(),
+                               source="router")
+            except OSError:
+                pass                      # snapshots are cumulative: next
+                #                           flush writes a superset anyway
         # In-process replicas have no agent/data sync — the fleet is
         # their only durable path. (InProcessServeDriver's endpoint
         # registry is the discriminator; real drivers lack it.) A pull
@@ -312,13 +335,18 @@ class ServeFleet:
         # never silently dropped.
         if getattr(self.scheduler.driver, "endpoints", None) is None:
             return exported
+        # Drain a replica's ring ONLY when there is a durable exporter
+        # to land the spans in — an SLO-only fleet (no backend) pulls
+        # metrics non-destructively, keeping the "no backend → spans
+        # stay in the in-process rings" contract.
+        drain = "1" if self._obs_exporter is not None else "0"
         batches = self._obs_pending
         self._obs_pending = []
         for task_id, info in self.refresh_endpoints().items():
             try:
                 body = json.loads(send(
-                    "GET", info["url"] + "/obs?drain=1", timeout=2.0,
-                    retries=0))
+                    "GET", info["url"] + f"/obs?drain={drain}",
+                    timeout=2.0, retries=0))
                 spans = [Span.from_json(record)
                          for record in body.get("spans", ())]
             except (urllib.error.URLError, OSError, ValueError, KeyError):
@@ -326,6 +354,10 @@ class ServeFleet:
             source = body.get("source", task_id)
             batches.append((spans, source, body.get("metrics")))
         for spans, source, metrics in batches:
+            if metrics:
+                replica_snaps.append(metrics)
+            if self._obs_exporter is None:
+                continue
             try:
                 self._obs_exporter.export(spans, source=source)
                 exported += len(spans)
@@ -335,6 +367,41 @@ class ServeFleet:
             except OSError:
                 self._obs_pending.append((spans, source, metrics))
         return exported
+
+    def _evaluate_slos(self, replica_snaps: List[dict]) -> None:
+        from tpu_task.obs import merge_snapshots, write_alert
+
+        merged = merge_snapshots(
+            [self.router.obs.metrics.snapshot(), *replica_snaps])
+        self._slo.observe(merged)
+        self.slo_statuses, alerts = self._slo.evaluate()
+        if self._obs_backend is None:
+            return
+        for alert in alerts:
+            try:
+                write_alert(self._obs_backend, alert)
+            except OSError:
+                pass                      # re-persisted next evaluation
+
+    def prometheus_text(self) -> str:
+        """The fleet-merged scrape surface: the router's registry merged
+        with every placed replica's ``/obs`` metrics snapshot (a
+        non-draining pull — the span rings are untouched), in Prometheus
+        text exposition."""
+        import urllib.error
+
+        from tpu_task.storage.http_util import send
+
+        snaps = []
+        for task_id, info in self.refresh_endpoints().items():
+            try:
+                body = json.loads(send("GET", info["url"] + "/obs",
+                                       timeout=2.0, retries=0))
+            except (urllib.error.URLError, OSError, ValueError):
+                continue
+            if body.get("metrics"):
+                snaps.append(body["metrics"])
+        return self.router.prometheus_text(snaps)
 
 
 def bucket_endpoint_source(bucket_dir_of: Callable[[str], str]):

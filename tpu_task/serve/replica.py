@@ -28,6 +28,13 @@ on the pooled transport of ``storage/http_util.py``):
   stream continues from the router's own high-water mark.
 * ``GET /poll?rid=`` · ``GET /stats`` · ``GET /healthz`` ·
   ``GET /export`` · ``POST /drain``.
+* ``GET /metrics`` — the replica's registry snapshot in Prometheus text
+  exposition (counters/gauges + cumulative histogram buckets), scrapable
+  by any standard collector. ``/healthz`` reports ``draining`` and the
+  open-work ``queue_depth`` so probes distinguish a draining replica
+  from a healthy one. ``GET /profile?ms=`` kicks an on-demand XLA
+  profiler capture (``ml/profiling.py``) whose artifact lands under the
+  working directory for the agent's data sync.
 
 Graceful drain (SIGTERM, the cloud preemption notice): stop admitting →
 finish the in-flight engine step → export every unfinished request
@@ -134,6 +141,19 @@ class _JSONHandler(BaseHTTPRequestHandler):
             # pull makes a lost response free to lose.
             self.close_connection = True
 
+    def _reply_text(self, body: str, status: int = 200) -> None:
+        raw = body.encode()
+        try:
+            self.send_response(status)
+            # The Prometheus text-exposition content type.
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(raw)))
+            self.end_headers()
+            self.wfile.write(raw)
+        except OSError:
+            self.close_connection = True
+
     def _query(self) -> dict:
         return {k: v[-1] for k, v in
                 parse_qs(urlsplit(self.path).query).items()}
@@ -143,8 +163,20 @@ class _JSONHandler(BaseHTTPRequestHandler):
         path = urlsplit(self.path).path
         try:
             if path == "/healthz":
-                self._reply({"ok": True, "boot_id": replica.boot_id,
-                             "draining": replica.draining})
+                # draining + queue_depth, not a bare green: an external
+                # probe (or the router) must distinguish a draining
+                # replica still serving suffixes from a healthy one.
+                self._reply(replica.health())
+            elif path == "/metrics":
+                self._reply_text(replica.metrics_text())
+            elif path == "/profile":
+                result = replica.profile(
+                    int(self._query().get("ms", 500)))
+                if result is None:
+                    self._reply({"error": "a profiler capture is already "
+                                          "running"}, 409)
+                else:
+                    self._reply(result)
             elif path == "/stats":
                 self._reply(replica.stats())
             elif path == "/poll":
@@ -219,7 +251,7 @@ class ReplicaServer:
     def __init__(self, engine=None, *, preset: str = "tiny",
                  serving: Optional[dict] = None, host: str = "127.0.0.1",
                  port: int = 0, drain_file: Optional[str] = None,
-                 obs_enabled: bool = True):
+                 obs_enabled: bool = True, profile_dir: str = "profiles"):
         self.boot_id = uuid.uuid4().hex[:12]
         #: One tracer + registry for the whole replica (front end AND
         #: engine — the engine records into the same registry, so /stats
@@ -232,6 +264,8 @@ class ReplicaServer:
             preset, serving, obs=self.obs)
         self.draining = False
         self.drain_file = drain_file
+        self.profile_dir = profile_dir
+        self._profile_thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._exported: Optional[list] = None
@@ -306,6 +340,53 @@ class ReplicaServer:
         self.obs.metrics.counter(f"replica.errors.{where.strip('/')}").inc()
         self.obs.tracer.error("replica.error", error, parent=trace,
                               path=where, boot_id=self.boot_id)
+
+    def health(self) -> dict:
+        """The ``/healthz`` body: ``ok`` (the process answers), whether a
+        graceful drain is in progress, and the open-work depth — a
+        draining replica is NOT a bare green to external probes, and the
+        router/fleet can weigh remaining drain work."""
+        with self._lock:
+            return {"ok": True, "boot_id": self.boot_id,
+                    "draining": self.draining,
+                    "queue_depth": self.engine.queue_depth
+                    + self.engine.n_active}
+
+    def metrics_text(self) -> str:
+        """``GET /metrics``: the whole replica's registry (front end AND
+        engine share one) in Prometheus text exposition."""
+        if self.obs is None:
+            return "# obs disabled (--no-obs)\n"
+        from tpu_task.obs import prometheus_text
+
+        return prometheus_text(self.obs.metrics.snapshot())
+
+    def profile(self, ms: int) -> Optional[dict]:
+        """Kick an on-demand XLA profiler capture of ``ms`` milliseconds
+        on a worker thread (the serving loop never blocks); the artifact
+        directory lands under ``profile_dir`` (working-directory-relative
+        on a real task, so the agent's data sync ships it home). Returns
+        None when a capture is already running (409 upstream)."""
+        from tpu_task.ml import profiling
+
+        # The reservation is taken HERE, on the handler thread — two
+        # concurrent /profile requests race the lock, not a stale busy()
+        # check, so exactly one gets {ok} and the other the 409.
+        if not profiling.acquire_capture():
+            return None
+        ms = max(10, min(int(ms), 60_000))
+        out_dir = os.path.abspath(os.path.join(
+            self.profile_dir, f"capture-{int(time.time() * 1000)}"))
+
+        def run() -> None:
+            try:
+                profiling.capture_reserved(out_dir, ms / 1000.0)
+            except Exception as error:   # unsupported backend
+                self.note_error("/profile", error)
+
+        self._profile_thread = threading.Thread(target=run, daemon=True)
+        self._profile_thread.start()
+        return {"ok": True, "dir": out_dir, "ms": ms}
 
     def obs_snapshot(self, drain: bool = False) -> dict:
         """The ``/obs`` endpoint: finished spans (``drain=1`` clears the

@@ -470,6 +470,15 @@ class Router:
             raise RuntimeError(f"request {fid} is {request.status}, not done")
         return list(request.tokens)
 
+    def prometheus_text(self, extra_snapshots=()) -> str:
+        """The router's registry — merged with any replica snapshots the
+        caller pulled (``ServeFleet.prometheus_text`` passes them) — in
+        Prometheus text exposition: the fleet-merged scrape surface."""
+        from tpu_task.obs import merge_snapshots, prometheus_text
+
+        return prometheus_text(merge_snapshots(
+            [self.obs.metrics.snapshot(), *extra_snapshots]))
+
     @property
     def queue_depth(self) -> int:
         """Open requests beyond what the fleet's slots could be running —
