@@ -54,10 +54,12 @@ bench-serving:
 bench-sched:
 	$(PYTHON) bench.py scheduler
 
-# Paged-decode kernel grid only: impl (xla gather vs Pallas kernel) ×
-# kv_dtype (model dtype vs int8) × batch {1,8,32} — decode ms/token and
-# KV bytes/token. Runs on CPU via the Pallas interpreter (emulation tax,
-# not kernel speed); compiled kernel numbers need a TPU backend. The
+# Paged-decode kernel grid only: impl (xla gather vs Pallas kernel vs the
+# DMA-pipelined kernel) × kv_dtype (model dtype vs int8) × batch {1,8,32}
+# — decode ms/token and KV bytes/token — plus the pipelined-vs-PR9
+# head-to-head on the long fragmented table. EXITS NONZERO if the
+# pipelined kernel regresses there (wall-clock on TPU; kernel parity
+# everywhere — interpreter wall is emulation tax, not kernel speed). The
 # tier-1 interpret-mode parity/smoke suite is tests/test_paged_attention.py.
 bench-decode:
 	$(PYTHON) bench.py generation --decode-kernel
@@ -121,7 +123,10 @@ bench-obs:
 # Goodput/MFU/dispatch-overhead leg: in-program vs host-gap wall split
 # (the ROADMAP-4 "dispatches dominate" gauge), goodput ratio, and the
 # static-FLOP-model MFU gauge at batch {1,8,32}, cross-checked against
-# XLA cost_analysis where the backend provides one.
+# XLA cost_analysis where the backend provides one. Includes the
+# micro_k ∈ {1,4,8} dispatch-amortization sweep at batch 32 (greedy
+# streams asserted bit-identical across K — exits nonzero on
+# divergence; dispatches/token and host_gap_frac per K).
 bench-goodput:
 	$(PYTHON) bench.py goodput
 

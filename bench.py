@@ -25,6 +25,7 @@ better).
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import sys
@@ -489,15 +490,20 @@ def bench_generation_decode_kernel(batches=(1, 8, 32), steps: int = 6,
     serving engine dispatches per iteration, with every slot ``depth``
     tokens deep. Reported per point: decode ms/token and KV bytes/token.
 
-    Runs on ANY backend: the kernel leg compiles the Pallas kernel on a
-    TPU (``impl="pallas"``, flagship-like d_head=128 geometry) and runs
-    the SAME kernel through the Pallas interpreter on CPU
-    (``impl="interpret"``) — interpreter wall-clock is an emulation tax,
-    NOT a kernel speed claim; the grid exists so the kernel path is
-    exercised and tracked everywhere, with the real speedup measured on
-    chip. The XLA legs are the gather+dense reference (the pre-kernel
-    serving path); int8 legs halve-or-better the KV bytes and pay a
-    per-step requantize of the written blocks."""
+    Runs on ANY backend: the kernel legs compile the Pallas kernels on a
+    TPU (``impl="pallas"``/``"pipelined"``, flagship-like d_head=128
+    geometry) and run the SAME kernels through the Pallas interpreter on
+    CPU (``impl="interpret"``/``"interpret_pipelined"``) — interpreter
+    wall-clock is an emulation tax, NOT a kernel speed claim; the grid
+    exists so the kernel paths are exercised and tracked everywhere,
+    with the real speedup measured on chip. The XLA legs are the
+    gather+dense reference (the pre-kernel serving path); int8 legs
+    halve-or-better the KV bytes and pay a per-step requantize of the
+    written blocks; the ``pipelined`` column is the PR 13
+    double-buffered-DMA kernel (block N+1's HBM→VMEM copy overlaps
+    block N's compute), compared head-to-head against the PR 9 kernel
+    on the long-fragmented-table case by
+    :func:`bench_decode_pipelined_vs_pr9`."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -509,6 +515,7 @@ def bench_generation_decode_kernel(batches=(1, 8, 32), steps: int = 6,
 
     on_tpu = jax.default_backend() == "tpu"
     kernel_impl = "pallas" if on_tpu else "interpret"
+    pipelined_impl = "pipelined" if on_tpu else "interpret_pipelined"
     if on_tpu:
         cfg = transformer.TransformerConfig(
             vocab_size=32768, d_model=1024, n_layers=8, n_heads=8,
@@ -534,7 +541,7 @@ def bench_generation_decode_kernel(batches=(1, 8, 32), steps: int = 6,
         scfg = ServingConfig(
             slots=batch, block_size=block_size, max_len=max_len,
             n_blocks=batch * m + 1, kv_dtype=kv_dtype, decode_impl=impl)
-        if impl == "pallas":
+        if impl in ("pallas", "pipelined"):
             # Same gate the engine applies at construction — an
             # unsatisfiable point reports itself instead of handing
             # Mosaic an allocation failure mid-bench.
@@ -592,18 +599,98 @@ def bench_generation_decode_kernel(batches=(1, 8, 32), steps: int = 6,
         }
 
     grid = [point(impl, kv_dtype, b)
-            for impl in ("xla", kernel_impl)
+            for impl in ("xla", kernel_impl, pipelined_impl)
             for kv_dtype in (None, "int8")
             for b in batches]
     return {
         "backend": jax.default_backend(),
         "kernel_impl": kernel_impl,
+        "pipelined_impl": pipelined_impl,
         "context_depth": depth,
         "steps_timed": steps,
         "note": ("interpret-mode ms is the Pallas interpreter's emulation "
                  "tax, not kernel speed — the kernel's win is measured "
                  "compiled on a TPU backend"),
         "grid": grid,
+    }
+
+
+def bench_decode_pipelined_vs_pr9(seed: int = 0) -> dict:
+    """Head-to-head on the LONG FRAGMENTED table — the case the DMA
+    pipeline exists for: every slot deep (many blocks to walk) and its
+    blocks scattered across the pool in scrambled order (no contiguity
+    for the memory system to exploit), so the walk is one dependent HBM
+    read per block unless the next block's copy overlaps the current
+    block's compute.
+
+    The regression gate behind ``make bench-decode`` (the CI satellite):
+    on a TPU backend, ``regressed`` is True when the compiled pipelined
+    kernel is measurably slower than the PR 9 kernel here (>5%
+    tolerance); on CPU the kernels run through the interpreter, where
+    wall-clock is emulation tax — the gate checks PARITY instead (both
+    kernels within the pinned tolerance of the XLA reference), so a
+    broken kernel still fails the make target everywhere."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_task.ml.ops.paged_attention import (
+        paged_attention, paged_reference_attention)
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        slots, h, kv, d, bs, max_blocks = 8, 8, 2, 128, 32, 64
+    else:
+        slots, h, kv, d, bs, max_blocks = 4, 8, 4, 32, 8, 16
+    n_blocks = slots * max_blocks + 1
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(slots, 1, h, d)), jnp.float32)
+    kp = jnp.asarray(rng.normal(size=(n_blocks, bs, kv, d)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(n_blocks, bs, kv, d)), jnp.float32)
+    # Fragmented: every slot at full depth, blocks drawn in scrambled
+    # order from the whole pool — the PR 9 follow-on's worst case.
+    perm = rng.permutation(np.arange(1, n_blocks))
+    tables = jnp.asarray(perm[:slots * max_blocks].reshape(
+        slots, max_blocks).astype(np.int32))
+    depth = max_blocks * bs - 1
+    pos = jnp.full((slots, 1), depth, jnp.int32)
+
+    impls = (("pallas", "pipelined") if on_tpu
+             else ("interpret", "interpret_pipelined"))
+
+    def time_impl(impl: str):
+        fn = jax.jit(functools.partial(paged_attention, impl=impl))
+        out = fn(q, kp, vp, tables, pos)
+        jax.block_until_ready(out)
+        steps = 20 if on_tpu else 2
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = fn(q, kp, vp, tables, pos)
+        jax.block_until_ready(out)
+        return out, (time.perf_counter() - t0) / steps
+
+    ref = paged_reference_attention(q, kp, vp, tables, pos)
+    out9, wall9 = time_impl(impls[0])
+    outp, wallp = time_impl(impls[1])
+    atol = 2e-5
+    err9 = float(jnp.max(jnp.abs(out9 - ref)))
+    errp = float(jnp.max(jnp.abs(outp - ref)))
+    if on_tpu:
+        regressed = wallp > wall9 * 1.05 or errp > atol
+    else:
+        regressed = err9 > atol or errp > atol
+    return {
+        "backend": jax.default_backend(),
+        "table": {"slots": slots, "blocks_per_slot": max_blocks,
+                  "block_size": bs, "depth": depth, "layout": "fragmented"},
+        "pr9_kernel": {"impl": impls[0], "ms": round(wall9 * 1e3, 3),
+                       "max_err_vs_reference": err9},
+        "pipelined_kernel": {"impl": impls[1], "ms": round(wallp * 1e3, 3),
+                             "max_err_vs_reference": errp},
+        "speedup_pipelined_over_pr9": round(wall9 / wallp, 3),
+        "gate": ("wall-clock (>5% regression fails) + parity" if on_tpu
+                 else "parity only (interpreter wall is emulation tax)"),
+        "regressed": regressed,
     }
 
 
@@ -803,29 +890,39 @@ def bench_serving(n_requests: int = 36, seed: int = 0) -> dict:
 
 def _kv_density(cfg, scfg, budget_bytes=None) -> dict:
     """bytes/token + effective ``n_blocks`` at a fixed byte budget, model
-    dtype vs int8 — the density half of ROADMAP item 3 in one dict."""
+    dtype vs int8 vs fp8 — the density half of ROADMAP item 3 (int8) and
+    the fp8 row of PR 13: fp8 e4m3 codes are byte-identical to int8's
+    (1 byte + the same amortized scale sidecar), so its density equals
+    int8's; what fp8 changes is the ERROR SHAPE — relative per-element
+    rounding instead of int8's uniform grid (docs/parity.md)."""
     import dataclasses
 
     from tpu_task.ml.serving.cache import (
         blocks_in_budget, kv_token_bytes, paged_cache_bytes)
 
     int8_scfg = dataclasses.replace(scfg, kv_dtype="int8")
+    fp8_scfg = dataclasses.replace(scfg, kv_dtype="fp8")
     budget = (paged_cache_bytes(cfg, scfg, scfg.n_blocks)
               if budget_bytes is None else budget_bytes)
     fp_tok = kv_token_bytes(cfg)
     i8_tok = kv_token_bytes(cfg, int8_scfg)
+    f8_tok = kv_token_bytes(cfg, fp8_scfg)
     fp_blocks = blocks_in_budget(cfg, scfg, budget)
     i8_blocks = blocks_in_budget(cfg, int8_scfg, budget)
+    f8_blocks = blocks_in_budget(cfg, fp8_scfg, budget)
     import jax.numpy as jnp
 
     return {
         "model_dtype": str(jnp.dtype(cfg.dtype)),
-        "kv_bytes_per_token": {"model_dtype": fp_tok, "int8": i8_tok},
+        "kv_bytes_per_token": {"model_dtype": fp_tok, "int8": i8_tok,
+                               "fp8": f8_tok},
         "int8_bytes_ratio": round(i8_tok / fp_tok, 4),
+        "fp8_bytes_ratio": round(f8_tok / fp_tok, 4),
         "pool_budget_mb": round(budget / 1e6, 3),
         "n_blocks_at_fixed_budget": {"model_dtype": fp_blocks,
-                                     "int8": i8_blocks},
+                                     "int8": i8_blocks, "fp8": f8_blocks},
         "int8_blocks_ratio": round(i8_blocks / max(1, fp_blocks), 2),
+        "fp8_blocks_ratio": round(f8_blocks / max(1, fp_blocks), 2),
     }
 
 
@@ -2067,7 +2164,7 @@ def bench_obs(n_requests: int = 8, max_new: int = 16, seed: int = 0,
 
 
 def bench_goodput(batches=(1, 8, 32), max_new: int = 24,
-                  seed: int = 0) -> dict:
+                  seed: int = 0, micro_ks=(1, 4, 8)) -> dict:
     """Goodput/MFU/dispatch-overhead accounting (PR 12): the engine's
     always-on split of step wall into in-program vs host-gap time — the
     direct measurement of ROADMAP 4's "dispatches dominate" claim — plus
@@ -2075,7 +2172,14 @@ def bench_goodput(batches=(1, 8, 32), max_new: int = 24,
     (= slots) ∈ {1, 8, 32} on a greedy workload. The static model is
     cross-checked against ``jax.jit(...).lower().cost_analysis()`` where
     the backend provides one. Compile warmup runs before the meter is
-    reset, so compile seconds never read as host gap."""
+    reset, so compile seconds never read as host gap.
+
+    The ``micro_k_sweep`` section (PR 13) is the acceptance metric of
+    the K-token fused micro-step: the SAME batch-32 workload at
+    ``micro_k`` ∈ ``micro_ks``, greedy streams asserted bit-identical
+    across K, reporting dispatches/token and host_gap_frac — dispatch
+    amortization alone must shrink both on any backend (CPU included;
+    no kernel involved)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -2137,16 +2241,77 @@ def bench_goodput(batches=(1, 8, 32), max_new: int = 24,
                          "only, XLA counts every op — ratios near 1 "
                          "validate the model's magnitude"),
             }
+    # -- micro_k sweep: dispatch amortization at batch = max(batches) ----
+    sweep_batch = max(batches)
+    # Longer generations than the per-batch leg: steady-state decode is
+    # where the micro-step amortizes (admission chunk steps are shared
+    # overhead at every K), so give the sweep enough decode tail for the
+    # host-gap drop to be the dominant signal.
+    sweep_max_new = 2 * max_new
+    sweep = {}
+    streams_by_k = {}
+    for K in micro_ks:
+        scfg = ServingConfig(slots=sweep_batch, block_size=8,
+                             n_blocks=max(96, 12 * sweep_batch),
+                             max_len=8 + sweep_max_new,
+                             prefix_cache=False, micro_k=K)
+        obs = Obs.create(f"goodput-k{K}")
+        engine = ServingEngine(params, cfg, scfg, obs=obs)
+        k_rng = np.random.default_rng(seed)
+        prompts = [k_rng.integers(0, cfg.vocab_size, size=8)
+                   for _ in range(sweep_batch)]
+        # Same warmup request at every K: drain() reports every request
+        # ever submitted, so the warmup stream is part of the asserted
+        # cross-K identity too (micro-steps cap in-program at the
+        # remaining budget, so max_new < K is fine).
+        engine.submit(prompts[0], 2)
+        engine.drain()                    # compile off the books
+        engine._goodput.reset()
+        t0 = time.perf_counter()
+        for p in prompts:
+            engine.submit(p, sweep_max_new)
+        streams_by_k[K] = engine.drain()
+        wall = time.perf_counter() - t0
+        gp = engine.stats()["goodput"]
+        sweep[str(K)] = {
+            "tokens_per_s": round(sweep_batch * sweep_max_new / wall, 1),
+            "dispatches_per_token": gp["dispatches_per_token"],
+            "host_gap_frac": gp["host_gap_frac"],
+            "in_program_frac": gp["in_program_frac"],
+            "host_ms_per_token": round(
+                gp["host_s"] / max(1, gp["tokens"]["emitted"]) * 1e3, 4),
+        }
+    # Baseline = the SMALLEST K (order-independent: --micro-k 8,4,1 must
+    # not report kmax-vs-kmax as the headline drop).
+    base_k = min(micro_ks)
+    identical = all(streams_by_k[K] == streams_by_k[base_k]
+                    for K in micro_ks)
+    micro_sweep = {
+        "batch": sweep_batch,
+        "max_new": sweep_max_new,
+        "per_k": sweep,
+        "greedy_streams_identical_across_k": identical,
+        "host_gap_drop_k1_to_kmax": (round(
+            sweep[str(base_k)]["host_gap_frac"]
+            - sweep[str(max(micro_ks))]["host_gap_frac"], 4)
+            if len(micro_ks) > 1 else None),
+    }
+    if not identical:
+        micro_sweep["ERROR"] = ("greedy streams DIVERGED across micro_k "
+                                "— the bit-identity contract is broken")
+
     return {
         "workload": {"batches": list(batches), "max_new": max_new,
                      "prompt_tokens": 8},
         "per_batch": per_batch,
+        "micro_k_sweep": micro_sweep,
         "flop_model_cross_check": xcheck,
         "note": ("host_gap_frac is the ROADMAP-4 dispatch-overhead "
                  "gauge (CPU ms-scale steps: expect a large host share; "
-                 "the multi-token micro-step work must shrink it); MFU "
-                 "off-TPU runs on the documented nominal peak — a "
-                 "relative gauge, not an absolute one"),
+                 "the micro_k_sweep shows the K-token fused micro-step "
+                 "shrinking it — dispatch amortization alone, no "
+                 "kernel); MFU off-TPU runs on the documented nominal "
+                 "peak — a relative gauge, not an absolute one"),
     }
 
 
@@ -2166,6 +2331,8 @@ def main() -> int:
     # The paged-decode kernel grid runs on ANY backend (interpret mode on
     # CPU) — the kernel + int8 paths stay tracked even off-chip.
     generation["decode_kernel"] = bench_generation_decode_kernel()
+    generation["decode_kernel"]["pipelined_vs_pr9"] = \
+        bench_decode_pipelined_vs_pr9()
     serving = bench_serving()
     # Needs >= 8 devices (real chips or a forced-host CPU platform); a
     # single-device full bench reports the section as skipped.
@@ -2281,6 +2448,7 @@ def _parse_args(argv):
     generation.add_argument(
         "--batches", default="1,8,32", metavar="B[,B...]",
         help="batch sizes for the decode-kernel grid (default 1,8,32)")
+    generation.add_argument("--seed", type=int, default=0)
     serving = sub.add_parser(
         "serving",
         help="continuous-batching vs generate section only "
@@ -2339,6 +2507,11 @@ def _parse_args(argv):
     goodput_cmd.add_argument("--max-new", type=int, default=24,
                              dest="max_new")
     goodput_cmd.add_argument("--seed", type=int, default=0)
+    goodput_cmd.add_argument(
+        "--micro-k", default="1,4,8", metavar="K[,K...]", dest="micro_k",
+        help="micro_k values for the dispatch-amortization sweep at "
+             "batch max(batches) — greedy streams asserted bit-identical "
+             "across K (default 1,4,8)")
     return parser.parse_args(argv)
 
 
@@ -2360,8 +2533,15 @@ if __name__ == "__main__":
         result = ({} if args.decode_kernel else bench_generation())
         result["decode_kernel"] = bench_generation_decode_kernel(
             batches=batches)
+        result["decode_kernel"]["pipelined_vs_pr9"] = \
+            bench_decode_pipelined_vs_pr9(seed=args.seed)
         print(json.dumps({"generation": result}))
-        raise SystemExit(0)
+        # The CI gate: `make bench-decode` fails when the pipelined
+        # kernel regresses vs PR 9's on the long-fragmented-table case
+        # (wall-clock on TPU, parity everywhere).
+        raise SystemExit(
+            1 if result["decode_kernel"]["pipelined_vs_pr9"]["regressed"]
+            else 0)
     if args.section == "fleet":
         counts = tuple(int(c) for c in str(args.replicas).split(",")
                        if c.strip())
@@ -2375,11 +2555,19 @@ if __name__ == "__main__":
             seed=args.seed, repeats=args.repeats)}))
         raise SystemExit(0)
     if args.section == "goodput":
+        # Empty flag values ("--batches ,") fall back to the defaults
+        # instead of crashing mid-section with no JSON emitted.
         batches = tuple(int(b) for b in str(args.batches).split(",")
-                        if b.strip())
-        print(json.dumps({"goodput": bench_goodput(
-            batches=batches, max_new=args.max_new, seed=args.seed)}))
-        raise SystemExit(0)
+                        if b.strip()) or (1, 8, 32)
+        micro_ks = tuple(int(k) for k in str(args.micro_k).split(",")
+                         if k.strip()) or (1, 4, 8)
+        result = bench_goodput(
+            batches=batches, max_new=args.max_new, seed=args.seed,
+            micro_ks=micro_ks)
+        print(json.dumps({"goodput": result}))
+        raise SystemExit(
+            0 if result["micro_k_sweep"][
+                "greedy_streams_identical_across_k"] else 1)
     if args.section == "serving":
         tps = tuple(int(t) for t in str(args.tp or "1,8").split(",")
                     if t.strip())

@@ -234,7 +234,7 @@ def test_decode_impl_validation_and_fallback(params, monkeypatch):
     with pytest.raises(ValueError, match="decode_impl"):
         ServingConfig(decode_impl="mosaic")
     with pytest.raises(ValueError, match="kv_dtype"):
-        ServingConfig(kv_dtype="fp8")
+        ServingConfig(kv_dtype="fp4")
     # CPU backend: explicit pallas points at interpret/xla.
     with pytest.raises(ValueError, match="interpret"):
         ServingEngine(params, TINY, ServingConfig(decode_impl="pallas"))
@@ -417,3 +417,145 @@ def test_engine_tp8_interpret_kernel_matches_single_chip():
     rids = [eng.submit(p, n) for p, n in reqs]
     out = eng.drain()
     assert [out[r] for r in rids] == single
+
+
+# -- fp8 (e4m3) quantized pools (PR 13) ---------------------------------------
+
+def test_fp8_round_trip_error_bound():
+    """fp8 e4m3 round trip mirrors the int8 property with a RELATIVE
+    bound: |dequant(quantize(x)) − x| ≤ max(|x|·2⁻⁴, scale·2⁻⁹) per
+    element (half-ulp of a 3-bit-mantissa normal; the subnormal step at
+    the bottom), across blocks of wildly mixed magnitudes. Where int8's
+    uniform grid loses small entries of an outlier-heavy block, fp8
+    keeps them to relative precision."""
+    from tpu_task.ml.serving.cache import FP8_MAX, fp8_supported
+
+    if not fp8_supported():
+        pytest.skip("no fp8 support in this jax build")
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(16, 8, 4, 32)) * (
+        10.0 ** rng.integers(-3, 3, size=(16, 1, 4, 1)))
+    x = jnp.asarray(x, jnp.float32)
+    codes, scale = quantize_blocks(x, jnp.float8_e4m3fn)
+    assert codes.dtype == jnp.dtype(jnp.float8_e4m3fn)
+    err = np.abs(np.asarray(dequantize_blocks(codes, scale))
+                 - np.asarray(x))
+    s = np.broadcast_to(np.asarray(scale)[:, None, :, None], err.shape)
+    bound = np.maximum(np.abs(np.asarray(x)) * 2.0 ** -4, s * 2.0 ** -9)
+    assert (err <= bound * (1 + 1e-6) + 1e-12).all()
+    # Nothing overflows: the amax element maps to exactly ±FP8_MAX.
+    finite = np.isfinite(np.asarray(codes.astype(jnp.float32)))
+    assert finite.all()
+    assert float(np.abs(np.asarray(codes.astype(jnp.float32))).max()) \
+        == FP8_MAX
+    # Small-vs-large precision shape: a block mixing 1e-3s with a 100.0
+    # outlier keeps the small entries nonzero at fp8 (within the
+    # subnormal-step bound, ~4e-4 at this scale); int8's uniform grid
+    # (scale ≈ 0.79) flattens them to exactly 0.
+    mixed = jnp.full((1, 8, 1, 8), 1e-3, jnp.float32)
+    mixed = mixed.at[0, 0, 0, 0].set(100.0)
+    f8 = dequantize_blocks(*quantize_blocks(mixed, jnp.float8_e4m3fn))
+    i8 = dequantize_blocks(*quantize_blocks(mixed))
+    assert float(f8[0, 3, 0, 3]) > 0.0
+    assert abs(float(f8[0, 3, 0, 3]) - 1e-3) < (100.0 / FP8_MAX) * 2 ** -9
+    assert float(i8[0, 3, 0, 3]) == 0.0
+    # All-zero blocks stay exactly zero at the epsilon scale.
+    z_codes, _ = quantize_blocks(jnp.zeros((2, 4, 2, 8)),
+                                 jnp.float8_e4m3fn)
+    assert not np.asarray(z_codes.astype(jnp.float32)).any()
+
+
+# -- DMA-pipelined kernel (PR 13) ---------------------------------------------
+
+def test_pipelined_kernel_matches_gather_reference():
+    """The double-buffered-DMA kernel vs the XLA gather+dense reference
+    over the SAME randomized fragmented/shared/scratch tables the PR 9
+    kernel is pinned on — fp32, int8, and fp8 pools, plain and
+    spec-shaped widths. One tolerance class for both kernels: same
+    values, different accumulation order."""
+    from tpu_task.ml.ops.paged_attention import (
+        paged_decode_pipelined_attention)
+    from tpu_task.ml.serving.cache import fp8_supported
+
+    rng = np.random.default_rng(23)
+    for w in (1, 3):
+        q, kp, vp, tables, pos, _, _ = _random_case(rng, w=w)
+        out = paged_decode_pipelined_attention(q, kp, vp, tables, pos,
+                                               interpret=True)
+        ref = paged_reference_attention(q, kp, vp, tables, pos)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=ATOL)
+    # Quantized pools: in-register dequantization through the same walk.
+    q, kp, vp, tables, pos, ks, vs = _random_case(rng, w=2, int8=True)
+    out = paged_decode_pipelined_attention(q, kp, vp, tables, pos, ks, vs,
+                                           interpret=True)
+    ref = paged_reference_attention(q, kp, vp, tables, pos, ks, vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=ATOL)
+    if fp8_supported():
+        q, kpf, vpf, tables, pos, _, _ = _random_case(rng, w=2)
+        kpf, ksf = quantize_blocks(kpf, jnp.float8_e4m3fn)
+        vpf, vsf = quantize_blocks(vpf, jnp.float8_e4m3fn)
+        out = paged_decode_pipelined_attention(
+            q, kpf, vpf, tables, pos, ksf, vsf, interpret=True)
+        ref = paged_reference_attention(q, kpf, vpf, tables, pos, ksf, vsf)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=ATOL)
+
+
+@pytest.mark.slow
+def test_engine_interpret_pipelined_greedy_matches_xla(params):
+    """The engine's fused steps routed through the interpret-mode
+    PIPELINED kernel produce the same greedy streams as the XLA gather
+    path — the decode_impl="interpret_pipelined" mode end to end,
+    micro-steps included."""
+    import dataclasses
+
+    rng = np.random.default_rng(0)
+    reqs = [(rng.integers(0, TINY.vocab_size, size=plen), new)
+            for plen, new in [(5, 6), (9, 4), (3, 8)]]
+    base_cfg = dict(slots=3, block_size=4, n_blocks=24, max_len=32,
+                    chunk_tokens=6, micro_k=2)
+    xla, _ = _drain(params, TINY, ServingConfig(**base_cfg), reqs)
+    pipe, eng = _drain(
+        params, TINY,
+        ServingConfig(decode_impl="interpret_pipelined", **base_cfg),
+        reqs)
+    assert xla == pipe
+    assert eng.stats()["decode_impl"] == "interpret_pipelined"
+
+
+@pytest.mark.slow
+def test_engine_fp8_greedy_stream_identity_small_config():
+    """The fp8 analogue of the int8 anchor pin: the fp8 engine
+    reproduces the fp32 engine's greedy streams exactly on the pinned
+    small config, at the same per-token bytes as int8."""
+    from tpu_task.ml.serving.cache import fp8_supported, kv_token_bytes
+
+    if not fp8_supported():
+        pytest.skip("no fp8 support in this jax build")
+    params = transformer.init(jax.random.PRNGKey(0), INT8_PIN)
+    rng = np.random.default_rng(0)
+    reqs = [(rng.integers(0, INT8_PIN.vocab_size, size=plen), 8)
+            for plen in (5, 11, 3)]
+    base_cfg = dict(slots=3, block_size=4, n_blocks=32, max_len=48,
+                    chunk_tokens=6, prefix_cache=False)
+    fp, _ = _drain(params, INT8_PIN, ServingConfig(**base_cfg), reqs)
+    f8, eng = _drain(params, INT8_PIN,
+                     ServingConfig(kv_dtype="fp8", **base_cfg), reqs)
+    assert fp == f8
+    st = eng.stats()
+    assert st["kv_quant"]["kv_dtype"] == "fp8"
+    assert st["kv_quant"]["quantized_block_writes"] > 0
+    # Same bytes/token as int8 — fp8 trades error shape, not density.
+    assert st["kv_bytes_per_token"] == kv_token_bytes(
+        INT8_PIN, ServingConfig(kv_dtype="int8", **base_cfg))
+
+
+def test_fp8_unsupported_backend_is_actionable(params, monkeypatch):
+    """A backend without fp8 gets a construction-time error naming the
+    gate and the alternatives — never a lowering failure mid-decode."""
+    import tpu_task.ml.serving.engine as engine_mod
+
+    monkeypatch.setattr(engine_mod, "fp8_supported", lambda: False)
+    with pytest.raises(ValueError, match="fp8_supported"):
+        ServingEngine(params, TINY, ServingConfig(kv_dtype="fp8"))

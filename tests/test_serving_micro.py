@@ -1,0 +1,245 @@
+"""K-token fused decode micro-steps (dispatch amortization, PR 13).
+
+The contract (docs/parity.md "Dispatch amortization"): ``micro_k`` is a
+pure SCHEDULING knob — it changes how many decode iterations one
+dispatch runs, never a token. Greedy streams at any K are bit-identical
+to K=1 and sampled streams key-identical (the per-token
+``fold_in(request_key, token_index)`` keys fold in-program from the
+iteration's running count, the same stream K=1 draws), across every
+production mode stacked since PR 5: chunked prefill, prefix-cache hits,
+speculative decoding (spec rounds stay the multi-token path — one path
+per slot per step), recompute preemption under pool pressure, and
+mid-stream export/resume landing on exact token boundaries mid-block.
+
+Two tier-1 ``perf`` smokes pin the cheap core (greedy identity + the
+dispatch-amortization accounting); the wider matrix is ``slow``.
+"""
+
+import dataclasses
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_task.ml.models import transformer
+from tpu_task.ml.serving import ServingConfig, ServingEngine
+
+TINY = transformer.TransformerConfig(
+    vocab_size=64, d_model=32, n_layers=2, n_heads=4, d_head=8, d_ff=64,
+    dtype=jnp.float32, n_kv_heads=2)
+
+BASE = ServingConfig(slots=4, block_size=4, n_blocks=64, max_len=48,
+                     prefill_buckets=(8, 16), chunk_tokens=4)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer.init(jax.random.PRNGKey(0), TINY)
+
+
+def _workload(seed=0, n=8):
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, TINY.vocab_size,
+                            size=int(rng.integers(3, 12))) for _ in range(n)]
+    max_new = [int(rng.integers(3, 14)) for _ in range(n)]
+    return prompts, max_new
+
+
+def _drain(params, scfg, temps=None, seed=0, n=8, **engine_kw):
+    engine = ServingEngine(params, TINY, scfg, **engine_kw)
+    prompts, max_new = _workload(seed, n)
+    for i, prompt in enumerate(prompts):
+        t = 0.0 if temps is None else temps[i]
+        engine.submit(prompt, max_new[i], eos_token=7, temperature=t,
+                      top_p=0.9 if t > 0 else None)
+    return engine.drain(), engine
+
+
+def test_micro_k_validation():
+    with pytest.raises(ValueError, match="micro_k"):
+        ServingConfig(micro_k=0)
+    with pytest.raises(ValueError, match="micro_k"):
+        ServingConfig(micro_k=512, max_len=256)
+
+
+@pytest.mark.perf
+def test_micro_k_greedy_streams_bit_identical_to_k1(params):
+    """The tier-1 pin of the tentpole: K=4 greedy streams — through
+    chunked prefill, the prefix cache, and mid-block eos/length
+    retirement (eos_token set, mixed max_new) — are bit-identical to the
+    per-token K=1 engine's, and the K-wide program actually amortizes
+    (fewer decode dispatches than tokens decoded)."""
+    ref, _ = _drain(params, BASE)
+    got, engine = _drain(params, dataclasses.replace(BASE, micro_k=4))
+    assert got == ref
+    assert engine.micro_steps > 0
+    decoded = sum(len(t) for t in got.values())
+    # Each micro dispatch covers up to 4 tokens per active slot: far
+    # fewer fused-decode dispatches than decoded tokens.
+    assert engine.micro_steps < decoded / 2
+    assert engine.stats()["micro_k"] == 4
+
+
+@pytest.mark.perf
+def test_micro_k_dispatch_accounting_stays_honest(params):
+    """GoodputMeter at K>1: one dispatch per micro-step but K tokens of
+    work — dispatches_per_token must DROP vs K=1 on the same workload
+    (the per-call accounting would misreport K tokens as one)."""
+    from tpu_task.obs import Obs
+
+    def gp(scfg):
+        out, engine = _drain(params, scfg,
+                             obs=Obs.create(f"micro-{scfg.micro_k}"))
+        return out, engine.stats()["goodput"]
+
+    out1, gp1 = gp(BASE)
+    out4, gp4 = gp(dataclasses.replace(BASE, micro_k=4))
+    assert out1 == out4
+    assert gp4["dispatches_per_token"] < gp1["dispatches_per_token"]
+    # Work accounting charges per valid token, so the FLOP model (and
+    # with it MFU's numerator) is schedule-invariant.
+    assert gp4["model_flops"] == pytest.approx(gp1["model_flops"])
+    assert gp4["tokens"]["emitted"] == gp1["tokens"]["emitted"]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("micro_k", [2, 4])
+def test_micro_k_matrix_greedy_identity(params, micro_k):
+    """K ∈ {2, 4} greedy bit-identity across the stacked production
+    modes: prefix-cache hits (shared prefixes), pool-pressure recompute
+    preemption, and bucketed prefill."""
+    # Shared prefixes → prefix-cache hits on re-admission.
+    rng = np.random.default_rng(3)
+    shared = rng.integers(0, TINY.vocab_size, size=8)
+
+    def run(scfg):
+        engine = ServingEngine(params, TINY, scfg)
+        for i in range(6):
+            prompt = np.concatenate(
+                [shared, rng.integers(0, TINY.vocab_size, size=1 + i % 3)])
+            engine.submit(prompt, 8, eos_token=7)
+        return engine.drain(), engine
+
+    rng = np.random.default_rng(3)
+    ref, _ = run(BASE)
+    rng = np.random.default_rng(3)
+    got, engine = run(dataclasses.replace(BASE, micro_k=micro_k))
+    assert got == ref
+    assert engine.prefix_hit_blocks > 0
+
+    # Pool pressure: tiny pool forces recompute preemption mid-decode.
+    tight = dataclasses.replace(BASE, n_blocks=14)
+    ref_t, _ = _drain(params, tight)
+    got_t, engine_t = _drain(
+        params, dataclasses.replace(tight, micro_k=micro_k))
+    assert got_t == ref_t
+    # And the unpressured engine agrees too (schedule independence).
+    assert got_t == _drain(params, BASE)[0]
+
+    # Bucketed prefill path (no chunk program in the loop).
+    bucketed = dataclasses.replace(
+        BASE, prefill="bucketed", prefix_cache=False)
+    assert _drain(params, dataclasses.replace(
+        bucketed, micro_k=micro_k))[0] == _drain(params, bucketed)[0]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("micro_k", [2, 4])
+def test_micro_k_sampled_streams_key_identical(params, micro_k):
+    """Sampled streams at K>1 equal K=1's: the micro program folds each
+    iteration's key in-program from the running n_generated — the same
+    fold_in(request_key, token_index) stream, schedule-independent."""
+    temps = [0.8, 0.7, 0.0, 0.9, 0.0, 0.8, 1.1, 0.0]
+    ref, _ = _drain(params, BASE, temps=temps)
+    got, _ = _drain(params, dataclasses.replace(BASE, micro_k=micro_k),
+                    temps=temps)
+    assert got == ref
+
+
+@pytest.mark.slow
+def test_micro_k_composes_with_spec_decode(params):
+    """One path per slot per step: with speculative decoding on, spec
+    rounds ARE the multi-token path and micro_k must not perturb the
+    (already pinned bit-exact) spec streams."""
+    spec = dataclasses.replace(BASE, spec_k=2)
+    ref, _ = _drain(params, spec, draft_params=params, draft_cfg=TINY,
+                    n=5)
+    got, engine = _drain(params, dataclasses.replace(spec, micro_k=4),
+                         draft_params=params, draft_cfg=TINY, n=5)
+    assert got == ref
+    assert engine.spec_rounds > 0
+    assert engine.micro_steps == 0     # spec rounds took the decode path
+
+
+@pytest.mark.slow
+def test_micro_k_export_resume_lands_on_token_boundaries(params):
+    """Mid-stream export from a K=4 engine (positions mid-block) resumes
+    token-identically in a fresh engine — at K=4 or K=1 — because
+    micro-steps commit tokens only at their host sweep, so exports
+    always see exact token boundaries."""
+    ref, _ = _drain(params, BASE)
+    prompts, max_new = _workload()
+    for resume_k in (1, 4):
+        engine = ServingEngine(
+            params, TINY, dataclasses.replace(BASE, micro_k=4))
+        for i, prompt in enumerate(prompts):
+            engine.submit(prompt, max_new[i], eos_token=7)
+        for _ in range(3):
+            engine.step()
+        records = engine.export_inflight()
+        assert records, "nothing in flight after 3 steps"
+        done = {rid: list(r.tokens) for rid, r in engine._requests.items()
+                if r.status == "done"}
+        sibling = ServingEngine(
+            params, TINY, dataclasses.replace(BASE, micro_k=resume_k))
+        mapping = sibling.resume_inflight(records)
+        out = sibling.drain()
+        for old, new in mapping.items():
+            assert out[new] == ref[old], \
+                f"resumed stream {old} diverged at resume_k={resume_k}"
+        for rid, toks in done.items():
+            assert toks == ref[rid]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kv_dtype", ["int8", "fp8"])
+def test_micro_k_quantized_streams_match_k1(params, kv_dtype):
+    """Quantized pools under micro-steps: K=4 streams identical to the
+    SAME dtype's K=1 streams (iteration j's write layout is exactly the
+    K=1 step's at position + j; a mid-span retiree's garbage rows touch
+    only its own never-again-read partial block)."""
+    from tpu_task.ml.serving.cache import fp8_supported
+
+    if kv_dtype == "fp8" and not fp8_supported():
+        pytest.skip("no fp8 support in this jax build")
+    quant = dataclasses.replace(BASE, kv_dtype=kv_dtype)
+    ref, _ = _drain(params, quant)
+    got, engine4 = _drain(params, dataclasses.replace(quant, micro_k=4))
+    assert got == ref
+    assert engine4.quantized_block_writes > 0
+    assert engine4.stats()["kv_quant"]["kv_dtype"] == kv_dtype
+
+
+@pytest.mark.slow
+def test_micro_k_tp8_matches_single_chip(params):
+    """The PR 6 contract holds under micro-steps: a tp=8 K=4 engine's
+    greedy streams are bit-identical to the single-chip K=4 (and so K=1)
+    engine's."""
+    from jax.sharding import Mesh
+
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 devices (XLA_FLAGS host platform)")
+    cfg8 = dataclasses.replace(TINY, n_heads=8, n_kv_heads=8)
+    params8 = transformer.init(jax.random.PRNGKey(0), cfg8)
+    scfg = dataclasses.replace(BASE, micro_k=4)
+
+    def run(mesh=None):
+        engine = ServingEngine(params8, cfg8, scfg, mesh=mesh)
+        prompts, max_new = _workload(n=4)
+        for i, prompt in enumerate(prompts):
+            engine.submit(prompt, max_new[i])
+        return engine.drain()
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("tp",))
+    assert run(mesh) == run()

@@ -546,6 +546,11 @@ def _watch_frame(merged, alerts, remote: str) -> str:
                  f"host-gap {value('goodput.host_gap_frac') * 100:.1f}%",
                  f"dispatch/tok "
                  f"{value('goodput.dispatches_per_token'):.2f}"]
+    if "engine.micro_k" in merged:
+        # Configured amortization factor next to the measured
+        # dispatches/token above — K>1 engines should show the measured
+        # number approaching 1/K in steady-state decode.
+        head.append(f"K {int(value('engine.micro_k', 1))}")
     depth = value("router.queue_depth") + value("engine.queue_depth")
     head.append(f"queue {int(depth)}")
     lines.append("  ".join(head))

@@ -34,6 +34,23 @@ rectangle); the fp32 ENGINE keeps its bit-exact greedy-stream pins by
 leaving the XLA path byte-identical and selecting the kernel only where
 configured. The int8 path is a documented tolerance contract.
 
+**DMA pipelining** (ROADMAP item 4): the PR 9 kernel above leans on the
+automatic Pallas pipeline — one grid cell per block, the BlockSpec
+index_map (scalar-prefetched table entry) driving each block's HBM→VMEM
+copy. :func:`paged_decode_pipelined_attention` takes manual control of
+that copy instead: one grid cell per ``(slot, kv_head)`` walks the
+slot's WHOLE block list with the KV pools left in HBM
+(``memory_space=ANY``), double-buffering two VMEM block slots — block
+N+1's ``make_async_copy`` is issued before block N's compute runs, so
+the DMA engine fills one buffer while the MXU consumes the other, and
+the walk stops at the slot's live depth (a dynamic loop bound off the
+scalar-prefetched positions — dead capacity is neither copied nor
+computed). Same online-softmax math, same masking, same int8/fp8 scale
+factoring; parity against the reference is pinned in interpret mode and
+the wall-clock claim is TPU-gated (``bench.py generation
+decode_kernel`` compares it against the PR 9 kernel on the
+long-fragmented-table case, ``make bench-decode`` fails on regression).
+
 ``interpret=True`` runs the kernel through the Pallas interpreter on any
 backend — the CPU parity suite (tests/test_paged_attention.py) and the
 ``decode_impl="interpret"`` engine mode use it; real-TPU runs compile the
@@ -287,6 +304,167 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, q_positions,
     return call(*scalars, q, k_pool, v_pool)
 
 
+# -- DMA-pipelined kernel (double-buffered manual block copies) ---------------
+
+def _paged_decode_pipelined_kernel(tables_ref, pos_ref, *rest, bs: int,
+                                   w: int, group: int, max_blocks: int,
+                                   quantized: bool):
+    """One (slot, kv_head) grid cell: walk the slot's live blocks with the
+    KV pools still in HBM, double-buffering the block DMA.
+
+    ``k_hbm``/``v_hbm`` are ANY-memory-space refs of the WHOLE pools —
+    nothing is staged by the automatic pipeline. The cell issues block
+    b+1's async copy into the other VMEM buffer slot before it computes
+    block b (the guide's double-buffer pattern), so the HBM read of the
+    next block overlaps the current block's two matmuls. The online
+    softmax state rides the loop carry ((rows, LANES)-shaped running
+    max/sum as in the PR 9 kernel's scratch, (rows, d) accumulator); the
+    loop bound is the slot's LIVE depth — ``max_pos // bs + 1`` off the
+    scalar-prefetched positions — so dead capacity costs neither DMA nor
+    compute (the PR 9 kernel still iterates its grid over dead blocks,
+    merely skipping their compute)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if quantized:
+        (ks_ref, vs_ref, q_ref, k_hbm, v_hbm, o_ref,
+         k_buf, v_buf, sems) = rest
+    else:
+        q_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf, sems = rest
+        ks_ref = vs_ref = None
+    s = pl.program_id(0)
+    kh = pl.program_id(1)
+    d = q_ref.shape[-1]
+    rows = w * group
+
+    max_pos = pos_ref[s, 0]
+    for i in range(1, w):
+        max_pos = jnp.maximum(max_pos, pos_ref[s, i])
+    num_live = jnp.minimum(max_pos // bs + 1, max_blocks)
+
+    def copies(b, slot):
+        blk = tables_ref[s, b]
+        return (pltpu.make_async_copy(
+                    k_hbm.at[blk, :, kh, :], k_buf.at[slot],
+                    sems.at[slot, 0]),
+                pltpu.make_async_copy(
+                    v_hbm.at[blk, :, kh, :], v_buf.at[slot],
+                    sems.at[slot, 1]))
+
+    for dma in copies(0, 0):
+        dma.start()
+
+    q = q_ref[...].reshape(rows, d).astype(jnp.float32) / math.sqrt(d)
+    rpos = jnp.repeat(jnp.stack([pos_ref[s, i] for i in range(w)]), group)
+
+    def body(b, carry):
+        m2d, l2d, acc = carry
+        slot = b % 2
+
+        @pl.when(b + 1 < num_live)
+        def _prefetch_next():
+            for dma in copies(b + 1, (b + 1) % 2):
+                dma.start()
+
+        for dma in copies(b, slot):
+            dma.wait()
+        k_blk = k_buf[slot].astype(jnp.float32)
+        sm = lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        if quantized:
+            sm = sm * ks_ref[tables_ref[s, b], kh]
+        cols = b * bs + lax.broadcasted_iota(jnp.int32, (rows, bs), 1)
+        mask = cols <= rpos[:, None]
+        sm = jnp.where(mask, sm, NEG_INF)
+        m = m2d[:, 0]
+        l = l2d[:, 0]
+        m_new = jnp.maximum(m, sm.max(axis=-1))
+        shift = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+        p = jnp.exp(sm - shift[:, None])
+        p = jnp.where(mask, p, 0.0)
+        corr = jnp.exp(jnp.where(m <= NEG_INF / 2, NEG_INF, m) - shift)
+        v_blk = v_buf[slot].astype(jnp.float32)
+        pv = lax.dot_general(p, v_blk, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        if quantized:
+            pv = pv * vs_ref[tables_ref[s, b], kh]
+        return (jnp.broadcast_to(m_new[:, None], m2d.shape),
+                jnp.broadcast_to((l * corr + p.sum(axis=-1))[:, None],
+                                 l2d.shape),
+                acc * corr[:, None] + pv)
+
+    m0 = jnp.full((rows, LANES), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((rows, LANES), jnp.float32)
+    acc0 = jnp.zeros((rows, d), jnp.float32)
+    _, l2d, acc = lax.fori_loop(0, num_live, body, (m0, l0, acc0))
+    l = l2d[:, 0]
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[...] = (acc / l_safe[:, None]).reshape(
+        o_ref.shape).astype(o_ref.dtype)
+
+
+def paged_decode_pipelined_attention(q, k_pool, v_pool, block_tables,
+                                     q_positions, k_scale=None,
+                                     v_scale=None, *,
+                                     interpret: bool = False):
+    """The DMA-pipelined variant of :func:`paged_decode_attention` — same
+    arguments, same semantics, same tolerance class vs the reference
+    (online softmax over blocks, exact 0.0 masked weights). Differences
+    are purely in data movement: grid (slots, kv_heads), pools stay in
+    HBM (ANY memory space), each cell double-buffers its own block
+    copies and walks only the slot's live depth."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    slots, w, h, d = q.shape
+    n_blocks, bs, kv, _ = k_pool.shape
+    if h % kv:
+        raise ValueError(f"n_heads {h} not divisible by kv_heads {kv}")
+    group = h // kv
+    max_blocks = block_tables.shape[1]
+    quantized = k_scale is not None
+    if quantized != (v_scale is not None):
+        raise ValueError("pass both k_scale and v_scale or neither")
+    if q_positions.ndim != 2 or q_positions.shape != (slots, w):
+        raise ValueError(
+            f"q_positions must be (slots, w) = ({slots}, {w}), got "
+            f"{q_positions.shape}")
+
+    kernel = functools.partial(
+        _paged_decode_pipelined_kernel, bs=bs, w=w, group=group,
+        max_blocks=max_blocks, quantized=quantized)
+    n_prefetch = 4 if quantized else 2
+
+    def idx_q(s, kh, *refs):
+        return (s, 0, kh, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=n_prefetch,
+        grid=(slots, kv),
+        in_specs=[
+            pl.BlockSpec((None, w, group, d), idx_q),
+            pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+            pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+        ],
+        out_specs=pl.BlockSpec((None, w, group, d), idx_q),
+        scratch_shapes=[
+            pltpu.VMEM((2, bs, d), k_pool.dtype),   # double-buffered K
+            pltpu.VMEM((2, bs, d), v_pool.dtype),   # double-buffered V
+            pltpu.SemaphoreType.DMA((2, 2)),        # (buffer, k|v)
+        ],
+    )
+    vma = _vma(q, k_pool, v_pool)
+    call = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=_out_struct((slots, w, h, d), q.dtype, vma),
+        interpret=interpret,
+    )
+    scalars = (block_tables, q_positions)
+    if quantized:
+        scalars += (k_scale, v_scale)
+    return call(*scalars, q, k_pool, v_pool)
+
+
 # -- dispatch (XLA reference / kernel / tp-sharded kernel) --------------------
 
 def paged_reference_attention(q, k_pool, v_pool, block_tables, q_positions,
@@ -317,13 +495,16 @@ def dequantize_view(view, scale, block_tables, block_size: int, dtype):
 
 
 @functools.lru_cache(maxsize=None)
-def _tp_kernel(mesh, axis_name: str, interpret: bool, quantized: bool):
+def _tp_kernel(mesh, axis_name: str, interpret: bool, quantized: bool,
+               pipelined: bool = False):
     """shard_map wrapper of the kernel over the kv-head axis — one memo
     per (mesh, axis, mode) so repeated traces reuse the closure. The
     kv-head axis is already LOCAL per shard (pools shard it, q's head axis
     shards with it, tables/positions replicate) and the kernel has no
     cross-shard reduction — per-kv-head independence makes the sharded
-    call bit-exact against running the kernel on each head slice."""
+    call bit-exact against running the kernel on each head slice. The
+    pipelined kernel shards identically: its grid is (slots, kv_heads)
+    and every DMA stays within the shard-local pool."""
     from jax.sharding import PartitionSpec
 
     from tpu_task.ml.parallel.mesh import shard_map
@@ -331,17 +512,18 @@ def _tp_kernel(mesh, axis_name: str, interpret: bool, quantized: bool):
     heads4 = PartitionSpec(None, None, axis_name, None)
     heads_scale = PartitionSpec(None, axis_name)
     rep = PartitionSpec()
+    kern = (paged_decode_pipelined_attention if pipelined
+            else paged_decode_attention)
 
     if quantized:
         def fn(q, kp, vp, tables, pos, ks, vs):
-            return paged_decode_attention(q, kp, vp, tables, pos, ks, vs,
-                                          interpret=interpret)
+            return kern(q, kp, vp, tables, pos, ks, vs,
+                        interpret=interpret)
         in_specs = (heads4, heads4, heads4, rep, rep, heads_scale,
                     heads_scale)
     else:
         def fn(q, kp, vp, tables, pos):
-            return paged_decode_attention(q, kp, vp, tables, pos,
-                                          interpret=interpret)
+            return kern(q, kp, vp, tables, pos, interpret=interpret)
         in_specs = (heads4, heads4, heads4, rep, rep)
     return shard_map(fn, mesh, in_specs=in_specs, out_specs=heads4,
                      check_vma=False)
@@ -353,25 +535,32 @@ def paged_attention(q, k_pool, v_pool, block_tables, q_positions,
     """The ONE paged-attention entry the serving programs call.
 
     ``impl``: ``"xla"`` = gather+dense reference (the CPU fallback and the
-    bit-exact fp32 path), ``"pallas"`` = compiled kernel, ``"interpret"``
-    = the same kernel through the Pallas interpreter (any backend — the
-    parity suite and CPU engine smokes). With ``mesh`` the kernel modes
-    run under ``shard_map`` with the kv-head axis sharded over
-    ``axis_name`` (the XLA mode needs no wrapper — SPMD partitions the
-    gather+einsum exactly as before this kernel existed)."""
-    if impl not in ("xla", "pallas", "interpret"):
+    bit-exact fp32 path), ``"pallas"`` = compiled PR 9 kernel,
+    ``"pipelined"`` = the compiled double-buffered-DMA kernel,
+    ``"interpret"``/``"interpret_pipelined"`` = the same kernels through
+    the Pallas interpreter (any backend — the parity suite and CPU
+    engine smokes). With ``mesh`` the kernel modes run under
+    ``shard_map`` with the kv-head axis sharded over ``axis_name`` (the
+    XLA mode needs no wrapper — SPMD partitions the gather+einsum
+    exactly as before this kernel existed)."""
+    if impl not in ("xla", "pallas", "interpret", "pipelined",
+                    "interpret_pipelined"):
         raise ValueError(f"unknown paged-attention impl {impl!r}")
     if q_positions.ndim == 1:
         q_positions = q_positions[:, None]
     if impl == "xla":
         return paged_reference_attention(
             q, k_pool, v_pool, block_tables, q_positions, k_scale, v_scale)
-    interpret = impl == "interpret"
+    interpret = impl.startswith("interpret")
+    pipelined = impl.endswith("pipelined")
     if mesh is None:
-        return paged_decode_attention(
+        kern = (paged_decode_pipelined_attention if pipelined
+                else paged_decode_attention)
+        return kern(
             q, k_pool, v_pool, block_tables, q_positions, k_scale, v_scale,
             interpret=interpret)
-    fn = _tp_kernel(mesh, axis_name, interpret, k_scale is not None)
+    fn = _tp_kernel(mesh, axis_name, interpret, k_scale is not None,
+                    pipelined)
     args = (q, k_pool, v_pool, block_tables, q_positions)
     if k_scale is not None:
         args += (k_scale, v_scale)

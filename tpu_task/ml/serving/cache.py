@@ -32,6 +32,16 @@ requantize over the step's touched blocks), and the attention paths
 dequantize on read (in-register inside the Pallas paged kernel). The
 fp32 bit-exactness contract demotes to a documented tolerance contract
 for quantized pools only (docs/parity.md "Decode kernel + quantized KV").
+
+``kv_dtype="fp8"`` generalizes the same sidecar machinery to float8
+e4m3 codes: the scale normalizes a block's amax to :data:`FP8_MAX`, the
+element then keeps a 3-bit mantissa of ITS OWN magnitude — error is
+relative (≤ ``|x|·2⁻⁴`` per element) where int8's is uniform
+(≤ ``scale/2``), so small entries of an outlier-heavy block survive
+where int8 flattens them. Bytes per element are identical to int8 (1 +
+the amortized sidecar); the knob trades accuracy shape, not density.
+Gated on backend dtype support (:func:`fp8_supported`) with the same
+interpret-mode CPU parity story as the int8 pools.
 """
 
 from __future__ import annotations
@@ -53,6 +63,31 @@ SCRATCH_BLOCK = 0
 #: block quantizes to zero codes at this scale and dequantizes back to
 #: exact zeros, so fresh pools read the same values int8 as fp32.
 INT8_SCALE_EPS = 1e-8
+
+#: Largest finite float8 e4m3 value — the fp8 analogue of int8's 127:
+#: the per-(block, kv-head) scale maps the block's amax to exactly this,
+#: so nothing overflows to inf/nan and the 3-bit mantissa spends its
+#: precision inside the block's real range.
+FP8_MAX = 448.0
+
+#: The quantized pool dtypes (``ServingConfig.kv_dtype`` values that
+#: carry scale sidecars and route writes through
+#: :func:`quantized_append`).
+QUANT_DTYPES = ("int8", "fp8")
+
+
+def fp8_supported() -> bool:
+    """Whether this jax build + backend can store and convert float8
+    e4m3 arrays — the construction-time gate for ``kv_dtype="fp8"``
+    (an unsupported backend gets an actionable error, never a lowering
+    failure mid-decode)."""
+    if not hasattr(jnp, "float8_e4m3fn"):
+        return False
+    try:
+        x = jnp.asarray([1.5], jnp.float8_e4m3fn).astype(jnp.float32)
+        return float(x[0]) == 1.5
+    except Exception:
+        return False
 
 
 @dataclass(frozen=True)
@@ -98,7 +133,17 @@ class ServingConfig:
       paged≡dense contract); ``"int8"`` stores int8 codes plus a
       per-(block, kv-head) fp32 scale sidecar — ~2× the blocks in the
       same bytes, under a documented tolerance contract
-      (docs/parity.md "Decode kernel + quantized KV").
+      (docs/parity.md "Decode kernel + quantized KV"); ``"fp8"`` stores
+      float8 e4m3 codes through the same sidecar machinery (equal bytes
+      to int8, relative-not-uniform rounding error).
+    - ``micro_k``: dispatch amortization — steady-state decode runs
+      ``micro_k`` sequential iterations inside ONE jitted program
+      (in-program eos/length retirement masks; a retired slot's
+      remaining iterations write scratch), so the engine re-enters
+      Python once per K tokens instead of per token. 1 (default) keeps
+      the per-token step loop and its byte-identical programs; greedy
+      streams at any K are bit-identical to K=1 and sampled streams
+      key-identical (docs/parity.md "Dispatch amortization").
     """
 
     slots: int = 8
@@ -112,6 +157,7 @@ class ServingConfig:
     spec_k: int = 0
     decode_impl: str = "auto"
     kv_dtype: Optional[str] = None
+    micro_k: int = 1
 
     def __post_init__(self):
         if self.slots < 1:
@@ -147,14 +193,22 @@ class ServingConfig:
                 "admission prefills only the tail, which is a chunk step")
         if self.spec_k < 0:
             raise ValueError(f"spec_k must be >= 0, got {self.spec_k}")
-        if self.decode_impl not in ("auto", "xla", "pallas", "interpret"):
+        if self.decode_impl not in ("auto", "xla", "pallas", "interpret",
+                                    "pipelined", "interpret_pipelined"):
             raise ValueError(
                 f"decode_impl must be one of 'auto', 'xla', 'pallas', "
-                f"'interpret', got {self.decode_impl!r}")
-        if self.kv_dtype not in (None, "int8"):
+                f"'interpret', 'pipelined', 'interpret_pipelined', got "
+                f"{self.decode_impl!r}")
+        if self.kv_dtype not in (None,) + QUANT_DTYPES:
             raise ValueError(
-                f"kv_dtype must be None (model dtype) or 'int8', got "
-                f"{self.kv_dtype!r}")
+                f"kv_dtype must be None (model dtype), 'int8', or 'fp8', "
+                f"got {self.kv_dtype!r}")
+        if self.micro_k < 1:
+            raise ValueError(
+                f"micro_k must be >= 1, got {self.micro_k}")
+        if self.micro_k > self.max_len:
+            raise ValueError(
+                f"micro_k {self.micro_k} exceeds max_len {self.max_len}")
 
     @property
     def max_blocks_per_slot(self) -> int:
@@ -178,8 +232,9 @@ def kv_token_bytes(cfg: TransformerConfig,
                    scfg: Optional[ServingConfig] = None) -> int:
     """KV bytes one token occupies across all layers (k + v) — DTYPE-AWARE:
     without ``scfg`` (or with ``kv_dtype=None``) the storage dtype is the
-    model dtype; with ``kv_dtype="int8"`` each element is one byte plus
-    the amortized per-(block, kv-head) fp32 scale sidecar
+    model dtype; with a quantized dtype (``"int8"``/``"fp8"`` — both
+    1-byte elements) each element is one byte plus the amortized
+    per-(block, kv-head) fp32 scale sidecar
     (``2 · n_layers · kv_heads · 4 / block_size`` bytes per token)."""
     per_channel = 2 * cfg.n_layers * cfg.kv_heads
     if scfg is None or scfg.kv_dtype is None:
@@ -193,11 +248,11 @@ def kv_token_bytes(cfg: TransformerConfig,
 def kv_block_bytes(cfg: TransformerConfig, scfg: ServingConfig) -> int:
     """Exact bytes ONE physical block costs (codes + its scale sidecar) —
     the unit ``blocks_in_budget`` divides an HBM budget by."""
-    elem = (1 if scfg.kv_dtype == "int8"
+    elem = (1 if scfg.kv_dtype in QUANT_DTYPES
             else jnp.dtype(cfg.dtype).itemsize)
     per_block = 2 * cfg.n_layers * cfg.kv_heads * (
         scfg.block_size * cfg.d_head * elem)
-    if scfg.kv_dtype == "int8":
+    if scfg.kv_dtype in QUANT_DTYPES:
         per_block += 2 * cfg.n_layers * cfg.kv_heads * 4
     return per_block
 
@@ -227,21 +282,25 @@ def paged_cache_bytes(cfg: TransformerConfig, scfg: ServingConfig,
 
 def init_pools(cfg: TransformerConfig, scfg: ServingConfig) -> List[dict]:
     """Per-layer k/v physical pools, same narrow KV-head layout (and the
-    same per-layer list-of-dicts pytree) as the dense cache. With
-    ``kv_dtype="int8"`` each layer additionally carries ``k_scale``/
-    ``v_scale`` sidecars of shape (n_blocks, kv_heads) float32; zero
-    codes at the epsilon scale dequantize to exact zeros, so a fresh
-    quantized pool reads identically to a fresh fp32 one."""
+    same per-layer list-of-dicts pytree) as the dense cache. With a
+    quantized ``kv_dtype`` (``"int8"``/``"fp8"``) each layer additionally
+    carries ``k_scale``/``v_scale`` sidecars of shape
+    (n_blocks, kv_heads) float32; zero codes at the epsilon scale
+    dequantize to exact zeros, so a fresh quantized pool reads
+    identically to a fresh fp32 one."""
     shape = (scfg.n_blocks, scfg.block_size, cfg.kv_heads, cfg.d_head)
-    if scfg.kv_dtype == "int8":
+    if scfg.kv_dtype in QUANT_DTYPES:
+        code_dtype = (jnp.int8 if scfg.kv_dtype == "int8"
+                      else jnp.float8_e4m3fn)
+
         # Distinct arrays per leaf: the engine DONATES the pool pytree,
         # and XLA rejects the same buffer donated twice.
         def scale():
             return jnp.full((scfg.n_blocks, cfg.kv_heads), INT8_SCALE_EPS,
                             jnp.float32)
 
-        return [{"k": jnp.zeros(shape, jnp.int8),
-                 "v": jnp.zeros(shape, jnp.int8),
+        return [{"k": jnp.zeros(shape, code_dtype),
+                 "v": jnp.zeros(shape, code_dtype),
                  "k_scale": scale(), "v_scale": scale()}
                 for _ in range(cfg.n_layers)]
     return [{"k": jnp.zeros(shape, cfg.dtype),
@@ -327,20 +386,33 @@ def gather_kv(pool_flat, block_table, block_size: int):
     return pool_flat[idx.reshape(block_table.shape[0], -1)]
 
 
-# -- int8 KV block quantization ----------------------------------------------
+# -- int8 / fp8 KV block quantization ----------------------------------------
 
-def quantize_blocks(x):
-    """(n, block_size, kv, d) float values → (int8 codes, (n, kv) float32
-    scales): symmetric per-(block, kv-head) quantization at
-    ``scale = amax / 127`` (floored at :data:`INT8_SCALE_EPS`). Round-trip
-    error is ≤ ``scale / 2`` per element (round-to-nearest; the amax
-    element maps to exactly ±127, so nothing clips) — the property pinned
-    in tests/test_paged_attention.py."""
+def quantize_blocks(x, code_dtype=jnp.int8):
+    """(n, block_size, kv, d) float values → (codes, (n, kv) float32
+    scales): symmetric per-(block, kv-head) quantization.
+
+    ``code_dtype=jnp.int8`` (default): ``scale = amax / 127`` (floored at
+    :data:`INT8_SCALE_EPS`), round-to-nearest integer codes — round-trip
+    error ≤ ``scale / 2`` per element, UNIFORM across the block (the amax
+    element maps to exactly ±127, nothing clips).
+
+    ``code_dtype=jnp.float8_e4m3fn``: ``scale = amax / FP8_MAX`` and the
+    scaled value keeps fp8's own 3-bit mantissa — round-trip error is
+    RELATIVE, ≤ ``max(|x| · 2⁻⁴, scale · 2⁻⁹)`` per element (half-ulp of
+    a normal, resp. the subnormal step at the bottom), so small entries
+    of an outlier-heavy block keep precision int8's uniform grid loses.
+    Both bounds are property-pinned in tests/test_paged_attention.py."""
     amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=(1, 3))
-    scale = jnp.maximum(amax / 127.0, INT8_SCALE_EPS)
-    codes = jnp.clip(
-        jnp.round(x.astype(jnp.float32) / scale[:, None, :, None]),
-        -127, 127).astype(jnp.int8)
+    if jnp.dtype(code_dtype) == jnp.dtype(jnp.int8):
+        scale = jnp.maximum(amax / 127.0, INT8_SCALE_EPS)
+        codes = jnp.clip(
+            jnp.round(x.astype(jnp.float32) / scale[:, None, :, None]),
+            -127, 127).astype(jnp.int8)
+        return codes, scale
+    scale = jnp.maximum(amax / FP8_MAX, INT8_SCALE_EPS)
+    codes = (x.astype(jnp.float32)
+             / scale[:, None, :, None]).astype(code_dtype)
     return codes, scale
 
 
@@ -352,8 +424,10 @@ def dequantize_blocks(codes, scale, dtype=jnp.float32):
 
 def quantized_append(pool: dict, new_k, new_v, touched, filled, wt, wo,
                      measure_error: bool = False):
-    """Append this step's tokens into an int8 pool layer, requantizing the
-    written blocks — the device half of "writes quantize at append time".
+    """Append this step's tokens into a quantized (int8/fp8) pool layer,
+    requantizing the written blocks — the device half of "writes quantize
+    at append time". The code dtype is read off the pool, so int8 and
+    fp8 pools share every caller.
 
     A per-(block, kv-head) scale cannot absorb a new token in place (the
     block's amax may grow), so the write is a dequantize→modify→requantize
@@ -391,7 +465,7 @@ def quantized_append(pool: dict, new_k, new_v, touched, filled, wt, wo,
         flat = staged.reshape(T * bs, *staged.shape[2:])
         flat = flat.at[wt * bs + wo].set(new.astype(jnp.float32))
         staged = jnp.where(rows_live, flat.reshape(staged.shape), 0.0)
-        q_codes, q_scale = quantize_blocks(staged)
+        q_codes, q_scale = quantize_blocks(staged, codes.dtype)
         if measure_error:
             qerr = jnp.maximum(qerr, jnp.max(jnp.where(
                 rows_live,

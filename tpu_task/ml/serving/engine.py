@@ -50,15 +50,21 @@ as everything else quantized: the recompute requantizes whole blocks in
 one pass where the original stream appended incrementally, so the
 rebuilt codes (and a near-tie argmax) can differ (docs/parity.md).
 
-Raw decode speed (ROADMAP item 3) rides two static knobs resolved at
-construction: ``ServingConfig.decode_impl`` selects the paged attention
-inside every fused step — the XLA gather+dense reference, or the Pallas
-block-table-walking kernel (``ml.ops.paged_attention``) that streams KV
-straight from the physical pools — and ``kv_dtype="int8"`` stores the
-pools as int8 codes + per-(block, kv-head) scales (~2× the blocks in the
-same HBM), with writes requantizing the touched blocks per step
-(host-computed ``_quant_layout``) and attention dequantizing on read.
-``stats()["decode_impl"]`` records which path actually compiled.
+Raw decode speed (ROADMAP items 3 + 4) rides three static knobs resolved
+at construction: ``ServingConfig.decode_impl`` selects the paged
+attention inside every fused step — the XLA gather+dense reference, the
+Pallas block-table-walking kernel (``ml.ops.paged_attention``) that
+streams KV straight from the physical pools, or its DMA-pipelined
+variant that double-buffers the block copies; ``kv_dtype`` stores the
+pools as int8 or fp8-e4m3 codes + per-(block, kv-head) scales (~2× the
+blocks in the same HBM), with writes requantizing the touched blocks per
+step (host-computed ``_quant_layout``) and attention dequantizing on
+read; and ``micro_k`` fuses K sequential decode iterations into ONE
+jitted program (in-program eos/length retirement), so steady-state
+decode is one dispatch per K tokens — streams bit-identical (greedy) /
+key-identical (sampled) to K=1 (docs/parity.md "Dispatch
+amortization"). ``stats()["decode_impl"]`` records which path actually
+compiled; ``stats()["micro_k"]`` the configured amortization.
 
 Host/device split: the scheduler (allocator, prefix cache, slot table,
 queues, timing) is plain Python/numpy; the device sees only static-shape
@@ -92,11 +98,13 @@ from tpu_task.ml.parallel.sharding import (
     device_put_tree,
 )
 from tpu_task.ml.serving.cache import (
+    QUANT_DTYPES,
     SCRATCH_BLOCK,
     BlockAllocator,
     PrefixCache,
     ServingConfig,
     copy_block,
+    fp8_supported,
     init_pools,
     kv_shard_bytes,
     kv_token_bytes,
@@ -107,6 +115,8 @@ from tpu_task.ml.serving.model import (
     chunked_step_greedy,
     decode_and_sample,
     greedy_decode_step,
+    micro_decode_greedy,
+    micro_decode_sample,
     paged_prefill,
     sample_tokens,
     spec_score_greedy,
@@ -117,8 +127,10 @@ QUEUED, RUNNING, DONE = "queued", "running", "done"
 
 
 def _kv_itemsize(scfg: ServingConfig, cfg) -> int:
-    """Bytes per KV POOL element — what sets the kernel's sublane tile."""
-    return 1 if scfg.kv_dtype == "int8" else jnp.dtype(cfg.dtype).itemsize
+    """Bytes per KV POOL element — what sets the kernel's sublane tile.
+    Both quantized dtypes (int8, fp8 e4m3) are 1-byte elements."""
+    return (1 if scfg.kv_dtype in QUANT_DTYPES
+            else jnp.dtype(cfg.dtype).itemsize)
 
 
 def resolve_decode_impl(scfg: ServingConfig, cfg, tp: int = 1) -> str:
@@ -133,7 +145,7 @@ def resolve_decode_impl(scfg: ServingConfig, cfg, tp: int = 1) -> str:
     everywhere else. ``tp``: kv-head shard width — per-shard SMEM holds
     only the local heads' scale sidecars."""
     want = scfg.decode_impl
-    if want in ("xla", "interpret"):
+    if want in ("xla", "interpret", "interpret_pipelined"):
         return want
     viol = pa.kernel_constraint_violation(
         scfg.block_size, cfg.d_head, _kv_itemsize(scfg, cfg),
@@ -142,18 +154,19 @@ def resolve_decode_impl(scfg: ServingConfig, cfg, tp: int = 1) -> str:
                             if scfg.prefill == "chunked" else 0),
         max_blocks=scfg.max_blocks_per_slot,
         q_width=scfg.spec_k + 1,
-        quantized=scfg.kv_dtype == "int8")
-    if want == "pallas":
+        quantized=scfg.kv_dtype in QUANT_DTYPES)
+    if want in ("pallas", "pipelined"):
         if not pa.use_pallas_paged():
             raise ValueError(
-                "decode_impl='pallas' needs a TPU backend for the "
-                "compiled kernel; use decode_impl='interpret' to emulate "
-                "it elsewhere, or 'xla'")
+                f"decode_impl={want!r} needs a TPU backend for the "
+                "compiled kernel; use decode_impl='interpret' (or "
+                "'interpret_pipelined') to emulate it elsewhere, or "
+                "'xla'")
         if viol:
             raise ValueError(
-                f"decode_impl='pallas' rejected: {viol} — adjust the "
+                f"decode_impl={want!r} rejected: {viol} — adjust the "
                 "ServingConfig/model geometry or use decode_impl='xla'")
-        return "pallas"
+        return want
     if pa.use_pallas_paged():
         if viol:
             warnings.warn(
@@ -288,7 +301,13 @@ class ServingEngine:
         #: may differ from decode_impl when the draft's geometry forces
         #: the XLA fallback; recorded in stats() like the target's.
         self.draft_decode_impl: Optional[str] = None
-        self._quantized = scfg.kv_dtype == "int8"
+        self._quantized = scfg.kv_dtype in QUANT_DTYPES
+        if scfg.kv_dtype == "fp8" and not fp8_supported():
+            raise ValueError(
+                "kv_dtype='fp8' needs float8_e4m3fn support in this jax "
+                "build/backend (cache.fp8_supported() is False) — use "
+                "kv_dtype='int8' for the same byte density or None for "
+                "model-dtype pools")
 
         # Speculative decoding: validate the draft triple together.
         self._spec_on = scfg.spec_k > 0
@@ -325,6 +344,7 @@ class ServingEngine:
         self._base_key = rng if rng is not None else jax.random.PRNGKey(0)
         self.steps = 0
         self.decode_steps = 0
+        self.micro_steps = 0             # K-wide fused micro dispatches
         self.prefills = 0
         self.prefill_chunks = 0
         self.chunk_steps = 0
@@ -367,7 +387,8 @@ class ServingEngine:
             # mutation sites (and bench's resets) unchanged. Monotonic
             # totals register as counters (they SUM in the fleet merge);
             # instantaneous values as gauges (last-write-wins).
-            for stat in ("steps", "decode_steps", "chunk_steps", "prefills",
+            for stat in ("steps", "decode_steps", "micro_steps",
+                         "chunk_steps", "prefills",
                          "prefill_chunks", "preemption_count", "cow_copies",
                          "prefix_hit_requests", "prefix_tokens_saved",
                          "spec_rounds", "spec_accepted"):
@@ -378,6 +399,11 @@ class ServingEngine:
                 metrics.gauge_fn(f"engine.{stat}",
                                  lambda self=self, stat=stat:
                                  float(getattr(self, stat)))
+            # The configured amortization factor next to the measured
+            # goodput.dispatches_per_token — the pair `obs watch` and the
+            # replica /stats surface (configured K vs what actually ran).
+            metrics.gauge_fn("engine.micro_k",
+                             lambda scfg=scfg: float(scfg.micro_k))
 
         # Draft-model state: its "dense" cache is a paged pool with a
         # STATIC identity block layout — slot s owns blocks
@@ -466,6 +492,51 @@ class ServingEngine:
                                    active, pools, attn_impl=impl,
                                    mesh=mesh),
                 plan((p_specs, rep, rep, rep, rep, k_specs), (5,))))
+        # K-token micro-steps (ROADMAP item 4): ONE program runs micro_k
+        # sequential decode iterations with in-program eos/length
+        # retirement, so steady-state decode is one dispatch per K tokens
+        # instead of one per token. Compiled only at micro_k > 1 — K=1
+        # keeps the byte-identical per-token programs above (and their
+        # bit-exact pins) untouched.
+        mk = scfg.micro_k
+        if mk > 1:
+            if quant:
+                self._micro_greedy_fn = self._wrap(compile_step(
+                    lambda params, tokens, positions, tables, active,
+                    limits, eos, qa, pools: micro_decode_greedy(
+                        params, cfg, tokens, positions, tables, active,
+                        limits, eos, pools, qa, micro_k=mk,
+                        attn_impl=impl, mesh=mesh, measure_qerr=dbg),
+                    plan((p_specs, rep, rep, rep, rep, rep, rep, rep,
+                          k_specs), (8,))))
+                self._micro_sample_fn = self._wrap(compile_step(
+                    lambda params, tokens, positions, tables, active,
+                    limits, eos, temps, tops, keys, ngen, qa, pools:
+                    micro_decode_sample(
+                        params, cfg, tokens, positions, tables, active,
+                        limits, eos, temps, tops, keys, ngen, pools, qa,
+                        micro_k=mk, attn_impl=impl, mesh=mesh,
+                        measure_qerr=dbg),
+                    plan((p_specs, rep, rep, rep, rep, rep, rep, rep,
+                          rep, rep, rep, rep, k_specs), (12,))))
+            else:
+                self._micro_greedy_fn = self._wrap(compile_step(
+                    lambda params, tokens, positions, tables, active,
+                    limits, eos, pools: micro_decode_greedy(
+                        params, cfg, tokens, positions, tables, active,
+                        limits, eos, pools, micro_k=mk, attn_impl=impl,
+                        mesh=mesh),
+                    plan((p_specs, rep, rep, rep, rep, rep, rep,
+                          k_specs), (7,))))
+                self._micro_sample_fn = self._wrap(compile_step(
+                    lambda params, tokens, positions, tables, active,
+                    limits, eos, temps, tops, keys, ngen, pools:
+                    micro_decode_sample(
+                        params, cfg, tokens, positions, tables, active,
+                        limits, eos, temps, tops, keys, ngen, pools,
+                        micro_k=mk, attn_impl=impl, mesh=mesh),
+                    plan((p_specs, rep, rep, rep, rep, rep, rep, rep,
+                          rep, rep, rep, k_specs), (11,))))
         self._prefill_sample_fn = self._wrap(jax.jit(
             lambda logits, temp, top, key, n: sample_tokens(
                 logits, temp, top, jax.random.fold_in(key, n)[None])))
@@ -522,7 +593,7 @@ class ServingEngine:
             draft_viol = pa.kernel_constraint_violation(
                 scfg.block_size, draft_cfg.d_head,
                 jnp.dtype(draft_cfg.dtype).itemsize)
-            if impl == "pallas" and draft_viol:
+            if impl in ("pallas", "pipelined") and draft_viol:
                 warnings.warn(
                     f"paged-decode kernel unavailable for the DRAFT model "
                     f"({draft_viol}); draft programs fall back to the XLA "
@@ -861,7 +932,16 @@ class ServingEngine:
             if self._spec_on:
                 self._spec_step(finished)
             elif not prefilling:
-                self._decode(finished)
+                # One path per slot per scheduler step: a step with an
+                # admitting slot runs the packed chunk program above (the
+                # chunk IS that step's multi-token budget); pure-decode
+                # steady state runs the K-wide micro-step when configured
+                # (spec rounds, when on, are already the multi-token
+                # path). K=1 keeps the byte-identical per-token program.
+                if self.scfg.micro_k > 1:
+                    self._micro_decode(finished)
+                else:
+                    self._decode(finished)
         if self._obs is not None:
             wall = time.perf_counter() - t0
             self._h_step.observe(wall)
@@ -1239,6 +1319,103 @@ class ServingEngine:
                 self._retire(slot)
                 finished.append(req.rid)
 
+    def _micro_spans(self) -> np.ndarray:
+        """Per-slot token span of the next micro-step: min(micro_k,
+        remaining max_new) for running slots, 0 for empty ones — both the
+        block-reservation widths and the in-program retirement limits."""
+        spans = np.zeros((self.scfg.slots,), np.int32)
+        for i, req in enumerate(self._slots):
+            if req is not None:
+                spans[i] = min(self.scfg.micro_k,
+                               req.max_new_tokens - len(req.tokens))
+        return spans
+
+    def _micro_quant_layout(self, positions: np.ndarray,
+                            spans: np.ndarray) -> Tuple:
+        """Stacked per-iteration write layouts for a quantized micro-step:
+        iteration j's layout is exactly the K=1 step's at ``positions +
+        j`` over the slots whose span covers j — laid out as if every
+        such slot lives through its span. A slot that retires on eos
+        mid-span diverges from that assumption only inside its OWN
+        exclusively-owned blocks: the garbage rows land past its last
+        valid position, the partial block holding them is never
+        registered with the prefix cache and frees at the host sweep, so
+        no bytes any other reader sees differ from a K=1 schedule."""
+        parts = [self._quant_layout(
+            self._tables, (positions + j)[:, None],
+            (spans > j)[:, None]) for j in range(self.scfg.micro_k)]
+        return tuple(jnp.stack([p[i] for p in parts])
+                     for i in range(4))
+
+    def _micro_decode(self, finished: list) -> None:
+        """K-token fused micro-step (ROADMAP item 4): ONE dispatch runs
+        ``micro_k`` sequential decode iterations with in-program
+        eos/length retirement; the host sweeps the (K, slots) token
+        block ONCE — retire, stats, and the goodput charge all happen
+        per micro-step, not per token. Token streams are bit-identical
+        (greedy) / key-identical (sampled) to K=1: each iteration is the
+        same arithmetic at the same positions with the same keys, and a
+        retired slot's remaining iterations are masked exactly like
+        inactive slots (writes land in scratch, outputs unread)."""
+        self._ensure_blocks(self._micro_spans())
+        if not self.n_active:
+            return
+        spans = self._micro_spans()       # preemption may have freed slots
+        active = spans > 0
+        positions = np.where(active, self._positions, 0)
+        eos = np.array(
+            [r.eos_token if r is not None and r.eos_token is not None
+             else -1 for r in self._slots], np.int32)
+        qa = (self._micro_quant_layout(positions, spans)
+              if self._quantized else None)
+        if self._all_greedy():
+            toks = self._run_program(
+                self._micro_greedy_fn, self.params,
+                jnp.asarray(self._last_token), jnp.asarray(positions),
+                jnp.asarray(self._tables), jnp.asarray(active),
+                jnp.asarray(spans), jnp.asarray(eos), qa=qa)
+        else:
+            temps, tops = self._temps_tops()
+            ngen = np.array(
+                [len(r.tokens) if r else 0 for r in self._slots], np.int32)
+            toks = self._run_program(
+                self._micro_sample_fn, self.params,
+                jnp.asarray(self._last_token), jnp.asarray(positions),
+                jnp.asarray(self._tables), jnp.asarray(active),
+                jnp.asarray(spans), jnp.asarray(eos), jnp.asarray(temps),
+                jnp.asarray(tops), jnp.asarray(self._slot_keys),
+                jnp.asarray(ngen), qa=qa)
+        self.decode_steps += 1
+        self.micro_steps += 1
+        toks = np.asarray(toks)           # (micro_k, slots)
+        now = time.monotonic()
+        emitted_total, pos_sum = 0, 0.0
+        for slot, req in enumerate(self._slots):
+            if req is None:
+                continue
+            for j in range(int(spans[slot])):
+                tok = int(toks[j, slot])
+                req.tokens.append(tok)
+                emitted_total += 1
+                pos_sum += float(positions[slot]) + j
+                self._positions[slot] += 1
+                self._last_token[slot] = tok
+                if req.first_token_t is None:
+                    req.first_token_t = now
+                    self._obs_first_token(req)
+                if req.finished:
+                    break
+            if req.finished:
+                self._retire(slot)
+                finished.append(req.rid)
+        if self._goodput is not None:
+            # One dispatch (already counted by _run_program) did
+            # emitted_total tokens of work — dispatches_per_token and
+            # MFU stay honest at K > 1 because the charge is per VALID
+            # token, same convention as the per-token step's.
+            self._goodput.work_counts(emitted_total, pos_sum)
+            self._goodput.emitted(emitted_total)
+
     def _chunk_step(self, finished: list) -> None:
         """ONE fused iteration: the admitting slot ingests its next prompt
         chunk (≤ chunk_tokens positions) while every decode-phase slot
@@ -1615,6 +1792,12 @@ class ServingEngine:
         out = {
             "steps": self.steps,
             "decode_steps": self.decode_steps,
+            # Dispatch amortization (ROADMAP item 4): the configured K
+            # and how many K-wide fused micro dispatches actually ran —
+            # the measured dispatches/token gauge lives in
+            # stats()["goodput"] when obs is on.
+            "micro_k": self.scfg.micro_k,
+            "micro_steps": self.micro_steps,
             "chunk_steps": self.chunk_steps,
             "prefills": self.prefills,
             "prefill_chunks": self.prefill_chunks,

@@ -60,7 +60,9 @@ from tpu_task.ml.serving.cache import (
 
 
 def pool_is_quantized(pools: List[dict]) -> bool:
-    """Whether the pool pytree carries int8 scale sidecars."""
+    """Whether the pool pytree carries quantized-code scale sidecars —
+    the shared int8/fp8 discriminator every paged program keys off (the
+    code dtype itself is read off the pool arrays)."""
     return "k_scale" in pools[0]
 
 
@@ -222,6 +224,116 @@ def greedy_decode_step(params: Params, cfg: TransformerConfig, tokens,
         attn_impl=attn_impl, mesh=mesh, measure_qerr=measure_qerr)
     toks = jnp.argmax(out[0], axis=-1).astype(jnp.int32)
     return (toks,) + tuple(out[1:])
+
+
+# -- K-token fused micro-steps (dispatch amortization, ROADMAP item 4) -------
+
+def _micro_scan(params: Params, cfg: TransformerConfig, tokens, positions,
+                block_tables, active, limits, eos, pools, qa, micro_k: int,
+                sampler, attn_impl: str, mesh, measure_qerr: bool):
+    """Run ``micro_k`` SEQUENTIAL decode iterations inside one program —
+    the engine's per-token host loop folded into a ``lax.scan`` whose
+    body is exactly :func:`paged_decode_step` plus the sampler plus the
+    retirement bookkeeping the host used to do between dispatches:
+
+    - iteration j samples slot i's next token iff the slot is still
+      ``alive`` (entered active, has not hit eos or its length limit);
+    - retirement is IN-PROGRAM masking: a slot whose sampled token is
+      its eos (``eos[i]`` ≥ 0) or whose emitted count reaches
+      ``limits[i]`` flips its alive bit, and every later iteration
+      treats it exactly like an inactive decode slot — position masked
+      to 0, k/v writes redirected to scratch, outputs garbage the host
+      sweep never reads;
+    - positions advance by 1 per emitted token, so iteration j writes
+      absolute position ``positions[i] + j`` — byte-identical addressing
+      to j separate steps.
+
+    ``sampler(logits, alive, j)`` returns (slots,) int32 next tokens —
+    argmax for the greedy program, the keyed sampler for the sampled one
+    (its per-token key is folded in-program from the iteration's
+    n_generated, the SAME ``fold_in(request_key, token_index)`` stream
+    K=1 draws, which is what makes K a pure scheduling knob: greedy
+    streams are bit-identical and sampled streams key-identical to K=1).
+
+    Quantized pools thread a STACKED ``qa`` (leading dim ``micro_k``,
+    one host-computed write layout per iteration, laid out as if every
+    entering slot lives through its span — a mid-span retiree's
+    remaining layout rows touch only its own exclusively-owned blocks,
+    whose garbage requantization is unread by construction: the partial
+    block is never cache-registered and frees at the host sweep).
+
+    Returns ((micro_k, slots) int32 tokens, pools[, max quant error]).
+    The host recovers each slot's valid prefix from the tokens alone —
+    it knows eos and the limits, so validity needs no extra output."""
+    quantized = pool_is_quantized(pools)
+    if quantized and qa is None:
+        raise ValueError(
+            "quantized (int8/fp8) pools need the host-computed stacked "
+            "`qa` write layouts (one per micro iteration) — see "
+            "ServingEngine._micro_quant_layout")
+
+    def body(carry, qa_j):
+        tok, pos, alive, emitted, pools = carry
+        out = paged_decode_step(
+            params, cfg, tok, jnp.where(alive, pos, 0), block_tables,
+            alive, pools, qa_j, attn_impl=attn_impl, mesh=mesh,
+            measure_qerr=measure_qerr)
+        logits, pools = out[0], out[1]
+        nxt = sampler(logits, alive, emitted)
+        emitted = emitted + alive.astype(jnp.int32)
+        done = alive & (((eos >= 0) & (nxt == eos)) | (emitted >= limits))
+        tok = jnp.where(alive, nxt, tok)
+        pos = pos + alive.astype(jnp.int32)
+        alive = alive & ~done
+        ys = (nxt, out[2]) if quantized else (nxt,)
+        return (tok, pos, alive, emitted, pools), ys
+
+    init = (tokens, positions, active, jnp.zeros_like(positions), pools)
+    if quantized:
+        (_, _, _, _, pools), ys = jax.lax.scan(body, init, qa)
+        return ys[0], pools, jnp.max(ys[1])
+    (_, _, _, _, pools), ys = jax.lax.scan(
+        body, init, None, length=micro_k)
+    return ys[0], pools
+
+
+def micro_decode_greedy(params: Params, cfg: TransformerConfig, tokens,
+                        positions, block_tables, active, limits, eos,
+                        pools, qa=None, *, micro_k: int,
+                        attn_impl: str = "xla", mesh=None,
+                        measure_qerr: bool = False):
+    """Greedy K-token micro-step: ``micro_k`` fused decode+argmax
+    iterations, ONE dispatch, ONE (micro_k, slots) readback — the
+    steady-state program that takes dispatch overhead from one-per-token
+    to one-per-K-tokens. Bit-identical tokens to ``micro_k`` separate
+    :func:`greedy_decode_step` calls (docs/parity.md "Dispatch
+    amortization")."""
+    def sampler(logits, alive, emitted):
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    return _micro_scan(params, cfg, tokens, positions, block_tables,
+                       active, limits, eos, pools, qa, micro_k, sampler,
+                       attn_impl, mesh, measure_qerr)
+
+
+def micro_decode_sample(params: Params, cfg: TransformerConfig, tokens,
+                        positions, block_tables, active, limits, eos,
+                        temperature, top_p, slot_keys, n_generated, pools,
+                        qa=None, *, micro_k: int, attn_impl: str = "xla",
+                        mesh=None, measure_qerr: bool = False):
+    """Sampled K-token micro-step: per-iteration keys fold in-program
+    from the running n_generated (``fold_in(slot_keys[i], ngen)``) — the
+    identical per-token key stream K=1's ``decode_and_sample`` draws, so
+    a request's sampled stream is the same at any K (key-identity, the
+    sampling half of the dispatch-amortization contract)."""
+    def sampler(logits, alive, emitted):
+        keys = jax.vmap(jax.random.fold_in)(
+            slot_keys, n_generated + emitted)
+        return sample_tokens(logits, temperature, top_p, keys)
+
+    return _micro_scan(params, cfg, tokens, positions, block_tables,
+                       active, limits, eos, pools, qa, micro_k, sampler,
+                       attn_impl, mesh, measure_qerr)
 
 
 def decode_and_sample(params: Params, cfg: TransformerConfig, tokens,
