@@ -8,7 +8,7 @@ PYTHON ?= python3
 # Seed for the chaos soak: any run is replayable by pinning this.
 TPU_TASK_CHAOS_SEED ?= 20260804
 
-.PHONY: test smoke sweep bench bench-steady bench-serving bench-sched bench-decode bench-fleet bench-obs bench-goodput sched sched-soak chaos fleet serve-soak obs watch wheel multichip kernels-tpu clean
+.PHONY: test smoke sweep bench bench-steady bench-serving bench-sched bench-decode bench-fleet bench-fleetkv bench-obs bench-goodput sched sched-soak chaos fleet kvfleet serve-soak obs watch wheel multichip kernels-tpu clean
 
 # Hermetic suite (the reference's `make test`, 30 s budget there; ours spans
 # the fake control planes, sharded-compute CPU checks, and the loopback GCS
@@ -97,6 +97,24 @@ chaos:
 # serve gangs through the scheduler — all in-process loopback HTTP.
 fleet:
 	$(PYTHON) -m pytest tests/ -m fleet -q
+
+# Fleet-wide KV plane tests: block export/import bit-faithfulness, the
+# delta-synced bucket index, block-aligned affinity, cross-engine import
+# stream identity, and (slow subset) the cold-replica-joins-mid-soak and
+# prefill/decode-split handoff legs.
+kvfleet:
+	$(PYTHON) -m pytest tests/ -m kvfleet -q
+
+# Fleet-KV bench legs only: shared_prefix_scaling (aggregate tok/s +
+# re-prefill chunk work at replicas {1,2,4}, fleet-KV on vs off,
+# 80%-shared-prefix workload) and prefill_decode_split (inter-token
+# latency + long TTFT of running streams under sustained long-prompt
+# load: 1 prefill + 2 decode vs 3 unified replicas at both unified
+# chunk budgets; decode_pool_chunk_rows pins the moved interference —
+# the wall-clock p99 win is hardware-gated). Same CPU shared-cores
+# caveat as bench-fleet.
+bench-fleetkv:
+	$(PYTHON) bench.py fleet --kvfleet-only
 
 # Serve-as-a-task chaos soak: replica gangs as REAL fake-mode TPU tasks,
 # a seeded mid-stream replica preemption (SIGTERM → drain → export →

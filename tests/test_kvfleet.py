@@ -1,0 +1,416 @@
+"""Fleet-wide KV plane tests (ROADMAP item 2): block export/import
+bit-faithfulness, the delta-synced bucket index and its staleness
+contract, block-aligned affinity + cached-depth routing, cross-engine
+import stream identity, and — in the slow subset — the
+cold-replica-joins-mid-soak and prefill/decode-split legs through the
+whole serve subsystem.
+
+The exactness spine: a block payload is only ever adopted under the
+content hash that names its exact token prefix, so an import can replace
+a prefill but can never change a stream — every stream assertion here is
+bit-identity against an unshared single engine.
+"""
+
+import tempfile
+
+import numpy as np
+import pytest
+
+from tpu_task.storage.backends import LocalBackend
+
+pytestmark = pytest.mark.kvfleet
+
+RNG = np.random.default_rng(99)
+
+
+def _micro():
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_task.ml.models import transformer
+
+    cfg = transformer.TransformerConfig(
+        dtype=jnp.float32, vocab_size=64, d_model=32, n_layers=2,
+        n_heads=4, d_head=8, d_ff=64, n_kv_heads=2)
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _engine(cfg, params, *, rng_seed=0, kv_client=None, **knobs):
+    import jax
+
+    from tpu_task.ml.serving import ServingConfig, ServingEngine
+
+    scfg = ServingConfig(**{"slots": 2, "block_size": 4, "n_blocks": 32,
+                            "max_len": 48, **knobs})
+    return ServingEngine(params, cfg, scfg,
+                         rng=jax.random.PRNGKey(rng_seed),
+                         kv_fleet=kv_client)
+
+
+# -- block payload export/import ---------------------------------------------
+
+
+@pytest.mark.parametrize("kv_dtype", [None, "int8", "fp8"])
+def test_block_payload_roundtrip_bit_faithful(kv_dtype):
+    """export → split → write into a FRESH pool → export again is
+    byte-identical, for model-dtype and quantized (codes + scale
+    sidecars) pools alike — the block-shipping exactness contract's
+    mechanical half."""
+    import jax.numpy as jnp
+
+    from tpu_task.ml.serving import ServingConfig, init_pools
+    from tpu_task.ml.serving.cache import (
+        block_payload_nbytes,
+        export_block_bytes,
+        fp8_supported,
+        split_block_bytes,
+        write_block,
+    )
+
+    if kv_dtype == "fp8" and not fp8_supported():
+        pytest.skip("no float8_e4m3fn support in this jax build")
+    cfg, _ = _micro()
+    scfg = ServingConfig(slots=2, block_size=4, n_blocks=8, max_len=16,
+                         kv_dtype=kv_dtype)
+    pools = init_pools(cfg, scfg)
+    # Fill block 3 with distinctive values through plain device writes.
+    rng = np.random.default_rng(7)
+    filled = []
+    for layer in pools:
+        out = {}
+        for name, arr in layer.items():
+            vals = rng.standard_normal(arr.shape[1:]).astype(np.float32)
+            out[name] = arr.at[3].set(jnp.asarray(vals).astype(arr.dtype))
+        filled.append(out)
+    payload = export_block_bytes(filled, 3)
+    assert len(payload) == block_payload_nbytes(cfg, scfg)
+    values = split_block_bytes(payload, cfg, scfg)
+    assert values is not None
+    fresh = init_pools(cfg, scfg)
+    imported = write_block(
+        fresh, jnp.int32(5),
+        [{name: jnp.asarray(leaf) for name, leaf in layer.items()}
+         for layer in values])
+    assert export_block_bytes(imported, 5) == payload
+    # A torn/foreign payload is a miss, never an exception.
+    assert split_block_bytes(payload[:-1], cfg, scfg) is None
+
+
+def test_fleet_index_delta_sync_merge_and_staleness(tmp_path):
+    """Two publishers' shards merge into one hash→source view; a chain
+    with a hole stops at the hole; a stale index entry (block object
+    gone from the bucket) degrades to a fetch miss — the
+    never-a-wrong-stream arm of the staleness contract."""
+    from tpu_task.serve.kvfleet import FleetKvClient, FleetKvIndex
+
+    backend = LocalBackend(str(tmp_path))
+    index_a = FleetKvIndex(backend, namespace="kvfleet/x",
+                           refresh_interval=0.0)
+    index_a.publish("ra", {"aa": 3, "bb": 3})
+    index_b = FleetKvIndex(backend, namespace="kvfleet/x",
+                           refresh_interval=0.0)
+    index_b.publish("rb", {"cc": 3})
+    index_b.refresh(force=True)
+    assert "aa" in index_b and "bb" in index_b and "cc" in index_b
+    assert index_b.source_of("aa") == "ra"
+    assert index_b.chain_depth(["aa", "bb", "cc"]) == 3
+    # A hole stops the chain: blocks past it would leave a KV gap.
+    assert index_b.chain_depth(["aa", "zz", "cc"]) == 1
+    # Repeated refreshes ride the conditional validators (no content
+    # change → same merged view, exercised via the 304/NOT_MODIFIED arm).
+    index_b.refresh(force=True)
+    assert index_b.chain_depth(["aa", "bb", "cc"]) == 3
+    # A publisher shard deleted from the bucket drops out on refresh.
+    backend.delete("kvfleet/x/index/ra.json")
+    index_b.refresh(force=True)
+    assert "aa" not in index_b and "cc" in index_b
+
+    # Client-level staleness: an advertised hash whose block object is
+    # gone answers None (degrade to local prefill), and counts the miss.
+    cfg, _ = _micro()
+    from tpu_task.ml.serving import ServingConfig
+
+    client = FleetKvClient(backend, "rc", refresh_interval=0.0)
+    client.bind(cfg, ServingConfig(slots=2, block_size=4, n_blocks=8,
+                                   max_len=16))
+    client.index.publish("rc", {"dd" * 16: 1})
+    assert client.fetch(bytes.fromhex("dd" * 16)) is None
+    assert client.fetch_misses == 1
+
+
+# -- router policy ------------------------------------------------------------
+
+
+def _bare_router(n=2, **kwargs):
+    from tpu_task.serve import Router
+
+    router = Router(seed=0, block_size=4, **kwargs)
+    router.set_replicas({
+        f"r{i}": {"url": f"http://127.0.0.1:{9000 + i}", "boot_id": f"b{i}"}
+        for i in range(n)})
+    return router
+
+
+def test_affinity_key_is_block_aligned_on_chain_hashes():
+    """The PR 10 affinity bug: keying on the first ``affinity_tokens``
+    raw ids split prompts that share every FULL cache block but diverge
+    inside the trailing partial block. The fixed key is the chain hash
+    of the longest full-block prefix of the window — affinity
+    granularity IS prefix-cache granularity, pinned by equality with
+    ``cache.chain_block_hashes``."""
+    from tpu_task.ml.serving import chain_block_hashes
+
+    router = _bare_router(affinity_tokens=10)     # NOT block-aligned
+    shared = list(range(1, 9))                    # two full 4-token blocks
+    a = shared + [50, 51]                         # diverge inside the
+    b = shared + [60, 61]                         # ...partial 3rd block
+    assert router._affinity_key(a) == router._affinity_key(b)
+    assert router.pick(a).name == router.pick(b).name
+    # Diverging inside a full block still separates.
+    c = [1, 2, 99, 4] + shared[4:] + [50, 51]
+    assert router._affinity_key(a) != router._affinity_key(c)
+    # The router's chain spelling is EXACTLY the engine cache's, so the
+    # depth/affinity keys name the same prefixes replicas actually hold.
+    assert router._chain_hashes(a) == chain_block_hashes(np.asarray(a), 4)
+
+
+def test_cached_depth_beats_affinity_and_raises_spill_threshold():
+    from tpu_task.serve import Router
+
+    router = _bare_router(n=3, spill_load=2, spill_depth_weight=1.0)
+    prompt = list(range(1, 13))                   # three full blocks
+    hashes = router._chain_hashes(prompt)
+    affinity_pick = router.pick(prompt).name
+    other = next(name for name in router._replicas
+                 if name != affinity_pick)
+    # The other replica served this prefix before: depth wins the pick.
+    Router._note_served(router._replicas[other], hashes)
+    assert router.pick(prompt).name == other
+    # Spilling away from a depth-3 replica needs load imbalance of
+    # spill_load + depth = 5, not 2.
+    router._replicas[other].load = 4
+    assert router.pick(prompt).name == other      # 4 - 0 < 5: stays
+    router._replicas[other].load = 5
+    spilled = router.pick(prompt).name
+    assert spilled != other                       # 5 - 0 >= 5: spills
+    # A zero-depth prompt spills at the plain threshold.
+    cold = list(range(40, 52))
+    cold_pick = router.pick(cold).name
+    router._replicas[cold_pick].load = 2
+    assert router.pick(cold).name != cold_pick
+
+
+# -- engine-to-engine sharing -------------------------------------------------
+
+
+@pytest.mark.perf
+def test_engine_imports_published_blocks_stream_bit_identical():
+    """The tentpole's tier-1 pin: engine B imports the full-block prefix
+    engine A published and produces the BIT-IDENTICAL greedy stream an
+    unshared engine produces — with import counters proving no prefill
+    replaced the shipped blocks."""
+    from tpu_task.serve.kvfleet import FleetKvClient
+
+    cfg, params = _micro()
+    tmp = tempfile.mkdtemp()
+    backend = LocalBackend(tmp)
+    client_a = FleetKvClient(backend, "ra", refresh_interval=0.0)
+    engine_a = _engine(cfg, params, rng_seed=1, kv_client=client_a)
+    prompt = np.asarray(list(range(1, 17)) + [20, 21], np.int32)
+    rid_a = engine_a.submit(prompt, 8)
+    out_a = engine_a.drain()[rid_a]
+    assert client_a.publish(engine_a) > 0
+    assert client_a.bytes_shipped > 0
+
+    client_b = FleetKvClient(backend, "rb", refresh_interval=0.0)
+    engine_b = _engine(cfg, params, rng_seed=2, kv_client=client_b)
+    rid_b = engine_b.submit(prompt, 8)
+    out_b = engine_b.drain()[rid_b]
+    stats = engine_b.stats()["kvfleet"]
+    assert stats["hit_blocks"] == 4               # 16 shared tokens / 4
+    assert stats["import_requests"] == 1
+    assert client_b.bytes_fetched > 0
+
+    reference = _engine(cfg, params, rng_seed=3)
+    rid_r = reference.submit(prompt, 8)
+    assert out_b == reference.drain()[rid_r] == out_a
+    # Re-admission of the same prefix hits LOCALLY now (adopted blocks
+    # joined B's prefix cache) — the fleet is consulted once per prefix.
+    rid_c = engine_b.submit(prompt, 8)
+    engine_b.drain()
+    assert engine_b.stats()["kvfleet"]["import_requests"] == 1
+
+
+@pytest.mark.slow
+def test_engine_import_int8_codes_and_sidecars_bit_identical():
+    """Quantized block shipping: the int8 codes + scale sidecars another
+    engine published import byte-faithfully — streams identical to an
+    unshared int8 engine on the anchor config (the same exactness class
+    as PR 9's int8 stream pin)."""
+    from tpu_task.serve.kvfleet import FleetKvClient
+
+    cfg, params = _micro()
+    tmp = tempfile.mkdtemp()
+    backend = LocalBackend(tmp)
+    knobs = dict(block_size=8, n_blocks=32, max_len=48, kv_dtype="int8")
+    client_a = FleetKvClient(backend, "ra", refresh_interval=0.0)
+    engine_a = _engine(cfg, params, rng_seed=1, kv_client=client_a, **knobs)
+    prompt = np.arange(1, 18, dtype=np.int32)
+    rid_a = engine_a.submit(prompt, 6)
+    out_a = engine_a.drain()[rid_a]
+    client_a.publish(engine_a)
+
+    client_b = FleetKvClient(backend, "rb", refresh_interval=0.0)
+    engine_b = _engine(cfg, params, rng_seed=2, kv_client=client_b, **knobs)
+    rid_b = engine_b.submit(prompt, 6)
+    out_b = engine_b.drain()[rid_b]
+    assert engine_b.stats()["kvfleet"]["hit_blocks"] == 2
+    reference = _engine(cfg, params, rng_seed=3, **knobs)
+    rid_r = reference.submit(prompt, 6)
+    assert out_b == reference.drain()[rid_r] == out_a
+
+
+# -- fleet-level legs (slow) --------------------------------------------------
+
+
+def _fleet(tmp_path, *, replicas=1, seed=0, **spec_kwargs):
+    from tpu_task.scheduler import CapacityPool, GangScheduler, TenantQuota
+    from tpu_task.serve import (
+        InProcessServeDriver,
+        Router,
+        ServeFleet,
+        ServeSpec,
+        wait_until,
+    )
+
+    driver = InProcessServeDriver(
+        kv_backend=LocalBackend(str(tmp_path)))
+    scheduler = GangScheduler(
+        CapacityPool([32]), {"svc": TenantQuota(chips=32, weight=1.0)},
+        driver)
+    router = Router(seed=seed)
+    spec = ServeSpec(service="chat", tenant="svc", replicas=replicas,
+                     preset="micro", serving={"slots": 4}, **spec_kwargs)
+    fleet = ServeFleet(scheduler, spec, router)
+    # An untaught router learns the spec's engine block size at fleet
+    # construction — affinity/depth chains stay aligned with what the
+    # preset's engines actually cache (micro: block_size 4).
+    assert router.block_size == 4
+    fleet.launch()
+    total = replicas + spec.prefill_replicas
+    assert wait_until(lambda: len(fleet.refresh_endpoints()) == total,
+                      60, tick=fleet.tick, period=0.05)
+    fleet.tick()
+    return driver, router, fleet
+
+
+def _teardown(driver):
+    for task_id in list(driver.running_ids()):
+        driver._stop(task_id, graceful=False)
+
+
+@pytest.mark.fleet
+@pytest.mark.slow
+def test_cold_replica_joining_mid_soak_hits_fleet_index(tmp_path):
+    """The ISSUE acceptance leg: an 80%-shared-prefix workload runs, a
+    new replica joins via the scheduler mid-soak, and its first
+    shared-prefix request imports from the fleet index instead of
+    re-prefilling — import counters prove it, and every stream is
+    bit-identical to an unshared single engine's."""
+    import jax.numpy as jnp
+
+    from tpu_task.serve.replica import build_engine
+
+    driver, router, fleet = _fleet(tmp_path, replicas=1)
+    try:
+        shared = list(range(1, 17))               # four full 4-token blocks
+        prompts = [np.asarray(shared + [30 + i, 31 + i], np.int32)
+                   if i % 5 else RNG.integers(0, 64, size=10)
+                   for i in range(10)]
+        fids = [router.submit(p, 6) for p in prompts]
+        router.drain(deadline_s=120, on_idle=fleet.tick)
+
+        # Mid-soak membership change: scale to 2 via the scheduler, then
+        # retire the warm replica so the cold one must serve.
+        fleet.scale_to(2)
+        assert fleet.live_replicas() == 2
+        from tpu_task.serve import wait_until
+        assert wait_until(
+            lambda: len(fleet.refresh_endpoints()) == 2, 60,
+            tick=fleet.tick, period=0.05)
+        warm = "chat-r0"
+        driver.kill(warm, graceful=True)
+        fleet.tick()
+        cold_name = next(tid for tid in driver.running_ids())
+        cold = driver._servers[cold_name]
+        assert cold.engine.stats()["kvfleet"]["hit_blocks"] == 0
+
+        fid = router.submit(np.asarray(shared + [99, 98], np.int32), 6)
+        out = router.drain(deadline_s=120, on_idle=fleet.tick)
+        stats = cold.engine.stats()["kvfleet"]
+        assert stats["hit_blocks"] > 0            # imported, not prefilled
+        assert stats["import_requests"] >= 1
+
+        # Bit-identity of EVERY stream vs one unshared engine fed the
+        # router-derived keys.
+        engine = build_engine("micro")
+        for f in [*fids, fid]:
+            request = router.request(f)
+            rid = engine.submit(
+                request.prompt, request.max_new_tokens,
+                key=jnp.asarray(np.asarray(request.key, np.uint32)))
+            assert engine.drain()[rid] == out[f]
+    finally:
+        _teardown(driver)
+
+
+@pytest.mark.fleet
+@pytest.mark.slow
+def test_prefill_decode_split_hands_off_at_boundary_token(tmp_path):
+    """Disaggregated prefill/decode: a long prompt takes the prefill
+    pool first (role-dispatched), hands off at the boundary token, and
+    the decode replica resumes by IMPORTING the published blocks — the
+    stream stays bit-identical to an unshared engine, and the dispatch
+    spans record the split (role + cached-prefix depth)."""
+    import jax.numpy as jnp
+
+    from tpu_task.serve.replica import build_engine
+
+    driver, router, fleet = _fleet(
+        tmp_path, replicas=1, prefill_replicas=1,
+        prefill_serving={"chunk_tokens": 24}, prefill_threshold=16)
+    try:
+        assert router.prefill_threshold == 16     # spec taught the router
+        roles = {name: r["role"] for name, r in router.replicas().items()}
+        assert sorted(roles.values()) == ["decode", "prefill"]
+
+        long_prompt = np.arange(1, 25, dtype=np.int32)
+        fid = router.submit(long_prompt, 8)
+        out = router.drain(deadline_s=120, on_idle=fleet.tick)
+        assert router.handoffs == 1
+
+        request = router.request(fid)
+        engine = build_engine("micro")
+        rid = engine.submit(
+            request.prompt, request.max_new_tokens,
+            key=jnp.asarray(np.asarray(request.key, np.uint32)))
+        assert engine.drain()[rid] == out[fid]
+
+        decode = driver._servers["chat-r0"]
+        assert decode.engine.stats()["kvfleet"]["hit_blocks"] > 0
+        prefill = driver._servers["chat-p0"]
+        assert prefill.engine.stats()["kvfleet"]["published_blocks"] > 0
+
+        spans = [s for s in router.obs.tracer.finished()
+                 if s.name == "dispatch" and s.attrs.get("fid") == fid]
+        assert [s.attrs["role"] for s in spans] == ["prefill", "decode"]
+        assert spans[0].status == "prefilled"
+        assert "cached_depth" in spans[0].attrs
+        # A short prompt never takes the prefill leg.
+        fid2 = router.submit(np.arange(1, 9, dtype=np.int32), 4)
+        router.drain(deadline_s=120, on_idle=fleet.tick)
+        assert router.request(fid2).dispatches == 1
+        assert router.handoffs == 1
+    finally:
+        _teardown(driver)

@@ -586,11 +586,19 @@ def test_cli_sched_status_renders_serve_kind(tmp_path, capsys, monkeypatch,
 
 def test_serve_spec_script_and_payload():
     spec = ServeSpec(service="chat", tenant="svc", replicas=2,
-                     preset="tiny", serving={"slots": 2})
+                     preset="tiny", serving={"slots": 2},
+                     prefill_serving={"chunk_tokens": 64},
+                     kv_bucket="/tmp/kv")
     script = replica_script(spec, python="python3.11")
     assert script.startswith("#!/bin/bash\n")
     assert "-m tpu_task.serve.replica" in script
     assert "--preset tiny" in script and '"slots": 2' in script
+    assert "--kv-bucket '/tmp/kv'" in script
     payload = spec.payload(3)
     assert payload == {"kind": "serve", "service": "chat", "replica": "3",
-                       "preset": "tiny"}
+                       "preset": "tiny", "role": "decode",
+                       "serving": '{"slots": 2}'}
+    # The prefill role's serving overrides land in its payload + script.
+    assert spec.payload(0, role="prefill")["serving"] == \
+        '{"chunk_tokens": 64, "slots": 2}'
+    assert '"chunk_tokens": 64' in replica_script(spec, role="prefill")

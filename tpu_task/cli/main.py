@@ -554,6 +554,16 @@ def _watch_frame(merged, alerts, remote: str) -> str:
     depth = value("router.queue_depth") + value("engine.queue_depth")
     head.append(f"queue {int(depth)}")
     lines.append("  ".join(head))
+    if any(name.startswith("kvfleet.") for name in merged):
+        # The fleet KV plane in one line: admission-side block hit/miss,
+        # bytes moved each way, and prefill→decode stream handoffs (the
+        # import-latency histogram shows up in the table below).
+        lines.append(
+            f"kvfleet  hit {int(value('kvfleet.hit_blocks'))}"
+            f"  miss {int(value('kvfleet.miss_blocks'))}"
+            f"  shipped {value('kvfleet.bytes_shipped') / 1e6:.2f}MB"
+            f"  fetched {value('kvfleet.bytes_fetched') / 1e6:.2f}MB"
+            f"  handoffs {int(value('router.handoffs'))}")
     rows = []
     for name, entry in sorted(merged.items()):
         if entry.get("type") != "histogram" or not entry.get("count"):

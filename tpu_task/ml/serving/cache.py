@@ -476,6 +476,107 @@ def quantized_append(pool: dict, new_k, new_v, touched, filled, wt, wo,
     return out, qerr
 
 
+# -- fleet block shipping (export/import of physical blocks) -----------------
+
+def kv_fingerprint(cfg: TransformerConfig, scfg: ServingConfig) -> str:
+    """Compatibility fingerprint of a pool's BLOCK PAYLOAD layout — the
+    namespace key of the fleet KV plane's bucket layout. Two engines may
+    exchange block bytes iff their fingerprints match: same per-block
+    geometry (block_size, kv_heads, d_head, n_layers) and same storage
+    representation (model dtype or quantized code dtype). It deliberately
+    ignores everything that does NOT change a block's bytes (n_blocks,
+    slots, chunking, spec_k), so differently-sized pools still share."""
+    parts = (cfg.n_layers, cfg.kv_heads, cfg.d_head,
+             str(jnp.dtype(cfg.dtype)), scfg.block_size,
+             scfg.kv_dtype or "model")
+    return hashlib.blake2b(repr(parts).encode(), digest_size=8).hexdigest()
+
+
+def block_payload_nbytes(cfg: TransformerConfig, scfg: ServingConfig) -> int:
+    """Exact byte length of one exported block payload — the importer's
+    validation gate (a payload of any other length is treated as a miss,
+    never written into the pool)."""
+    elem = (1 if scfg.kv_dtype in QUANT_DTYPES
+            else jnp.dtype(cfg.dtype).itemsize)
+    per_layer = 2 * scfg.block_size * cfg.kv_heads * cfg.d_head * elem
+    if scfg.kv_dtype in QUANT_DTYPES:
+        per_layer += 2 * cfg.kv_heads * 4          # k_scale + v_scale rows
+    return cfg.n_layers * per_layer
+
+
+def export_block_bytes(pools: List[dict], block: int) -> bytes:
+    """ONE physical block's bytes across every layer, in the deterministic
+    (layer, sorted leaf name) order — codes AND scale sidecars for
+    quantized pools, raw model-dtype values otherwise. The unit the fleet
+    KV plane ships: for int8/fp8 pools this is exactly the 1-byte codes
+    plus the per-(block, kv-head) fp32 scales, ~4× cheaper than fp32.
+    Round-trips bit-faithfully through :func:`split_block_bytes` +
+    :func:`write_block` (every leaf's leading axis is n_blocks, so
+    ``leaf[block]`` is the complete per-block slice)."""
+    return b"".join(
+        np.asarray(layer[name][block]).tobytes()
+        for layer in pools for name in sorted(layer))
+
+
+def split_block_bytes(data: bytes, cfg: TransformerConfig,
+                      scfg: ServingConfig) -> Optional[List[dict]]:
+    """Inverse of :func:`export_block_bytes`: parse one block payload into
+    the per-layer {leaf name: array} pytree :func:`write_block` consumes
+    (shapes without the leading n_blocks axis). Returns None — a miss,
+    never an exception — when the payload length does not match this
+    config's layout (a foreign or torn object in the bucket)."""
+    if len(data) != block_payload_nbytes(cfg, scfg):
+        return None
+    if scfg.kv_dtype in QUANT_DTYPES:
+        code_dtype = (jnp.int8 if scfg.kv_dtype == "int8"
+                      else jnp.float8_e4m3fn)
+        leaves = (("k", code_dtype), ("k_scale", jnp.float32),
+                  ("v", code_dtype), ("v_scale", jnp.float32))
+    else:
+        leaves = (("k", cfg.dtype), ("v", cfg.dtype))
+    shape = (scfg.block_size, cfg.kv_heads, cfg.d_head)
+    out: List[dict] = []
+    offset = 0
+    for _ in range(cfg.n_layers):
+        layer = {}
+        for name, dtype in leaves:
+            leaf_shape = (cfg.kv_heads,) if name.endswith("_scale") \
+                else shape
+            n = int(np.prod(leaf_shape)) * jnp.dtype(dtype).itemsize
+            layer[name] = np.frombuffer(
+                data, dtype=np.dtype(dtype), count=int(np.prod(leaf_shape)),
+                offset=offset).reshape(leaf_shape)
+            offset += n
+        out.append(layer)
+    return out
+
+
+def write_block(pools: List[dict], dst, values: List[dict]) -> List[dict]:
+    """Write one imported block's values (the :func:`split_block_bytes`
+    pytree) into physical block ``dst`` of every layer — the import half
+    of fleet block shipping, shaped exactly like :func:`copy_block` so
+    the engine compiles it once with donated pools. Quantized layers'
+    scale sidecars land with their codes; the write is a byte copy, so an
+    imported block dequantizes to exactly the publisher's values."""
+    return [{name: arr.at[dst].set(vals[name])
+             for name, arr in pool.items()}
+            for pool, vals in zip(pools, values)]
+
+
+def write_blocks(pools: List[dict], dsts, values: List[dict]) -> List[dict]:
+    """Batched :func:`write_block`: ``dsts`` is (N,) physical block ids
+    and every ``values`` leaf carries a leading N axis — ONE device
+    dispatch imports a whole shipped prefix chain instead of one
+    dispatch per block (the import sits on the admission path, where a
+    running batch is waiting on it). Rows may be padded with the scratch
+    sentinel as ``dst`` (scratch rewrites are harmless by definition);
+    duplicate scratch rows scatter in unspecified order onto bytes
+    nothing ever reads."""
+    return [{name: arr.at[dsts].set(vals[name])
+             for name, arr in pool.items()}
+            for pool, vals in zip(pools, values)]
+
+
 class BlockAllocator:
     """Host-side refcounted free list over the physical blocks (block 0
     excluded — it is the scratch block). Every allocated block carries a
@@ -683,6 +784,40 @@ class PrefixCache:
             self._touch(b)
             new += 1
         return new
+
+    def adopt(self, h: bytes, block: int) -> bool:
+        """Register an ALLOCATED block imported from the fleet KV plane
+        under its content hash ``h`` (the publisher's chained block hash —
+        content-addressing is what makes adoption safe: equal hashes mean
+        equal token prefixes, so the imported bytes are exactly the KV a
+        local prefill of those ids would have produced, up to the
+        quantization contract). The block is retained like any registered
+        block; the importing slot's reference comes from its allocation.
+        Returns False (nothing adopted) when the hash is already cached —
+        the caller should have used :meth:`lookup` instead."""
+        if h in self._by_hash:
+            self._touch(self._by_hash[h])
+            return False
+        self._by_hash[h] = block
+        self._hash_of[block] = h
+        self._alloc.retain(block)
+        self._touch(block)
+        return True
+
+    def hot_entries(self, limit: Optional[int] = None) -> List[Tuple[bytes, int]]:
+        """The publishable working set: (hash, block) of every RETAINED
+        refcount-0 cached block, most recently touched first — "hot ref-0"
+        is exactly the set a replica may read without racing a slot's
+        writes (referenced blocks are still being appended to; retained
+        ones are frozen until eviction or resurrection)."""
+        entries = sorted(
+            ((t, b) for b, t in self._lru.items()
+             if self._alloc.refcount(b) == 0
+             and self._alloc.is_retained(b)),
+            reverse=True)
+        if limit is not None:
+            entries = entries[:limit]
+        return [(self._hash_of[b], b) for _, b in entries]
 
     def evict(self, n: int) -> int:
         """Evict up to ``n`` refcount-0 cached blocks, LRU first, back to

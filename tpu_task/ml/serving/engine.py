@@ -103,13 +103,17 @@ from tpu_task.ml.serving.cache import (
     BlockAllocator,
     PrefixCache,
     ServingConfig,
+    chain_block_hashes,
     copy_block,
+    export_block_bytes,
     fp8_supported,
     init_pools,
     kv_shard_bytes,
     kv_token_bytes,
     paged_cache_bytes,
     pool_pspecs,
+    split_block_bytes,
+    write_blocks,
 )
 from tpu_task.ml.serving.model import (
     chunked_step_greedy,
@@ -261,7 +265,7 @@ class ServingEngine:
                  rng: Optional[jax.Array] = None, mesh=None,
                  draft_params: Optional[Params] = None,
                  draft_cfg: Optional[TransformerConfig] = None,
-                 obs: Optional[Obs] = None):
+                 obs: Optional[Obs] = None, kv_fleet=None):
         self.cfg = cfg
         self.scfg = scfg = scfg or ServingConfig()
         self.mesh = mesh
@@ -308,6 +312,29 @@ class ServingEngine:
                 "build/backend (cache.fp8_supported() is False) — use "
                 "kv_dtype='int8' for the same byte density or None for "
                 "model-dtype pools")
+
+        # Fleet KV plane (ROADMAP item 2): an attached client (duck-typed
+        # — serve/kvfleet.py defines the real one; ml.serving never
+        # imports it) lets admission import content-hash-matched blocks
+        # other replicas published instead of prefilling them, and lets
+        # this engine publish its own hot cached blocks
+        # (export_cached_blocks). Single-chip only: an imported payload
+        # is one unsharded block's bytes.
+        self._fleet = kv_fleet
+        if kv_fleet is not None:
+            if mesh is not None:
+                raise ValueError(
+                    "kv_fleet is single-chip for now: block payloads are "
+                    "unsharded (attach it to a mesh=None engine)")
+            if not scfg.prefix_cache:
+                raise ValueError(
+                    "kv_fleet needs prefix_cache=True — imported blocks "
+                    "are adopted INTO the local prefix cache")
+            kv_fleet.bind(cfg, scfg)
+        self.fleet_hit_blocks = 0
+        self.fleet_miss_blocks = 0
+        self.fleet_import_requests = 0
+        self._h_kv_import = None
 
         # Speculative decoding: validate the draft triple together.
         self._spec_on = scfg.spec_k > 0
@@ -404,6 +431,25 @@ class ServingEngine:
             # replica /stats surface (configured K vs what actually ran).
             metrics.gauge_fn("engine.micro_k",
                              lambda scfg=scfg: float(scfg.micro_k))
+            if kv_fleet is not None:
+                # The fleet-KV counters the obs satellite names: block
+                # hit/miss at admission, bytes shipped out by the
+                # publisher, bytes pulled in by the importer, and the
+                # per-admission import latency histogram. All flow to
+                # replica /stats, /metrics Prometheus text, and the
+                # `obs watch` KV line through the one registry.
+                self._h_kv_import = metrics.histogram("kvfleet.import_s")
+                for stat in ("fleet_hit_blocks", "fleet_miss_blocks",
+                             "fleet_import_requests"):
+                    name = stat.replace("fleet_", "")
+                    metrics.counter_fn(f"kvfleet.{name}",
+                                       lambda self=self, stat=stat:
+                                       float(getattr(self, stat)))
+                for stat in ("bytes_shipped", "bytes_fetched",
+                             "published_blocks"):
+                    metrics.counter_fn(f"kvfleet.{stat}",
+                                       lambda kv_fleet=kv_fleet, stat=stat:
+                                       float(getattr(kv_fleet, stat, 0)))
 
         # Draft-model state: its "dense" cache is a paged pool with a
         # STATIC identity block layout — slot s owns blocks
@@ -550,6 +596,19 @@ class ServingEngine:
             lambda pools, src, dst: copy_block(pools, src, dst),
             plan((k_specs, rep, rep), (0,),
                  out=k_specs if mesh is not None else None)))
+        # Fleet block import: write a whole shipped prefix chain into
+        # local physical blocks in ONE dispatch (the import sits on the
+        # admission path with a running batch behind it — per-block
+        # dispatches would stall every decode slot for the chain's
+        # length). Chains are padded to power-of-two widths so the jit
+        # cache holds O(log max_blocks) programs, not one per length;
+        # kv_fleet is gated to mesh=None above, so a plain
+        # donate-the-pools plan suffices.
+        if kv_fleet is not None:
+            self._import_blocks_fn = self._wrap(compile_step(
+                lambda pools, dsts, values: write_blocks(
+                    pools, dsts, values),
+                PartitionPlan(donate=(0,))))
         if self._spec_on:
             # Target scoring: the chunked multi-token step at width k+1.
             if quant:
@@ -998,6 +1057,97 @@ class ServingEngine:
             return None
         return self.allocator.alloc(n)
 
+    def _fleet_import(self, ctx: np.ndarray, have: int) -> List[int]:
+        """Import the consecutive full-block tail of ``ctx`` that the
+        local prefix cache missed (``have`` = local hit depth in blocks)
+        from the fleet KV plane: look the chained hashes up in the fleet
+        index, fetch each payload, write it into a freshly allocated
+        local block, and adopt it into the local prefix cache under its
+        hash. Any failure — index hole, stale entry (missing object),
+        torn payload, pool pressure — STOPS the import and the remaining
+        tail prefills locally; a wrong stream is impossible because a
+        payload is only adopted under the hash naming its exact token
+        prefix. Returns the imported physical blocks in chain order (the
+        caller appends them to its cached-prefix list; their allocation
+        refcount is the admitting slot's reference)."""
+        hashes = chain_block_hashes(ctx, self.scfg.block_size)
+        want = hashes[have:]
+        if not want:
+            return []
+        t0 = time.perf_counter()
+        try:
+            n_hit = self._fleet.lookup_chain(want)
+        except OSError:
+            n_hit = 0
+        payloads: List[Tuple[bytes, List[dict]]] = []
+        for h in want[:n_hit]:
+            data = self._fleet.fetch(h)
+            if data is None:
+                break             # stale index entry → local prefill
+            values = split_block_bytes(data, self.cfg, self.scfg)
+            if values is None:
+                break             # foreign/torn payload → local prefill
+            payloads.append((h, values))
+        imported: List[int] = []
+        for _ in payloads:
+            got = self._reserve(1, 0)
+            if got is None:
+                break             # pool pressure → prefill what's left
+            imported.append(got[0])
+        payloads = payloads[:len(imported)]
+        if imported:
+            # ONE padded dispatch writes the whole chain; pad rows target
+            # the scratch block (harmless by definition). The pad width
+            # is FIXED at max_blocks_per_slot (no chain can be longer),
+            # so exactly one import program ever compiles — a varying
+            # width would recompile mid-traffic and stall every running
+            # slot for the compile, the exact tail latency the batched
+            # write exists to avoid.
+            n = len(imported)
+            pad = self.scfg.max_blocks_per_slot
+            dsts = np.full((pad,), SCRATCH_BLOCK, np.int32)
+            dsts[:n] = imported
+            stacked = [
+                {name: jnp.asarray(np.concatenate(
+                    [np.stack([p[1][li][name] for p in payloads]),
+                     np.zeros((pad - n,) + leaf.shape, leaf.dtype)])
+                    if pad > n else
+                    np.stack([p[1][li][name] for p in payloads]))
+                 for name, leaf in layer.items()}
+                for li, layer in enumerate(payloads[0][1])]
+            self.pools = self._gp_timed(
+                self._import_blocks_fn, self.pools, jnp.asarray(dsts),
+                stacked)
+            for (h, _), block in zip(payloads, imported):
+                self._pcache.adopt(h, block)
+        self.fleet_hit_blocks += len(imported)
+        self.fleet_miss_blocks += len(want) - len(imported)
+        if imported:
+            self.fleet_import_requests += 1
+            if self._h_kv_import is not None:
+                self._h_kv_import.observe(time.perf_counter() - t0)
+        return imported
+
+    def export_cached_blocks(self, limit: int = 16,
+                             skip=()) -> List[Tuple[str, bytes]]:
+        """The publish half of the fleet KV plane: up to ``limit`` hot
+        ref-0 retained prefix-cache blocks as (hash hex, payload bytes),
+        hottest first, skipping hashes in ``skip`` (the client's
+        already-published set). Retained ref-0 blocks are frozen — no
+        slot can write them without a COW copy — so the payload read is
+        race-free by construction."""
+        if self._pcache is None:
+            return []
+        out: List[Tuple[str, bytes]] = []
+        for h, block in self._pcache.hot_entries():
+            if len(out) >= limit:
+                break
+            hash_hex = h.hex()
+            if hash_hex in skip:
+                continue
+            out.append((hash_hex, export_block_bytes(self.pools, block)))
+        return out
+
     def _admit(self, admitted: list, finished: list) -> None:
         if self.scfg.prefill == "chunked":
             self._admit_chunked(admitted)
@@ -1025,6 +1175,13 @@ class ServingEngine:
             cached: List[int] = []
             if self._pcache is not None:
                 cached = self._pcache.lookup(ctx)          # increfs
+                if self._fleet is not None:
+                    # The blocks the LOCAL cache missed may exist in the
+                    # fleet: import them by content hash instead of
+                    # prefilling them (each imported block lands in the
+                    # local cache too, so the fleet is consulted once per
+                    # prefix, not once per request).
+                    cached += self._fleet_import(ctx, len(cached))
             # The last prompt token is ALWAYS recomputed (its logits seed
             # the first sample), so a whole-prompt hit caps at plen - 1 —
             # and that one write lands inside the final shared block, the
@@ -1841,6 +1998,20 @@ class ServingEngine:
                                   if self._pcache else 0),
                 "evictions": (self._pcache.evictions
                               if self._pcache else 0),
+            },
+            "kvfleet": {
+                "enabled": self._fleet is not None,
+                # Admission-side: blocks imported from (resp. missed in)
+                # the fleet plane instead of being prefilled locally.
+                "hit_blocks": self.fleet_hit_blocks,
+                "miss_blocks": self.fleet_miss_blocks,
+                "import_requests": self.fleet_import_requests,
+                # Publisher-side (client-owned): what this replica shipped
+                # out and pulled in, in bytes.
+                "published_blocks": getattr(
+                    self._fleet, "published_blocks", 0),
+                "bytes_shipped": getattr(self._fleet, "bytes_shipped", 0),
+                "bytes_fetched": getattr(self._fleet, "bytes_fetched", 0),
             },
             "spec": {
                 "k": self.scfg.spec_k,

@@ -2,9 +2,12 @@
 engine fleet — replica gangs on the PR 7 scheduler, a session-affine
 router on the PR 2 transport, graceful drain on the PR 3 preemption
 machinery, and token streams that survive a mid-stream replica
-preemption bit-identically."""
+preemption bit-identically. The fleet KV plane (``kvfleet``, ROADMAP
+item 2) adds cross-replica prefix-cache sharing by content hash and the
+disaggregated prefill/decode split on top of the same seams."""
 
 from tpu_task.serve.autoscale import QueueDepthAutoscaler
+from tpu_task.serve.kvfleet import FleetKvClient, FleetKvIndex
 from tpu_task.serve.fleet import (
     InProcessServeDriver,
     ServeFleet,
@@ -18,6 +21,8 @@ from tpu_task.serve.replica import MODEL_PRESETS, ReplicaServer, build_engine
 from tpu_task.serve.router import FleetRequest, NoReplicaAvailable, Router
 
 __all__ = [
+    "FleetKvClient",
+    "FleetKvIndex",
     "FleetRequest",
     "InProcessServeDriver",
     "MODEL_PRESETS",

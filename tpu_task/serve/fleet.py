@@ -48,7 +48,22 @@ __all__ = [
 
 @dataclass(frozen=True)
 class ServeSpec:
-    """What one serving fleet is made of."""
+    """What one serving fleet is made of.
+
+    The ``role`` axis (disaggregated prefill/decode, ROADMAP item 2):
+    ``prefill_replicas > 0`` runs that many DEDICATED prompt-ingestion
+    replicas next to the ``replicas`` decode pool. The router sends fresh
+    prompts of at least ``prefill_threshold`` tokens to the prefill pool
+    first (one boundary token, KV published through the fleet KV plane),
+    then hands the stream to a decode replica. ``prefill_serving``
+    overrides ``serving`` for the prefill pool only — the chunked-prefill
+    budget (``chunk_tokens``) becomes a per-pool knob instead of a shared
+    compromise: crank it on the prefill pool (ingestion throughput),
+    keep it small on the decode pool (inter-token latency). ``kv_bucket``
+    is the SHARED storage root of the fleet KV plane for real-task
+    replicas (in-process fleets pass a backend to the driver instead);
+    the split leans on it — without block shipping the decode replica
+    would re-prefill what the prefill replica just ingested."""
 
     service: str
     tenant: str
@@ -58,25 +73,53 @@ class ServeSpec:
     priority: int = 1
     preset: str = "tiny"
     serving: Dict = field(default_factory=dict)
+    prefill_replicas: int = 0
+    prefill_serving: Dict = field(default_factory=dict)
+    prefill_threshold: int = 64
+    kv_bucket: Optional[str] = None
 
-    def payload(self, replica_index: int) -> Dict[str, str]:
+    def serving_for(self, role: str) -> Dict:
+        """ServingConfig overrides for one role's replicas."""
+        if role == "prefill":
+            return {**self.serving, **self.prefill_serving}
+        return dict(self.serving)
+
+    def engine_block_size(self) -> int:
+        """The KV block size this spec's engines actually run (serving
+        override > preset default > ServingConfig default) — what the
+        router's affinity/depth chain hashes must be aligned on."""
+        from tpu_task.serve.replica import SERVING_PRESETS
+
+        preset = SERVING_PRESETS.get(self.preset, {})
+        return int(self.serving.get(
+            "block_size", preset.get("block_size", 16)))
+
+    def payload(self, replica_index: int,
+                role: str = "decode") -> Dict[str, str]:
         """The durable queue payload a replica gang carries — `kind` is
-        what the CLI and status snapshot key the serve/batch split on."""
+        what the CLI and status snapshot key the serve/batch split on,
+        `role` what the router keys the prefill/decode split on."""
         return {"kind": "serve", "service": self.service,
-                "replica": str(replica_index), "preset": self.preset}
+                "replica": str(replica_index), "preset": self.preset,
+                "role": role,
+                "serving": json.dumps(self.serving_for(role),
+                                      sort_keys=True)}
 
 
-def replica_script(spec: ServeSpec, python: str = "python3") -> str:
+def replica_script(spec: ServeSpec, python: str = "python3",
+                   role: str = "decode") -> str:
     """The task script a REAL replica machine runs — the paper's
     one-script-per-machine unit, where the script is the serving engine.
     The endpoint announcement and the graceful-drain export both land in
     the working directory, which the agent's data sync mirrors to the
-    task bucket (that is the discovery plane — no new channel)."""
-    serving = json.dumps(spec.serving) if spec.serving else "{}"
+    task bucket (that is the discovery plane — no new channel). With a
+    ``kv_bucket`` the replica also joins the fleet KV plane."""
+    serving = json.dumps(spec.serving_for(role))
+    kv = f"--kv-bucket '{spec.kv_bucket}' " if spec.kv_bucket else ""
     return (
         "#!/bin/bash\n"
         f"exec {python} -m tpu_task.serve.replica "
-        f"--preset {spec.preset} --serving '{serving}' "
+        f"--preset {spec.preset} --serving '{serving}' {kv}"
         "--endpoint-file endpoint.json --drain-file inflight.json\n")
 
 
@@ -89,18 +132,36 @@ class InProcessServeDriver:
 
     self_recovering = False
 
-    def __init__(self, replica_factory: Optional[Callable] = None):
+    def __init__(self, replica_factory: Optional[Callable] = None,
+                 kv_backend=None):
         #: task -> started ReplicaServer; default builds from the payload.
         self._factory = replica_factory or self._default_factory
+        #: shared storage Backend of the fleet KV plane — the in-process
+        #: twin of ServeSpec.kv_bucket: every replica this driver builds
+        #: gets a FleetKvClient on it (None = no cross-replica sharing).
+        self.kv_backend = kv_backend
         self._servers: Dict[str, object] = {}
         self._killed: Dict[str, bool] = {}
         self.endpoints: Dict[str, dict] = {}
 
-    @staticmethod
-    def _default_factory(task):
+    def _default_factory(self, task):
         from tpu_task.serve.replica import ReplicaServer
 
-        return ReplicaServer(preset=task.payload.get("preset", "tiny"))
+        serving = json.loads(task.payload.get("serving") or "{}")
+        kv_client = None
+        if self.kv_backend is not None:
+            from tpu_task.serve.kvfleet import FleetKvClient
+
+            kv_client = FleetKvClient(self.kv_backend,
+                                      source=task.task_id)
+        return ReplicaServer(
+            preset=task.payload.get("preset", "tiny"), serving=serving,
+            kv_client=kv_client,
+            # A prefill replica's whole job is making blocks available to
+            # the decode pool before the handoff lands — publish every
+            # step; decode replicas publish on the relaxed default beat.
+            kv_publish_every=1
+            if task.payload.get("role") == "prefill" else 20)
 
     # -- GangDriver protocol ---------------------------------------------------
     def launch(self, task) -> None:
@@ -163,12 +224,27 @@ class ServeFleet:
 
     def __init__(self, scheduler, spec: ServeSpec, router: Router,
                  endpoint_source: Optional[Callable[[str], Optional[dict]]] = None,
-                 autoscaler=None, obs_flush_every: int = 25,
+                 autoscaler=None, prefill_autoscaler=None,
+                 obs_flush_every: int = 25,
                  slos=None, slo_clock: Callable[[], float] = time.monotonic):
         self.scheduler = scheduler
         self.spec = spec
         self.router = router
+        #: the decode pool's autoscaler (queue depth = decode pressure);
+        #: the prefill pool scales separately on the router's
+        #: prefill_backlog — per-role pools, per-role signals.
         self.autoscaler = autoscaler
+        self.prefill_autoscaler = prefill_autoscaler
+        if spec.prefill_replicas > 0 and router.prefill_threshold is None:
+            # The spec declares the split; teach the router its knob
+            # unless the caller already configured one.
+            router.prefill_threshold = spec.prefill_threshold
+        if router.block_size is None:
+            # Align the router's affinity/depth chain hashes with the
+            # blocks this spec's engines actually cache — a mismatched
+            # block size silently turns block-aligned affinity back into
+            # the raw-id hash the PR 10 bugfix replaced.
+            router.block_size = spec.engine_block_size()
         # SLO plane (PR 12): objectives evaluated fleet-wide over the
         # merged registry (router + every replica pulled this flush) in
         # flush_obs; breaches land as durable alert records under
@@ -199,70 +275,84 @@ class ServeFleet:
         self._endpoint_source = endpoint_source or (
             lambda task_id: getattr(
                 self.scheduler.driver, "endpoints", {}).get(task_id))
-        self._next_replica = 0
-        self._gangs: List[str] = []      # live replica task ids, oldest first
+        self._next_index = {"decode": 0, "prefill": 0}
+        #: live replica task ids PER ROLE, oldest first.
+        self._pools: Dict[str, List[str]] = {"decode": [], "prefill": []}
+
+    # Decode-pool view, kept name-stable for existing callers/tests.
+    @property
+    def _gangs(self) -> List[str]:
+        return self._pools["decode"]
 
     # -- replica gang management ----------------------------------------------
     def launch(self) -> List[str]:
-        """Submit the initial ``spec.replicas`` replica gangs."""
+        """Submit the initial gangs: ``spec.prefill_replicas`` prefill
+        gangs (when the spec splits) then ``spec.replicas`` decode."""
+        for _ in range(self.spec.prefill_replicas):
+            self._submit_replica(role="prefill")
         for _ in range(self.spec.replicas):
             self._submit_replica()
-        return list(self._gangs)
+        return [*self._pools["prefill"], *self._pools["decode"]]
 
-    def _submit_replica(self) -> str:
-        index = self._next_replica
-        self._next_replica += 1
-        task_id = f"{self.spec.service}-r{index}"
+    def _submit_replica(self, role: str = "decode") -> str:
+        index = self._next_index[role]
+        self._next_index[role] = index + 1
+        tag = "p" if role == "prefill" else "r"
+        task_id = f"{self.spec.service}-{tag}{index}"
         task = self.scheduler.submit(
             self.spec.tenant, self.spec.accelerator,
             slices=self.spec.slices, priority=self.spec.priority,
             task_id=task_id)
-        task.payload.update(self.spec.payload(index))
+        task.payload.update(self.spec.payload(index, role=role))
         self.scheduler.queue.update(task)
-        self._gangs.append(task_id)
+        self._pools[role].append(task_id)
         return task_id
 
-    def _retire_replica(self) -> Optional[str]:
-        """Retire the NEWEST replica gang (oldest ones hold the warmest
-        caches) through the scheduler's administrative withdrawal —
-        graceful drain, capacity release, terminal ``retired`` record."""
-        for task_id in reversed(self._gangs):
+    def _retire_replica(self, role: str = "decode") -> Optional[str]:
+        """Retire the NEWEST replica gang of the role (oldest ones hold
+        the warmest caches) through the scheduler's administrative
+        withdrawal — graceful drain, capacity release, terminal
+        ``retired`` record."""
+        for task_id in reversed(self._pools[role]):
             task = self.scheduler.queue.tasks[task_id]
             if task.state in ("succeeded", "failed"):
                 continue
-            self._gangs.remove(task_id)
+            self._pools[role].remove(task_id)
             self.scheduler.withdraw(task_id, failure="retired")
             return task_id
         return None
 
-    def scale_to(self, desired: int) -> None:
+    def scale_to(self, desired: int, role: str = "decode") -> None:
         desired = max(0, desired)
-        while self.live_replicas() < desired:
-            self._submit_replica()
-        while self.live_replicas() > desired:
-            if self._retire_replica() is None:
+        while self.live_replicas(role) < desired:
+            self._submit_replica(role=role)
+        while self.live_replicas(role) > desired:
+            if self._retire_replica(role=role) is None:
                 break
 
-    def live_replicas(self) -> int:
+    def live_replicas(self, role: str = "decode") -> int:
         return sum(
-            1 for task_id in self._gangs
+            1 for task_id in self._pools[role]
             if self.scheduler.queue.tasks[task_id].state
             not in ("succeeded", "failed"))
 
     # -- control tick ----------------------------------------------------------
     def refresh_endpoints(self) -> Dict[str, dict]:
-        """Endpoint map for PLACED replica gangs. A gang that is queued,
-        preempted, or backoff-parked contributes nothing — its old
-        endpoint (if any) drops out of membership, which is what makes
-        the router re-dispatch that replica's streams."""
+        """Endpoint map for PLACED replica gangs, each annotated with its
+        role (what the router keys the prefill/decode split on). A gang
+        that is queued, preempted, or backoff-parked contributes nothing
+        — its old endpoint (if any) drops out of membership, which is
+        what makes the router re-dispatch that replica's streams."""
         endpoints: Dict[str, dict] = {}
-        for task_id in self._gangs:
-            task = self.scheduler.queue.tasks[task_id]
-            if task.state != "placed":
-                continue
-            info = self._endpoint_source(task_id)
-            if info and info.get("url"):
-                endpoints[task_id] = info
+        for role, gangs in self._pools.items():
+            for task_id in gangs:
+                task = self.scheduler.queue.tasks[task_id]
+                if task.state != "placed":
+                    continue
+                info = self._endpoint_source(task_id)
+                if info and info.get("url"):
+                    endpoints[task_id] = {
+                        **info, "role": info.get("role", role)}
         return endpoints
 
     def tick(self) -> None:
@@ -275,6 +365,13 @@ class ServeFleet:
                 busy=stats["open"])
             if desired != self.live_replicas():
                 self.scale_to(desired)
+        if self.prefill_autoscaler is not None:
+            backlog = self.router.prefill_backlog
+            desired = self.prefill_autoscaler.observe(
+                backlog, max(1, self.live_replicas("prefill")),
+                busy=backlog)
+            if desired != self.live_replicas("prefill"):
+                self.scale_to(desired, role="prefill")
         self._ticks += 1
         if (self._obs_exporter is not None or self._slo is not None) \
                 and self._ticks % self._obs_flush_every == 0:

@@ -1,0 +1,297 @@
+"""Fleet-wide KV plane: cross-replica prefix-cache sharing by content.
+
+Router prefix-affinity (PR 10) is the only cache locality the fleet has
+without this module: a popular system prompt is re-prefilled once per
+replica, and every membership change cold-starts that replica's cache
+from zero. The content-hash block chain (``cache.chain_block_hashes``)
+is already a global, replica-independent naming scheme for KV blocks —
+equal hashes mean equal token prefixes mean equal KV bytes — so this
+module makes it the key of a fleet-wide KV layer (Mooncake-style
+KVCache-centric sharing, through the repo's own storage plane):
+
+* **Publish** — each replica ships its hot ref-0 retained prefix-cache
+  blocks (``ServingEngine.export_cached_blocks``: int8/fp8 codes + scale
+  sidecars when the pool is quantized — ~4× cheaper than fp32 to ship)
+  into the bucket under ``kvfleet/<fingerprint>/blocks/<hash>``, via the
+  PR 2 pooled transport that already backs every storage backend.
+  ``write_if_absent`` makes concurrent publishers of the same content a
+  free race: the key IS the content hash.
+* **Index** — :class:`FleetKvIndex` is bucket-backed and delta-synced
+  like the PR 4 poll caches: each publisher owns ONE shard
+  (``kvfleet/<fingerprint>/index/<source>.json``); readers list the
+  shards and re-read only the ones whose conditional validator changed
+  (ETag/304 on object stores, one stat on local backends), merging into
+  a hash → source map. A no-change refresh costs ~one bodyless
+  round-trip per publisher.
+* **Import** — engine admission (``ServingEngine._fleet_import``)
+  consults the index for the chained hashes its local prefix cache
+  missed, fetches matching block payloads, and writes them straight into
+  the local pool (``cache.write_block``, bit-faithful), registering them
+  in the local prefix cache so later admissions hit locally.
+
+Staleness contract (docs/parity.md "Fleet KV"): the index is advisory.
+A stale entry (block evicted from the bucket, torn payload, foreign
+config) degrades to a local prefill of that tail — ``fetch`` answers
+None and the importer stops — never a wrong stream, because a payload is
+only ever adopted under the hash that names its exact token prefix.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from tpu_task.common.errors import ResourceNotFoundError
+from tpu_task.storage.backends import NOT_MODIFIED, Backend
+
+__all__ = ["FleetKvClient", "FleetKvIndex"]
+
+#: Index shards drop their oldest entries past this many hashes — a
+#: bound on shard JSON size, not on the bucket (blocks stay addressable
+#: by content; a dropped index entry merely stops advertising them).
+MAX_SHARD_ENTRIES = 4096
+
+
+class FleetKvIndex:
+    """Bucket-backed, delta-synced map: block hash (hex) → publisher.
+
+    One shard per publisher keeps writes single-writer (no read-modify-
+    write races on a shared object); readers merge all shards. Refreshes
+    are throttled (``refresh_interval``) and conditional per shard, so
+    the steady-state cost of consulting the fleet index from every
+    admission is near zero.
+    """
+
+    def __init__(self, backend: Backend, namespace: str = "kvfleet",
+                 refresh_interval: float = 0.25,
+                 clock: Callable[[], float] = time.monotonic):
+        self._backend = backend
+        self.namespace = namespace.rstrip("/")
+        self.refresh_interval = refresh_interval
+        self._clock = clock
+        self._by_hash: Dict[str, str] = {}           # hash hex -> source
+        self._shards: Dict[str, Dict[str, int]] = {}  # shard key -> entries
+        self._validators: Dict[str, object] = {}
+        self._last_refresh: Optional[float] = None
+
+    def __len__(self) -> int:
+        return len(self._by_hash)
+
+    def _shard_key(self, source: str) -> str:
+        return f"{self.namespace}/index/{source}.json"
+
+    def block_key(self, hash_hex: str) -> str:
+        return f"{self.namespace}/blocks/{hash_hex}"
+
+    # -- publisher side ------------------------------------------------------
+    def publish(self, source: str, entries: Dict[str, int]) -> None:
+        """Replace ``source``'s shard with ``entries`` (hash hex → payload
+        size). The publisher's own entries merge into the local view
+        immediately, so a process sees its own publications without
+        waiting out the refresh throttle."""
+        if len(entries) > MAX_SHARD_ENTRIES:
+            entries = dict(list(entries.items())[-MAX_SHARD_ENTRIES:])
+        key = self._shard_key(source)
+        self._backend.write(
+            key, json.dumps(entries, sort_keys=True).encode())
+        self._shards[key] = dict(entries)
+        self._validators.pop(key, None)   # our write invalidated it anyway
+        self._rebuild()
+
+    # -- reader side ---------------------------------------------------------
+    def refresh(self, force: bool = False) -> None:
+        """Merge every publisher's shard, re-reading only changed ones.
+        Throttled to ``refresh_interval`` unless ``force``; any shard that
+        fails to list/read/parse just keeps its previous view (the index
+        is advisory — staleness degrades to a local prefill)."""
+        now = self._clock()
+        if not force and self._last_refresh is not None \
+                and now - self._last_refresh < self.refresh_interval:
+            return
+        self._last_refresh = now
+        try:
+            keys = set(self._backend.list(f"{self.namespace}/index/"))
+        except OSError:
+            return
+        gone = set(self._shards) - keys
+        for key in gone:
+            self._shards.pop(key, None)
+            self._validators.pop(key, None)
+        changed = bool(gone)
+        for key in sorted(keys):
+            try:
+                data, validator = self._backend.read_conditional(
+                    key, self._validators.get(key))
+            except (OSError, ResourceNotFoundError):
+                continue
+            self._validators[key] = validator
+            if data is NOT_MODIFIED:
+                continue
+            try:
+                entries = json.loads(data)
+            except ValueError:
+                continue
+            if isinstance(entries, dict):
+                self._shards[key] = {str(h): int(n)
+                                     for h, n in entries.items()}
+                changed = True
+        if changed:
+            self._rebuild()
+
+    def _rebuild(self) -> None:
+        merged: Dict[str, str] = {}
+        for key in sorted(self._shards):
+            source = key.rsplit("/", 1)[-1][:-len(".json")]
+            for h in self._shards[key]:
+                merged.setdefault(h, source)
+        self._by_hash = merged
+
+    def source_of(self, hash_hex: str) -> Optional[str]:
+        return self._by_hash.get(hash_hex)
+
+    def __contains__(self, hash_hex: str) -> bool:
+        return hash_hex in self._by_hash
+
+    def chain_depth(self, hashes: Sequence[str]) -> int:
+        """How many LEADING entries of ``hashes`` the index advertises —
+        the fleet's consecutive-hit depth (a chain with a hole stops at
+        the hole: blocks past it would leave a KV gap no import can
+        fill)."""
+        depth = 0
+        for h in hashes:
+            if h not in self._by_hash:
+                break
+            depth += 1
+        return depth
+
+
+class FleetKvClient:
+    """One replica's handle on the fleet KV plane: publish this engine's
+    hot cached blocks, look up and fetch other replicas'. Bound to a pool
+    layout at engine construction (:meth:`bind` — the fingerprint
+    namespaces the bucket layout, so incompatible configs can never
+    exchange bytes). Duck-typed from the engine side: ``ml.serving``
+    never imports this module."""
+
+    def __init__(self, backend: Backend, source: str,
+                 namespace: str = "kvfleet",
+                 refresh_interval: float = 0.25,
+                 clock: Callable[[], float] = time.monotonic):
+        self._backend = backend
+        self.source = source
+        self._root = namespace.rstrip("/")
+        self._refresh_interval = refresh_interval
+        self._clock = clock
+        self.index: Optional[FleetKvIndex] = None
+        self._payload_nbytes: Optional[int] = None
+        #: everything this client has published: hash hex -> payload size
+        #: (the shard body; also the skip set for the next publish pass).
+        self._published: Dict[str, int] = {}
+        self.bytes_shipped = 0
+        self.bytes_fetched = 0
+        self.published_blocks = 0
+        self.fetch_misses = 0
+
+    # -- binding -------------------------------------------------------------
+    def bind(self, cfg, scfg) -> None:
+        """Pin this client to one pool layout (called by the engine it is
+        attached to): the fingerprint becomes the bucket namespace and
+        the expected payload length becomes the import validation gate."""
+        from tpu_task.ml.serving.cache import (
+            block_payload_nbytes,
+            kv_fingerprint,
+        )
+
+        namespace = f"{self._root}/{kv_fingerprint(cfg, scfg)}"
+        if self.index is not None and self.index.namespace == namespace:
+            return
+        self.index = FleetKvIndex(
+            self._backend, namespace=namespace,
+            refresh_interval=self._refresh_interval, clock=self._clock)
+        self._payload_nbytes = block_payload_nbytes(cfg, scfg)
+
+    def _require_bound(self) -> FleetKvIndex:
+        if self.index is None:
+            raise RuntimeError(
+                "FleetKvClient is not bound to a pool layout — attach it "
+                "to a ServingEngine (kv_fleet=) or call bind(cfg, scfg)")
+        return self.index
+
+    # -- publish -------------------------------------------------------------
+    def publish(self, engine, limit: int = 16) -> int:
+        """Ship up to ``limit`` of the engine's hot ref-0 cached blocks
+        that this client has not already published. Content-addressed
+        writes (``write_if_absent``) make duplicate publishers free:
+        bytes move only for hashes the bucket has never seen. Returns how
+        many blocks were newly advertised in this publisher's shard."""
+        index = self._require_bound()
+        entries = engine.export_cached_blocks(
+            limit=limit, skip=self._published)
+        if not entries:
+            return 0
+        for hash_hex, payload in entries:
+            try:
+                if self._backend.write_if_absent(
+                        index.block_key(hash_hex), payload):
+                    self.bytes_shipped += len(payload)
+            except OSError:
+                # A failed ship never advertises: the hash stays out of
+                # the shard, so no importer chases a missing object.
+                continue
+            self._published[hash_hex] = len(payload)
+            self.published_blocks += 1
+        if len(self._published) > MAX_SHARD_ENTRIES:
+            self._published = dict(
+                list(self._published.items())[-MAX_SHARD_ENTRIES:])
+        try:
+            index.publish(self.source, self._published)
+        except OSError:
+            pass                          # re-advertised on the next pass
+        return len(entries)
+
+    # -- lookup / fetch ------------------------------------------------------
+    def lookup_chain(self, hashes: Sequence[bytes]) -> int:
+        """Consecutive-leading-hit depth of ``hashes`` (raw digest bytes)
+        in the fleet index, after a throttled refresh. A depth-0 answer
+        forces ONE un-throttled retry: the prefill→decode handoff races
+        the publish beat by design, and a decode admission landing inside
+        the refresh window must not re-prefill a whole prompt to save
+        one conditional round-trip per publisher."""
+        index = self._require_bound()
+        index.refresh()
+        want = [h.hex() for h in hashes]
+        depth = index.chain_depth(want)
+        if depth == 0:
+            index.refresh(force=True)
+            depth = index.chain_depth(want)
+        return depth
+
+    def fetch(self, h: bytes) -> Optional[bytes]:
+        """One block payload by hash, or None on ANY failure (missing
+        object, torn read, wrong length) — the staleness contract's
+        degrade-to-local-prefill arm. Length validation happens in the
+        engine via ``split_block_bytes``; here only existence."""
+        index = self._require_bound()
+        try:
+            data = self._backend.read(index.block_key(h.hex()))
+        except (OSError, ResourceNotFoundError):
+            self.fetch_misses += 1
+            return None
+        if self._payload_nbytes is not None \
+                and len(data) != self._payload_nbytes:
+            self.fetch_misses += 1
+            return None
+        self.bytes_fetched += len(data)
+        return data
+
+    def stats(self) -> dict:
+        return {
+            "source": self.source,
+            "namespace": self.index.namespace if self.index else self._root,
+            "published_blocks": self.published_blocks,
+            "bytes_shipped": self.bytes_shipped,
+            "bytes_fetched": self.bytes_fetched,
+            "fetch_misses": self.fetch_misses,
+            "index_entries": len(self.index) if self.index else 0,
+        }

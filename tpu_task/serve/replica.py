@@ -92,10 +92,13 @@ SERVING_PRESETS: Dict[str, dict] = {
 
 
 def build_engine(preset: str = "tiny", serving: Optional[dict] = None,
-                 rng_seed: int = 0, obs: Optional[Obs] = None):
+                 rng_seed: int = 0, obs: Optional[Obs] = None,
+                 kv_client=None):
     """A ServingEngine from a preset name: same name → same weights, same
     config, same streams, in any process. ``obs`` threads the PR 11
-    observability handle through (None = the zero-overhead path)."""
+    observability handle through (None = the zero-overhead path);
+    ``kv_client`` a :class:`~tpu_task.serve.kvfleet.FleetKvClient` for
+    fleet-wide prefix-cache sharing (None = replica-local cache only)."""
     import jax
     import jax.numpy as jnp
 
@@ -112,7 +115,8 @@ def build_engine(preset: str = "tiny", serving: Optional[dict] = None,
     knobs = dict(SERVING_PRESETS.get(preset, {}))
     knobs.update(serving or {})
     return ServingEngine(params, cfg, ServingConfig(**knobs),
-                         rng=jax.random.PRNGKey(rng_seed), obs=obs)
+                         rng=jax.random.PRNGKey(rng_seed), obs=obs,
+                         kv_fleet=kv_client)
 
 
 class _JSONHandler(BaseHTTPRequestHandler):
@@ -251,7 +255,8 @@ class ReplicaServer:
     def __init__(self, engine=None, *, preset: str = "tiny",
                  serving: Optional[dict] = None, host: str = "127.0.0.1",
                  port: int = 0, drain_file: Optional[str] = None,
-                 obs_enabled: bool = True, profile_dir: str = "profiles"):
+                 obs_enabled: bool = True, profile_dir: str = "profiles",
+                 kv_client=None, kv_publish_every: int = 20):
         self.boot_id = uuid.uuid4().hex[:12]
         #: One tracer + registry for the whole replica (front end AND
         #: engine — the engine records into the same registry, so /stats
@@ -260,8 +265,15 @@ class ReplicaServer:
         #: recording site below short-circuits on None.
         self.obs = Obs.create(f"replica:{self.boot_id[:6]}") \
             if obs_enabled else None
+        #: Fleet KV plane handle: the step loop publishes this engine's
+        #: hot cached blocks right after any step that retired a request
+        #: (the prefill→decode handoff races this publish — promptness is
+        #: the whole point) and every ``kv_publish_every`` steps besides.
+        self.kv_client = kv_client
+        self.kv_publish_every = max(1, kv_publish_every)
+        self._steps_since_publish = 0
         self.engine = engine if engine is not None else build_engine(
-            preset, serving, obs=self.obs)
+            preset, serving, obs=self.obs, kv_client=kv_client)
         self.draining = False
         self.drain_file = drain_file
         self.profile_dir = profile_dir
@@ -306,8 +318,23 @@ class ReplicaServer:
             try:
                 with self._lock:
                     if not self.draining and self.engine.has_work:
-                        self.engine.step()
+                        result = self.engine.step()
                         stepped = True
+                        if self.kv_client is not None:
+                            # Publish retired requests' blocks the same
+                            # step they enter the prefix cache (plus a
+                            # periodic pass for blocks cached by other
+                            # paths) — a best-effort beat: a failed
+                            # publish just re-offers next time.
+                            self._steps_since_publish += 1
+                            if result["finished"] or \
+                                    self._steps_since_publish \
+                                    >= self.kv_publish_every:
+                                self._steps_since_publish = 0
+                                try:
+                                    self.kv_client.publish(self.engine)
+                                except OSError:
+                                    pass
             except Exception as error:
                 # A dying step loop must never wedge the replica silently
                 # (healthz green, streams empty forever): drain instead —
@@ -516,13 +543,27 @@ def main(argv=None) -> int:
     parser.add_argument("--no-obs", action="store_true",
                         help="disable tracing/metrics (the documented "
                              "zero-overhead path)")
+    parser.add_argument("--kv-bucket", default="",
+                        help="SHARED storage root of the fleet KV plane "
+                             "(any backend connection string) — enables "
+                             "cross-replica prefix-cache sharing; must be "
+                             "the same bucket for every replica of the "
+                             "service, NOT the replica's own task bucket")
     args = parser.parse_args(argv)
+
+    kv_client = None
+    if args.kv_bucket:
+        from tpu_task.serve.kvfleet import FleetKvClient
+        from tpu_task.storage.backends import open_backend
+
+        kv_backend, _ = open_backend(args.kv_bucket)
+        kv_client = FleetKvClient(kv_backend, source=uuid.uuid4().hex[:12])
 
     replica = ReplicaServer(
         preset=args.preset, serving=json.loads(args.serving),
         host=args.host, port=args.port,
         drain_file=os.path.abspath(args.drain_file),
-        obs_enabled=not args.no_obs)
+        obs_enabled=not args.no_obs, kv_client=kv_client)
     replica.start()
 
     # Durable observability export: spans/metrics land under obs/ in the

@@ -5,14 +5,32 @@ submit once to the router; the router owns dispatch, streaming, and every
 failure mode a preemptible fleet has. Three policies, all deliberately
 boring and deterministic:
 
-* **Dispatch** — session affinity + least-loaded-slot. The replica choice
-  is keyed by a stable hash of the request's prompt PREFIX (the first
-  ``affinity_tokens`` ids — the shared-system-prompt part of production
-  traffic), so same-prefix requests keep landing on the same replica and
-  the PR 8 content-hash prefix cache keeps hitting. Affinity yields to
-  load only when the preferred replica is ``spill_load`` requests deeper
-  than the least-loaded one — cache locality is worth a bounded queue
-  imbalance, not an unbounded one.
+* **Dispatch** — session affinity + cached-depth-aware spill. The replica
+  choice is keyed by a stable hash of the request's prompt prefix,
+  BLOCK-ALIGNED on the same chained block hashes the PR 8 prefix cache
+  keys on (the chain hash of the longest full-block prefix inside the
+  first ``affinity_tokens`` ids), so affinity and the cache agree on what
+  "same prefix" means: two prompts that share every full block of the
+  window land together even when they diverge inside the trailing
+  partial block. The router also remembers which prefix chains it sent
+  each replica (the replica's prefix cache holds them afterwards) and
+  weighs that cached-prefix DEPTH against load: a replica holding a
+  deeper cached prefix wins the pick, and spilling away from it needs a
+  load imbalance of ``spill_load + spill_depth_weight × depth`` — the
+  deeper the cached prefix, the more re-prefill work a spill would burn,
+  so the more imbalance it must buy back. Cache locality is worth a
+  bounded queue imbalance, not an unbounded one.
+* **Disaggregated prefill/decode** — with ``prefill_threshold`` set and
+  prefill-role replicas in membership, a fresh long-prompt request takes
+  a PREFILL LEG first: it is dispatched to the prefill pool with
+  ``max_new_tokens`` forced to 1, the prefill replica ingests the prompt
+  (and publishes its KV blocks through the fleet KV plane), and the
+  moment that leg reports done the router hands the stream off to a
+  decode replica with the boundary token as the received prefix — the
+  decode replica resumes at the boundary, importing the published blocks
+  instead of re-prefilling. Long-prompt ingestion thus never competes
+  with the decode pool's inter-token latency, and the chunked-prefill
+  budget becomes a per-pool knob.
 * **Streaming** — offset-based pulls (``/stream?rid=&offset=``) driven by
   :meth:`Router.pump`. The router's own token high-water mark is the one
   source of truth; a replica answer only ever APPENDS past it, so lost
@@ -56,17 +74,33 @@ class NoReplicaAvailable(RuntimeError):
     router and re-dispatch when membership recovers."""
 
 
+#: Per-replica bound on remembered served-prefix chain hashes (the
+#: cached-depth routing signal) — oldest forgotten first, mirroring the
+#: replica-side LRU the memory stands in for.
+MAX_SERVED_HASHES = 4096
+
+
 @dataclass
 class _Replica:
     name: str
     url: str
     boot_id: str = ""
+    #: "decode" (the default — a unified replica serves everything) or
+    #: "prefill" (a dedicated prompt-ingestion replica: it only ever sees
+    #: the 1-token prefill leg of long-prompt requests).
+    role: str = "decode"
     healthy: bool = True
     load: int = 0               # open fleet requests assigned here
     faults: int = 0
     #: monotonic stamp after which a fault quarantine may heal (inf for a
     #: draining replica — it only returns by rebooting under a new boot id)
     quarantined_until: float = 0.0
+    #: chain hashes of prompt prefixes this replica has served — the
+    #: router-side estimate of its prefix-cache contents (dict for
+    #: insertion-order trimming). Reset with the record on reboot: a new
+    #: boot id means a cold cache.
+    kv_hashes: Dict[bytes, None] = field(default_factory=dict, repr=False,
+                                         compare=False)
 
 
 @dataclass
@@ -112,14 +146,30 @@ class Router:
     backoff against a dead socket."""
 
     def __init__(self, *, seed: int = 0, affinity_tokens: int = 16,
-                 spill_load: int = 4, retries: int = 1,
+                 block_size: Optional[int] = None, spill_load: int = 4,
+                 spill_depth_weight: float = 1.0,
+                 prefill_threshold: Optional[int] = None,
+                 retries: int = 1,
                  timeout: float = 10.0, quarantine_s: float = 2.0,
                  urlopen=None,
                  clock: Callable[[], float] = time.monotonic,
                  obs: Optional[Obs] = None):
         self.seed = seed
         self.affinity_tokens = affinity_tokens
+        #: KV block size the fleet's engines run — what block-aligns the
+        #: affinity key and the cached-depth chain hashes. Affinity and
+        #: the prefix cache only "agree on what same prefix means" when
+        #: this matches the engines' ``ServingConfig.block_size``. None =
+        #: not yet taught: ``ServeFleet`` sets it from the spec's engine
+        #: config at construction (a standalone router falls back to the
+        #: ServingConfig default, 16).
+        self.block_size = block_size
         self.spill_load = spill_load
+        self.spill_depth_weight = spill_depth_weight
+        #: prompts at least this long (tokens) take the disaggregated
+        #: prefill leg when prefill-role replicas are in membership;
+        #: None disables the split (every replica is unified).
+        self.prefill_threshold = prefill_threshold
         self.retries = retries
         self.timeout = timeout
         self.quarantine_s = quarantine_s
@@ -131,6 +181,7 @@ class Router:
         self._base_key = None            # lazy: jax import off the init path
         self.redispatches = 0
         self.transport_faults = 0
+        self.handoffs = 0                # prefill→decode stream handoffs
         # Observability: the router is where traces are MINTED (one per
         # fleet request at submit) and where the fleet-level latency
         # histograms live. Tracing here is host-side bookkeeping around
@@ -141,7 +192,7 @@ class Router:
         self._h_ttft = metrics.histogram("router.ttft_s")
         self._h_e2e = metrics.histogram("router.e2e_s")
         self._h_queue_wait = metrics.histogram("router.queue_wait_s")
-        for stat in ("redispatches", "transport_faults"):
+        for stat in ("redispatches", "transport_faults", "handoffs"):
             metrics.counter_fn(f"router.{stat}",
                                lambda self=self, stat=stat:
                                float(getattr(self, stat)))
@@ -164,14 +215,17 @@ class Router:
         for name, info in endpoints.items():
             known = self._replicas.get(name)
             boot = info.get("boot_id", "")
+            role = info.get("role", "decode")
             if known is None or known.url != info["url"] \
-                    or known.boot_id != boot:
+                    or known.boot_id != boot or known.role != role:
                 if known is not None:
                     # Unassigns the old incarnation's open requests too —
-                    # the fresh record always starts at load 0.
+                    # the fresh record always starts at load 0 (and an
+                    # empty served-prefix memory: a reboot is a cold
+                    # cache).
                     self._drop_replica(name)
                 self._replicas[name] = _Replica(
-                    name=name, url=info["url"], boot_id=boot)
+                    name=name, url=info["url"], boot_id=boot, role=role)
             elif not known.healthy and now >= known.quarantined_until:
                 known.healthy = True
 
@@ -186,28 +240,108 @@ class Router:
                 request.status = QUEUED
 
     def replicas(self) -> Dict[str, dict]:
-        return {name: {"url": r.url, "boot_id": r.boot_id,
+        return {name: {"url": r.url, "boot_id": r.boot_id, "role": r.role,
                        "healthy": r.healthy, "load": r.load}
                 for name, r in sorted(self._replicas.items())}
 
     # -- dispatch policy -------------------------------------------------------
-    def _affinity_hash(self, prompt: List[int]) -> int:
-        prefix = ",".join(str(t) for t in prompt[:self.affinity_tokens])
-        return int.from_bytes(
-            hashlib.blake2b(prefix.encode(), digest_size=8).digest(), "big")
+    @property
+    def _block(self) -> int:
+        return max(1, self.block_size or 16)
 
-    def pick(self, prompt: List[int],
-             exclude: Optional[set] = None) -> _Replica:
-        """Affinity-preferred, least-loaded-spill replica choice."""
+    def _chain_hashes(self, ids: List[int]) -> List[bytes]:
+        """Chained content hash per FULL ``block_size`` block of ``ids`` —
+        the same chain the engines' prefix cache keys on
+        (``cache.chain_block_hashes`` over int32 little-endian words), so
+        the router's affinity/depth keys and the replica-side cache name
+        identical prefixes. Spelled locally to keep jax imports off the
+        router path."""
+        out: List[bytes] = []
+        h = b""
+        bs = self._block
+        for i in range(len(ids) // bs):
+            block = ids[i * bs:(i + 1) * bs]
+            h = hashlib.blake2b(
+                h + b"".join(int(t).to_bytes(4, "little", signed=True)
+                             for t in block),
+                digest_size=16).digest()
+            out.append(h)
+        return out
+
+    def _affinity_key(self, prompt: List[int]) -> bytes:
+        """The affinity key, BLOCK-ALIGNED on the prefix cache's chain
+        hashes: the chain hash of the longest full-block prefix inside
+        the first ``affinity_tokens`` ids. Prompts that share every full
+        block of the window agree even when they diverge inside the
+        trailing partial block — affinity granularity IS cache
+        granularity. Prompts shorter than one block fall back to their
+        raw ids (nothing block-shaped to share yet)."""
+        window = prompt[:self.affinity_tokens]
+        chain = self._chain_hashes(window)
+        if chain:
+            return chain[-1]
+        return ",".join(str(t) for t in window).encode()
+
+    def _affinity_hash(self, prompt: List[int]) -> int:
+        return int.from_bytes(
+            hashlib.blake2b(self._affinity_key(prompt),
+                            digest_size=8).digest(), "big")
+
+    @staticmethod
+    def _cached_depth(replica: _Replica, hashes: List[bytes]) -> int:
+        """Leading blocks of this prompt's chain the replica has served
+        before — the router-side estimate of its cached-prefix depth."""
+        depth = 0
+        for h in hashes:
+            if h not in replica.kv_hashes:
+                break
+            depth += 1
+        return depth
+
+    @staticmethod
+    def _note_served(replica: _Replica, hashes: List[bytes]) -> None:
+        for h in hashes:
+            replica.kv_hashes.pop(h, None)    # re-insert: refresh recency
+            replica.kv_hashes[h] = None
+        while len(replica.kv_hashes) > MAX_SERVED_HASHES:
+            replica.kv_hashes.pop(next(iter(replica.kv_hashes)))
+
+    def _has_prefill_pool(self) -> bool:
+        return any(r.healthy and r.role == "prefill"
+                   for r in self._replicas.values())
+
+    def pick(self, prompt: List[int], exclude: Optional[set] = None,
+             role: Optional[str] = None,
+             hashes: Optional[List[bytes]] = None) -> _Replica:
+        """Affinity-preferred, cached-depth-aware, load-spilled replica
+        choice. ``role="prefill"`` picks from the dedicated prefill pool;
+        the default picks from the decode pool (every non-prefill
+        replica). A replica known to hold a DEEPER cached prefix of this
+        prompt beats the affinity pick (affinity is only a stand-in for
+        cache locality; recorded depth is the ground truth), and the
+        spill threshold grows with the chosen replica's depth — spilling
+        away from a warm cache must buy back the re-prefill it causes."""
         exclude = exclude or set()
-        healthy = [r for name, r in sorted(self._replicas.items())
-                   if r.healthy and name not in exclude]
-        if not healthy:
+        pool = [r for name, r in sorted(self._replicas.items())
+                if r.healthy and name not in exclude]
+        pool = [r for r in pool
+                if (r.role == "prefill") == (role == "prefill")]
+        if not pool:
             raise NoReplicaAvailable(
-                f"no healthy replica (of {len(self._replicas)}) to dispatch to")
-        preferred = healthy[self._affinity_hash(prompt) % len(healthy)]
-        least = min(healthy, key=lambda r: (r.load, r.name))
-        if preferred.load - least.load >= self.spill_load:
+                f"no healthy {role or 'decode'} replica (of "
+                f"{len(self._replicas)}) to dispatch to")
+        if hashes is None:       # _dispatch precomputes; direct calls don't
+            hashes = self._chain_hashes(prompt)
+        depth = {r.name: self._cached_depth(r, hashes) for r in pool}
+        preferred = pool[self._affinity_hash(prompt) % len(pool)]
+        deepest = max(pool, key=lambda r: (depth[r.name],
+                                           r is preferred, r.name))
+        if depth[deepest.name] > depth[preferred.name]:
+            preferred = deepest
+        least = min(pool, key=lambda r: (r.load, -depth[r.name], r.name))
+        threshold = self.spill_load + \
+            self.spill_depth_weight * depth[preferred.name]
+        if preferred.load - least.load >= threshold:
             return least
         return preferred
 
@@ -247,12 +381,40 @@ class Router:
             pass                          # stays QUEUED; pump retries
         return fid
 
+    def _wants_prefill_leg(self, request: FleetRequest) -> bool:
+        """A fresh long-prompt request takes the dedicated prefill pool
+        first (when one exists): its prompt is ingested there, and the
+        stream hands off to a decode replica at the boundary token."""
+        return (self.prefill_threshold is not None
+                and not request.tokens
+                and len(request.prompt) >= self.prefill_threshold
+                and self._has_prefill_pool())
+
     def _dispatch(self, request: FleetRequest,
                   exclude: Optional[set] = None) -> None:
-        replica = self.pick(request.prompt, exclude=exclude)
+        prefill_leg = self._wants_prefill_leg(request)
+        # ONE chain computation per dispatch attempt: pick, the span's
+        # cached_depth, and _note_served below all consume it.
+        hashes = self._chain_hashes(request.prompt)
+        try:
+            replica = self.pick(request.prompt, exclude=exclude,
+                                role="prefill" if prefill_leg else None,
+                                hashes=hashes)
+        except NoReplicaAvailable:
+            if not prefill_leg:
+                raise
+            # The prefill pool is down/excluded: degrade to a unified
+            # dispatch rather than queueing the request to death.
+            prefill_leg = False
+            replica = self.pick(request.prompt, exclude=exclude,
+                                hashes=hashes)
         payload = {
             "prompt": request.prompt,
-            "max_new_tokens": request.max_new_tokens,
+            # The prefill leg asks for exactly the boundary token: prompt
+            # ingestion + one sample, then the stream hands off to the
+            # decode pool (pump's "prefilled" arm) with the published KV
+            # blocks waiting in the fleet plane.
+            "max_new_tokens": 1 if prefill_leg else request.max_new_tokens,
             "temperature": request.temperature,
             "top_p": request.top_p,
             "eos_token": request.eos_token,
@@ -268,9 +430,14 @@ class Router:
         # under it. token_start marks where this assignment picks up the
         # stream; a re-dispatch after a preemption is therefore a sibling
         # child span of the SAME trace, starting at the high-water mark.
+        # cached_depth records how many leading prompt blocks the chosen
+        # replica was known to hold — the routing decision's cache side,
+        # next to its load side, in every dispatch waterfall.
         span = self.obs.tracer.start(
             "dispatch", parent=request.root_span, fid=request.fid,
             replica=replica.name, attempt=request.dispatches + 1,
+            role=replica.role,
+            cached_depth=self._cached_depth(replica, hashes),
             token_start=len(request.tokens))
         try:
             body = self._call(replica, "POST", "/submit", data=payload,
@@ -317,6 +484,9 @@ class Router:
         if request.dispatches > 1:
             self.redispatches += 1
         replica.load += 1
+        # The replica's prefix cache will hold this prompt's chain after
+        # serving it — remember that for cached-depth routing.
+        self._note_served(replica, hashes)
 
     # -- transport -------------------------------------------------------------
     def _call(self, replica: _Replica, method: str, path: str,
@@ -428,6 +598,27 @@ class Router:
                 self._end_root(request, dispatches=request.dispatches)
                 if replica.load > 0:
                     replica.load -= 1
+            elif replica.role == "prefill" and request.tokens \
+                    and body.get("status") == "done":
+                # Prefill leg complete: the prompt is ingested, its KV
+                # blocks published, and the boundary token received —
+                # hand the stream off to the decode pool. The decode
+                # replica resumes at the boundary (the received prefix
+                # rides the dispatch payload) and its admission imports
+                # the published blocks instead of re-prefilling; a
+                # publish that has not landed yet merely degrades the
+                # import to a local prefill of the missing tail.
+                self.handoffs += 1
+                self._end_dispatch(request, status="prefilled")
+                if replica.load > 0:
+                    replica.load -= 1
+                request.replica = None
+                request.rid = None
+                request.status = QUEUED
+                try:
+                    self._dispatch(request)
+                except NoReplicaAvailable:
+                    pass              # stays QUEUED; next pump retries
             elif body.get("draining"):
                 # Graceful preemption notice: take the suffix it still
                 # served, then fail over.
@@ -480,6 +671,16 @@ class Router:
             [self.obs.metrics.snapshot(), *extra_snapshots]))
 
     @property
+    def prefill_backlog(self) -> int:
+        """Open requests still awaiting their prefill leg (long prompt,
+        zero tokens received) — the prefill pool's autoscale signal."""
+        if self.prefill_threshold is None:
+            return 0
+        return sum(1 for r in self._requests.values()
+                   if r.status not in (DONE, FAILED) and not r.tokens
+                   and len(r.prompt) >= self.prefill_threshold)
+
+    @property
     def queue_depth(self) -> int:
         """Open requests beyond what the fleet's slots could be running —
         the autoscaler's signal (0 when capacity covers the backlog)."""
@@ -512,6 +713,8 @@ class Router:
             "queue_depth": self.queue_depth,
             "redispatches": self.redispatches,
             "transport_faults": self.transport_faults,
+            "handoffs": self.handoffs,
+            "prefill_backlog": self.prefill_backlog,
             # One export path: the counters above ride the registry as
             # lazy gauges; TTFT / queue-wait / e2e live there natively.
             "obs": self.obs.metrics.snapshot(),
