@@ -8,7 +8,7 @@ PYTHON ?= python3
 # Seed for the chaos soak: any run is replayable by pinning this.
 TPU_TASK_CHAOS_SEED ?= 20260804
 
-.PHONY: test smoke sweep bench bench-steady bench-serving bench-sched bench-decode bench-fleet bench-fleetkv bench-obs bench-goodput sched sched-soak chaos fleet kvfleet serve-soak obs watch wheel multichip kernels-tpu clean
+.PHONY: test smoke sweep bench bench-steady bench-serving bench-sched bench-decode bench-fleet bench-fleetkv bench-obs bench-goodput sched sched-soak chaos fleet kvfleet moe moe-serve serve-soak obs watch wheel multichip kernels-tpu clean
 
 # Hermetic suite (the reference's `make test`, 30 s budget there; ours spans
 # the fake control planes, sharded-compute CPU checks, and the loopback GCS
@@ -104,6 +104,20 @@ fleet:
 # prefill/decode-split handoff legs.
 kvfleet:
 	$(PYTHON) -m pytest tests/ -m kvfleet -q
+
+# Sharded-replica / MoE serving tests: ep all_to_all dispatch identity,
+# tp×ep gang engines, sharded spec decode, scheduler chip accounting,
+# the fleet serving a MoE config too big for one chip (slow subset runs
+# the full tp×ep matrix and the fleet legs).
+moe:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -m moe -q
+
+# Sharded-replica MoE serving grid: engine tok/s + per-shard KV MB (÷tp)
+# + per-shard expert-weight MB (÷ep) at tp {1,8} × ep {1,4} on a forced
+# 32-device host platform. EXITS NONZERO if greedy streams diverge
+# anywhere on the grid (the docs/parity.md token-identity contract).
+moe-serve:
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py fleet --moe-only
 
 # Fleet-KV bench legs only: shared_prefix_scaling (aggregate tok/s +
 # re-prefill chunk work at replicas {1,2,4}, fleet-KV on vs off,

@@ -1016,6 +1016,97 @@ def bench_serving_multichip(tps=(1, 8), n_requests: int = 16,
     }
 
 
+def bench_moe_tp_ep(grid=((1, 1), (8, 1), (1, 4), (8, 4)),
+                    n_requests: int = 12, seed: int = 0) -> dict:
+    """Sharded-replica MoE serving grid (ROADMAP item 1): one MoE engine
+    per (tp, ep) point — expert weights one group per ep shard, kv-head
+    pools over tp, the ep all_to_all dispatch inside every fused step —
+    reporting engine tok/s, per-shard KV MB (divides by tp), and
+    per-shard EXPERT-weight MB (divides by ep — the axis that lets an
+    expert table too big for one chip serve at all). Greedy streams are
+    ASSERTED identical across every grid point (a divergence raises —
+    nonzero exit from ``make moe-serve`` — never a buried JSON field).
+    Grid points needing more devices than the process has are skipped
+    with a note; ``make moe-serve`` forces a 32-device host platform so
+    the full tp ∈ {1,8} × ep ∈ {1,4} grid runs. Same CPU caveat as every
+    multichip point: virtual devices split one host's cores, so tok/s
+    across points measures overhead, not chip scaling."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_task.ml.models import transformer
+    from tpu_task.ml.parallel.mesh import make_mesh
+    from tpu_task.ml.serving import ServingConfig, ServingEngine
+    from tpu_task.ml.serving.cache import kv_shard_bytes, paged_cache_bytes
+
+    grid = tuple(tuple(point) for point in grid)
+    n_dev = len(jax.devices())
+    # kv_heads=8 divides every tp in the grid; n_experts=4 divides ep.
+    cfg = transformer.TransformerConfig(
+        vocab_size=512, d_model=256, n_layers=3, n_heads=8, d_head=32,
+        d_ff=512, dtype=jnp.float32, n_kv_heads=8, moe_every=3,
+        n_experts=4)
+    scfg = ServingConfig(slots=8, block_size=8, n_blocks=80, max_len=96)
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    pool_bytes = paged_cache_bytes(cfg, scfg, scfg.n_blocks)
+    expert_bytes = sum(
+        int(np.prod(layer[name].shape)) * 4
+        for layer in params["layers"] if "w_in" in layer
+        for name in ("w_in", "w_out"))
+
+    rng = np.random.default_rng(seed)
+    work = [{
+        "prompt": rng.integers(0, cfg.vocab_size, size=int(rng.choice(
+            (8, 16, 32)))),
+        "max_new": 4 if rng.random() < 2 / 3 else 32,
+    } for _ in range(n_requests)]
+    useful = sum(w["max_new"] for w in work)
+
+    points, skipped, streams = [], [], {}
+    for tp, ep in grid:
+        if tp * ep > n_dev:
+            skipped.append({"tp": tp, "ep": ep,
+                            "need_devices": tp * ep, "have": n_dev})
+            continue
+        mesh = (None if tp * ep == 1 else make_mesh(
+            tp * ep, axis_names=("tp", "ep"), axis_sizes=(tp, ep)))
+        eng = ServingEngine(params, cfg, scfg, mesh=mesh)
+        eng.submit(np.zeros((8,), np.int32), 2)
+        eng.drain()                       # compile off the clock
+        t0 = time.perf_counter()
+        rids = [eng.submit(w["prompt"], w["max_new"]) for w in work]
+        out = eng.drain()
+        wall = time.perf_counter() - t0
+        streams[(tp, ep)] = [out[r] for r in rids]
+        points.append({
+            "tp": tp, "ep": ep,
+            "decode_tokens_per_s": round(useful / wall, 1),
+            "makespan_s": round(wall, 3),
+            "kv_pool_mb_per_shard": round(
+                kv_shard_bytes(cfg, scfg, scfg.n_blocks, tp) / 1e6, 3),
+            "expert_param_mb_per_shard": round(
+                expert_bytes / ep / 1e6, 3),
+        })
+    first = next(iter(streams), None)
+    for key, got in streams.items():
+        if got != streams[first]:
+            raise RuntimeError(
+                f"greedy MoE streams diverged between tp×ep={first} and "
+                f"{key} — the docs/parity.md token-identity contract is "
+                "broken")
+    return {
+        "config": {"n_experts": cfg.n_experts, "moe_every": cfg.moe_every,
+                   "kv_heads": cfg.kv_heads, "slots": scfg.slots,
+                   "n_requests": n_requests, "useful_tokens": useful,
+                   "expert_param_mb_total": round(expert_bytes / 1e6, 3),
+                   "kv_pool_mb_total": round(pool_bytes / 1e6, 3)},
+        "points": points,
+        "skipped": skipped,
+        "greedy_streams_identical_across_grid": bool(points),
+    }
+
+
 def _production_serving_model():
     """Shared tiny-but-representative model for the production-traffic
     serving scenarios (CPU-friendly: the per-step compute still dominates
@@ -2578,12 +2669,51 @@ def bench_goodput(batches=(1, 8, 32), max_new: int = 24,
         micro_sweep["ERROR"] = ("greedy streams DIVERGED across micro_k "
                                 "— the bit-identity contract is broken")
 
+    # -- MoE FLOP model: top-k awareness + the ep-sharded cross-check ----
+    # The static model charges moe_top_k experts' FFN per token (the
+    # algorithmic/MFU convention); the DISPATCHED dense-dispatch program
+    # computes all n_experts buffers, so XLA's count sits above the
+    # model by roughly the expert-FFN over-dispatch — the recorded
+    # ratios document that honestly rather than pretending equality.
+    from tpu_task.ml.parallel.mesh import make_mesh
+    from tpu_task.obs.goodput import token_flops
+
+    def moe_cfg(top_k):
+        return transformer.TransformerConfig(
+            vocab_size=256, d_model=128, n_layers=2, n_heads=8, d_head=16,
+            d_ff=256, dtype=jnp.float32, n_kv_heads=4, moe_every=2,
+            n_experts=4, moe_top_k=top_k)
+
+    m_scfg = ServingConfig(slots=4, block_size=8, n_blocks=32, max_len=32,
+                           prefix_cache=False)
+    per_expert_ffn = 2.0 * 2 * 128 * 256     # 2 FLOPs × (w_in + w_out)
+    moe_check = {
+        "token_flops_top1": token_flops(moe_cfg(1), 1),
+        "token_flops_top2": token_flops(moe_cfg(2), 1),
+        # top_k-awareness in one number: the top1→top2 delta must be
+        # exactly one more expert's FFN matmul FLOPs (per MoE layer).
+        "top_k_delta_matches_one_expert_ffn": (
+            token_flops(moe_cfg(2), 1) - token_flops(moe_cfg(1), 1)
+            == per_expert_ffn),
+        "xla_flops_single_chip": decode_step_cost_analysis_flops(
+            moe_cfg(1), m_scfg),
+    }
+    if len(jax.devices()) >= 4:
+        # The ep-sharded program (all_to_all dispatch): per-shard count.
+        moe_check["xla_flops_per_shard_ep4"] = \
+            decode_step_cost_analysis_flops(
+                moe_cfg(1), m_scfg,
+                mesh=make_mesh(4, axis_names=("ep",), axis_sizes=(4,)))
+    else:
+        moe_check["xla_flops_per_shard_ep4"] = None
+
     return {
         "workload": {"batches": list(batches), "max_new": max_new,
                      "prompt_tokens": 8},
         "per_batch": per_batch,
         "micro_k_sweep": micro_sweep,
         "flop_model_cross_check": xcheck,
+        "moe_flop_model": moe_check,
         "note": ("host_gap_frac is the ROADMAP-4 dispatch-overhead "
                  "gauge (CPU ms-scale steps: expect a large host share; "
                  "the micro_k_sweep shows the K-token fused micro-step "
@@ -2628,6 +2758,11 @@ def main() -> int:
     # Fleet-wide KV (ROADMAP item 2): shared-prefix scaling with block
     # shipping on vs off + the prefill/decode split latency leg.
     fleet["kvfleet"] = bench_fleet_kv()
+    # Sharded-replica MoE serving (ROADMAP item 1): the tp×ep grid —
+    # engine tok/s, per-shard KV MB (÷tp), per-shard expert-weight MB
+    # (÷ep); points beyond the device count report skipped (`make
+    # moe-serve` forces a 32-device host platform for the full grid).
+    fleet["moe_tp_ep"] = bench_moe_tp_ep()
     # Observability overhead (PR 11): engine tok/s with the obs plane on
     # vs off — the ≤ 5% tracing-overhead contract, tracked per capture.
     obs = bench_obs()
@@ -2772,6 +2907,16 @@ def _parse_args(argv):
     fleet_cmd.add_argument(
         "--no-kvfleet", action="store_true", dest="no_kvfleet",
         help="skip the fleet-KV legs")
+    fleet_cmd.add_argument(
+        "--moe-only", action="store_true", dest="moe_only",
+        help="run only the sharded-replica MoE tp×ep grid (also `make "
+             "moe-serve`); forces a virtual host platform big enough "
+             "for the grid's largest tp×ep point")
+    fleet_cmd.add_argument(
+        "--moe-grid", default="1x1,8x1,1x4,8x4", dest="moe_grid",
+        metavar="TPxEP[,TPxEP...]",
+        help="(tp, ep) points for the MoE grid (default 1x1,8x1,1x4,"
+             "8x4)")
     obs_cmd = sub.add_parser(
         "obs",
         help="observability overhead section only (also `make bench-obs`): "
@@ -2833,6 +2978,20 @@ if __name__ == "__main__":
     if args.section == "fleet":
         counts = tuple(int(c) for c in str(args.replicas).split(",")
                        if c.strip())
+        grid = tuple(
+            tuple(int(v) for v in point.lower().split("x"))
+            for point in str(args.moe_grid).split(",") if point.strip()
+        ) or ((1, 1), (8, 1), (1, 4), (8, 4))
+        if args.moe_only:
+            # The grid's widest point sets the virtual platform BEFORE
+            # jax initializes (sections import it lazily).
+            _ensure_host_devices(max(tp * ep for tp, ep in grid))
+            result = {"moe_tp_ep": bench_moe_tp_ep(
+                grid=grid, seed=args.seed)}
+            print(json.dumps({"fleet": result}))
+            raise SystemExit(
+                0 if result["moe_tp_ep"].get(
+                    "greedy_streams_identical_across_grid") else 1)
         result = {} if args.kvfleet_only else bench_serving_fleet(
             replica_counts=counts, n_requests=args.requests,
             seed=args.seed)
@@ -2840,6 +2999,9 @@ if __name__ == "__main__":
             result["kvfleet"] = bench_fleet_kv(
                 replica_counts=counts, n_requests=args.requests,
                 seed=args.seed)
+        if not args.kvfleet_only:
+            result["moe_tp_ep"] = bench_moe_tp_ep(
+                grid=grid, seed=args.seed)
         print(json.dumps({"fleet": result}))
         raise SystemExit(0)
     if args.section == "obs":

@@ -414,3 +414,112 @@ def test_prefill_decode_split_hands_off_at_boundary_token(tmp_path):
         assert router.handoffs == 1
     finally:
         _teardown(driver)
+
+
+# -- prefetch-ahead imports (router next-turn hints) --------------------------
+
+
+def test_engine_prefetch_chain_warms_cache_before_any_request():
+    """The prefetch-ahead satellite, engine half: ``prefetch_chain``
+    pulls a published chain into the LOCAL prefix cache with no request
+    in sight — counted under ``kvfleet.prefetch_blocks`` — so the
+    session's next turn admits on local hits (the fleet is not even
+    consulted) and streams bit-identically."""
+    from tpu_task.ml.serving.cache import chain_block_hashes
+    from tpu_task.serve.kvfleet import FleetKvClient
+
+    cfg, params = _micro()
+    tmp = tempfile.mkdtemp()
+    backend = LocalBackend(tmp)
+    client_a = FleetKvClient(backend, "ra", refresh_interval=0.0)
+    engine_a = _engine(cfg, params, rng_seed=1, kv_client=client_a)
+    prompt = np.asarray(list(range(1, 17)), np.int32)
+    rid_a = engine_a.submit(prompt, 8)
+    out_a = engine_a.drain()[rid_a]
+    assert client_a.publish(engine_a) > 0
+
+    # The hint: the next turn's context extends prompt + out_a — its
+    # full-block chain is knowable now and already published above.
+    session_ids = np.concatenate([prompt, np.asarray(out_a, np.int32)])
+    hashes = chain_block_hashes(session_ids, 4)
+
+    client_b = FleetKvClient(backend, "rb", refresh_interval=0.0)
+    engine_b = _engine(cfg, params, rng_seed=2, kv_client=client_b)
+    imported = engine_b.prefetch_chain(hashes)
+    # The stream's LAST token is emitted but never written back (decode
+    # stops), so its block holds one fewer valid position than the id
+    # chain implies: every published block imports, the tail one misses.
+    assert imported == len(hashes) - 1
+    stats = engine_b.stats()["kvfleet"]
+    assert stats["prefetch_blocks"] == imported
+    assert engine_b.allocator.referenced == 0     # cached at ref 0
+    # Idempotent: a second hint for the same chain imports nothing.
+    assert engine_b.prefetch_chain(hashes) == 0
+
+    # Next turn: the extended prompt admits on LOCAL hits — zero new
+    # fleet imports on the TTFT path — and streams bit-identically.
+    turn2 = np.concatenate([session_ids, np.asarray([30, 31], np.int32)])
+    rid_b = engine_b.submit(turn2, 6)
+    out_b = engine_b.drain()[rid_b]
+    after = engine_b.stats()["kvfleet"]
+    assert after["import_requests"] == 0
+    assert engine_b.stats()["prefix_cache"]["blocks_saved"] >= imported
+    reference = _engine(cfg, params, rng_seed=3)
+    rid_r = reference.submit(turn2, 6)
+    assert out_b == reference.drain()[rid_r]
+
+
+def test_router_hints_next_turn_pick_on_completion():
+    """The router half: with ``prefetch_next_turn`` on, a completed
+    request fires ONE ``POST /prefetch`` at the replica the session's
+    next turn would land on; when the serving replica drains away, the
+    hint warms the SIBLING (counted on both sides), and the next turn
+    served there needs no admission-path fleet import."""
+    from tpu_task.serve import ReplicaServer, Router, wait_until
+    from tpu_task.serve.kvfleet import FleetKvClient
+
+    tmp = tempfile.mkdtemp()
+    backend = LocalBackend(tmp)
+    servers = [
+        ReplicaServer(preset="micro",
+                      kv_client=FleetKvClient(backend, f"r{i}",
+                                              refresh_interval=0.0),
+                      kv_publish_every=1).start()
+        for i in range(2)]
+    try:
+        router = Router(seed=0, prefetch_next_turn=True, block_size=4)
+        router.set_replicas({
+            f"r{i}": {"url": server.url, "boot_id": server.boot_id}
+            for i, server in enumerate(servers)})
+        prompt = list(range(1, 17))
+        fid = router.submit(prompt, 8)
+        out = router.drain(deadline_s=60)[fid]
+        server_by_name = {f"r{i}": s for i, s in enumerate(servers)}
+        serving = server_by_name[router.request(fid).replica]
+        sibling = next(s for s in servers if s is not serving)
+        # Wait out the publish beat, then fail the serving replica out
+        # of membership: the session's next turn must land elsewhere.
+        assert wait_until(
+            lambda: serving.engine.stats()["kvfleet"]["published_blocks"]
+            > 0 or serving.kv_client.published_blocks > 0, 10)
+        # pump's DONE arm already fired one hint automatically (then
+        # targeting the warm serving replica — a no-op import).
+        auto_hints = router.prefetch_hints
+        assert auto_hints >= 1
+        router._replicas[router.request(fid).replica].healthy = False
+        router._hint_next_turn(router.request(fid))
+        assert router.prefetch_hints == auto_hints + 1
+        assert sibling.engine.stats()["kvfleet"]["prefetch_blocks"] > 0
+
+        turn2 = prompt + out + [30, 31]
+        fid2 = router.submit(turn2, 4)
+        out2 = router.drain(deadline_s=60)[fid2]
+        assert router.request(fid2).replica != router.request(fid).replica
+        assert len(out2) == 4
+        # The prefetched blocks served the admission locally: no fleet
+        # import landed on the next turn's TTFT path.
+        assert sibling.engine.stats()["kvfleet"]["import_requests"] == 0
+        assert sibling.engine.stats()["prefix_cache"]["blocks_saved"] > 0
+    finally:
+        for server in servers:
+            server.stop()

@@ -597,8 +597,165 @@ def test_serve_spec_script_and_payload():
     payload = spec.payload(3)
     assert payload == {"kind": "serve", "service": "chat", "replica": "3",
                        "preset": "tiny", "role": "decode",
+                       "tp": "1", "ep": "1",
                        "serving": '{"slots": 2}'}
     # The prefill role's serving overrides land in its payload + script.
     assert spec.payload(0, role="prefill")["serving"] == \
         '{"chunk_tokens": 64, "slots": 2}'
     assert '"chunk_tokens": 64' in replica_script(spec, role="prefill")
+
+
+# -- sharded replicas: tp×ep gangs (ROADMAP item 1) ---------------------------
+
+
+class _NullDriver:
+    """Accounting-only GangDriver: placements succeed, nothing launches
+    — the scheduler math is the test subject, not the replicas."""
+
+    self_recovering = False
+
+    def launch(self, task):
+        pass
+
+    def poll(self, task):
+        from tpu_task.scheduler import driver as driver_module
+
+        return driver_module.RUNNING
+
+    def preempt(self, task, graceful=True):
+        pass
+
+    def release(self, task):
+        pass
+
+    def failure_reason(self, task):
+        return "task-failed"
+
+
+@pytest.mark.moe
+def test_serve_spec_tp_ep_gang_accounting():
+    """The scheduler-accounting satellite: a sharded replica's gang
+    reserves EXACTLY tp×ep chips — derived accelerator, quota math, and
+    the status snapshot's serve chips column all agree — and the
+    dishonest combinations fail loudly at construction."""
+    spec = ServeSpec(service="moe", tenant="svc", replicas=2,
+                     preset="moe", tp=2, ep=2)
+    assert spec.chips == 4
+    assert spec.gang_accelerator == "v4-8"        # 4 chips exactly
+    assert spec.payload(0)["tp"] == "2" and spec.payload(0)["ep"] == "2"
+    assert "--tp 2 --ep 2" in replica_script(spec)
+    # Explicit accelerator must match tp×ep; fleet KV is single-chip.
+    with pytest.raises(ValueError, match="chips"):
+        ServeSpec(service="x", tenant="t", accelerator="v4-8", tp=8, ep=1)
+    with pytest.raises(ValueError, match="single-chip"):
+        ServeSpec(service="x", tenant="t", tp=2, kv_bucket="/tmp/kv")
+    with pytest.raises(ValueError, match="tp and ep"):
+        ServeSpec(service="x", tenant="t", tp=0)
+
+    scheduler = GangScheduler(
+        CapacityPool([16]), {"svc": TenantQuota(chips=16, weight=1.0)},
+        _NullDriver())
+    router = Router(seed=0)
+    fleet = ServeFleet(scheduler, spec, router)
+    fleet.launch()
+    scheduler.tick()
+    for task_id in fleet._gangs:
+        assert scheduler.queue.tasks[task_id].gang.total_chips == 4
+    status = scheduler.status()["tenants"]["svc"]
+    assert status["serve"]["chips"] == 8          # 2 replicas × tp×ep
+    assert status["running_chips"] == 8
+    # A third 4-chip gang still fits the 16-chip pool; a tp8×ep4 one
+    # could never (quota says so before anything launches).
+    with pytest.raises(ValueError, match="chips"):
+        scheduler.submit("svc", ServeSpec(
+            service="big", tenant="svc", tp=8, ep=4).gang_accelerator)
+
+
+@pytest.mark.slow
+@pytest.mark.moe
+def test_sharded_replica_preemption_handoff_token_identical(monkeypatch,
+                                                           torn_down):
+    """The preemption half of the tentpole's exit: a mid-stream graceful
+    preemption of a SHARDED (tp2×ep2 MoE) replica drains, exports, and
+    fails over through the existing inflight seam — every affected
+    stream continues on the sibling token-identically to an
+    uninterrupted single-chip dense reference."""
+    monkeypatch.setenv("TPU_TASK_REQUEUE_BACKOFF_BASE", "0.05")
+    monkeypatch.setenv("TPU_TASK_REQUEUE_BACKOFF_CAP", "0.2")
+    driver = InProcessServeDriver()
+    scheduler = GangScheduler(
+        CapacityPool([16]), {"svc": TenantQuota(chips=16, weight=1.0)},
+        driver)
+    router = Router(seed=3)
+    spec = ServeSpec(service="moe", tenant="svc", replicas=2,
+                     preset="moe", tp=2, ep=2)
+    fleet = ServeFleet(scheduler, spec, router)
+    torn_down.append(fleet)
+    fleet.launch()
+    assert wait_until(lambda: len(fleet.refresh_endpoints()) == 2, 60,
+                      tick=fleet.tick, period=0.05)
+    fleet.tick()
+
+    fids = [router.submit(RNG.integers(0, 64, size=8), 24)
+            for _ in range(4)]
+    assert wait_until(
+        lambda: all(router.request(fid).tokens for fid in fids),
+        30, tick=router.pump, period=0)
+    live = [fid for fid in fids
+            if router.request(fid).status not in ("done", "failed")
+            and router.request(fid).replica]
+    assert live, "every stream finished before the kill could land"
+    victim = router.request(live[0]).replica
+    affected = [fid for fid in live
+                if router.request(fid).replica == victim]
+    driver.kill(victim, graceful=True)
+
+    out = router.drain(deadline_s=120, on_idle=fleet.tick)
+    assert all(len(out[fid]) == 24 for fid in fids)
+    assert out == _reference_streams(router, fids, preset="moe")
+    # Every stream open on the victim at kill time failed over.
+    assert router.redispatches >= len(affected) >= 1
+
+
+@pytest.mark.slow
+@pytest.mark.moe
+def test_fleet_serves_moe_exceeding_one_chip_at_ep4(torn_down):
+    """THE acceptance criterion: an MoE config whose expert weights
+    exceed one chip's (notional) weight budget serves end-to-end through
+    ServeFleet at ep=4 — each gang honestly reserves 4 chips, each
+    device holds 1/4 of the expert table, and greedy streams are
+    bit-identical to the single-chip dense-dispatch reference."""
+    driver = InProcessServeDriver()
+    scheduler = GangScheduler(
+        CapacityPool([8]), {"svc": TenantQuota(chips=8, weight=1.0)},
+        driver)
+    router = Router(seed=5)
+    spec = ServeSpec(service="bigmoe", tenant="svc", replicas=1,
+                     preset="moe", tp=1, ep=4)
+    fleet = ServeFleet(scheduler, spec, router)
+    torn_down.append(fleet)
+    fleet.launch()
+    assert wait_until(lambda: len(fleet.refresh_endpoints()) == 1, 60,
+                      tick=fleet.tick, period=0.05)
+    fleet.tick()
+    task = scheduler.queue.tasks[fleet._gangs[0]]
+    assert task.gang.total_chips == 4
+
+    server = next(iter(driver._servers.values()))
+    eng = server.engine
+    assert eng.stats()["ep"] == 4
+    expert_bytes = sum(
+        leaf.nbytes for layer in eng.params["layers"]
+        if "w_in" in layer for leaf in (layer["w_in"], layer["w_out"]))
+    budget = 32 * 1024                 # notional per-chip expert budget
+    assert expert_bytes > budget                  # too big for one chip
+    for layer in eng.params["layers"]:
+        if "w_in" in layer:
+            shard = layer["w_in"].addressable_shards[0].data.nbytes
+            assert shard * 4 == layer["w_in"].nbytes
+            assert 2 * shard <= budget            # w_in + w_out fit
+
+    fids = [router.submit(RNG.integers(0, 64, size=6), 8)
+            for _ in range(3)]
+    out = router.drain(deadline_s=120, on_idle=fleet.tick)
+    assert out == _reference_streams(router, fids, preset="moe")

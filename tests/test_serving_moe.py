@@ -1,0 +1,283 @@
+"""Sharded-replica MoE serving (ROADMAP item 1): the fleet stops being
+single-chip.
+
+The contract under test: a serve replica is a **tp×ep gang** sharing ONE
+engine through a ``("tp", "ep")`` mesh — tp shards weights and the paged
+pools' kv-head axis (PR 6), ep places MoE expert weights one group per
+shard and routes decode tokens through the ``moe.apply_sharded``
+all_to_all dispatch inside every fused step — and a request's greedy
+stream is IDENTICAL to the single-chip dense-dispatch path at every
+width (the serving dispatch is dropless by construction: capacity = the
+per-shard token count, so no masked garbage row can evict a real
+token's slot). The draft pool of speculative decoding shards with the
+same rules (closing PR 8's single-chip note), and a sharded replica's
+mid-stream preemption still hands off token-identically through the
+existing inflight seam.
+
+Tier-1 keeps the cheap spine (one ep-identity pin + host-side
+validation/accounting); the tp×ep matrix and the fleet legs are
+``slow`` (tier-1 sits at ~800 s of its 870 s budget).
+"""
+
+import dataclasses
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_task.ml.models import transformer
+from tpu_task.ml.parallel.mesh import make_mesh
+from tpu_task.ml.serving import ServingConfig, ServingEngine
+from tpu_task.ml.serving.model import serving_moe_fn
+
+pytestmark = pytest.mark.moe
+
+# Layer 1's FFN is a 4-expert MoE; kv_heads=2 bounds tp at 2 here (the
+# wider-tp points build their own config).
+MOE = transformer.TransformerConfig(
+    vocab_size=64, d_model=32, n_layers=2, n_heads=4, d_head=8, d_ff=64,
+    dtype=jnp.float32, n_kv_heads=2, moe_every=2, n_experts=4)
+
+BASE = ServingConfig(slots=3, block_size=4, n_blocks=32, max_len=32,
+                     prefill_buckets=(8, 16))
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer.init(jax.random.PRNGKey(0), MOE)
+
+
+def _workload(seed=0, n=3):
+    rng = np.random.default_rng(seed)
+    return [(rng.integers(0, MOE.vocab_size, size=plen), new)
+            for plen, new in [(5, 6), (8, 3), (12, 9), (3, 12)][:n]]
+
+
+def _drain(params, cfg, scfg, mesh=None, temps=None, seed=0, n=3,
+           **engine_kw):
+    engine = ServingEngine(params, cfg, scfg, mesh=mesh,
+                           rng=jax.random.PRNGKey(42), **engine_kw)
+    rids = []
+    for i, (prompt, new) in enumerate(_workload(seed, n)):
+        t = 0.0 if temps is None else temps[i]
+        rids.append(engine.submit(
+            prompt, new, temperature=t, top_p=0.9 if t > 0 else None))
+    out = engine.drain()
+    assert engine.allocator.referenced == 0
+    return [out[r] for r in rids], engine
+
+
+# -- resolution + validation (host-side, cheap) -------------------------------
+
+
+def test_serving_moe_fn_resolution():
+    """The dispatch builder's contract: None wherever there is nothing
+    to dispatch over (dense config, no mesh, ep=1 — the dense-dispatch
+    reference path), a callable on an ep mesh, and a LOUD error for an
+    indivisible expert count at construction, never mid-decode."""
+    dense = dataclasses.replace(MOE, moe_every=0, n_experts=0)
+    mesh = make_mesh(4, axis_names=("ep",), axis_sizes=(4,))
+    assert serving_moe_fn(MOE, None) is None
+    assert serving_moe_fn(dense, mesh) is None
+    assert serving_moe_fn(
+        MOE, make_mesh(2, axis_names=("tp",), axis_sizes=(2,))) is None
+    assert serving_moe_fn(MOE, mesh) is not None
+    bad = dataclasses.replace(MOE, n_experts=6)
+    with pytest.raises(ValueError, match="n_experts"):
+        serving_moe_fn(bad, mesh)
+
+
+def test_engine_mesh_validation(params):
+    """An ep mesh under a dense model is a configuration error (nothing
+    shards over ep), as is an expert count the ep width cannot split."""
+    dense_cfg = dataclasses.replace(MOE, moe_every=0, n_experts=0)
+    dense_params = transformer.init(jax.random.PRNGKey(0), dense_cfg)
+    mesh = make_mesh(4, axis_names=("ep",), axis_sizes=(4,))
+    with pytest.raises(ValueError, match="no MoE layers"):
+        ServingEngine(dense_params, dense_cfg, BASE, mesh=mesh)
+    bad = dataclasses.replace(MOE, n_experts=6)
+    with pytest.raises(ValueError, match="n_experts"):
+        ServingEngine(transformer.init(jax.random.PRNGKey(0), bad), bad,
+                      BASE, mesh=mesh)
+
+
+def test_moe_flop_model_top_k_aware():
+    """The MFU satellite: the static FLOP model charges ``moe_top_k``
+    experts' FFN per token — the top1→top2 delta is exactly one more
+    expert's (w_in + w_out) matmul FLOPs per MoE layer, and XLA's own
+    count for the dispatched program sits at or above the model (the
+    dense dispatch computes every expert's buffer; the model charges
+    the algorithmic top-k — the MFU convention)."""
+    from tpu_task.obs.goodput import (
+        decode_step_cost_analysis_flops,
+        token_flops,
+    )
+
+    top1 = token_flops(MOE, 1)
+    top2 = token_flops(dataclasses.replace(MOE, moe_top_k=2), 1)
+    # One MoE layer; one more expert = 2 FLOPs × (d_model·d_ff × 2 mats).
+    assert top2 - top1 == 2.0 * 2 * MOE.d_model * MOE.d_ff
+    scfg = dataclasses.replace(BASE, prefix_cache=False)
+    xla = decode_step_cost_analysis_flops(MOE, scfg)
+    if xla is not None:
+        assert xla >= scfg.slots * top1 * 0.9
+
+
+def test_moe_flop_model_cross_check_under_ep_sharding():
+    """The ep-sharded fused step lowers and cost-analyzes too: the
+    per-shard count is positive and below the single-chip dispatch's
+    (each shard holds 1/ep of the experts; the all_to_all moves bytes,
+    not FLOPs)."""
+    from tpu_task.obs.goodput import decode_step_cost_analysis_flops
+
+    scfg = dataclasses.replace(BASE, prefix_cache=False)
+    mesh = make_mesh(4, axis_names=("ep",), axis_sizes=(4,))
+    sharded = decode_step_cost_analysis_flops(MOE, scfg, mesh=mesh)
+    single = decode_step_cost_analysis_flops(MOE, scfg)
+    if sharded is None or single is None:
+        pytest.skip("backend exposes no cost analysis for this program")
+    assert 0 < sharded <= single
+
+
+# -- the tentpole pin: ep dispatch ≡ single-chip dense ------------------------
+
+
+@pytest.mark.perf
+def test_engine_ep4_moe_greedy_matches_single_chip_dense(params):
+    """THE sharded-MoE serving contract (docs/parity.md): an ep=4 engine
+    — expert weights one group per shard, every fused step routing
+    tokens through the all_to_all dispatch — produces greedy streams
+    IDENTICAL to the single-chip engine's dense-dispatch reference, and
+    the expert weights really shard (1/ep of the bytes per device)."""
+    single, _ = _drain(params, MOE, BASE)
+    mesh = make_mesh(4, axis_names=("ep",), axis_sizes=(4,))
+    sharded, eng = _drain(params, MOE, BASE, mesh=mesh)
+    assert single == sharded
+    assert eng.stats()["ep"] == 4 and eng.stats()["tp"] == 1
+    w_in = eng.params["layers"][1]["w_in"]
+    assert w_in.addressable_shards[0].data.nbytes * 4 == w_in.nbytes
+    # Dense layers' weights replicate over ep (nothing of theirs is
+    # expert-sharded) — the ep axis pays only for what it shards.
+    w_gate = eng.params["layers"][0]["w_gate"]
+    assert w_gate.addressable_shards[0].data.nbytes == w_gate.nbytes
+
+
+# -- the slow matrix ----------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_engine_tp_ep_matrix_streams_identical(params):
+    """tp×ep composition: {tp2×ep2, tp2×ep4} greedy streams identical
+    to single-chip, KV pools still 1/tp per shard, expert weights 1/ep."""
+    single, _ = _drain(params, MOE, BASE)
+    for tp, ep in ((2, 2), (2, 4)):
+        mesh = make_mesh(tp * ep, axis_names=("tp", "ep"),
+                         axis_sizes=(tp, ep))
+        got, eng = _drain(params, MOE, BASE, mesh=mesh)
+        assert got == single, f"streams diverged at tp{tp}xep{ep}"
+        k0 = eng.pools[0]["k"]
+        assert k0.addressable_shards[0].data.nbytes * tp == k0.nbytes
+        w_in = eng.params["layers"][1]["w_in"]
+        assert w_in.addressable_shards[0].data.nbytes * tp * ep \
+            == w_in.nbytes  # ep over groups × tp over the hidden dim
+
+
+@pytest.mark.slow
+def test_engine_ep_sampled_streams_key_identical(params):
+    """Sampled requests: the ep dispatch changes no draw — streams are
+    key-identical to single-chip at temperature > 0 (fold_in keys plus
+    bit-identical greedy logits would already imply it; this pins the
+    sampled program end to end)."""
+    temps = [0.9, 0.0, 0.7]
+    single, _ = _drain(params, MOE, BASE, temps=temps)
+    mesh = make_mesh(4, axis_names=("ep",), axis_sizes=(4,))
+    sharded, _ = _drain(params, MOE, BASE, mesh=mesh, temps=temps)
+    assert single == sharded
+
+
+@pytest.mark.slow
+def test_engine_ep_micro_k_streams_identical(params):
+    """micro_k > 1 under ep: the K-wide fused micro-step (the scan body
+    runs the all_to_all dispatch K times in one program) stays
+    bit-identical to K=1 and to single-chip."""
+    scfg = dataclasses.replace(BASE, micro_k=4)
+    single, _ = _drain(params, MOE, BASE)
+    mesh = make_mesh(4, axis_names=("ep",), axis_sizes=(4,))
+    got, eng = _drain(params, MOE, scfg, mesh=mesh)
+    assert got == single
+    assert eng.micro_steps > 0
+
+
+@pytest.mark.slow
+def test_spec_decode_sharded_draft_bit_identical(params):
+    """PR 8's "spec decode is single-chip" note closes: a tp=2 engine
+    with speculative decoding — draft pool kv-head-sharded with the SAME
+    rules as the target's — produces greedy streams bit-identical to
+    the non-speculative engine at every width."""
+    tp_cfg = dataclasses.replace(MOE, moe_every=0, n_experts=0)
+    tp_params = transformer.init(jax.random.PRNGKey(0), tp_cfg)
+    draft_cfg = transformer.TransformerConfig(
+        vocab_size=64, d_model=16, n_layers=1, n_heads=2, d_head=8,
+        d_ff=32, dtype=jnp.float32, n_kv_heads=2)
+    draft_params = transformer.init(jax.random.PRNGKey(7), draft_cfg)
+    spec = dataclasses.replace(BASE, spec_k=3)
+    mesh = make_mesh(2, axis_names=("tp",), axis_sizes=(2,))
+
+    nonspec, _ = _drain(tp_params, tp_cfg, BASE)
+    sharded_spec, eng = _drain(tp_params, tp_cfg, spec, mesh=mesh,
+                               draft_params=draft_params,
+                               draft_cfg=draft_cfg)
+    assert sharded_spec == nonspec
+    assert eng.stats()["spec"]["rounds"] > 0
+    k0 = eng._draft_pools[0]["k"]
+    assert k0.addressable_shards[0].data.nbytes * 2 == k0.nbytes
+
+
+@pytest.mark.slow
+def test_spec_decode_on_moe_target_under_ep(params):
+    """Speculative decoding COMPOSES with the ep dispatch: an MoE target
+    at ep=2 (spec scoring runs the all_to_all at width k+1) with a dense
+    draft stays bit-identical to the non-speculative single-chip path."""
+    draft_cfg = transformer.TransformerConfig(
+        vocab_size=64, d_model=16, n_layers=1, n_heads=2, d_head=8,
+        d_ff=32, dtype=jnp.float32, n_kv_heads=2)
+    draft_params = transformer.init(jax.random.PRNGKey(7), draft_cfg)
+    spec = dataclasses.replace(BASE, spec_k=2)
+    mesh = make_mesh(2, axis_names=("ep",), axis_sizes=(2,))
+    nonspec, _ = _drain(params, MOE, BASE)
+    got, eng = _drain(params, MOE, spec, mesh=mesh,
+                      draft_params=draft_params, draft_cfg=draft_cfg)
+    assert got == nonspec
+    assert eng.stats()["ep"] == 2 and eng.stats()["spec"]["rounds"] > 0
+
+
+@pytest.mark.slow
+def test_engine_ep4_serves_experts_exceeding_single_chip_budget():
+    """The capacity half of the exit criterion, engine-level: an expert
+    table bigger than one chip's (notional) weight budget serves at
+    ep=4 with each device holding exactly 1/4 of it."""
+    cfg = transformer.TransformerConfig(
+        vocab_size=64, d_model=64, n_layers=2, n_heads=4, d_head=16,
+        d_ff=512, dtype=jnp.float32, n_kv_heads=4, moe_every=2,
+        n_experts=8)
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    expert_bytes = sum(
+        int(np.prod(layer[name].shape)) * 4
+        for layer in params["layers"] if "w_in" in layer
+        for name in ("w_in", "w_out"))
+    budget = 1 * 1024 * 1024          # notional per-chip expert budget
+    assert expert_bytes > budget                   # won't fit one chip
+    assert expert_bytes // 4 <= budget             # fits at ep=4
+    scfg = ServingConfig(slots=2, block_size=4, n_blocks=16, max_len=16)
+    mesh = make_mesh(4, axis_names=("ep",), axis_sizes=(4,))
+    eng = ServingEngine(params, cfg, scfg, mesh=mesh)
+    for layer in eng.params["layers"]:
+        if "w_in" in layer:
+            assert layer["w_in"].addressable_shards[0].data.nbytes * 4 \
+                == layer["w_in"].nbytes
+    prompt = np.random.default_rng(1).integers(0, cfg.vocab_size, size=5)
+    rid = eng.submit(prompt, 6)
+    out = eng.drain()[rid]
+    assert len(out) == 6 and all(0 <= t < cfg.vocab_size for t in out)
+    assert eng.allocator.referenced == 0

@@ -119,20 +119,41 @@ def apply_dense(params, cfg: MoEConfig, x, rng=None):
 
 
 def apply_sharded(params, cfg: MoEConfig, x, mesh, axis_name: str = "ep",
-                  rng=None, batch_axes=None):
+                  rng=None, batch_axes=None, tp_axis=None, capacity=None):
     """Expert-parallel forward: tokens sharded over ep, experts one group
     each, all_to_all token exchange both ways.
 
     ``batch_axes``: mesh axes the token batch dim shards over (default:
     just ``axis_name``). Pass e.g. ``("dp", "ep")`` to compose expert
     parallelism with data parallelism in one mesh — the all_to_all stays
-    inside each dp group (experts replicate over dp, shard over ep)."""
+    inside each dp group (experts replicate over dp, shard over ep).
+
+    ``tp_axis``: tensor-parallel axis the expert FFN's HIDDEN (d_ff) dim
+    additionally shards over — the tp×ep composition serving gangs run:
+    each device holds ``n_experts/ep`` experts × ``d_ff/tp`` of their
+    hidden width (exactly the layout the partition registry's
+    ``("expert", "embed", "mlp")`` annotation places), the local ``w_out``
+    contraction is a partial sum over its f-shard, and one ``psum`` over
+    ``tp_axis`` completes it before tokens return through the ep
+    all_to_all. Tokens replicate over tp (the ep exchange stays inside
+    each tp group). None (default) keeps the training-path layout
+    byte-identical.
+
+    ``capacity``: explicit per-expert capacity-buffer depth, overriding
+    the ``capacity_factor`` formula. The serving dispatch passes its
+    (static) local token count here, making the dispatch DROPLESS by
+    construction — no masked garbage row can ever evict a real token's
+    slot, which is what keeps the ep path's greedy streams identical to
+    the dense-dispatch reference."""
     if batch_axes is None:
         batch_axes = (axis_name,)
     n_shards = mesh.shape[axis_name]
     if cfg.n_experts % n_shards:
         raise ValueError(f"n_experts {cfg.n_experts} not divisible by "
                          f"ep={n_shards}")
+    if tp_axis is not None and cfg.d_ff % mesh.shape[tp_axis]:
+        raise ValueError(f"d_ff {cfg.d_ff} not divisible by "
+                         f"{tp_axis}={mesh.shape[tp_axis]}")
     experts_per_shard = cfg.n_experts // n_shards
 
     def shard_fn(router, w_in, w_out, x_local):
@@ -148,8 +169,9 @@ def apply_sharded(params, cfg: MoEConfig, x, mesh, axis_name: str = "ep",
             for ax in batch_axes:
                 shard_rng = jax.random.fold_in(shard_rng, lax.axis_index(ax))
         expert_index, gate, stats = _route(tokens, router, cfg, shard_rng)
-        capacity = max(1, int(cfg.capacity_factor * n_tokens * cfg.top_k
-                              / cfg.n_experts))
+        cap = capacity if capacity is not None else max(
+            1, int(cfg.capacity_factor * n_tokens * cfg.top_k
+                   / cfg.n_experts))
 
         # Flatten the (tokens, k) assignments slot-major so primary-slot
         # assignments win capacity over secondary ones.
@@ -161,10 +183,10 @@ def apply_sharded(params, cfg: MoEConfig, x, mesh, axis_name: str = "ep",
         # 0-based arrival order among assignments routed to the same expert.
         one_hot = jax.nn.one_hot(flat_expert, cfg.n_experts, dtype=jnp.int32)
         position = jnp.sum(jnp.cumsum(one_hot, axis=0) * one_hot, axis=-1) - 1
-        keep = position < capacity
+        keep = position < cap
 
         # Dispatch buffer: (n_experts, capacity, d).
-        buffer = jnp.zeros((cfg.n_experts, capacity, d), x_local.dtype)
+        buffer = jnp.zeros((cfg.n_experts, cap, d), x_local.dtype)
         safe_pos = jnp.where(keep, position, 0)
         buffer = buffer.at[flat_expert, safe_pos].add(
             flat_tokens * keep[:, None].astype(tokens.dtype))
@@ -172,17 +194,23 @@ def apply_sharded(params, cfg: MoEConfig, x, mesh, axis_name: str = "ep",
         # all_to_all: (n_experts, cap, d) → exchange expert groups so each
         # shard holds its experts' tokens from EVERY shard:
         # (experts_per_shard * n_shards_tokens, cap, d).
-        grouped = buffer.reshape(n_shards, experts_per_shard, capacity, d)
+        grouped = buffer.reshape(n_shards, experts_per_shard, cap, d)
         exchanged = lax.all_to_all(grouped, axis_name, split_axis=0,
                                    concat_axis=0, tiled=False)
         # exchanged: (n_shards, experts_per_shard, capacity, d) where leading
         # axis is source shard.
         hidden = jax.nn.silu(jnp.einsum("xecd,edf->xecf", exchanged, w_in))
         out = jnp.einsum("xecf,efd->xecd", hidden, w_out)
+        if tp_axis is not None:
+            # Local f-shard contraction above is a partial sum; complete
+            # it across tp BEFORE tokens return through the ep exchange
+            # (the psum also makes the output tp-invariant, matching the
+            # tokens-replicated-over-tp out spec).
+            out = lax.psum(out, tp_axis)
         # Return tokens to their source shards.
         returned = lax.all_to_all(out, axis_name, split_axis=0,
                                   concat_axis=0, tiled=False)
-        returned = returned.reshape(cfg.n_experts, capacity, d)
+        returned = returned.reshape(cfg.n_experts, cap, d)
 
         delivered = returned[flat_expert, safe_pos]
         if cfg.dropped_identity:
@@ -205,11 +233,15 @@ def apply_sharded(params, cfg: MoEConfig, x, mesh, axis_name: str = "ep",
         return combined.reshape(b, s, d), aux
 
     token_spec = PartitionSpec(batch_axes, None, None)  # batch over dp×ep
-    expert_spec = PartitionSpec(axis_name, None, None)  # experts sharded on ep
+    # Experts sharded on ep; with a tp axis the hidden (d_ff) dim of the
+    # expert weights additionally shards over tp — the registry's
+    # ("expert", "embed", "mlp") layout, consumed in place.
+    w_in_spec = PartitionSpec(axis_name, None, tp_axis)
+    w_out_spec = PartitionSpec(axis_name, tp_axis, None)
     fn = _shard_map(
         shard_fn,
         mesh=mesh,
-        in_specs=(PartitionSpec(None, None), expert_spec, expert_spec,
+        in_specs=(PartitionSpec(None, None), w_in_spec, w_out_spec,
                   token_spec),
         out_specs=(token_spec, PartitionSpec()),
     )

@@ -93,6 +93,17 @@ def logical_tree_pspecs(axes_tree, mesh=None, rules=None):
     )
 
 
+def mesh_axis_size(mesh, name: str) -> int:
+    """Size of mesh axis ``name``, 1 when the mesh is None or lacks the
+    axis — the one resolution every consumer of an OPTIONAL mesh axis
+    shares (the serving engine reads its tp and ep widths through this,
+    so a tp-only mesh, an ep-only mesh, and a tp×ep gang all resolve
+    consistently)."""
+    if mesh is None:
+        return 1
+    return int(dict(mesh.shape).get(name, 1))
+
+
 def mesh_batch_axes(mesh) -> Tuple[str, ...]:
     """The mesh axes the logical "batch" dim shards over, normalized to a
     (possibly empty) tuple — the one resolution every train-step builder

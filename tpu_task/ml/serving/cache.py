@@ -738,6 +738,11 @@ class PrefixCache:
     def __len__(self) -> int:
         return len(self._by_hash)
 
+    def has(self, h: bytes) -> bool:
+        """Whether ``h`` is cached — refcount-free membership (the
+        prefetch path's skip test; ``lookup`` increfs, this must not)."""
+        return h in self._by_hash
+
     def _touch(self, block: int) -> None:
         self._tick += 1
         self._lru[block] = self._tick

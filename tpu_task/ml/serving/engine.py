@@ -96,6 +96,7 @@ from tpu_task.ml.parallel.sharding import (
     PartitionPlan,
     compile_step,
     device_put_tree,
+    mesh_axis_size,
 )
 from tpu_task.ml.serving.cache import (
     QUANT_DTYPES,
@@ -123,6 +124,7 @@ from tpu_task.ml.serving.model import (
     micro_decode_sample,
     paged_prefill,
     sample_tokens,
+    serving_moe_fn,
     spec_score_greedy,
     spec_score_probs,
 )
@@ -255,10 +257,14 @@ class ServingEngine:
     """Front end: :meth:`submit` → request id, :meth:`poll` → status/tokens,
     :meth:`step` → one scheduler iteration, :meth:`drain` → run to empty.
 
-    ``mesh=`` turns on tensor-parallel serving exactly as in PR 6 (weights
-    per the logical rules, paged pools kv-head-sharded, scheduler
-    unchanged). ``draft_params``/``draft_cfg`` + ``scfg.spec_k > 0`` turn
-    on speculative decoding (single-chip only for now)."""
+    ``mesh=`` turns on multi-chip serving: a tp axis shards weights and
+    the paged pools' kv-head axis exactly as in PR 6; an ``ep`` axis
+    places MoE expert weights one group per shard (the SAME logical
+    rules training uses) and routes tokens through the
+    ``moe.apply_sharded`` all_to_all dispatch inside every fused step —
+    the scheduler is unchanged at any tp×ep width. ``draft_params``/
+    ``draft_cfg`` + ``scfg.spec_k > 0`` turn on speculative decoding;
+    the draft pool shards with the same rules as the target's."""
 
     def __init__(self, params: Params, cfg: TransformerConfig,
                  scfg: Optional[ServingConfig] = None,
@@ -270,28 +276,46 @@ class ServingEngine:
         self.scfg = scfg = scfg or ServingConfig()
         self.mesh = mesh
         self.tp = 1
+        self.ep = 1
         pools = init_pools(cfg, scfg)
         if mesh is None:
             self.params = params
             self.pools = pools
         else:
-            # Tensor-parallel serving: weights lay out per the SAME logical
-            # rules training uses (param_pspecs), the paged pools shard
-            # their kv-head axis over tp (pool_pspecs, regex registry), and
-            # everything the host scheduler owns — tokens, positions, block
-            # tables, active masks, sampling params — replicates. Paging is
-            # along the token axis, so block accounting (allocator, tables,
-            # scratch block, prefix cache) is IDENTICAL at every tp width.
-            self.tp = int(dict(mesh.shape).get("tp", 1))
+            # Multi-chip serving: weights lay out per the SAME logical
+            # rules training uses (param_pspecs — MoE expert weights shard
+            # one group per ep shard, their hidden dim over tp), the paged
+            # pools shard their kv-head axis over tp (pool_pspecs, regex
+            # registry), and everything the host scheduler owns — tokens,
+            # positions, block tables, active masks, sampling params —
+            # replicates. Paging is along the token axis, so block
+            # accounting (allocator, tables, scratch block, prefix cache)
+            # is IDENTICAL at every tp×ep width.
+            self.tp = mesh_axis_size(mesh, "tp")
+            self.ep = mesh_axis_size(mesh, "ep")
             if cfg.kv_heads % self.tp:
                 raise ValueError(
                     f"kv_heads {cfg.kv_heads} not divisible by tp "
                     f"{self.tp} (mesh axes {tuple(mesh.axis_names)}): the "
                     "paged pools shard their kv-head axis over tp")
+            if self.ep > 1 and cfg.moe_every <= 0:
+                raise ValueError(
+                    f"mesh carries ep={self.ep} but the model has no MoE "
+                    "layers (moe_every=0): drop the ep axis or serve an "
+                    "MoE config")
+            # Resolve the ep dispatch BEFORE any placement: an
+            # indivisible expert count must fail with ITS error, not a
+            # device_put sharding failure.
+            serving_moe_fn(cfg, mesh)
             self._param_specs = transformer.param_pspecs(cfg, mesh=mesh)
             self._pool_specs = pool_pspecs(pools, mesh)
             self.params = device_put_tree(params, self._param_specs, mesh)
             self.pools = device_put_tree(pools, self._pool_specs, mesh)
+        #: The expert-parallel MoE dispatch threading through every fused
+        #: step (None = the dense-dispatch reference — single chip, or a
+        #: mesh without an ep axis). Resolved ONCE here; validates
+        #: n_experts % ep at construction, never mid-decode.
+        self._moe_fn = serving_moe_fn(cfg, mesh)
         self.allocator = BlockAllocator(scfg.n_blocks)
         self._pcache = (PrefixCache(self.allocator, scfg.block_size)
                         if scfg.prefix_cache else None)
@@ -334,23 +358,34 @@ class ServingEngine:
         self.fleet_hit_blocks = 0
         self.fleet_miss_blocks = 0
         self.fleet_import_requests = 0
+        self.fleet_prefetch_blocks = 0
         self._h_kv_import = None
 
-        # Speculative decoding: validate the draft triple together.
+        # Speculative decoding: validate the draft triple together. The
+        # draft rides the SAME partition rules as the target (PR 8's
+        # "spec decode is single-chip" note closes here): draft weights
+        # through param_pspecs, the draft pool's kv-head axis over tp.
         self._spec_on = scfg.spec_k > 0
         if self._spec_on and (draft_params is None or draft_cfg is None):
             raise ValueError(
                 "spec_k > 0 needs draft_params and draft_cfg")
-        if self._spec_on and mesh is not None:
+        if self._spec_on and mesh is not None \
+                and draft_cfg.kv_heads % self.tp:
             raise ValueError(
-                "speculative decoding is single-chip for now (the draft "
-                "cache is unsharded)")
+                f"draft kv_heads {draft_cfg.kv_heads} not divisible by tp "
+                f"{self.tp}: the draft pool shards its kv-head axis with "
+                "the same rules as the target's")
         if draft_cfg is not None and draft_cfg.vocab_size != cfg.vocab_size:
             raise ValueError(
                 f"draft vocab {draft_cfg.vocab_size} != target vocab "
                 f"{cfg.vocab_size}")
         self.draft_cfg = draft_cfg
         self.draft_params = draft_params
+        if self._spec_on and mesh is not None:
+            self._draft_param_specs = transformer.param_pspecs(
+                draft_cfg, mesh=mesh)
+            self.draft_params = device_put_tree(
+                draft_params, self._draft_param_specs, mesh)
 
         n, m = scfg.slots, scfg.max_blocks_per_slot
         self._slots: List[Optional[Request]] = [None] * n
@@ -440,7 +475,8 @@ class ServingEngine:
                 # `obs watch` KV line through the one registry.
                 self._h_kv_import = metrics.histogram("kvfleet.import_s")
                 for stat in ("fleet_hit_blocks", "fleet_miss_blocks",
-                             "fleet_import_requests"):
+                             "fleet_import_requests",
+                             "fleet_prefetch_blocks"):
                     name = stat.replace("fleet_", "")
                     metrics.counter_fn(f"kvfleet.{name}",
                                        lambda self=self, stat=stat:
@@ -462,6 +498,14 @@ class ServingEngine:
                 {"k": jnp.zeros(d_shape, draft_cfg.dtype),
                  "v": jnp.zeros(d_shape, draft_cfg.dtype)}
                 for _ in range(draft_cfg.n_layers)]
+            if mesh is not None:
+                # The draft pool shards exactly like the target's: the
+                # kv-head axis over tp (the one SERVING_POOL_RULES
+                # registry), tables/positions replicated.
+                self._draft_pool_specs = pool_pspecs(
+                    self._draft_pools, mesh)
+                self._draft_pools = device_put_tree(
+                    self._draft_pools, self._draft_pool_specs, mesh)
             self._draft_tables = jnp.asarray(
                 1 + np.arange(n * m, dtype=np.int32).reshape(n, m))
         self._draft_pos = np.zeros((n,), np.int32)
@@ -477,6 +521,7 @@ class ServingEngine:
         rep = PartitionSpec()
         impl = self.decode_impl
         quant = self._quantized
+        mfn = self._moe_fn   # static per engine: the ep MoE dispatch
         dbg = self.debug        # static: only debug engines pay for the
                                 # in-program quant-error measurement
 
@@ -496,7 +541,7 @@ class ServingEngine:
         self._prefill_fn = self._wrap(compile_step(
             lambda params, tokens, length, table, pools: paged_prefill(
                 params, cfg, tokens, length, table, pools,
-                measure_qerr=dbg),
+                measure_qerr=dbg, moe_fn=mfn),
             plan((p_specs, rep, rep, rep, k_specs), (4,))))
         # One fused program per decode iteration: forward + in-program key
         # fold + sampler — per-step dispatch overhead is the engine's whole
@@ -511,21 +556,23 @@ class ServingEngine:
                 tops, keys, ngen, qa, pools: decode_and_sample(
                     params, cfg, tokens, positions, tables, active, temps,
                     tops, keys, ngen, pools, qa, attn_impl=impl, mesh=mesh,
-                    measure_qerr=dbg),
+                    measure_qerr=dbg, moe_fn=mfn),
                 plan((p_specs, rep, rep, rep, rep, rep, rep, rep, rep,
                       rep, k_specs), (10,))))
             self._decode_greedy_fn = self._wrap(compile_step(
                 lambda params, tokens, positions, tables, active, qa,
                 pools: greedy_decode_step(
                     params, cfg, tokens, positions, tables, active, pools,
-                    qa, attn_impl=impl, mesh=mesh, measure_qerr=dbg),
+                    qa, attn_impl=impl, mesh=mesh, measure_qerr=dbg,
+                    moe_fn=mfn),
                 plan((p_specs, rep, rep, rep, rep, rep, k_specs), (6,))))
         else:
             self._decode_fn = self._wrap(compile_step(
                 lambda params, tokens, positions, tables, active, temps,
                 tops, keys, ngen, pools: decode_and_sample(
                     params, cfg, tokens, positions, tables, active, temps,
-                    tops, keys, ngen, pools, attn_impl=impl, mesh=mesh),
+                    tops, keys, ngen, pools, attn_impl=impl, mesh=mesh,
+                    moe_fn=mfn),
                 plan((p_specs, rep, rep, rep, rep, rep, rep, rep, rep,
                       k_specs), (9,))))
             # Greedy fast path: when every active slot decodes at
@@ -536,7 +583,7 @@ class ServingEngine:
                 lambda params, tokens, positions, tables, active, pools:
                 greedy_decode_step(params, cfg, tokens, positions, tables,
                                    active, pools, attn_impl=impl,
-                                   mesh=mesh),
+                                   mesh=mesh, moe_fn=mfn),
                 plan((p_specs, rep, rep, rep, rep, k_specs), (5,))))
         # K-token micro-steps (ROADMAP item 4): ONE program runs micro_k
         # sequential decode iterations with in-program eos/length
@@ -552,7 +599,8 @@ class ServingEngine:
                     limits, eos, qa, pools: micro_decode_greedy(
                         params, cfg, tokens, positions, tables, active,
                         limits, eos, pools, qa, micro_k=mk,
-                        attn_impl=impl, mesh=mesh, measure_qerr=dbg),
+                        attn_impl=impl, mesh=mesh, measure_qerr=dbg,
+                        moe_fn=mfn),
                     plan((p_specs, rep, rep, rep, rep, rep, rep, rep,
                           k_specs), (8,))))
                 self._micro_sample_fn = self._wrap(compile_step(
@@ -562,7 +610,7 @@ class ServingEngine:
                         params, cfg, tokens, positions, tables, active,
                         limits, eos, temps, tops, keys, ngen, pools, qa,
                         micro_k=mk, attn_impl=impl, mesh=mesh,
-                        measure_qerr=dbg),
+                        measure_qerr=dbg, moe_fn=mfn),
                     plan((p_specs, rep, rep, rep, rep, rep, rep, rep,
                           rep, rep, rep, rep, k_specs), (12,))))
             else:
@@ -571,7 +619,7 @@ class ServingEngine:
                     limits, eos, pools: micro_decode_greedy(
                         params, cfg, tokens, positions, tables, active,
                         limits, eos, pools, micro_k=mk, attn_impl=impl,
-                        mesh=mesh),
+                        mesh=mesh, moe_fn=mfn),
                     plan((p_specs, rep, rep, rep, rep, rep, rep,
                           k_specs), (7,))))
                 self._micro_sample_fn = self._wrap(compile_step(
@@ -580,7 +628,8 @@ class ServingEngine:
                     micro_decode_sample(
                         params, cfg, tokens, positions, tables, active,
                         limits, eos, temps, tops, keys, ngen, pools,
-                        micro_k=mk, attn_impl=impl, mesh=mesh),
+                        micro_k=mk, attn_impl=impl, mesh=mesh,
+                        moe_fn=mfn),
                     plan((p_specs, rep, rep, rep, rep, rep, rep, rep,
                           rep, rep, rep, k_specs), (11,))))
         self._prefill_sample_fn = self._wrap(jax.jit(
@@ -610,34 +659,43 @@ class ServingEngine:
                     pools, dsts, values),
                 PartitionPlan(donate=(0,))))
         if self._spec_on:
-            # Target scoring: the chunked multi-token step at width k+1.
+            # Target scoring: the chunked multi-token step at width k+1
+            # — under a mesh it rides the SAME plan family as the
+            # decode programs (weights/pools pinned, host arrays
+            # replicated), closing PR 8's single-chip note.
             if quant:
                 self._spec_greedy_fn = self._wrap(compile_step(
                     lambda params, tokens, positions, valid, tables, qa,
                     pools: spec_score_greedy(
                         params, cfg, tokens, positions, valid, tables,
-                        pools, qa, attn_impl=impl, measure_qerr=dbg),
-                    PartitionPlan(donate=(6,))))
+                        pools, qa, attn_impl=impl, mesh=mesh,
+                        measure_qerr=dbg, moe_fn=mfn),
+                    plan((p_specs, rep, rep, rep, rep, rep, k_specs),
+                         (6,))))
                 self._spec_probs_fn = self._wrap(compile_step(
                     lambda params, tokens, positions, valid, tables,
                     temps, tops, qa, pools: spec_score_probs(
                         params, cfg, tokens, positions, valid, tables,
                         temps, tops, pools, qa, attn_impl=impl,
-                        measure_qerr=dbg),
-                    PartitionPlan(donate=(8,))))
+                        mesh=mesh, measure_qerr=dbg, moe_fn=mfn),
+                    plan((p_specs, rep, rep, rep, rep, rep, rep, rep,
+                          k_specs), (8,))))
             else:
                 self._spec_greedy_fn = self._wrap(compile_step(
                     lambda params, tokens, positions, valid, tables,
                     pools: spec_score_greedy(
                         params, cfg, tokens, positions, valid, tables,
-                        pools, attn_impl=impl),
-                    PartitionPlan(donate=(5,))))
+                        pools, attn_impl=impl, mesh=mesh, moe_fn=mfn),
+                    plan((p_specs, rep, rep, rep, rep, k_specs),
+                         (5,))))
                 self._spec_probs_fn = self._wrap(compile_step(
                     lambda params, tokens, positions, valid, tables,
                     temps, tops, pools: spec_score_probs(
                         params, cfg, tokens, positions, valid, tables,
-                        temps, tops, pools, attn_impl=impl),
-                    PartitionPlan(donate=(7,))))
+                        temps, tops, pools, attn_impl=impl, mesh=mesh,
+                        moe_fn=mfn),
+                    plan((p_specs, rep, rep, rep, rep, rep, rep,
+                          k_specs), (7,))))
             # Draft programs: plain decode step (proposals) + multi-token
             # chunk (prompt ingestion / catch-up), compiled on draft_cfg.
             # The draft pool stays in the model dtype (it is small — the
@@ -660,18 +718,33 @@ class ServingEngine:
                     RuntimeWarning)
                 draft_impl = "xla"
             self.draft_decode_impl = draft_impl
+            dmfn = serving_moe_fn(draft_cfg, mesh)
+            d_specs = getattr(self, '_draft_pool_specs', None)
+            dp_specs = getattr(self, '_draft_param_specs', None)
+
+            def draft_plan(arg_specs, donate):
+                if mesh is None:
+                    return PartitionPlan(donate=donate)
+                return PartitionPlan(mesh=mesh, in_specs=arg_specs,
+                                     out_specs=(rep, d_specs),
+                                     donate=donate)
+
             self._draft_decode_fn = self._wrap(compile_step(
                 lambda params, tokens, positions, tables, active, pools:
                 greedy_decode_step(params, draft_cfg, tokens, positions,
                                    tables, active, pools,
-                                   attn_impl=draft_impl),
-                PartitionPlan(donate=(5,))))
+                                   attn_impl=draft_impl, mesh=mesh,
+                                   moe_fn=dmfn),
+                draft_plan((dp_specs, rep, rep, rep, rep, d_specs),
+                           (5,))))
             self._draft_chunk_fn = self._wrap(compile_step(
                 lambda params, tokens, positions, valid, last_idx, tables,
                 pools: chunked_step_greedy(
                     params, draft_cfg, tokens, positions, valid, last_idx,
-                    tables, pools, attn_impl=draft_impl),
-                PartitionPlan(donate=(6,))))
+                    tables, pools, attn_impl=draft_impl, mesh=mesh,
+                    moe_fn=dmfn),
+                draft_plan((dp_specs, rep, rep, rep, rep, rep, d_specs),
+                           (6,))))
             # Rejection-sampling uniforms for a WHOLE round in one call:
             # (slots, k+1, 2) — two uniforms per (request, absolute
             # position), derived exactly as the per-position contract
@@ -1060,21 +1133,44 @@ class ServingEngine:
     def _fleet_import(self, ctx: np.ndarray, have: int) -> List[int]:
         """Import the consecutive full-block tail of ``ctx`` that the
         local prefix cache missed (``have`` = local hit depth in blocks)
-        from the fleet KV plane: look the chained hashes up in the fleet
-        index, fetch each payload, write it into a freshly allocated
-        local block, and adopt it into the local prefix cache under its
-        hash. Any failure — index hole, stale entry (missing object),
-        torn payload, pool pressure — STOPS the import and the remaining
-        tail prefills locally; a wrong stream is impossible because a
-        payload is only adopted under the hash naming its exact token
-        prefix. Returns the imported physical blocks in chain order (the
-        caller appends them to its cached-prefix list; their allocation
-        refcount is the admitting slot's reference)."""
+        from the fleet KV plane. Any failure — index hole, stale entry
+        (missing object), torn payload, pool pressure — STOPS the import
+        and the remaining tail prefills locally; a wrong stream is
+        impossible because a payload is only adopted under the hash
+        naming its exact token prefix. Returns the imported physical
+        blocks in chain order (the caller appends them to its
+        cached-prefix list; their allocation refcount is the admitting
+        slot's reference)."""
         hashes = chain_block_hashes(ctx, self.scfg.block_size)
         want = hashes[have:]
         if not want:
             return []
         t0 = time.perf_counter()
+        imported = self._import_hash_chain(want)
+        self.fleet_hit_blocks += len(imported)
+        self.fleet_miss_blocks += len(want) - len(imported)
+        if imported:
+            self.fleet_import_requests += 1
+            if self._h_kv_import is not None:
+                self._h_kv_import.observe(time.perf_counter() - t0)
+        return imported
+
+    def _import_hash_chain(self, want: List[bytes]) -> List[int]:
+        """The fetch+write+adopt core shared by admission imports and
+        prefetch-ahead hints: look ``want`` (consecutive chained hashes)
+        up in the fleet index, fetch each payload, write the whole chain
+        into freshly allocated local blocks in ONE batched dispatch, and
+        adopt each under its hash. Returns the imported blocks (each at
+        allocation refcount 1 AND cache-retained — the caller keeps the
+        ref for a slot table, or drops it to leave the block cached).
+        Chains clamp to ``max_blocks_per_slot`` — the batched write's
+        fixed pad width (admission chains can never exceed it; a
+        router-supplied prefetch hint CAN, e.g. from a pool with a
+        larger max_len, and must degrade to a shorter import, not an
+        error after blocks were already allocated)."""
+        want = want[:self.scfg.max_blocks_per_slot]
+        if not want:
+            return []
         try:
             n_hit = self._fleet.lookup_chain(want)
         except OSError:
@@ -1120,13 +1216,34 @@ class ServingEngine:
                 stacked)
             for (h, _), block in zip(payloads, imported):
                 self._pcache.adopt(h, block)
-        self.fleet_hit_blocks += len(imported)
-        self.fleet_miss_blocks += len(want) - len(imported)
-        if imported:
-            self.fleet_import_requests += 1
-            if self._h_kv_import is not None:
-                self._h_kv_import.observe(time.perf_counter() - t0)
         return imported
+
+    def prefetch_chain(self, hashes: List[bytes]) -> int:
+        """Prefetch-ahead import (the router's next-turn hint): pull a
+        published chain into the LOCAL prefix cache before any request
+        references it, so the session's next turn admits on a warm cache
+        instead of paying the fleet fetch on its TTFT path. Leading
+        hashes already cached are skipped (consecutive — a mid-chain
+        hole stops the prefetch exactly like an index hole stops an
+        admission import); imported blocks are left cache-retained at
+        refcount 0, the same state a released cached block sits in, so
+        pool pressure can evict them LRU like anything else cached.
+        Best-effort by contract: every failure arm degrades to a smaller
+        (possibly empty) prefetch, never an error to the hinter."""
+        if self._fleet is None or self._pcache is None or not hashes:
+            return 0
+        have = 0
+        for h in hashes:
+            if not self._pcache.has(h):
+                break
+            have += 1
+        imported = self._import_hash_chain(list(hashes[have:]))
+        for block in imported:
+            # adopt() retained the block; dropping the allocation ref
+            # leaves it cached at ref 0 (off the free list, evictable).
+            self.allocator.decref(block)
+        self.fleet_prefetch_blocks += len(imported)
+        return len(imported)
 
     def export_cached_blocks(self, limit: int = 16,
                              skip=()) -> List[Tuple[str, bytes]]:
@@ -1960,6 +2077,7 @@ class ServingEngine:
             "prefill_chunks": self.prefill_chunks,
             "recompute_preemptions": self.preemption_count,
             "tp": self.tp,
+            "ep": self.ep,
             # Which paged attention the fused steps COMPILED with — a
             # silent auto-fallback to the gather path is visible here, so
             # benches and soaks record which path actually ran.
@@ -2006,6 +2124,10 @@ class ServingEngine:
                 "hit_blocks": self.fleet_hit_blocks,
                 "miss_blocks": self.fleet_miss_blocks,
                 "import_requests": self.fleet_import_requests,
+                # Prefetch-ahead imports (router next-turn hints):
+                # blocks pulled into the local cache BEFORE any
+                # request referenced them.
+                "prefetch_blocks": self.fleet_prefetch_blocks,
                 # Publisher-side (client-owned): what this replica shipped
                 # out and pulled in, in bytes.
                 "published_blocks": getattr(

@@ -66,6 +66,73 @@ def pool_is_quantized(pools: List[dict]) -> bool:
     return "k_scale" in pools[0]
 
 
+def serving_moe_fn(cfg: TransformerConfig, mesh):
+    """The expert-parallel MoE dispatch for the fused serving steps — or
+    None when there is nothing to dispatch over (no MoE layers, no mesh,
+    or no ``ep`` axis wider than 1), in which case ``_block`` falls back
+    to the dense-dispatch reference (``moe.apply_dense``), the exact
+    single-chip arithmetic every sharded stream is pinned against.
+
+    The dispatch is :func:`tpu_task.ml.models.moe.apply_sharded` — the
+    SAME all_to_all program training uses — specialized for serving:
+
+    - **Row layout**: every fused step's activations are (rows, w, d)
+      with rows ∈ {slots, slots + chunk_tokens, 1} and w ∈ {1, bucket,
+      k+1}; the dispatch flattens to (rows·w, 1, d) token rows, pads to
+      an ep multiple with zero rows (static shapes — one program per
+      step geometry, like everything else serving compiles), shards the
+      token axis over ep, and un-pads on the way out. The dense compute
+      between MoE layers stays on the jit/SPMD path — only the expert
+      FFN enters shard_map.
+    - **Droplessness**: capacity is pinned to the per-shard token count,
+      so every row — real, masked-inactive, or pad — holds a capacity
+      slot and none can evict another. That is what makes the ep path's
+      greedy streams identical to the dense dispatch (which has no
+      capacity limit at all): per token, both compute the same
+      gate-weighted expert dot products; a capacity drop would be the
+      one divergence, so it is made impossible by construction.
+    - **tp×ep**: with a ``tp`` axis in the mesh the expert weights'
+      hidden dim additionally shards over tp (the registry's
+      ``("expert", "embed", "mlp")`` placement consumed in place — no
+      per-step all-gather of expert weights), completed by one psum.
+    - The router aux loss is computed (shared code path) and discarded
+      by serving — decode has no loss to regularize."""
+    if mesh is None or cfg.moe_every <= 0:
+        return None
+    from tpu_task.ml.models import moe
+    from tpu_task.ml.parallel.sharding import mesh_axis_size
+
+    ep = mesh_axis_size(mesh, "ep")
+    if ep == 1:
+        return None
+    if cfg.n_experts % ep:
+        raise ValueError(
+            f"n_experts {cfg.n_experts} not divisible by ep={ep} "
+            f"(mesh axes {tuple(mesh.axis_names)}): expert weights shard "
+            "one group per ep shard")
+    mcfg = cfg.moe_cfg
+    tp_axis = "tp" if mesh_axis_size(mesh, "tp") > 1 else None
+
+    def fn(layer, h):
+        b, s, d = h.shape
+        rows = b * s
+        pad = (-rows) % ep
+        flat = h.reshape(rows, 1, d)
+        if pad:
+            # jnp.pad, NOT concatenate-with-zeros: under an outer jit on
+            # a tp×ep mesh, XLA SPMD (jax 0.4.x CPU) miscompiles a
+            # concatenate feeding the shard_map's token slicing (every
+            # row's values corrupt, not just low bits — caught by the
+            # ep-vs-dense stream pin); pad lowers to a clean slice.
+            flat = jnp.pad(flat, ((0, pad), (0, 0), (0, 0)))
+        out, aux = moe.apply_sharded(
+            layer, mcfg, flat, mesh, batch_axes=("ep",), tp_axis=tp_axis,
+            capacity=(rows + pad) // ep)
+        return out[:rows].reshape(b, s, d), aux
+
+    return fn
+
+
 def _fold_qerr(qerrs: List[jax.Array]) -> jax.Array:
     """Max write-quantization error across a step's layers."""
     return functools.reduce(jnp.maximum, qerrs)
@@ -73,7 +140,7 @@ def _fold_qerr(qerrs: List[jax.Array]) -> jax.Array:
 
 def paged_prefill(params: Params, cfg: TransformerConfig, tokens, length,
                   block_table, pools: List[dict], *,
-                  measure_qerr: bool = False):
+                  measure_qerr: bool = False, moe_fn=None):
     """One request's prompt through the model, writing its k/v into the
     paged pool. ``tokens``: (1, bucket) right-padded to a prefill bucket;
     ``length``: the real prompt length (may be traced — one compile per
@@ -133,7 +200,8 @@ def paged_prefill(params: Params, cfg: TransformerConfig, tokens, length,
                     v[0]).reshape(pool["v"].shape)
             return gqa_cached_attention(q, k, v, positions)
 
-        x, _aux = _block(x, layer, cfg, attn_fn, positions=positions)
+        x, _aux = _block(x, layer, cfg, attn_fn, positions=positions,
+                         moe_fn=moe_fn)
         new_pools.append(updated)
     x = _rmsnorm(x, params["final_norm"])
     logits = x[:, length - 1] @ params["unembed"].astype(cfg.dtype)
@@ -145,7 +213,7 @@ def paged_prefill(params: Params, cfg: TransformerConfig, tokens, length,
 def paged_decode_step(params: Params, cfg: TransformerConfig, tokens,
                       positions, block_tables, active, pools: List[dict],
                       qa=None, *, attn_impl: str = "xla", mesh=None,
-                      measure_qerr: bool = False):
+                      measure_qerr: bool = False, moe_fn=None):
     """ONE decode step across every slot: each slot's last token in, each
     slot's next-token logits out. ``tokens``: (slots,) int32; ``positions``:
     (slots,) — the absolute position each new token occupies (per-slot: no
@@ -202,7 +270,8 @@ def paged_decode_step(params: Params, cfg: TransformerConfig, tokens,
             v_view = gather_kv(vf, block_tables, block_size)
             return gqa_cached_attention(q, k_view, v_view, pos2d)
 
-        x, _aux = _block(x, layer, cfg, attn_fn, positions=pos2d)
+        x, _aux = _block(x, layer, cfg, attn_fn, positions=pos2d,
+                         moe_fn=moe_fn)
         new_pools.append(updated)
     x = _rmsnorm(x, params["final_norm"])
     logits = x[:, -1] @ params["unembed"].astype(cfg.dtype)
@@ -214,14 +283,15 @@ def paged_decode_step(params: Params, cfg: TransformerConfig, tokens,
 def greedy_decode_step(params: Params, cfg: TransformerConfig, tokens,
                        positions, block_tables, active, pools, qa=None, *,
                        attn_impl: str = "xla", mesh=None,
-                       measure_qerr: bool = False):
+                       measure_qerr: bool = False, moe_fn=None):
     """Fused decode + argmax: the greedy fast path of the engine — when
     every active slot decodes at temperature 0 the sampler reduces to one
     argmax and the step program carries no sort/cumsum/key-fold. Returns
     ((slots,) int32 next tokens, pools[, max quant error])."""
     out = paged_decode_step(
         params, cfg, tokens, positions, block_tables, active, pools, qa,
-        attn_impl=attn_impl, mesh=mesh, measure_qerr=measure_qerr)
+        attn_impl=attn_impl, mesh=mesh, measure_qerr=measure_qerr,
+        moe_fn=moe_fn)
     toks = jnp.argmax(out[0], axis=-1).astype(jnp.int32)
     return (toks,) + tuple(out[1:])
 
@@ -230,7 +300,8 @@ def greedy_decode_step(params: Params, cfg: TransformerConfig, tokens,
 
 def _micro_scan(params: Params, cfg: TransformerConfig, tokens, positions,
                 block_tables, active, limits, eos, pools, qa, micro_k: int,
-                sampler, attn_impl: str, mesh, measure_qerr: bool):
+                sampler, attn_impl: str, mesh, measure_qerr: bool,
+                moe_fn=None):
     """Run ``micro_k`` SEQUENTIAL decode iterations inside one program —
     the engine's per-token host loop folded into a ``lax.scan`` whose
     body is exactly :func:`paged_decode_step` plus the sampler plus the
@@ -277,7 +348,7 @@ def _micro_scan(params: Params, cfg: TransformerConfig, tokens, positions,
         out = paged_decode_step(
             params, cfg, tok, jnp.where(alive, pos, 0), block_tables,
             alive, pools, qa_j, attn_impl=attn_impl, mesh=mesh,
-            measure_qerr=measure_qerr)
+            measure_qerr=measure_qerr, moe_fn=moe_fn)
         logits, pools = out[0], out[1]
         nxt = sampler(logits, alive, emitted)
         emitted = emitted + alive.astype(jnp.int32)
@@ -301,7 +372,7 @@ def micro_decode_greedy(params: Params, cfg: TransformerConfig, tokens,
                         positions, block_tables, active, limits, eos,
                         pools, qa=None, *, micro_k: int,
                         attn_impl: str = "xla", mesh=None,
-                        measure_qerr: bool = False):
+                        measure_qerr: bool = False, moe_fn=None):
     """Greedy K-token micro-step: ``micro_k`` fused decode+argmax
     iterations, ONE dispatch, ONE (micro_k, slots) readback — the
     steady-state program that takes dispatch overhead from one-per-token
@@ -313,14 +384,15 @@ def micro_decode_greedy(params: Params, cfg: TransformerConfig, tokens,
 
     return _micro_scan(params, cfg, tokens, positions, block_tables,
                        active, limits, eos, pools, qa, micro_k, sampler,
-                       attn_impl, mesh, measure_qerr)
+                       attn_impl, mesh, measure_qerr, moe_fn=moe_fn)
 
 
 def micro_decode_sample(params: Params, cfg: TransformerConfig, tokens,
                         positions, block_tables, active, limits, eos,
                         temperature, top_p, slot_keys, n_generated, pools,
                         qa=None, *, micro_k: int, attn_impl: str = "xla",
-                        mesh=None, measure_qerr: bool = False):
+                        mesh=None, measure_qerr: bool = False,
+                        moe_fn=None):
     """Sampled K-token micro-step: per-iteration keys fold in-program
     from the running n_generated (``fold_in(slot_keys[i], ngen)``) — the
     identical per-token key stream K=1's ``decode_and_sample`` draws, so
@@ -333,14 +405,14 @@ def micro_decode_sample(params: Params, cfg: TransformerConfig, tokens,
 
     return _micro_scan(params, cfg, tokens, positions, block_tables,
                        active, limits, eos, pools, qa, micro_k, sampler,
-                       attn_impl, mesh, measure_qerr)
+                       attn_impl, mesh, measure_qerr, moe_fn=moe_fn)
 
 
 def decode_and_sample(params: Params, cfg: TransformerConfig, tokens,
                       positions, block_tables, active, temperature, top_p,
                       slot_keys, n_generated, pools, qa=None, *,
                       attn_impl: str = "xla", mesh=None,
-                      measure_qerr: bool = False):
+                      measure_qerr: bool = False, moe_fn=None):
     """Fused decode step + sampler: ONE program (one dispatch, one (slots,)
     readback) per engine iteration — the serving analogue of ``generate``
     folding its sampler into the scan body. Per-token sampling keys are
@@ -350,7 +422,8 @@ def decode_and_sample(params: Params, cfg: TransformerConfig, tokens,
     pools[, max quant error])."""
     out = paged_decode_step(
         params, cfg, tokens, positions, block_tables, active, pools, qa,
-        attn_impl=attn_impl, mesh=mesh, measure_qerr=measure_qerr)
+        attn_impl=attn_impl, mesh=mesh, measure_qerr=measure_qerr,
+        moe_fn=moe_fn)
     keys = jax.vmap(jax.random.fold_in)(slot_keys, n_generated)
     toks = sample_tokens(out[0], temperature, top_p, keys)
     return (toks,) + tuple(out[1:])
@@ -361,7 +434,7 @@ def decode_and_sample(params: Params, cfg: TransformerConfig, tokens,
 def _multitoken_features(params: Params, cfg: TransformerConfig, tokens,
                          positions, valid, block_tables, pools, qa=None, *,
                          attn_impl: str = "xla", mesh=None,
-                         measure_qerr: bool = False):
+                         measure_qerr: bool = False, moe_fn=None):
     """The width-``w`` generalization of ``paged_decode_step``: run
     ``tokens`` (slots, w) through the model with PER-TOKEN absolute
     ``positions`` (slots, w) and a ``valid`` mask (slots, w), scattering
@@ -433,7 +506,8 @@ def _multitoken_features(params: Params, cfg: TransformerConfig, tokens,
             v_view = gather_kv(vf, block_tables, block_size)
             return gqa_cached_attention(q, k_view, v_view, qpos)
 
-        x, _aux = _block(x, layer, cfg, attn_fn, positions=qpos)
+        x, _aux = _block(x, layer, cfg, attn_fn, positions=qpos,
+                         moe_fn=moe_fn)
         new_pools.append(updated)
     feats = _rmsnorm(x, params["final_norm"])
     if quantized:
@@ -444,13 +518,14 @@ def _multitoken_features(params: Params, cfg: TransformerConfig, tokens,
 def paged_multitoken_logits(params: Params, cfg: TransformerConfig, tokens,
                             positions, valid, block_tables, pools, qa=None,
                             *, attn_impl: str = "xla", mesh=None,
-                            measure_qerr: bool = False):
+                            measure_qerr: bool = False, moe_fn=None):
     """Full-width logits (slots, w, vocab) float32 — the speculative
     scoring step: ONE fused target pass scores all k+1 positions of every
     slot's [last_token, draft_1..draft_k] row against the paged cache."""
     out = _multitoken_features(
         params, cfg, tokens, positions, valid, block_tables, pools, qa,
-        attn_impl=attn_impl, mesh=mesh, measure_qerr=measure_qerr)
+        attn_impl=attn_impl, mesh=mesh, measure_qerr=measure_qerr,
+        moe_fn=moe_fn)
     logits = out[0] @ params["unembed"].astype(cfg.dtype)
     return (logits.astype(jnp.float32),) + tuple(out[1:])
 
@@ -458,20 +533,21 @@ def paged_multitoken_logits(params: Params, cfg: TransformerConfig, tokens,
 def spec_score_greedy(params: Params, cfg: TransformerConfig, tokens,
                       positions, valid, block_tables, pools, qa=None, *,
                       attn_impl: str = "xla", mesh=None,
-                      measure_qerr: bool = False):
+                      measure_qerr: bool = False, moe_fn=None):
     """Fused speculative scoring + argmax: (slots, w) int32 target tokens
     — the greedy accept rule (longest agreeing prefix + bonus token) runs
     on these host-side and is bit-identical to non-speculative decoding."""
     out = paged_multitoken_logits(
         params, cfg, tokens, positions, valid, block_tables, pools, qa,
-        attn_impl=attn_impl, mesh=mesh, measure_qerr=measure_qerr)
+        attn_impl=attn_impl, mesh=mesh, measure_qerr=measure_qerr,
+        moe_fn=moe_fn)
     return (jnp.argmax(out[0], axis=-1).astype(jnp.int32),) + tuple(out[1:])
 
 
 def spec_score_probs(params: Params, cfg: TransformerConfig, tokens,
                      positions, valid, block_tables, temperature, top_p,
                      pools, qa=None, *, attn_impl: str = "xla", mesh=None,
-                     measure_qerr: bool = False):
+                     measure_qerr: bool = False, moe_fn=None):
     """Fused speculative scoring for SAMPLED requests: per-position target
     probabilities (slots, w, vocab) float32 after the SAME temper-then-
     top_p filter ``sample_tokens`` applies — so host-side rejection
@@ -481,7 +557,8 @@ def spec_score_probs(params: Params, cfg: TransformerConfig, tokens,
     equals argmax(logits) — softmax is monotonic."""
     out = paged_multitoken_logits(
         params, cfg, tokens, positions, valid, block_tables, pools, qa,
-        attn_impl=attn_impl, mesh=mesh, measure_qerr=measure_qerr)
+        attn_impl=attn_impl, mesh=mesh, measure_qerr=measure_qerr,
+        moe_fn=moe_fn)
     logits = out[0]
     safe_t = jnp.where(temperature > 0, temperature, 1.0)
     filtered = _top_p_filter(
@@ -494,7 +571,7 @@ def spec_score_probs(params: Params, cfg: TransformerConfig, tokens,
 def chunked_step_greedy(params: Params, cfg: TransformerConfig, tokens,
                         positions, valid, last_idx, block_tables, pools,
                         qa=None, *, attn_impl: str = "xla", mesh=None,
-                        measure_qerr: bool = False):
+                        measure_qerr: bool = False, moe_fn=None):
     """Fused multi-row chunk ingestion: every row advances by its own
     ``valid`` span and emits the argmax at its LAST valid position
     (``last_idx``: (slots,)); mid-prompt rows' outputs are discarded by
@@ -505,7 +582,8 @@ def chunked_step_greedy(params: Params, cfg: TransformerConfig, tokens,
     ((slots,) int32, pools[, max quant error])."""
     out = _multitoken_features(
         params, cfg, tokens, positions, valid, block_tables, pools, qa,
-        attn_impl=attn_impl, mesh=mesh, measure_qerr=measure_qerr)
+        attn_impl=attn_impl, mesh=mesh, measure_qerr=measure_qerr,
+        moe_fn=moe_fn)
     slots = tokens.shape[0]
     last = out[0][jnp.arange(slots), last_idx]      # (slots, d_model)
     logits = (last @ params["unembed"].astype(cfg.dtype)).astype(jnp.float32)

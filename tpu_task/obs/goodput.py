@@ -133,25 +133,34 @@ def flops_for_positions(cfg, positions) -> float:
             + 4.0 * cfg.n_layers * cfg.d_attn * float(np.sum(pos + 1.0)))
 
 
-def decode_step_cost_analysis_flops(cfg, scfg) -> Optional[float]:
+def decode_step_cost_analysis_flops(cfg, scfg, mesh=None) -> Optional[float]:
     """XLA's own FLOP count for one fused greedy decode step (via
     ``jax.jit(...).lower().cost_analysis()``) — the cross-check that
     keeps the static model honest where the backend provides one.
-    Returns None when the backend exposes no cost analysis."""
+    ``mesh``: lower the SHARDED program instead (MoE configs get the ep
+    all_to_all dispatch threaded exactly as the engine compiles it; the
+    returned count is then the per-shard partition's). Returns None when
+    the backend exposes no cost analysis (or cannot analyze the sharded
+    program)."""
     try:
         import jax
         import jax.numpy as jnp
 
         from tpu_task.ml.models import transformer
         from tpu_task.ml.serving.cache import init_pools
-        from tpu_task.ml.serving.model import greedy_decode_step
+        from tpu_task.ml.serving.model import (
+            greedy_decode_step,
+            serving_moe_fn,
+        )
 
         params = transformer.init(jax.random.PRNGKey(0), cfg)
         pools = init_pools(cfg, scfg)
+        mfn = serving_moe_fn(cfg, mesh)
         n, m = scfg.slots, scfg.max_blocks_per_slot
         lowered = jax.jit(
             lambda p, t, pos, tab, act, pl: greedy_decode_step(
-                p, cfg, t, pos, tab, act, pl)).lower(
+                p, cfg, t, pos, tab, act, pl, mesh=mesh,
+                moe_fn=mfn)).lower(
             params, jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.int32),
             jnp.zeros((n, m), jnp.int32), jnp.ones((n,), bool), pools)
         analysis = lowered.cost_analysis()

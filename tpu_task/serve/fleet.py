@@ -50,6 +50,16 @@ __all__ = [
 class ServeSpec:
     """What one serving fleet is made of.
 
+    The ``tp``/``ep`` axes (sharded replicas): a replica is a
+    **tp×ep-chip gang** whose workers share ONE engine through a
+    ``("tp", "ep")`` device mesh — tp shards weights and the paged
+    pools' kv-head axis, ep places MoE expert weights one group per
+    shard and routes decode tokens through the all_to_all dispatch.
+    The gang the scheduler admits requests EXACTLY tp×ep chips
+    (``accelerator`` is derived when left None, validated against
+    tp×ep when set), so tenant quotas, fair-share deficits, and the
+    ``sched status`` chip columns stay honest for multi-chip replicas.
+
     The ``role`` axis (disaggregated prefill/decode, ROADMAP item 2):
     ``prefill_replicas > 0`` runs that many DEDICATED prompt-ingestion
     replicas next to the ``replicas`` decode pool. The router sends fresh
@@ -68,15 +78,55 @@ class ServeSpec:
     service: str
     tenant: str
     replicas: int = 2
-    accelerator: str = "v4-8"
+    accelerator: Optional[str] = None
     slices: int = 1
     priority: int = 1
     preset: str = "tiny"
     serving: Dict = field(default_factory=dict)
+    tp: int = 1
+    ep: int = 1
     prefill_replicas: int = 0
     prefill_serving: Dict = field(default_factory=dict)
     prefill_threshold: int = 64
     kv_bucket: Optional[str] = None
+
+    def __post_init__(self):
+        if self.tp < 1 or self.ep < 1:
+            raise ValueError(
+                f"tp and ep must be >= 1, got tp={self.tp} ep={self.ep}")
+        if self.kv_bucket and self.chips > 1:
+            raise ValueError(
+                "kv_bucket (fleet KV) is single-chip for now: block "
+                "payloads are unsharded — drop tp/ep or the bucket")
+        if self.accelerator is not None and self.chips > 1:
+            # The accounting contract: a sharded replica's gang must
+            # reserve exactly the chips its mesh uses, or every quota,
+            # deficit, and status column lies about the fleet.
+            from tpu_task.backends.tpu.accelerators import parse_accelerator
+
+            got = parse_accelerator(self.accelerator).chips * self.slices
+            if got != self.chips:
+                raise ValueError(
+                    f"accelerator {self.accelerator!r} × {self.slices} "
+                    f"slice(s) is {got} chips but the replica mesh needs "
+                    f"tp×ep = {self.chips}; drop accelerator= to derive "
+                    "an exact-fit slice")
+
+    @property
+    def chips(self) -> int:
+        """Chips one replica gang occupies — the mesh size its workers
+        share one engine over."""
+        return self.tp * self.ep
+
+    @property
+    def gang_accelerator(self) -> str:
+        """The accelerator string replica gangs are submitted with:
+        explicit ``accelerator`` when set (validated above), else the
+        smallest v4 slice holding exactly tp×ep chips (v4 sizes count
+        cores, 2 per chip)."""
+        if self.accelerator is not None:
+            return self.accelerator
+        return f"v4-{2 * self.chips}"
 
     def serving_for(self, role: str) -> Dict:
         """ServingConfig overrides for one role's replicas."""
@@ -101,7 +151,7 @@ class ServeSpec:
         `role` what the router keys the prefill/decode split on."""
         return {"kind": "serve", "service": self.service,
                 "replica": str(replica_index), "preset": self.preset,
-                "role": role,
+                "role": role, "tp": str(self.tp), "ep": str(self.ep),
                 "serving": json.dumps(self.serving_for(role),
                                       sort_keys=True)}
 
@@ -116,10 +166,11 @@ def replica_script(spec: ServeSpec, python: str = "python3",
     ``kv_bucket`` the replica also joins the fleet KV plane."""
     serving = json.dumps(spec.serving_for(role))
     kv = f"--kv-bucket '{spec.kv_bucket}' " if spec.kv_bucket else ""
+    shard = (f"--tp {spec.tp} --ep {spec.ep} " if spec.chips > 1 else "")
     return (
         "#!/bin/bash\n"
         f"exec {python} -m tpu_task.serve.replica "
-        f"--preset {spec.preset} --serving '{serving}' {kv}"
+        f"--preset {spec.preset} --serving '{serving}' {kv}{shard}"
         "--endpoint-file endpoint.json --drain-file inflight.json\n")
 
 
@@ -148,15 +199,20 @@ class InProcessServeDriver:
         from tpu_task.serve.replica import ReplicaServer
 
         serving = json.loads(task.payload.get("serving") or "{}")
+        tp = int(task.payload.get("tp", 1))
+        ep = int(task.payload.get("ep", 1))
         kv_client = None
-        if self.kv_backend is not None:
+        if self.kv_backend is not None and tp * ep == 1:
+            # Fleet KV is single-chip (unsharded block payloads);
+            # ServeSpec validation rejects the combination upstream —
+            # the guard here covers hand-built payloads.
             from tpu_task.serve.kvfleet import FleetKvClient
 
             kv_client = FleetKvClient(self.kv_backend,
                                       source=task.task_id)
         return ReplicaServer(
             preset=task.payload.get("preset", "tiny"), serving=serving,
-            kv_client=kv_client,
+            tp=tp, ep=ep, kv_client=kv_client,
             # A prefill replica's whole job is making blocks available to
             # the decode pool before the handoff lands — publish every
             # step; decode replicas publish on the relaxed default beat.
@@ -245,6 +301,14 @@ class ServeFleet:
             # block size silently turns block-aligned affinity back into
             # the raw-id hash the PR 10 bugfix replaced.
             router.block_size = spec.engine_block_size()
+        if spec.kv_bucket or getattr(scheduler.driver, "kv_backend",
+                                     None) is not None:
+            # A fleet with a KV plane gets prefetch-ahead hints: on a
+            # completed request, the router tells the next-turn affinity
+            # pick to pull the session's published chain before the
+            # request arrives (replicas without a fleet client answer 0
+            # imports — the hint is advisory either way).
+            router.prefetch_next_turn = True
         # SLO plane (PR 12): objectives evaluated fleet-wide over the
         # merged registry (router + every replica pulled this flush) in
         # flush_obs; breaches land as durable alert records under
@@ -300,7 +364,7 @@ class ServeFleet:
         tag = "p" if role == "prefill" else "r"
         task_id = f"{self.spec.service}-{tag}{index}"
         task = self.scheduler.submit(
-            self.spec.tenant, self.spec.accelerator,
+            self.spec.tenant, self.spec.gang_accelerator,
             slices=self.spec.slices, priority=self.spec.priority,
             task_id=task_id)
         task.payload.update(self.spec.payload(index, role=role))

@@ -82,25 +82,39 @@ MODEL_PRESETS: Dict[str, dict] = {
     # that still exercises GQA + paging.
     "micro": dict(seed=0, vocab_size=64, d_model=32, n_layers=2, n_heads=4,
                   d_head=8, d_ff=64, n_kv_heads=2),
+    # Mixture-of-experts: layer 1's FFN is a 4-expert MoE — the model
+    # class that NEEDS a multi-chip replica (expert weights shard one
+    # group per ep shard; kv_heads=4 admits tp up to 4). Serve it with
+    # ServeSpec(preset="moe", tp=..., ep=...).
+    "moe": dict(seed=0, vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+                d_head=8, d_ff=64, n_kv_heads=4, moe_every=2, n_experts=4),
 }
 
 #: ServingConfig defaults per preset — overridable via --serving / serving=.
 SERVING_PRESETS: Dict[str, dict] = {
     "tiny": dict(slots=4, block_size=8, n_blocks=96, max_len=128),
     "micro": dict(slots=4, block_size=4, n_blocks=64, max_len=48),
+    "moe": dict(slots=4, block_size=4, n_blocks=64, max_len=48),
 }
 
 
 def build_engine(preset: str = "tiny", serving: Optional[dict] = None,
                  rng_seed: int = 0, obs: Optional[Obs] = None,
-                 kv_client=None):
+                 kv_client=None, tp: int = 1, ep: int = 1):
     """A ServingEngine from a preset name: same name → same weights, same
     config, same streams, in any process. ``obs`` threads the PR 11
     observability handle through (None = the zero-overhead path);
     ``kv_client`` a :class:`~tpu_task.serve.kvfleet.FleetKvClient` for
-    fleet-wide prefix-cache sharing (None = replica-local cache only)."""
+    fleet-wide prefix-cache sharing (None = replica-local cache only).
+
+    ``tp``/``ep`` > 1 make this replica a MULTI-CHIP gang sharing one
+    engine: the process's first tp×ep devices form a ``("tp", "ep")``
+    mesh — on a real tp×ep-chip slice that is every chip of the gang
+    (the scheduler reserved exactly that many); in-process drivers get
+    the forced-host CPU platform's virtual devices."""
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
     from tpu_task.ml.models import transformer
     from tpu_task.ml.serving import ServingConfig, ServingEngine
@@ -114,9 +128,19 @@ def build_engine(preset: str = "tiny", serving: Optional[dict] = None,
     params = transformer.init(jax.random.PRNGKey(seed), cfg)
     knobs = dict(SERVING_PRESETS.get(preset, {}))
     knobs.update(serving or {})
+    mesh = None
+    if tp * ep > 1:
+        devices = jax.devices()
+        if len(devices) < tp * ep:
+            raise ValueError(
+                f"replica mesh needs tp×ep = {tp * ep} devices, the "
+                f"process sees {len(devices)} (forced-host CPU platforms "
+                "set XLA_FLAGS=--xla_force_host_platform_device_count)")
+        mesh = jax.sharding.Mesh(
+            np.asarray(devices[:tp * ep]).reshape(tp, ep), ("tp", "ep"))
     return ServingEngine(params, cfg, ServingConfig(**knobs),
                          rng=jax.random.PRNGKey(rng_seed), obs=obs,
-                         kv_fleet=kv_client)
+                         kv_fleet=kv_client, mesh=mesh)
 
 
 class _JSONHandler(BaseHTTPRequestHandler):
@@ -223,6 +247,9 @@ class _JSONHandler(BaseHTTPRequestHandler):
             elif path == "/drain":
                 replica.begin_drain()
                 self._reply({"ok": True, "draining": True})
+            elif path == "/prefetch":
+                self._reply({"imported": replica.prefetch(
+                    payload.get("hashes") or [])})
             else:
                 self._reply({"error": f"no such path {path!r}"}, 404)
         except (KeyError, ValueError, TypeError) as error:
@@ -256,7 +283,8 @@ class ReplicaServer:
                  serving: Optional[dict] = None, host: str = "127.0.0.1",
                  port: int = 0, drain_file: Optional[str] = None,
                  obs_enabled: bool = True, profile_dir: str = "profiles",
-                 kv_client=None, kv_publish_every: int = 20):
+                 kv_client=None, kv_publish_every: int = 20,
+                 tp: int = 1, ep: int = 1):
         self.boot_id = uuid.uuid4().hex[:12]
         #: One tracer + registry for the whole replica (front end AND
         #: engine — the engine records into the same registry, so /stats
@@ -273,7 +301,8 @@ class ReplicaServer:
         self.kv_publish_every = max(1, kv_publish_every)
         self._steps_since_publish = 0
         self.engine = engine if engine is not None else build_engine(
-            preset, serving, obs=self.obs, kv_client=kv_client)
+            preset, serving, obs=self.obs, kv_client=kv_client, tp=tp,
+            ep=ep)
         self.draining = False
         self.drain_file = drain_file
         self.profile_dir = profile_dir
@@ -490,6 +519,20 @@ class ReplicaServer:
                         "draining": self.draining}
             time.sleep(0.002)
 
+    def prefetch(self, hashes) -> int:
+        """``POST /prefetch``: the router's prefetch-ahead hint — pull a
+        published chain (hex hash list, leading-consecutive) from the
+        fleet KV plane into the local prefix cache BEFORE the session's
+        next turn arrives. Best-effort: malformed hashes and engines
+        without a fleet client answer 0 imports, never an error (the
+        hint is advisory by contract)."""
+        try:
+            chain = [bytes.fromhex(str(h)) for h in hashes]
+        except ValueError:
+            return 0
+        with self._lock:
+            return self.engine.prefetch_chain(chain)
+
     def stats(self) -> dict:
         with self._lock:
             stats = self.engine.stats()
@@ -540,6 +583,12 @@ def main(argv=None) -> int:
                              "the task bucket for router discovery)")
     parser.add_argument("--drain-file", default="inflight.json",
                         help="graceful-drain export destination")
+    parser.add_argument("--tp", type=int, default=1,
+                        help="tensor-parallel width of this replica's mesh "
+                             "(the gang's chips = tp*ep share ONE engine)")
+    parser.add_argument("--ep", type=int, default=1,
+                        help="expert-parallel width (MoE presets: expert "
+                             "weights shard one group per ep shard)")
     parser.add_argument("--no-obs", action="store_true",
                         help="disable tracing/metrics (the documented "
                              "zero-overhead path)")
@@ -563,7 +612,8 @@ def main(argv=None) -> int:
         preset=args.preset, serving=json.loads(args.serving),
         host=args.host, port=args.port,
         drain_file=os.path.abspath(args.drain_file),
-        obs_enabled=not args.no_obs, kv_client=kv_client)
+        obs_enabled=not args.no_obs, kv_client=kv_client,
+        tp=args.tp, ep=args.ep)
     replica.start()
 
     # Durable observability export: spans/metrics land under obs/ in the

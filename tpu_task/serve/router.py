@@ -153,7 +153,8 @@ class Router:
                  timeout: float = 10.0, quarantine_s: float = 2.0,
                  urlopen=None,
                  clock: Callable[[], float] = time.monotonic,
-                 obs: Optional[Obs] = None):
+                 obs: Optional[Obs] = None,
+                 prefetch_next_turn: bool = False):
         self.seed = seed
         self.affinity_tokens = affinity_tokens
         #: KV block size the fleet's engines run — what block-aligns the
@@ -182,6 +183,16 @@ class Router:
         self.redispatches = 0
         self.transport_faults = 0
         self.handoffs = 0                # prefill→decode stream handoffs
+        #: Prefetch-ahead (fleet-KV follow-on): when a request completes,
+        #: hint the replica the SESSION's next turn would land on (the
+        #: affinity pick over prompt + emitted tokens — the next turn's
+        #: context is a strict extension of that, so its full-block
+        #: prefix chain is already knowable NOW) to pull the published
+        #: chain from the fleet KV plane before the request arrives.
+        #: Purely advisory: a failed hint costs nothing but the hint.
+        #: ServeFleet turns this on when the fleet has a KV plane.
+        self.prefetch_next_turn = prefetch_next_turn
+        self.prefetch_hints = 0          # hints sent (POST /prefetch)
         # Observability: the router is where traces are MINTED (one per
         # fleet request at submit) and where the fleet-level latency
         # histograms live. Tracing here is host-side bookkeeping around
@@ -192,7 +203,8 @@ class Router:
         self._h_ttft = metrics.histogram("router.ttft_s")
         self._h_e2e = metrics.histogram("router.e2e_s")
         self._h_queue_wait = metrics.histogram("router.queue_wait_s")
-        for stat in ("redispatches", "transport_faults", "handoffs"):
+        for stat in ("redispatches", "transport_faults", "handoffs",
+                     "prefetch_hints"):
             metrics.counter_fn(f"router.{stat}",
                                lambda self=self, stat=stat:
                                float(getattr(self, stat)))
@@ -598,6 +610,8 @@ class Router:
                 self._end_root(request, dispatches=request.dispatches)
                 if replica.load > 0:
                     replica.load -= 1
+                if self.prefetch_next_turn:
+                    self._hint_next_turn(request)
             elif replica.role == "prefill" and request.tokens \
                     and body.get("status") == "done":
                 # Prefill leg complete: the prompt is ingested, its KV
@@ -627,6 +641,42 @@ class Router:
                 self._unassign(request)
         return sum(1 for r in self._requests.values()
                    if r.status not in (DONE, FAILED))
+
+    def _hint_next_turn(self, request: FleetRequest) -> None:
+        """Prefetch-ahead: the session's next turn will extend
+        ``prompt + tokens``, whose full-block chain the serving replica
+        just published through the fleet KV plane — so the replica the
+        next turn's affinity would pick can pull those blocks NOW,
+        before the request arrives, instead of on its TTFT path. Sends
+        only the chain suffix the target is not already known to hold;
+        the hint also feeds the served-chain memory, so cached-depth
+        routing sends the next turn where the prefetch landed. Entirely
+        best-effort: any failure is swallowed (the blocks import at
+        admission instead — exactly the behavior without the hint)."""
+        ids = list(request.prompt) + list(request.tokens)
+        hashes = self._chain_hashes(ids)
+        if not hashes:
+            return
+        try:
+            target = self.pick(ids, hashes=hashes)
+        except NoReplicaAvailable:
+            return
+        known = self._cached_depth(target, hashes)
+        if known >= len(hashes):
+            return                        # already warm — nothing to pull
+        try:
+            body = self._call(target, "POST", "/prefetch",
+                              data={"hashes": [h.hex() for h in hashes]})
+        except (urllib.error.URLError, OSError, ValueError):
+            return                        # advisory: no fault, no retry
+        self.prefetch_hints += 1
+        # Record only the depth the target VERIFIABLY holds now (what
+        # the router already knew plus what this hint imported) — noting
+        # the full chain after a 0-import answer (publish beat not
+        # landed, no fleet client) would make cached-depth routing
+        # prefer a cold replica over the actually-warm one.
+        warm = known + int(body.get("imported") or 0)
+        self._note_served(target, hashes[:warm])
 
     def drain(self, deadline_s: float = 120.0, wait_ms: int = 20,
               on_idle: Optional[Callable[[], None]] = None) -> Dict[int, List[int]]:
@@ -714,6 +764,7 @@ class Router:
             "redispatches": self.redispatches,
             "transport_faults": self.transport_faults,
             "handoffs": self.handoffs,
+            "prefetch_hints": self.prefetch_hints,
             "prefill_backlog": self.prefill_backlog,
             # One export path: the counters above ride the registry as
             # lazy gauges; TTFT / queue-wait / e2e live there natively.
