@@ -8,13 +8,20 @@ PYTHON ?= python3
 # Seed for the chaos soak: any run is replayable by pinning this.
 TPU_TASK_CHAOS_SEED ?= 20260804
 
-.PHONY: test smoke sweep bench bench-steady bench-serving bench-sched bench-decode bench-fleet bench-fleetkv bench-obs bench-goodput sched sched-soak chaos fleet kvfleet moe moe-serve serve-soak obs watch wheel multichip kernels-tpu clean
+.PHONY: test lint smoke sweep bench bench-steady bench-serving bench-sched bench-decode bench-fleet bench-fleetkv bench-obs bench-goodput sched sched-soak chaos fleet kvfleet moe moe-serve serve-soak obs watch wheel multichip kernels-tpu clean
 
 # Hermetic suite (the reference's `make test`, 30 s budget there; ours spans
 # the fake control planes, sharded-compute CPU checks, and the loopback GCS
 # integration, so the budget is minutes, not seconds).
 test:
 	$(PYTHON) -m pytest tests/ -q
+
+# Repo lint (runs in tier-1 via tests/test_repo_lint.py): flags
+# jnp.concatenate feeding shard_map token paths (the jax 0.4.x CPU SPMD
+# miscompile, PR 15) and blocking calls inside the engine's overlapped
+# dispatch region (PR 16) — the two invariants a refactor silently breaks.
+lint:
+	$(PYTHON) -m tpu_task.tools.repo_lint
 
 # Real-cloud smoke: full lifecycle with double-invoke idempotency, gated per
 # provider (`make smoke` equivalent; 30 min budget — Makefile:42-44).
@@ -61,8 +68,14 @@ bench-sched:
 # pipelined kernel regresses there (wall-clock on TPU; kernel parity
 # everywhere — interpreter wall is emulation tax, not kernel speed). The
 # tier-1 interpret-mode parity/smoke suite is tests/test_paged_attention.py.
+# The second line runs the async-engine legs (PR 16): sync vs overlapped
+# loop A/B (greedy bit-identity asserted — exits nonzero on divergence)
+# and the admission-burst p99-TTFT scenario (prefill_slots 1 vs burst).
+# On TPU the grid also records a compiled pipelined-kernel profiler
+# capture under profiles/decode_pipelined.
 bench-decode:
 	$(PYTHON) bench.py generation --decode-kernel
+	$(PYTHON) bench.py goodput --async-only
 
 # Fleet-serving cost model only: aggregate tok/s + TTFT percentiles vs
 # replica count {1,2,4} through the WHOLE serve subsystem (scheduler-
@@ -158,9 +171,10 @@ bench-obs:
 # XLA cost_analysis where the backend provides one. Includes the
 # micro_k ∈ {1,4,8} dispatch-amortization sweep at batch 32 (greedy
 # streams asserted bit-identical across K — exits nonzero on
-# divergence; dispatches/token and host_gap_frac per K).
+# divergence; dispatches/token and host_gap_frac per K). Pass --async
+# for the sync-vs-overlapped A/B + admission-burst legs as well.
 bench-goodput:
-	$(PYTHON) bench.py goodput
+	$(PYTHON) bench.py goodput --async
 
 # One-shot `obs watch` frame against the default state root — the render
 # smoke for the live dashboard (tok/s, goodput, MFU, queue depth, QLAT,

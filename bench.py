@@ -536,7 +536,7 @@ def bench_generation_decode_kernel(batches=(1, 8, 32), steps: int = 6,
     params = transformer.init(jax.random.PRNGKey(0), cfg)
     rng = np.random.default_rng(0)
 
-    def point(impl: str, kv_dtype, batch: int) -> dict:
+    def point(impl: str, kv_dtype, batch: int, capture_dir=None) -> dict:
         m = -(-max_len // block_size)
         scfg = ServingConfig(
             slots=batch, block_size=block_size, max_len=max_len,
@@ -591,21 +591,54 @@ def bench_generation_decode_kernel(batches=(1, 8, 32), steps: int = 6,
             pools = out[1]
         jax.block_until_ready(out)
         wall = time.perf_counter() - t0
-        return {
+        result = {
             "impl": impl, "kv_dtype": kv_dtype or str(jnp.dtype(cfg.dtype)),
             "batch": batch,
             "decode_ms_per_token": round(wall * 1e3 / (steps * batch), 4),
             "kv_bytes_per_token": kv_token_bytes(cfg, scfg),
         }
+        if capture_dir:
+            # Recorded compiled-kernel capture (PR 16 satellite): a few
+            # steady steps of THIS compiled program traced into the
+            # TensorBoard profile layout, after the timed loop so the
+            # profiler's own overhead never pollutes the grid numbers.
+            # Under the task WORKDIR the data sync ships the trace home.
+            from tpu_task.ml import profiling
+
+            with profiling.trace(capture_dir):
+                for step_ix in range(steps):
+                    with profiling.annotate(
+                            f"paged_decode_{impl}_step{step_ix}"):
+                        out = fn(out[0], pools)
+                        pools = out[1]
+                jax.block_until_ready(out)
+            result["capture_dir"] = capture_dir
+        return result
 
     grid = [point(impl, kv_dtype, b)
             for impl in ("xla", kernel_impl, pipelined_impl)
             for kv_dtype in (None, "int8")
             for b in batches]
+    # Compiled-TPU profiler capture of the pipelined kernel at the
+    # largest batch — only where the kernel actually compiles (the
+    # interpreter's host timeline says nothing about the DMA pipeline).
+    kernel_capture = {"skipped": "no TPU attached"}
+    if on_tpu:
+        capture_dir = os.path.join("profiles", "decode_pipelined")
+        captured = point(pipelined_impl, None, max(batches),
+                         capture_dir=capture_dir)
+        n_files = sum(len(files) for _, _, files in os.walk(capture_dir))
+        kernel_capture = {
+            "impl": pipelined_impl, "batch": max(batches),
+            "log_dir": capture_dir, "trace_files": n_files,
+            "note": ("TensorBoard profile-plugin layout; empty captures "
+                     "mean the tracer recorded nothing, not an error"),
+        } if "skipped" not in captured else {"skipped": captured["skipped"]}
     return {
         "backend": jax.default_backend(),
         "kernel_impl": kernel_impl,
         "pipelined_impl": pipelined_impl,
+        "kernel_capture": kernel_capture,
         "context_depth": depth,
         "steps_timed": steps,
         "note": ("interpret-mode ms is the Pallas interpreter's emulation "
@@ -2723,6 +2756,160 @@ def bench_goodput(batches=(1, 8, 32), max_new: int = 24,
     }
 
 
+def bench_goodput_async(batch: int = 32, max_new: int = 48, seed: int = 0,
+                        micro_ks=(1, 8)) -> dict:
+    """Sync vs overlapped engine loop A/B (PR 16): the SAME batch-32
+    greedy workload through ``overlap=False`` and ``overlap=True``
+    engines at each ``micro_k``, greedy streams asserted bit-identical
+    between the two loops (the tentpole contract), reporting wall-clock
+    tok/s plus the overlap-aware goodput split. In the overlapped loop
+    the host sweep of step N runs while the device executes step N+1, so
+    ``host_gap_frac`` counts only host time with NO program in flight —
+    the covered remainder shows up as ``overlapped_host_ms_per_token``.
+    On a one-core CPU host the wall win is bounded by the host and
+    device serializing onto the same core; the attribution split (and
+    the real TPU) is where the dispatch gap actually vanishes."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_task.ml.models import transformer
+    from tpu_task.ml.serving import ServingConfig, ServingEngine
+    from tpu_task.obs import Obs
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=256, d_model=128, n_layers=2, n_heads=8, d_head=16,
+        d_ff=256, dtype=jnp.float32, n_kv_heads=4)
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    per_k = {}
+    identical = True
+    for K in micro_ks:
+        legs = {}
+        streams = {}
+        preemptions = {}
+        for mode in ("sync", "overlap"):
+            scfg = ServingConfig(
+                slots=batch, block_size=8, n_blocks=max(96, 12 * batch),
+                max_len=8 + max_new, prefix_cache=False, micro_k=K,
+                overlap=(mode == "overlap"))
+            obs = Obs.create(f"goodput-async-{mode}-k{K}")
+            engine = ServingEngine(params, cfg, scfg, obs=obs)
+            rng = np.random.default_rng(seed)
+            prompts = [rng.integers(0, cfg.vocab_size, size=8)
+                       for _ in range(batch)]
+            engine.submit(prompts[0], 2)
+            engine.drain()                # compile off the books
+            engine._goodput.reset()
+            t0 = time.perf_counter()
+            for p in prompts:
+                engine.submit(p, max_new)
+            streams[mode] = engine.drain()
+            wall = time.perf_counter() - t0
+            stats = engine.stats()
+            gp = stats["goodput"]
+            emitted = max(1, gp["tokens"]["emitted"])
+            preemptions[mode] = stats["recompute_preemptions"]
+            legs[mode] = {
+                "tokens_per_s": round(batch * max_new / wall, 1),
+                "host_gap_frac": gp["host_gap_frac"],
+                "in_program_frac": gp["in_program_frac"],
+                "dispatches_per_token": gp["dispatches_per_token"],
+                "host_ms_per_token": round(
+                    gp["host_s"] / emitted * 1e3, 4),
+                "overlapped_host_ms_per_token": round(
+                    gp["overlapped_host_s"] / emitted * 1e3, 4),
+            }
+        same = streams["sync"] == streams["overlap"]
+        identical = identical and same
+        per_k[str(K)] = {
+            "sync": legs["sync"],
+            "overlap": legs["overlap"],
+            "greedy_streams_identical": same,
+            "extra_preemptions": preemptions["overlap"]
+            - preemptions["sync"],
+            "host_gap_drop_sync_to_overlap": round(
+                legs["sync"]["host_gap_frac"]
+                - legs["overlap"]["host_gap_frac"], 4),
+        }
+    out = {
+        "batch": batch, "max_new": max_new, "per_k": per_k,
+        "greedy_streams_identical": identical,
+    }
+    if not identical:
+        out["ERROR"] = ("greedy streams DIVERGED between the sync and "
+                        "overlapped loops — the bit-identity contract "
+                        "is broken")
+    return out
+
+
+def bench_goodput_burst(burst: int = 16, prompt_len: int = 4,
+                        max_new: int = 16, seed: int = 0) -> dict:
+    """Admission-burst TTFT (PR 16): ``burst`` requests submitted at
+    once against an idle engine, reporting p50/p99 time-to-first-token.
+    The contrast is ``prefill_slots``: at 1 (the pre-PR-16 behavior) a
+    burst serializes admissions one slot per step — the p99 request
+    waits through every earlier request's chunk program; at ``burst``
+    the chunk budget packs MULTIPLE admitting slots' chunks into ONE
+    program, so the tail admission lands a few programs in. Prompts are
+    shorter than the chunk budget so packing, not chunking, is what the
+    A/B isolates; both the sync and overlapped loops run both settings
+    (multi-slot packing is a scheduler property, not an overlap one)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_task.ml.models import transformer
+    from tpu_task.ml.serving import ServingConfig, ServingEngine
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=256, d_model=128, n_layers=2, n_heads=8, d_head=16,
+        d_ff=256, dtype=jnp.float32, n_kv_heads=4)
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+
+    def pctl(sorted_vals, q):
+        ix = min(len(sorted_vals) - 1,
+                 max(0, int(-(-q * len(sorted_vals) // 1)) - 1))
+        return sorted_vals[ix]
+
+    legs = {}
+    for mode in ("sync", "overlap"):
+        for pslots in (1, burst):
+            scfg = ServingConfig(
+                slots=burst, block_size=8, n_blocks=max(96, 12 * burst),
+                max_len=prompt_len + max_new, prefix_cache=False,
+                prefill_slots=pslots, overlap=(mode == "overlap"))
+            engine = ServingEngine(params, cfg, scfg)
+            rng = np.random.default_rng(seed)
+            prompts = [rng.integers(0, cfg.vocab_size, size=prompt_len)
+                       for _ in range(burst)]
+            engine.submit(prompts[0], 2)
+            engine.drain()                # compile off the books
+            rids = [engine.submit(p, max_new) for p in prompts]
+            engine.drain()
+            ttfts = sorted(
+                engine._requests[r].first_token_t
+                - engine._requests[r].submit_t for r in rids)
+            legs[f"{mode}_prefill_slots_{pslots}"] = {
+                "p50_ttft_ms": round(pctl(ttfts, 0.50) * 1e3, 3),
+                "p99_ttft_ms": round(pctl(ttfts, 0.99) * 1e3, 3),
+            }
+    improved = (
+        legs[f"overlap_prefill_slots_{burst}"]["p99_ttft_ms"]
+        < legs["overlap_prefill_slots_1"]["p99_ttft_ms"]
+        and legs[f"sync_prefill_slots_{burst}"]["p99_ttft_ms"]
+        < legs["sync_prefill_slots_1"]["p99_ttft_ms"])
+    return {
+        "burst": burst, "prompt_len": prompt_len, "max_new": max_new,
+        "legs": legs,
+        "multi_slot_p99_improved": improved,
+        "p99_speedup_overlap": round(
+            legs["overlap_prefill_slots_1"]["p99_ttft_ms"]
+            / max(1e-9,
+                  legs[f"overlap_prefill_slots_{burst}"]["p99_ttft_ms"]),
+            2),
+    }
+
+
 def main() -> int:
     import jax
 
@@ -2769,6 +2956,10 @@ def main() -> int:
     # Goodput/MFU + dispatch-overhead accounting (PR 12): in-program vs
     # host-gap split, goodput ratio, MFU gauge at batch ∈ {1, 8, 32}.
     goodput = bench_goodput()
+    # Async engine loop (PR 16): sync vs overlapped A/B (bit-identity
+    # asserted) + the admission-burst p99-TTFT multi-slot prefill leg.
+    goodput["overlap_ab"] = bench_goodput_async()
+    goodput["admission_burst"] = bench_goodput_burst()
     transport = bench_transport()
     data_plane = bench_data_plane()
     steady_state = bench_steady_state()
@@ -2945,6 +3136,16 @@ def _parse_args(argv):
         help="micro_k values for the dispatch-amortization sweep at "
              "batch max(batches) — greedy streams asserted bit-identical "
              "across K (default 1,4,8)")
+    goodput_cmd.add_argument(
+        "--async", action="store_true", dest="async_ab",
+        help="add the sync-vs-overlapped loop A/B leg (bit-identity "
+             "asserted) and the admission-burst p99-TTFT scenario "
+             "(prefill_slots 1 vs burst)")
+    goodput_cmd.add_argument(
+        "--async-only", action="store_true", dest="async_only",
+        help="run ONLY the async A/B + admission-burst legs (skip the "
+             "per-batch/micro_k/FLOP sections — the `make bench-decode` "
+             "wiring)")
     return parser.parse_args(argv)
 
 
@@ -3016,13 +3217,26 @@ if __name__ == "__main__":
                         if b.strip()) or (1, 8, 32)
         micro_ks = tuple(int(k) for k in str(args.micro_k).split(",")
                          if k.strip()) or (1, 4, 8)
+        if args.async_only:
+            result = {
+                "overlap_ab": bench_goodput_async(seed=args.seed),
+                "admission_burst": bench_goodput_burst(seed=args.seed),
+            }
+            print(json.dumps({"goodput": result}))
+            raise SystemExit(
+                0 if result["overlap_ab"]["greedy_streams_identical"]
+                else 1)
         result = bench_goodput(
             batches=batches, max_new=args.max_new, seed=args.seed,
             micro_ks=micro_ks)
+        if args.async_ab:
+            result["overlap_ab"] = bench_goodput_async(seed=args.seed)
+            result["admission_burst"] = bench_goodput_burst(seed=args.seed)
         print(json.dumps({"goodput": result}))
-        raise SystemExit(
-            0 if result["micro_k_sweep"][
-                "greedy_streams_identical_across_k"] else 1)
+        ok = result["micro_k_sweep"]["greedy_streams_identical_across_k"] \
+            and result.get("overlap_ab", {}).get(
+                "greedy_streams_identical", True)
+        raise SystemExit(0 if ok else 1)
     if args.section == "serving":
         tps = tuple(int(t) for t in str(args.tp or "1,8").split(",")
                     if t.strip())

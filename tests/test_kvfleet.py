@@ -497,11 +497,18 @@ def test_router_hints_next_turn_pick_on_completion():
         server_by_name = {f"r{i}": s for i, s in enumerate(servers)}
         serving = server_by_name[router.request(fid).replica]
         sibling = next(s for s in servers if s is not serving)
-        # Wait out the publish beat, then fail the serving replica out
-        # of membership: the session's next turn must land elsewhere.
+        # Wait until the SIBLING can see the next-turn chain's head —
+        # not just any published block: publishes go hottest-first, so
+        # under a starved host a published_blocks>0 wait can observe a
+        # mid-beat state whose advertised blocks miss the chain head,
+        # and the (one-shot) hint below, importing leading-consecutive
+        # only, would pull 0. lookup_chain is the hint handler's own
+        # precondition (refresh + consecutive depth, no import
+        # counters). Then fail the serving replica out of membership:
+        # the session's next turn must land elsewhere.
+        chain = router._chain_hashes(prompt + out)
         assert wait_until(
-            lambda: serving.engine.stats()["kvfleet"]["published_blocks"]
-            > 0 or serving.kv_client.published_blocks > 0, 10)
+            lambda: sibling.kv_client.lookup_chain(chain) >= 1, 10)
         # pump's DONE arm already fired one hint automatically (then
         # targeting the warm serving replica — a no-op import).
         auto_hints = router.prefetch_hints

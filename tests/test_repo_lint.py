@@ -1,0 +1,80 @@
+"""Repo lint (``tpu_task.tools.repo_lint``): the live tree stays clean,
+and the two rules actually catch their seeded violations.
+
+Rule 1: no ``jnp.concatenate`` in serving token paths (jax 0.4.x CPU
+SPMD miscompile under shard_map, PR 15). Rule 2: no blocking device
+reads inside the engine's marked overlapped-dispatch region (PR 16) —
+and deleting the markers is itself a finding, so the check cannot be
+silently disabled.
+"""
+
+import textwrap
+
+from tpu_task.tools import repo_lint
+
+
+def test_repo_is_clean():
+    assert repo_lint.run() == []
+
+
+def test_concatenate_flagged_without_allow_comment():
+    text = textwrap.dedent("""\
+        import jax.numpy as jnp
+        def pack(a, b):
+            return jnp.concatenate([a, b], axis=0)
+    """)
+    findings = repo_lint.lint_concatenate_text(text, "fake/model.py")
+    assert len(findings) == 1
+    assert findings[0].startswith("fake/model.py:3:")
+    assert "shard_map" in findings[0]
+
+
+def test_concatenate_allow_comment_opts_out():
+    text = ("host_ids = jnp.concatenate(parts)"
+            "  # lint: allow-concatenate (host-side)\n")
+    assert repo_lint.lint_concatenate_text(text, "fake/model.py") == []
+
+
+def test_jnp_asarray_never_trips_blocking_rule():
+    # jnp.asarray is the sanctioned host->device staging call; only a
+    # bare np.asarray (a device read) may be flagged inside the region.
+    text = textwrap.dedent("""\
+        # lint: begin-overlap-dispatch
+        x = jnp.asarray(tokens)
+        # lint: end-overlap-dispatch
+    """)
+    assert repo_lint.lint_overlap_text(text, "fake/engine.py") == []
+
+
+def test_blocking_reads_flagged_inside_region_only():
+    text = textwrap.dedent("""\
+        ys = np.asarray(record["ys"])      # before region: fine
+        # lint: begin-overlap-dispatch
+        jax.block_until_ready(ys)
+        host = np.asarray(device_value)
+        got = jax.device_get(device_value)
+        # lint: end-overlap-dispatch
+        tail = np.asarray(record["ys"])    # after region: fine
+    """)
+    findings = repo_lint.lint_overlap_text(text, "fake/engine.py")
+    assert len(findings) == 3
+    assert [f.split(":")[1] for f in findings] == ["3", "4", "5"]
+    assert all("overlapped" in f for f in findings)
+
+
+def test_missing_markers_is_a_finding():
+    findings = repo_lint.lint_overlap_text("x = 1\n", "fake/engine.py")
+    assert len(findings) == 1
+    assert "not found" in findings[0]
+
+
+def test_unterminated_begin_marker_is_a_finding():
+    text = textwrap.dedent("""\
+        # lint: begin-overlap-dispatch
+        x = 1
+        # lint: end-overlap-dispatch
+        # lint: begin-overlap-dispatch
+        y = 2
+    """)
+    findings = repo_lint.lint_overlap_text(text, "fake/engine.py")
+    assert any("unterminated" in f for f in findings)

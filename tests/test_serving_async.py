@@ -1,0 +1,236 @@
+"""The fully asynchronous engine loop (PR 16, ``ServingConfig.overlap``).
+
+The contract (docs/parity.md "Async overlap"): overlap is a pure
+SCHEDULING change — the host sweep of program N runs while the device
+executes program N+1, admissions join the NEXT program, and several
+admitting slots' chunks pack into one program (``prefill_slots``) — but
+never a token: greedy and keyed sampled streams are bit-identical to the
+synchronous loop at every ``micro_k``, preemption counts are equal (pool
+pressure flushes to the synchronous edge before preempting, exactly
+where the sync loop would), and ``obs=None`` stays zero-overhead.
+
+Tier-1 pins the cheap core (batch-4 bit-identity at K ∈ {1, 8}, the
+multi-slot burst, flush/export, attribution fields); the seeded
+randomized-schedule soak across admit/retire/preempt interleavings is
+``slow``.
+"""
+
+import dataclasses
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_task.ml.models import transformer
+from tpu_task.ml.serving import ServingConfig, ServingEngine
+
+TINY = transformer.TransformerConfig(
+    vocab_size=64, d_model=32, n_layers=2, n_heads=4, d_head=8, d_ff=64,
+    dtype=jnp.float32, n_kv_heads=2)
+
+BASE = ServingConfig(slots=4, block_size=4, n_blocks=64, max_len=48,
+                     chunk_tokens=4, prefix_cache=False)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer.init(jax.random.PRNGKey(0), TINY)
+
+
+def _workload(seed=0, n=8, temps=False):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        prompt = rng.integers(0, TINY.vocab_size,
+                              size=int(rng.integers(3, 12)))
+        t = float(rng.choice([0.0, 0.8])) if temps else 0.0
+        out.append(dict(prompt=prompt, max_new=int(rng.integers(3, 14)),
+                        temperature=t, top_p=0.9 if t else None,
+                        eos_token=7))
+    return out
+
+def _submit(engine, spec):
+    return engine.submit(spec["prompt"], spec["max_new"],
+                         temperature=spec["temperature"],
+                         top_p=spec["top_p"], eos_token=spec["eos_token"])
+
+
+def _drain(params, scfg, seed=0, n=8, temps=False, **engine_kw):
+    engine = ServingEngine(params, TINY, scfg,
+                           rng=jax.random.PRNGKey(99), **engine_kw)
+    for spec in _workload(seed, n, temps):
+        _submit(engine, spec)
+    return engine.drain(), engine
+
+
+def test_overlap_validation():
+    with pytest.raises(ValueError, match="prefill_slots"):
+        ServingConfig(prefill_slots=0)
+    with pytest.raises(ValueError, match="prefill_slots"):
+        ServingConfig(slots=4, prefill_slots=5)
+    with pytest.raises(ValueError, match="overlap"):
+        ServingConfig(overlap=True, prefill="bucketed",
+                      prefix_cache=False)
+    with pytest.raises(ValueError, match="overlap"):
+        ServingConfig(overlap=True, spec_k=2)
+
+
+@pytest.mark.perf
+def test_overlap_greedy_streams_bit_identical(params):
+    """The tier-1 pin of the tentpole: the overlapped loop's greedy
+    streams at batch 4 — through chunked prefill, mixed eos/length
+    retirement — are bit-identical to the synchronous loop's at
+    micro_k 1 AND 8, with no extra preemptions and the overlap
+    machinery demonstrably engaged (results lag one step, so the
+    engine must have dispatched ahead)."""
+    for k in (1, 8):
+        scfg = dataclasses.replace(BASE, micro_k=k)
+        ref, ref_eng = _drain(params, scfg)
+        got, eng = _drain(params, dataclasses.replace(scfg, overlap=True))
+        assert got == ref, f"greedy streams diverged at micro_k={k}"
+        assert eng.preemption_count == ref_eng.preemption_count == 0
+        assert eng.stats()["overlap"] is True
+        assert eng.decode_steps > 0
+
+
+def test_overlap_sampled_streams_identical(params):
+    """Sampled streams ride position-keyed fold_in draws — schedule
+    independent, so the overlapped loop must reproduce them exactly
+    (unquantized; fp8/int8 replay is a documented tolerance class)."""
+    ref, _ = _drain(params, BASE, temps=True)
+    got, _ = _drain(params, dataclasses.replace(BASE, overlap=True),
+                    temps=True)
+    assert got == ref
+
+
+def test_overlap_multi_slot_prefill_packs_burst(params):
+    """prefill_slots > 1: an admission burst packs several admitting
+    slots' chunks into ONE program — fewer chunk programs than a
+    one-slot-per-step serialization, same streams."""
+    scfg = dataclasses.replace(BASE, chunk_tokens=16)
+    ref, ref_eng = _drain(params, scfg)
+    for overlap in (False, True):
+        packed = dataclasses.replace(scfg, prefill_slots=4,
+                                     overlap=overlap)
+        got, eng = _drain(params, packed)
+        assert got == ref
+        assert eng.chunk_steps < ref_eng.chunk_steps, \
+            f"multi-slot prefill did not pack (overlap={overlap})"
+
+
+def test_overlap_pool_pressure_flush_matches_sync_preemptions(params):
+    """Pool pressure beyond eviction flushes the pipeline to the sync
+    edge and preempts exactly where the synchronous loop would: equal
+    preemption counts, identical streams, and the flush counter shows
+    the fallback actually ran."""
+    tight = dataclasses.replace(BASE, slots=3, n_blocks=10, max_len=32)
+    ref, ref_eng = _drain(params, tight, seed=3, n=6)
+    got, eng = _drain(params, dataclasses.replace(tight, overlap=True),
+                      seed=3, n=6)
+    assert got == ref
+    assert eng.preemption_count == ref_eng.preemption_count > 0
+    assert eng.overlap_flushes > 0
+
+
+def test_overlap_export_inflight_flushes_and_resumes(params):
+    """export_inflight() mid-pipeline flushes the in-flight program
+    first (mirrors exact), and the export resumes into a fresh engine
+    with streams identical to an uninterrupted synchronous run."""
+    ref, _ = _drain(params, BASE, seed=5)
+    engine = ServingEngine(params, TINY,
+                           dataclasses.replace(BASE, overlap=True),
+                           rng=jax.random.PRNGKey(99))
+    rids = [_submit(engine, s) for s in _workload(5)]
+    for _ in range(4):
+        engine.step()
+    exported = engine.export_inflight()
+    assert engine._inflight is None        # the flush happened
+    done = {rid: list(engine._requests[rid].tokens) for rid in rids
+            if engine._requests[rid].status == "done"}
+    resumed = ServingEngine(params, TINY,
+                            dataclasses.replace(BASE, overlap=True),
+                            rng=jax.random.PRNGKey(99))
+    remap = resumed.resume_inflight(exported)
+    out = resumed.drain()
+    got = dict(done)
+    for old, new in remap.items():
+        got[old] = out[new]        # resumed streams carry their prefix
+    assert got == ref
+
+
+def test_overlap_goodput_attribution(params):
+    """The overlap-aware 3-way split: with a program in flight across
+    every mid-drain step, host work lands in overlapped_host_s, the
+    residual host gap is ~zero, and busy_s still covers the step wall
+    (the MFU denominator does not shrink)."""
+    from tpu_task.obs import Obs
+
+    engine = ServingEngine(params, TINY,
+                           dataclasses.replace(BASE, overlap=True),
+                           obs=Obs.create("async-goodput"))
+    for spec in _workload(0):
+        _submit(engine, spec)
+    engine.drain()
+    gp = engine.stats()["goodput"]
+    assert gp["overlapped_host_s"] > 0
+    assert gp["host_gap_frac"] < 0.1
+    assert gp["in_program_frac"] + gp["host_gap_frac"] <= 1.0 + 1e-9
+
+
+def test_overlap_obs_none_zero_overhead(params):
+    """obs=None keeps the zero-overhead contract: no goodput meter, no
+    step histograms — the overlapped loop never touches them."""
+    engine = ServingEngine(params, TINY,
+                           dataclasses.replace(BASE, overlap=True))
+    for spec in _workload(0, n=3):
+        _submit(engine, spec)
+    engine.drain()
+    assert engine._goodput is None
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(6))
+def test_overlap_randomized_schedule_soak(params, seed):
+    """Seeded randomized-schedule soak: arrivals interleaved with steps
+    (admissions land mid-flight, retire under the pipeline), randomized
+    prompt/max_new/eos/temperature mixes, pool sizes tight enough to
+    preempt, micro_k and prefill_slots drawn per run — async streams
+    and preemption counts must match the synchronous loop exactly."""
+    rng = np.random.default_rng(1000 + seed)
+    n = int(rng.integers(8, 14))
+    specs = []
+    for _ in range(n):
+        prompt = rng.integers(0, TINY.vocab_size,
+                              size=int(rng.integers(2, 14)))
+        t = float(rng.choice([0.0, 0.7, 1.1]))
+        specs.append(dict(prompt=prompt,
+                          max_new=int(rng.integers(2, 16)),
+                          temperature=t, top_p=0.9 if t else None,
+                          eos_token=int(rng.integers(0, 16))))
+    # steps to run between arrivals — the interleaving under test
+    gaps = [int(rng.integers(0, 4)) for _ in specs]
+    scfg = dataclasses.replace(
+        BASE,
+        slots=int(rng.integers(2, 5)),
+        n_blocks=int(rng.integers(12, 40)),
+        max_len=32,
+        micro_k=int(rng.choice([1, 2, 8])),
+        chunk_tokens=int(rng.choice([4, 16])))
+    scfg = dataclasses.replace(
+        scfg, prefill_slots=int(rng.integers(1, scfg.slots + 1)))
+
+    def run(overlap):
+        eng = ServingEngine(
+            params, TINY, dataclasses.replace(scfg, overlap=overlap),
+            rng=jax.random.PRNGKey(42))
+        for spec, gap in zip(specs, gaps):
+            _submit(eng, spec)
+            for _ in range(gap):
+                eng.step()
+        return eng.drain(), eng
+
+    ref, ref_eng = run(False)
+    got, eng = run(True)
+    assert got == ref
+    assert eng.preemption_count == ref_eng.preemption_count

@@ -144,6 +144,25 @@ class ServingConfig:
       the per-token step loop and its byte-identical programs; greedy
       streams at any K are bit-identical to K=1 and sampled streams
       key-identical (docs/parity.md "Dispatch amortization").
+    - ``overlap``: the fully asynchronous engine loop (docs/parity.md
+      "Async overlap"): each scheduler step DISPATCHES the next fused
+      program (its inputs are a device-resident carry threaded program
+      to program, never read back) before it blocks on — and host-sweeps
+      — the previous one, so retire/admit/publish/obs bookkeeping runs
+      while the device executes the next micro-step. Admissions are
+      staged into the NEXT program's chunk rows (the in-flight program
+      is never restarted or recompiled). Greedy streams stay
+      bit-identical to the synchronous loop at every ``micro_k``;
+      requires ``prefill="chunked"``, ``spec_k == 0``, and a mesh-less
+      engine. False (default) keeps the synchronous step loop.
+    - ``prefill_slots``: how many admitting slots may prefill
+      CONCURRENTLY — the per-step ``chunk_tokens`` budget packs the
+      oldest ``prefill_slots`` admissions' chunks into ONE program
+      (each chunk row carries its own slot's block table). 1 (default)
+      keeps the one-admission-at-a-time schedule; raising it drains an
+      admission burst in ~burst/``prefill_slots`` fewer steps whenever
+      prompts are shorter than the chunk budget (the admission-p99
+      lever — ``bench.py goodput`` measures it).
     """
 
     slots: int = 8
@@ -158,6 +177,8 @@ class ServingConfig:
     decode_impl: str = "auto"
     kv_dtype: Optional[str] = None
     micro_k: int = 1
+    overlap: bool = False
+    prefill_slots: int = 1
 
     def __post_init__(self):
         if self.slots < 1:
@@ -209,6 +230,22 @@ class ServingConfig:
         if self.micro_k > self.max_len:
             raise ValueError(
                 f"micro_k {self.micro_k} exceeds max_len {self.max_len}")
+        if self.prefill_slots < 1:
+            raise ValueError(
+                f"prefill_slots must be >= 1, got {self.prefill_slots}")
+        if self.prefill_slots > self.slots:
+            raise ValueError(
+                f"prefill_slots {self.prefill_slots} exceeds slots "
+                f"{self.slots}")
+        if self.overlap and self.prefill != "chunked":
+            raise ValueError(
+                "overlap=True needs prefill='chunked': admissions are "
+                "staged into the next program's chunk rows")
+        if self.overlap and self.spec_k > 0:
+            raise ValueError(
+                "overlap=True is incompatible with speculative decoding "
+                "(spec_k > 0): the draft/score round-trip is a host "
+                "sync point every round")
 
     @property
     def max_blocks_per_slot(self) -> int:
@@ -516,6 +553,27 @@ def export_block_bytes(pools: List[dict], block: int) -> bytes:
     return b"".join(
         np.asarray(layer[name][block]).tobytes()
         for layer in pools for name in sorted(layer))
+
+
+def stage_block_arrays(pools: List[dict], block: int) -> List:
+    """The NON-BLOCKING half of :func:`export_block_bytes`: slice one
+    physical block out of every layer (deterministic layer, sorted-leaf
+    order) WITHOUT forcing the values to the host. Each slice is its own
+    device array (enqueued after every already-dispatched pool program,
+    so the bytes read later are exactly the pool state at staging time —
+    and independent of the pool buffers, so later donations of the pool
+    cannot invalidate it). The overlapped engine stages publishes on its
+    critical path and lets :func:`staged_block_to_bytes` pay the
+    blocking readback off it (a publisher thread, or simply after the
+    next dispatch)."""
+    return [layer[name][block] for layer in pools for name in sorted(layer)]
+
+
+def staged_block_to_bytes(staged: List) -> bytes:
+    """Force a :func:`stage_block_arrays` staging to host bytes — the
+    blocking half; byte-identical to :func:`export_block_bytes` over the
+    pool state the staging captured."""
+    return b"".join(np.asarray(leaf).tobytes() for leaf in staged)
 
 
 def split_block_bytes(data: bytes, cfg: TransformerConfig,

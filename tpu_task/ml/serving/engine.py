@@ -106,7 +106,6 @@ from tpu_task.ml.serving.cache import (
     ServingConfig,
     chain_block_hashes,
     copy_block,
-    export_block_bytes,
     fp8_supported,
     init_pools,
     kv_shard_bytes,
@@ -114,12 +113,18 @@ from tpu_task.ml.serving.cache import (
     paged_cache_bytes,
     pool_pspecs,
     split_block_bytes,
+    stage_block_arrays,
+    staged_block_to_bytes,
     write_blocks,
 )
 from tpu_task.ml.serving.model import (
+    chunk_carry_greedy,
+    chunk_carry_sample,
     chunked_step_greedy,
     decode_and_sample,
     greedy_decode_step,
+    micro_carry_greedy,
+    micro_carry_sample,
     micro_decode_greedy,
     micro_decode_sample,
     paged_prefill,
@@ -360,6 +365,36 @@ class ServingEngine:
         self.fleet_import_requests = 0
         self.fleet_prefetch_blocks = 0
         self._h_kv_import = None
+
+        # Asynchronous engine loop (ROADMAP item 4, the overlap PR): the
+        # host sweep of micro-step N runs while the device executes
+        # micro-step N+1 — see _step_overlapped for the loop contract.
+        # Single-chip only for now: carry programs pack chunk rows with
+        # static slices, and the jax 0.4.x CPU SPMD concatenate gotcha
+        # (docs/parity.md) is moot when no shard_map is in the path.
+        self._overlap = scfg.overlap
+        if self._overlap and mesh is not None:
+            raise ValueError(
+                "overlap=True is single-chip for now: run the overlapped "
+                "loop on a mesh=None engine (the sharded gangs keep the "
+                "synchronous loop)")
+        #: The dispatched-but-unswept program's record (None between
+        #: drains): device token futures + the host-side plan the sweep
+        #: replays against. Exactly ONE program is ever in flight.
+        self._inflight: Optional[dict] = None
+        #: Device-resident (tok, pos, alive, emitted) threaded from
+        #: program to program — None means "rebuild from the host
+        #: mirrors at the next dispatch" (engine start, or after flush).
+        self._carry = None
+        #: Worst-case per-slot device position/emitted count after every
+        #: dispatched program completes — what block reservation and
+        #: planning read while the mirrors lag one program behind.
+        self._planned_pos = np.zeros((scfg.slots,), np.int32)
+        self._planned_emitted = np.zeros((scfg.slots,), np.int32)
+        #: Retirements swept outside step() (a flush) — reported in the
+        #: NEXT step's ``finished`` list rather than dropped.
+        self._pending_finished: List[int] = []
+        self.overlap_flushes = 0
 
         # Speculative decoding: validate the draft triple together. The
         # draft rides the SAME partition rules as the target (PR 8's
@@ -632,6 +667,87 @@ class ServingEngine:
                         moe_fn=mfn),
                     plan((p_specs, rep, rep, rep, rep, rep, rep, rep,
                           rep, rep, rep, k_specs), (11,))))
+        # Carry-threaded programs for the overlapped loop: the loop state
+        # (tok, pos, alive, emitted) stays ON DEVICE between dispatches,
+        # so the host never restages it and the only blocking edge is the
+        # swept token readback. Compiled at ANY micro_k (a K=1 scan —
+        # bit-identical to the plain step, the PR 13 pin) because even
+        # K=1 overlap needs the carry threading; mesh is None here
+        # (validated above), so the plans are plain donate-the-pools.
+        if self._overlap:
+            if quant:
+                self._micro_carry_greedy_fn = self._wrap(compile_step(
+                    lambda params, tok, pos, alive, emitted, tables,
+                    limits, eos, qa, pools: micro_carry_greedy(
+                        params, cfg, tok, pos, alive, emitted, tables,
+                        limits, eos, pools, qa, micro_k=mk,
+                        attn_impl=impl, mesh=None, measure_qerr=dbg,
+                        moe_fn=mfn),
+                    PartitionPlan(donate=(9,))))
+                self._micro_carry_sample_fn = self._wrap(compile_step(
+                    lambda params, tok, pos, alive, emitted, tables,
+                    limits, eos, temps, tops, keys, qa, pools:
+                    micro_carry_sample(
+                        params, cfg, tok, pos, alive, emitted, tables,
+                        limits, eos, temps, tops, keys, pools, qa,
+                        micro_k=mk, attn_impl=impl, mesh=None,
+                        measure_qerr=dbg, moe_fn=mfn),
+                    PartitionPlan(donate=(12,))))
+                self._chunk_carry_greedy_fn = self._wrap(compile_step(
+                    lambda params, tok, pos, alive, emitted, ctoks, cpos,
+                    cvalid, tables, limits, eos, prow, ppos, pngen, qa,
+                    pools: chunk_carry_greedy(
+                        params, cfg, tok, pos, alive, emitted, ctoks,
+                        cpos, cvalid, tables, limits, eos, prow, ppos,
+                        pngen, pools, qa, attn_impl=impl, mesh=None,
+                        measure_qerr=dbg, moe_fn=mfn),
+                    PartitionPlan(donate=(15,))))
+                self._chunk_carry_sample_fn = self._wrap(compile_step(
+                    lambda params, tok, pos, alive, emitted, ctoks, cpos,
+                    cvalid, tables, limits, eos, prow, ppos, pngen,
+                    temps, tops, rkeys, cngen, qa, pools:
+                    chunk_carry_sample(
+                        params, cfg, tok, pos, alive, emitted, ctoks,
+                        cpos, cvalid, tables, limits, eos, prow, ppos,
+                        pngen, temps, tops, rkeys, cngen, pools, qa,
+                        attn_impl=impl, mesh=None, measure_qerr=dbg,
+                        moe_fn=mfn),
+                    PartitionPlan(donate=(19,))))
+            else:
+                self._micro_carry_greedy_fn = self._wrap(compile_step(
+                    lambda params, tok, pos, alive, emitted, tables,
+                    limits, eos, pools: micro_carry_greedy(
+                        params, cfg, tok, pos, alive, emitted, tables,
+                        limits, eos, pools, micro_k=mk, attn_impl=impl,
+                        mesh=None, moe_fn=mfn),
+                    PartitionPlan(donate=(8,))))
+                self._micro_carry_sample_fn = self._wrap(compile_step(
+                    lambda params, tok, pos, alive, emitted, tables,
+                    limits, eos, temps, tops, keys, pools:
+                    micro_carry_sample(
+                        params, cfg, tok, pos, alive, emitted, tables,
+                        limits, eos, temps, tops, keys, pools,
+                        micro_k=mk, attn_impl=impl, mesh=None,
+                        moe_fn=mfn),
+                    PartitionPlan(donate=(11,))))
+                self._chunk_carry_greedy_fn = self._wrap(compile_step(
+                    lambda params, tok, pos, alive, emitted, ctoks, cpos,
+                    cvalid, tables, limits, eos, prow, ppos, pngen,
+                    pools: chunk_carry_greedy(
+                        params, cfg, tok, pos, alive, emitted, ctoks,
+                        cpos, cvalid, tables, limits, eos, prow, ppos,
+                        pngen, pools, attn_impl=impl, mesh=None,
+                        moe_fn=mfn),
+                    PartitionPlan(donate=(14,))))
+                self._chunk_carry_sample_fn = self._wrap(compile_step(
+                    lambda params, tok, pos, alive, emitted, ctoks, cpos,
+                    cvalid, tables, limits, eos, prow, ppos, pngen,
+                    temps, tops, rkeys, cngen, pools: chunk_carry_sample(
+                        params, cfg, tok, pos, alive, emitted, ctoks,
+                        cpos, cvalid, tables, limits, eos, prow, ppos,
+                        pngen, temps, tops, rkeys, cngen, pools,
+                        attn_impl=impl, mesh=None, moe_fn=mfn),
+                    PartitionPlan(donate=(18,))))
         self._prefill_sample_fn = self._wrap(jax.jit(
             lambda logits, temp, top, key, n: sample_tokens(
                 logits, temp, top, jax.random.fold_in(key, n)[None])))
@@ -917,7 +1033,11 @@ class ServingEngine:
         sibling engine needs to continue the stream token-identically via
         :meth:`resume_inflight`. The graceful-drain half of the serve
         subsystem's preemption contract (docs/parity.md "Serve as a
-        task"); the engine itself is left untouched."""
+        task"); the engine itself is left untouched. In overlap mode the
+        pipeline is flushed first — tokens still riding the in-flight
+        program belong in the exported records, not on the floor."""
+        if self._overlap:
+            self.flush()
         records = []
         for req in self._requests.values():
             if req.status == DONE:
@@ -1038,11 +1158,17 @@ class ServingEngine:
 
     @property
     def has_work(self) -> bool:
-        return bool(self._queue) or self.n_active > 0
+        return bool(self._queue) or self.n_active > 0 \
+            or self._inflight is not None
 
     def step(self) -> dict:
         """One scheduler iteration: admit → (chunk|spec|decode) → retire.
-        Returns what happened (request ids admitted/finished, active)."""
+        Returns what happened (request ids admitted/finished, active).
+        With ``ServingConfig.overlap`` on, the iteration instead runs the
+        asynchronous loop (:meth:`_step_overlapped`): dispatch the NEXT
+        program, then sweep the PREVIOUS one — results lag one step."""
+        if self._overlap:
+            return self._step_overlapped()
         t0 = time.perf_counter() if self._obs is not None else 0.0
         if self._goodput is not None:
             self._goodput.begin_step()
@@ -1100,12 +1226,440 @@ class ServingEngine:
             steps += 1
         return {rid: list(r.tokens) for rid, r in self._requests.items()}
 
+    # -- the asynchronous loop (ServingConfig.overlap) -----------------------
+    #
+    # One iteration of the overlapped engine (ROADMAP item 4's last rung):
+    #
+    #   admit        — into slots free as of the LAST sweep; the admitted
+    #                  request rides the NEXT program's chunk rows, so the
+    #                  in-flight program is never recompiled or restarted
+    #   dispatch N+1 — planned from the worst-case device positions
+    #                  (exact for live slots); loop state comes from
+    #                  program N's device carry, never from the host
+    #   consume N    — the ONE blocking edge: read program N's tokens
+    #                  back and replay the sweep from its dispatch record
+    #
+    # so the host sweep of program N (retire, admit bookkeeping, publish
+    # staging, obs) runs while the device executes program N+1.
+    # Correctness leans on two facts. (a) Donated pools serialize device
+    # execution in dispatch order: blocks freed by sweep N and handed to
+    # a new admission are only written by programs enqueued after N+1,
+    # and a ref-0 cached block is never in a dispatched table, so
+    # eviction under an in-flight program races nothing. (b) Greedy and
+    # keyed sampled streams are schedule-independent (the repo-wide pin),
+    # so the async loop's streams are bit-identical to the synchronous
+    # loop's even where admission lands one sweep later. Pool pressure
+    # the planner cannot cover flushes to the synchronous edge first —
+    # preemption happens exactly where (and only where) the sync loop
+    # would preempt. docs/parity.md "Async overlap" carries the full
+    # contract.
+
+    def _step_overlapped(self) -> dict:
+        t0 = time.perf_counter() if self._obs is not None else 0.0
+        if self._goodput is not None:
+            self._goodput.begin_step()
+        self.steps += 1
+        admitted: List[int] = []
+        finished: List[int] = self._pending_finished
+        self._pending_finished = []
+        self._admit(admitted, finished)
+        rec = self._dispatch_next(finished)   # pool pressure may flush
+        # Covered = a program spanned this step's host work: either the
+        # previous one was still unconsumed or a new one just enqueued.
+        covered = rec is not None or self._inflight is not None
+        self._consume_one(self._inflight, finished)
+        self._inflight = rec
+        if self._obs is not None:
+            wall = time.perf_counter() - t0
+            self._h_step.observe(wall)
+            if self._goodput is not None:
+                self._goodput.end_step_overlapped(wall, covered)
+        return {"admitted": admitted, "finished": finished,
+                "active": self.n_active, "queued": len(self._queue)}
+
+    def flush(self) -> None:
+        """Drain the overlap pipeline to the synchronous edge: consume
+        and sweep the in-flight program, then drop the device carry (the
+        next dispatch rebuilds it from the host mirrors — legal because
+        the carry convention is absolute, so after a full sweep the
+        mirrors ARE the device state). Every synchronous code path that
+        needs exact state (preemption, export_inflight, direct reads)
+        runs behind a flush. Retirements swept here surface in the next
+        step's ``finished`` list. No-op in sync mode or when idle."""
+        self._consume_one(self._inflight, self._pending_finished)
+        self._inflight = None
+        self._carry = None
+
+    def _rebuild_carry(self) -> None:
+        """Host mirrors → device carry (engine start, or after a flush).
+        Prefilling and empty slots enter dead: the chunk program's
+        in-program promotion is the only writer that turns a carry row
+        live, so a dead row's tok/pos staleness is unreadable."""
+        alive = np.array(
+            [req is not None and req.status == RUNNING
+             and not self._prefilling(i)
+             for i, req in enumerate(self._slots)])
+        emitted = np.array(
+            [len(req.tokens) if req is not None else 0
+             for req in self._slots], np.int32)
+        self._carry = (
+            jnp.asarray(self._last_token),
+            jnp.asarray(np.where(alive, self._positions, 0)),
+            jnp.asarray(alive),
+            jnp.asarray(emitted))
+        self._planned_pos = np.asarray(self._positions, np.int32).copy()
+        self._planned_emitted = emitted.copy()
+
+    # lint: begin-overlap-dispatch — nothing between these markers may
+    # block on the device (block_until_ready / device_get / np.asarray
+    # of a device value): this code runs while the PREVIOUS program is
+    # still executing, and a blocking read here re-serializes the loop
+    # the overlap exists to kill. `make lint` (tier-1) enforces it.
+
+    def _plan_step(self):
+        """What the next program should run, read off the worst-case
+        device state (``_planned_pos``/``_planned_emitted`` — exact for
+        live slots; an over-estimate only for slots that eos-retired
+        inside a still-unswept program, whose rows the device masks
+        anyway). Prefill rows split the ONE shared ``chunk_tokens``
+        budget oldest-admission-first — several admitting slots pack
+        into one program instead of serializing one slot per step.
+        Returns (prefill rows, decode candidate slots, per-slot
+        reservation widths), or None when nothing is worth running."""
+        n, K, W = self.scfg.slots, self.scfg.micro_k, self.scfg.chunk_tokens
+        prefill = []                  # (slot, chunk, planned pos, completing)
+        budget = W
+        for i in sorted(range(n), key=lambda j: self._admit_seq[j]):
+            req = self._slots[i]
+            if req is None or req.status != RUNNING or not budget:
+                continue
+            pos = int(self._planned_pos[i])
+            target = int(self._prefill_target[i])
+            if pos < target:
+                c = min(budget, target - pos)
+                budget -= c
+                prefill.append((i, c, pos, pos + c >= target))
+        decode = [
+            i for i, req in enumerate(self._slots)
+            if req is not None and req.status == RUNNING
+            and int(self._planned_pos[i]) >= int(self._prefill_target[i])
+            and int(self._planned_emitted[i]) < req.max_new_tokens]
+        if not prefill and not decode:
+            return None
+        widths = np.zeros((n,), np.int32)
+        for i, c, _, _ in prefill:
+            widths[i] = c
+        for i in decode:
+            widths[i] = 1 if prefill else min(
+                K, self._slots[i].max_new_tokens
+                - int(self._planned_emitted[i]))
+        return prefill, decode, widths
+
+    def _reserve_planned(self, widths: np.ndarray) -> bool:
+        """The async half of :meth:`_ensure_blocks`: cover every slot's
+        next ``widths[i]`` writes FROM ITS PLANNED POSITION, evicting
+        ref-0 cached blocks but never preempting — the in-flight program
+        pins every running slot (a preemption would roll back state the
+        device is still advancing). False = the pool can't cover it;
+        allocations made so far are kept (the slots own them) and the
+        caller flushes so the synchronous reservation path — the only
+        place overlap mode preempts — can run on exact state."""
+        bs = self.scfg.block_size
+        for slot in sorted(range(self.scfg.slots),
+                           key=lambda i: self._admit_seq[i]):
+            w = int(widths[slot])
+            if not w:
+                continue
+            pos = int(self._planned_pos[slot])
+            for block_i in range(pos // bs, (pos + w - 1) // bs + 1):
+                if self._tables[slot, block_i] != SCRATCH_BLOCK:
+                    continue
+                got = self._reserve(1, 0)
+                if got is None:
+                    return False
+                self._tables[slot, block_i] = got[0]
+        return True
+
+    def _dispatch_next(self, finished: list) -> Optional[dict]:
+        """Plan, reserve, and enqueue the next program; returns its sweep
+        record (the caller installs it as in-flight AFTER consuming the
+        previous program). Returns None when there is nothing to run —
+        the consume-only drain tail."""
+        if self._carry is None:
+            self._rebuild_carry()
+        plan = self._plan_step()
+        if plan is None:
+            return None
+        prefill, decode, widths = plan
+        if not self._reserve_planned(widths):
+            # Pool pressure beyond eviction: fall back to the sync edge.
+            # After the flush the mirrors are exact, so _ensure_blocks
+            # preempts exactly where the synchronous loop would have.
+            self.overlap_flushes += 1
+            self.flush()
+            finished.extend(self._pending_finished)
+            self._pending_finished = []
+            self._rebuild_carry()
+            plan = self._plan_step()
+            if plan is None:
+                return None
+            prefill, decode, widths = plan
+            before = self.preemption_count
+            self._ensure_blocks(widths)
+            if self.preemption_count != before:
+                self._rebuild_carry()     # preempted slots left the carry
+                plan = self._plan_step()
+                if plan is None:
+                    return None
+                prefill, decode, widths = plan
+        if prefill:
+            return self._dispatch_chunk(prefill, decode)
+        return self._dispatch_micro(decode, widths)
+
+    def _req_limits_eos(self):
+        limits = np.array(
+            [r.max_new_tokens if r is not None else 0
+             for r in self._slots], np.int32)
+        eos = np.array(
+            [r.eos_token if r is not None and r.eos_token is not None
+             else -1 for r in self._slots], np.int32)
+        return limits, eos
+
+    def _launch(self, fn, *args, qa=None):
+        """Enqueue one carry program against the donated pools WITHOUT
+        reading anything back: only the dispatch call's wall is charged
+        to the program bucket here (execution overlaps the sweep; the
+        consume edge charges the blocked wait). Installs the returned
+        device carry/pools; returns the (ys, qerr) futures."""
+        t0 = time.perf_counter() if self._goodput is not None else 0.0
+        if self._quantized:
+            ys, self._carry, self.pools, qerr = fn(*args, qa, self.pools)
+        else:
+            ys, self._carry, self.pools = fn(*args, self.pools)
+            qerr = None
+        if self._goodput is not None:
+            self._goodput.program(time.perf_counter() - t0)
+        return ys, qerr
+
+    def _dispatch_micro(self, decode: List[int], widths: np.ndarray) -> dict:
+        """Pure-decode program: the K-token carry micro-step (K=1 is a
+        length-1 scan of the same body — bit-identical to the plain
+        step, the PR 13 pin)."""
+        n = self.scfg.slots
+        tok, pos, alive, emitted = self._carry
+        limits, eos = self._req_limits_eos()
+        cand = np.zeros((n,), bool)
+        cand[decode] = True
+        qa = None
+        if self._quantized:
+            qa = self._micro_quant_layout(
+                np.where(cand, self._planned_pos, 0).astype(np.int32),
+                widths)
+        rec_pos = self._planned_pos.copy()
+        if self._all_greedy():
+            ys, qerr = self._launch(
+                self._micro_carry_greedy_fn, self.params, tok, pos, alive,
+                emitted, jnp.asarray(self._tables), jnp.asarray(limits),
+                jnp.asarray(eos), qa=qa)
+        else:
+            temps, tops = self._temps_tops()
+            ys, qerr = self._launch(
+                self._micro_carry_sample_fn, self.params, tok, pos, alive,
+                emitted, jnp.asarray(self._tables), jnp.asarray(limits),
+                jnp.asarray(eos), jnp.asarray(temps), jnp.asarray(tops),
+                jnp.asarray(self._slot_keys), qa=qa)
+        self.decode_steps += 1
+        if self.scfg.micro_k > 1:
+            self.micro_steps += 1
+        for i in decode:
+            w = int(widths[i])
+            self._planned_pos[i] += w
+            self._planned_emitted[i] += w
+        return {"kind": "micro", "ys": ys, "qerr": qerr,
+                "reqs": list(self._slots), "cand": cand, "pos0": rec_pos}
+
+    def _dispatch_chunk(self, prefill, decode: List[int]) -> dict:
+        """Mixed program: every admitting slot's chunk rows packed beside
+        the decode carry rows — the multi-slot generalization of
+        :meth:`_chunk_step`, with completing prefills PROMOTED in-program
+        into the carry (their first token samples on device; the host
+        only reads it back at the sweep)."""
+        n, W = self.scfg.slots, self.scfg.chunk_tokens
+        m = self.scfg.max_blocks_per_slot
+        tok, pos_c, alive_c, emitted_c = self._carry
+        limits, eos = self._req_limits_eos()
+        ctoks = np.zeros((W,), np.int32)
+        cpos = np.zeros((W,), np.int32)
+        cvalid = np.zeros((W,), bool)
+        tables = np.zeros((n + W, m), np.int32)
+        tables[:n] = self._tables
+        prow = np.full((n,), -1, np.int32)
+        ppos = np.zeros((n,), np.int32)
+        pngen = np.zeros((n,), np.int32)
+        temps = np.zeros((n + W,), np.float32)
+        tops = np.ones((n + W,), np.float32)
+        rkeys = np.zeros((n + W, 2), np.uint32)
+        cngen = np.zeros((W,), np.int32)
+        temps[:n], tops[:n] = self._temps_tops()
+        rkeys[:n] = self._slot_keys
+        rows = []                     # (slot, row offset, c, pos, completing)
+        off = 0
+        for i, c, pos, completing in prefill:
+            req = self._slots[i]
+            ctx = self._context_ids(req)
+            ctoks[off:off + c] = ctx[pos:pos + c]
+            cpos[off:off + c] = np.arange(pos, pos + c)
+            cvalid[off:off + c] = True
+            tables[n + off:n + off + c] = self._tables[i]
+            temps[n + off:n + off + c] = req.temperature
+            tops[n + off:n + off + c] = req.top_p
+            rkeys[n + off:n + off + c] = self._slot_keys[i]
+            cngen[off:off + c] = len(req.tokens)
+            if completing:
+                prow[i] = off + c - 1
+                ppos[i] = int(self._prefill_target[i])
+                pngen[i] = len(req.tokens)
+            rows.append((i, off, c, pos, completing))
+            off += c
+        qa = None
+        if self._quantized:
+            rpos = np.zeros((n + W,), np.int32)
+            rvalid = np.zeros((n + W,), bool)
+            for i in decode:
+                rpos[i] = self._planned_pos[i]
+                rvalid[i] = True
+            rpos[n:], rvalid[n:] = cpos, cvalid
+            qa = self._quant_layout(tables, rpos[:, None], rvalid[:, None])
+        rec_pos = self._planned_pos.copy()
+        work = (len(decode) + int(cvalid.sum()),
+                float(sum(int(rec_pos[i]) for i in decode))
+                + float(cpos[cvalid].sum()))
+        base = (self.params, tok, pos_c, alive_c, emitted_c,
+                jnp.asarray(ctoks), jnp.asarray(cpos),
+                jnp.asarray(cvalid), jnp.asarray(tables),
+                jnp.asarray(limits), jnp.asarray(eos), jnp.asarray(prow),
+                jnp.asarray(ppos), jnp.asarray(pngen))
+        if self._all_greedy():
+            ys, qerr = self._launch(
+                self._chunk_carry_greedy_fn, *base, qa=qa)
+        else:
+            ys, qerr = self._launch(
+                self._chunk_carry_sample_fn, *base, jnp.asarray(temps),
+                jnp.asarray(tops), jnp.asarray(rkeys),
+                jnp.asarray(cngen), qa=qa)
+        self.chunk_steps += 1
+        for i, c, pos, completing in prefill:
+            if completing:
+                self._planned_pos[i] = int(self._prefill_target[i])
+                self._planned_emitted[i] += 1
+            else:
+                self._planned_pos[i] = pos + c
+        for i in decode:
+            self._planned_pos[i] += 1
+            self._planned_emitted[i] += 1
+        return {"kind": "chunk", "ys": ys, "qerr": qerr,
+                "reqs": list(self._slots), "decode": list(decode),
+                "rows": rows, "pos0": rec_pos, "work": work}
+
+    # lint: end-overlap-dispatch
+
+    def _consume_one(self, rec: Optional[dict], finished: list) -> None:
+        """The pipeline's ONE blocking edge: force the recorded program's
+        tokens and replay the sweep strictly from the DISPATCH RECORD —
+        never from current slot state. Rows whose recorded request
+        already retired (an earlier sweep saw its last token) are
+        skipped: their slot and mirrors may belong to a newer admission.
+        The replayed retirement rule is the device's own (eos match or
+        emitted ≥ max_new), so host and carry agree exactly."""
+        if rec is None:
+            return
+        t0 = time.perf_counter() if self._goodput is not None else 0.0
+        ys = np.asarray(rec["ys"])
+        if self._goodput is not None:
+            self._goodput.consume_wait(time.perf_counter() - t0)
+        if rec["qerr"] is not None:
+            self._note_qerr(rec["qerr"])
+        now = time.monotonic()
+        n = self.scfg.slots
+        emitted_total, pos_sum = 0, 0.0
+        if rec["kind"] == "micro":
+            for slot in range(n):
+                if not rec["cand"][slot]:
+                    continue
+                req = rec["reqs"][slot]
+                if req is None or req.status != RUNNING:
+                    continue
+                for j in range(ys.shape[0]):
+                    tok = int(ys[j, slot])
+                    req.tokens.append(tok)
+                    emitted_total += 1
+                    pos_sum += float(rec["pos0"][slot]) + j
+                    self._positions[slot] += 1
+                    self._last_token[slot] = tok
+                    if req.first_token_t is None:
+                        req.first_token_t = now
+                        self._obs_first_token(req)
+                    if req.finished:
+                        break
+                if req.finished:
+                    self._retire(slot)
+                    finished.append(req.rid)
+            if self._goodput is not None:
+                self._goodput.work_counts(emitted_total, pos_sum)
+                self._goodput.emitted(emitted_total)
+            return
+        for slot in rec["decode"]:
+            req = rec["reqs"][slot]
+            if req is None or req.status != RUNNING:
+                continue
+            tok = int(ys[slot])
+            req.tokens.append(tok)
+            emitted_total += 1
+            self._positions[slot] += 1
+            self._last_token[slot] = tok
+            if req.first_token_t is None:
+                req.first_token_t = now
+                self._obs_first_token(req)
+            if req.finished:
+                self._retire(slot)
+                finished.append(req.rid)
+        for slot, off, c, pos, completing in rec["rows"]:
+            req = rec["reqs"][slot]
+            if req is None or req.status != RUNNING:
+                continue
+            self._positions[slot] = pos + c
+            self.prefill_chunks += 1
+            if not completing:
+                continue
+            self.prefills += 1               # prompt complete: first token
+            tok = int(ys[n + off + c - 1])
+            req.tokens.append(tok)
+            emitted_total += 1
+            self._last_token[slot] = tok
+            if req.first_token_t is None:
+                req.first_token_t = now
+                self._obs_first_token(req)
+            if req.finished:
+                self._retire(slot)
+                finished.append(req.rid)
+        if self._goodput is not None:
+            self._goodput.work_counts(*rec["work"])
+            self._goodput.emitted(emitted_total)
+
     # -- scheduler internals -------------------------------------------------
 
     def _prefilling(self, slot: int) -> bool:
         req = self._slots[slot]
         return req is not None and \
             int(self._positions[slot]) < int(self._prefill_target[slot])
+
+    def _prefilling_planned(self, slot: int) -> bool:
+        """Prefilling as of the last DISPATCH (overlap mode): the chunk
+        program that completes this slot's prompt may still be in
+        flight, but no further prefill work remains to plan."""
+        req = self._slots[slot]
+        return req is not None and \
+            int(self._planned_pos[slot]) < int(self._prefill_target[slot])
 
     def _context_ids(self, req: Request) -> np.ndarray:
         return np.concatenate(
@@ -1245,25 +1799,42 @@ class ServingEngine:
         self.fleet_prefetch_blocks += len(imported)
         return len(imported)
 
-    def export_cached_blocks(self, limit: int = 16,
-                             skip=()) -> List[Tuple[str, bytes]]:
-        """The publish half of the fleet KV plane: up to ``limit`` hot
-        ref-0 retained prefix-cache blocks as (hash hex, payload bytes),
-        hottest first, skipping hashes in ``skip`` (the client's
-        already-published set). Retained ref-0 blocks are frozen — no
-        slot can write them without a COW copy — so the payload read is
-        race-free by construction."""
+    def stage_cached_blocks(self, limit: int = 16,
+                            skip=()) -> List[Tuple[str, List]]:
+        """The NON-BLOCKING half of the publish path: up to ``limit`` hot
+        ref-0 retained prefix-cache blocks as (hash hex, staged device
+        slices) — no readback happens here, so the call is safe on the
+        engine's critical path even with a program in flight (the slices
+        enqueue behind it; pools donated to LATER programs reuse their
+        buffers only after these reads complete). Retained ref-0 blocks
+        are frozen — no slot can write them without a COW copy — and
+        never sit in a dispatched table, so the staged values are exact.
+        Force each entry with ``cache.staged_block_to_bytes`` OFF the
+        critical path (a publisher thread, or after the next dispatch)."""
         if self._pcache is None:
             return []
-        out: List[Tuple[str, bytes]] = []
+        out: List[Tuple[str, List]] = []
         for h, block in self._pcache.hot_entries():
             if len(out) >= limit:
                 break
             hash_hex = h.hex()
             if hash_hex in skip:
                 continue
-            out.append((hash_hex, export_block_bytes(self.pools, block)))
+            out.append((hash_hex, stage_block_arrays(self.pools, block)))
         return out
+
+    def export_cached_blocks(self, limit: int = 16,
+                             skip=()) -> List[Tuple[str, bytes]]:
+        """The publish half of the fleet KV plane: up to ``limit`` hot
+        ref-0 retained prefix-cache blocks as (hash hex, payload bytes),
+        hottest first, skipping hashes in ``skip`` (the client's
+        already-published set). The blocking stage+force composition of
+        :meth:`stage_cached_blocks` — callers that care about the
+        engine's dispatch cadence stage on the critical path and force
+        elsewhere; this remains the simple synchronous form."""
+        return [(hash_hex, staged_block_to_bytes(staged))
+                for hash_hex, staged in self.stage_cached_blocks(
+                    limit=limit, skip=skip)]
 
     def _admit(self, admitted: list, finished: list) -> None:
         if self.scfg.prefill == "chunked":
@@ -1272,12 +1843,24 @@ class ServingEngine:
             self._admit_bucketed(admitted, finished)
 
     def _admit_chunked(self, admitted: list) -> None:
-        """Assign a free slot + blocks; prompt ingestion happens across the
-        following steps' chunk programs. At most ONE slot prefills at a
-        time — its chunk IS the step's prefill token budget."""
+        """Assign a free slot + blocks; prompt ingestion happens across
+        the following steps' chunk programs. At most ``prefill_slots``
+        slots prefill at a time (default 1 — the historical one-slot
+        behavior): admitting slots SHARE the step's ``chunk_tokens``
+        budget oldest-first, so an admission burst packs several prompt
+        tails into one program instead of serializing one slot per step
+        — the admission-p99 lever (ISSUE 16)."""
         bs = self.scfg.block_size
+        # In overlap mode the gate reads PLANNED positions: a completing
+        # chunk already dispatched counts as done even though its sweep
+        # lands next step — otherwise every admission would wait one
+        # extra step for the mirror update and a burst would serialize
+        # at half rate.
+        prefilling = (self._prefilling_planned if self._overlap
+                      else self._prefilling)
         while self._queue:
-            if any(self._prefilling(i) for i in range(self.scfg.slots)):
+            if sum(prefilling(i) for i in range(self.scfg.slots)) \
+                    >= self.scfg.prefill_slots:
                 return
             slot = next(
                 (i for i, r in enumerate(self._slots) if r is None), None)
@@ -1344,6 +1927,14 @@ class ServingEngine:
             self._prefill_target[slot] = plen
             self._last_token[slot] = 0
             self._draft_pos[slot] = 0
+            if self._overlap:
+                # The slot's planned device state restarts with the new
+                # occupant: any still-unswept program dispatched against
+                # the previous request runs this row dead (record-skip
+                # discipline), so its stale planned advance must not
+                # leak into the new request's prefill plan.
+                self._planned_pos[slot] = cached_len
+                self._planned_emitted[slot] = len(req.tokens)
             admitted.append(req.rid)
             self._obs_admit(req, cached_tokens=cached_len)
 
@@ -1698,8 +2289,10 @@ class ServingEngine:
 
         The step is TOKEN-PACKED: the program is the plain decode step at
         batch ``slots + chunk_tokens`` — rows 0..slots-1 are the decode
-        slots (one token each) and rows slots.. are the admitting slot's
-        chunk, one token per row, all sharing that slot's block table. The
+        slots (one token each) and rows slots.. are the admitting slots'
+        chunks (oldest-admission-first under the one shared budget when
+        ``prefill_slots > 1``), one token per row, each row carrying its
+        owning slot's block table. The
         per-step token budget is therefore exactly slots + chunk_tokens
         positions of compute (a padded (slots, chunk) layout would pay
         slots × chunk — width for every row), and the program is the SAME
@@ -1713,14 +2306,22 @@ class ServingEngine:
             # With spec on, decode rows are HELD here (width 0) — the spec
             # round this same scheduler step advances them instead, keeping
             # every sampled token on the position-keyed spec streams.
+            # Prefilling slots SHARE the one chunk budget oldest-first
+            # (prefill_slots > 1): a slot the budget can't reach this
+            # step simply waits — never more than W prompt positions of
+            # work ride one program.
             w = np.zeros((n,), np.int32)
-            for i, req in enumerate(self._slots):
+            budget = W
+            for i in sorted(range(n), key=lambda j: self._admit_seq[j]):
+                req = self._slots[i]
                 if req is None:
                     continue
                 pos = int(self._positions[i])
                 target = int(self._prefill_target[i])
                 if pos < target:
-                    w[i] = min(W, target - pos)
+                    c = min(budget, target - pos)
+                    w[i] = c
+                    budget -= c
                 elif not self._spec_on:
                     w[i] = 1
             return w
@@ -1731,7 +2332,11 @@ class ServingEngine:
         widths = chunk_widths()           # preemption may have freed slots
         if not widths.max():              # the ingesting slot was preempted
             return
-        pre = next((i for i in range(n) if self._prefilling(i)), None)
+        # Admitting slots with a chunk share this step, oldest first —
+        # each one's rows carry ITS OWN block table, so several prompt
+        # tails pack into the one program.
+        pres = [i for i in sorted(range(n), key=lambda j: self._admit_seq[j])
+                if self._prefilling(i) and widths[i]]
         R = n + W
         tokens = np.zeros((R,), np.int32)
         positions = np.zeros((R,), np.int32)
@@ -1744,27 +2349,31 @@ class ServingEngine:
         tables[:n] = self._tables
         temps[:n], tops[:n] = self._temps_tops()
         for i, req in enumerate(self._slots):
-            if req is None or not widths[i] or i == pre:
+            if req is None or not widths[i] or i in pres:
                 continue
             tokens[i] = self._last_token[i]
             positions[i] = self._positions[i]
             active[i] = True
             keys[i], ngen[i] = self._slot_keys[i], len(req.tokens)
-        c = 0
-        if pre is not None:
-            req = self._slots[pre]
-            pos, c = int(self._positions[pre]), int(widths[pre])
+        rows = {}                     # slot -> (row offset, c, pos)
+        off = 0
+        for i in pres:
+            req = self._slots[i]
+            pos, c = int(self._positions[i]), int(widths[i])
             ctx = self._context_ids(req)       # prompt + any resumed prefix
-            tokens[n:n + c] = ctx[pos:pos + c]
-            positions[n:n + c] = np.arange(pos, pos + c)
-            tables[n:] = self._tables[pre]
-            active[n:n + c] = True
-            temps[n:n + c], tops[n:n + c] = req.temperature, req.top_p
-            keys[n:n + c] = self._slot_keys[pre]
+            tokens[n + off:n + off + c] = ctx[pos:pos + c]
+            positions[n + off:n + off + c] = np.arange(pos, pos + c)
+            tables[n + off:n + off + c] = self._tables[i]
+            active[n + off:n + off + c] = True
+            temps[n + off:n + off + c] = req.temperature
+            tops[n + off:n + off + c] = req.top_p
+            keys[n + off:n + off + c] = self._slot_keys[i]
             # The post-prefill sample rides fold_in(key, len(tokens)) —
             # 0 for a fresh admission (the same draw a bucketed admission
             # makes), the resumed-token count for resume_inflight imports.
-            ngen[n:n + c] = len(req.tokens)
+            ngen[n + off:n + off + c] = len(req.tokens)
+            rows[i] = (off, c, pos)
+            off += c
         pos_masked = np.where(active, positions, 0)
         qa = (self._quant_layout(tables, pos_masked[:, None],
                                  active[:, None])
@@ -1790,13 +2399,14 @@ class ServingEngine:
         for i, req in enumerate(self._slots):
             if req is None or not widths[i]:        # empty or spec-held row
                 continue
-            if i == pre:                            # prefill rows
+            if i in rows:                           # prefill rows
+                off, c, pos = rows[i]
                 self._positions[i] = pos + c
                 self.prefill_chunks += 1
                 if pos + c < int(self._prefill_target[i]):
                     continue                        # mid-prompt: no token
                 self.prefills += 1                  # prompt complete
-                tok = int(toks[n + c - 1])          # last chunk row's sample
+                tok = int(toks[n + off + c - 1])    # last chunk row's sample
             else:                                   # decode row
                 self._positions[i] = int(self._positions[i]) + 1
                 tok = int(toks[i])
@@ -2073,6 +2683,13 @@ class ServingEngine:
             "micro_k": self.scfg.micro_k,
             "micro_steps": self.micro_steps,
             "chunk_steps": self.chunk_steps,
+            # The asynchronous loop (ISSUE 16): whether the overlapped
+            # dispatch/consume pipeline ran, how many admitting slots may
+            # share a chunk program, and how often pool pressure forced
+            # a flush back to the synchronous edge.
+            "overlap": self._overlap,
+            "prefill_slots": self.scfg.prefill_slots,
+            "overlap_flushes": self.overlap_flushes,
             "prefills": self.prefills,
             "prefill_chunks": self.prefill_chunks,
             "recompute_preemptions": self.preemption_count,

@@ -301,7 +301,7 @@ def greedy_decode_step(params: Params, cfg: TransformerConfig, tokens,
 def _micro_scan(params: Params, cfg: TransformerConfig, tokens, positions,
                 block_tables, active, limits, eos, pools, qa, micro_k: int,
                 sampler, attn_impl: str, mesh, measure_qerr: bool,
-                moe_fn=None):
+                moe_fn=None, emitted0=None, return_carry: bool = False):
     """Run ``micro_k`` SEQUENTIAL decode iterations inside one program —
     the engine's per-token host loop folded into a ``lax.scan`` whose
     body is exactly :func:`paged_decode_step` plus the sampler plus the
@@ -335,7 +335,18 @@ def _micro_scan(params: Params, cfg: TransformerConfig, tokens, positions,
 
     Returns ((micro_k, slots) int32 tokens, pools[, max quant error]).
     The host recovers each slot's valid prefix from the tokens alone —
-    it knows eos and the limits, so validity needs no extra output."""
+    it knows eos and the limits, so validity needs no extra output.
+
+    The overlapped engine threads the loop state PROGRAM TO PROGRAM
+    instead of rebuilding it from host mirrors each dispatch:
+    ``emitted0`` seeds the emitted counter (the carry convention is then
+    ABSOLUTE — emitted ≡ the request's total generated-token count and
+    ``limits`` ≡ max_new_tokens, which emits the identical tokens: with
+    relative limits ``span = min(K, remaining)``, ``emitted_rel ≥ span``
+    fires exactly when ``emitted_abs ≥ max_new`` inside the K
+    iterations) and ``return_carry=True`` additionally returns the final
+    (tok, pos, alive, emitted) carry as device arrays, so the next
+    micro-step's inputs never round-trip through the host."""
     quantized = pool_is_quantized(pools)
     if quantized and qa is None:
         raise ValueError(
@@ -359,12 +370,19 @@ def _micro_scan(params: Params, cfg: TransformerConfig, tokens, positions,
         ys = (nxt, out[2]) if quantized else (nxt,)
         return (tok, pos, alive, emitted, pools), ys
 
-    init = (tokens, positions, active, jnp.zeros_like(positions), pools)
+    init = (tokens, positions, active,
+            jnp.zeros_like(positions) if emitted0 is None else emitted0,
+            pools)
     if quantized:
-        (_, _, _, _, pools), ys = jax.lax.scan(body, init, qa)
-        return ys[0], pools, jnp.max(ys[1])
-    (_, _, _, _, pools), ys = jax.lax.scan(
+        (tok, pos, alive, emitted, pools), ys = jax.lax.scan(body, init, qa)
+        qerr = jnp.max(ys[1])
+        if return_carry:
+            return ys[0], (tok, pos, alive, emitted), pools, qerr
+        return ys[0], pools, qerr
+    (tok, pos, alive, emitted, pools), ys = jax.lax.scan(
         body, init, None, length=micro_k)
+    if return_carry:
+        return ys[0], (tok, pos, alive, emitted), pools
     return ys[0], pools
 
 
@@ -406,6 +424,160 @@ def micro_decode_sample(params: Params, cfg: TransformerConfig, tokens,
     return _micro_scan(params, cfg, tokens, positions, block_tables,
                        active, limits, eos, pools, qa, micro_k, sampler,
                        attn_impl, mesh, measure_qerr, moe_fn=moe_fn)
+
+
+# -- carry-threaded programs (the overlapped engine loop, ROADMAP item 4) ----
+#
+# The async engine never reads the loop state back between dispatches:
+# each program takes the previous program's (tok, pos, alive, emitted)
+# carry as device arrays and returns the next one, so the host's only
+# blocking edge is the (K, slots) token readback it sweeps — and that
+# sweep runs while the device executes the NEXT (already dispatched)
+# program. The carry convention is ABSOLUTE: ``emitted`` is the
+# request's total generated count (== len(req.tokens)) and ``limits``
+# is max_new_tokens, so a carry rebuilt from host mirrors after any
+# full sweep is exactly the device's (docs/parity.md "Async overlap").
+
+
+def micro_carry_greedy(params: Params, cfg: TransformerConfig, tok, pos,
+                       alive, emitted, block_tables, limits, eos, pools,
+                       qa=None, *, micro_k: int, attn_impl: str = "xla",
+                       mesh=None, measure_qerr: bool = False, moe_fn=None):
+    """Greedy K-token micro-step with the loop carry threaded in AND out
+    — :func:`micro_decode_greedy` emitting the identical tokens (same
+    scan body, absolute instead of relative retirement limits), plus the
+    final (tok, pos, alive, emitted) carry for the next dispatch.
+    Returns ((micro_k, slots) tokens, carry, pools[, max quant err])."""
+    def sampler(logits, alive_, emitted_):
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    return _micro_scan(params, cfg, tok, pos, block_tables, alive, limits,
+                       eos, pools, qa, micro_k, sampler, attn_impl, mesh,
+                       measure_qerr, moe_fn=moe_fn, emitted0=emitted,
+                       return_carry=True)
+
+
+def micro_carry_sample(params: Params, cfg: TransformerConfig, tok, pos,
+                       alive, emitted, block_tables, limits, eos,
+                       temperature, top_p, slot_keys, pools, qa=None, *,
+                       micro_k: int, attn_impl: str = "xla", mesh=None,
+                       measure_qerr: bool = False, moe_fn=None):
+    """Sampled K-token micro-step with the carry threaded through. The
+    carry's absolute ``emitted`` IS each slot's n_generated, so the
+    per-iteration key is ``fold_in(slot_keys[i], emitted)`` directly —
+    the same per-token key stream every other sampler draws."""
+    def sampler(logits, alive_, emitted_):
+        keys = jax.vmap(jax.random.fold_in)(slot_keys, emitted_)
+        return sample_tokens(logits, temperature, top_p, keys)
+
+    return _micro_scan(params, cfg, tok, pos, block_tables, alive, limits,
+                       eos, pools, qa, micro_k, sampler, attn_impl, mesh,
+                       measure_qerr, moe_fn=moe_fn, emitted0=emitted,
+                       return_carry=True)
+
+
+def _chunk_carry(params: Params, cfg: TransformerConfig, tok, pos, alive,
+                 emitted, ctoks, cpos, cvalid, block_tables, limits, eos,
+                 promote_row, promote_pos, promote_ngen, pools, qa,
+                 sampler, attn_impl: str, mesh, measure_qerr: bool,
+                 moe_fn=None):
+    """The carry-threaded packed chunk step: ONE decode pass at batch
+    ``slots + chunk_tokens`` where rows 0..slots-1 advance the carry
+    (width-1 decode with in-program retirement — the K=1 micro body) and
+    rows slots.. ingest prompt chunks from host-supplied arrays (each
+    chunk row carries its OWN slot's table row, so several admissions
+    pack into one program). ``promote_row[i] >= 0`` marks slot ``i`` as
+    COMPLETING its prefill this step: the program lifts that (absolute)
+    chunk row's sampled token into the carry as the slot's first
+    generated token, sets its position to ``promote_pos[i]`` (the
+    prefill target) and its emitted count to ``promote_ngen[i] + 1``,
+    and applies the same eos/limit retirement every decode row gets —
+    so a newly admitted request joins the NEXT program's decode rows
+    without the host ever touching the in-flight one. Returns
+    ((slots + chunk_tokens,) sampled tokens, carry, pools[, qerr])."""
+    n = tok.shape[0]
+    W = ctoks.shape[0]
+    R = n + W
+    quantized = pool_is_quantized(pools)
+    # Static-slice packing (.at[].set), NOT jnp.concatenate: token-path
+    # concatenates feeding shard_map are the documented jax 0.4.x CPU
+    # SPMD miscompile (see serving_moe_fn) and the repo lint flags them.
+    tokens = jnp.zeros((R,), jnp.int32).at[:n].set(tok).at[n:].set(ctoks)
+    positions = jnp.zeros((R,), jnp.int32) \
+        .at[:n].set(jnp.where(alive, pos, 0)) \
+        .at[n:].set(jnp.where(cvalid, cpos, 0))
+    active = jnp.zeros((R,), bool).at[:n].set(alive).at[n:].set(cvalid)
+    out = paged_decode_step(
+        params, cfg, tokens, positions, block_tables, active, pools, qa,
+        attn_impl=attn_impl, mesh=mesh, measure_qerr=measure_qerr,
+        moe_fn=moe_fn)
+    nxt = sampler(out[0], emitted)                   # (R,) int32
+    # Decode-row update: exactly the micro-scan body at K=1.
+    new_tok = jnp.where(alive, nxt[:n], tok)
+    new_emitted = emitted + alive.astype(jnp.int32)
+    done = alive & (((eos >= 0) & (new_tok == eos))
+                    | (new_emitted >= limits))
+    new_pos = pos + alive.astype(jnp.int32)
+    new_alive = alive & ~done
+    # Promotion: completing prefill slots enter the carry with their
+    # first sampled token — and the same retirement check a bucketed
+    # admission's immediate first token gets (max_new == 1, or the
+    # first token IS eos).
+    promoting = promote_row >= 0
+    ptok = nxt[n + jnp.clip(promote_row, 0, W - 1)]
+    p_emitted = promote_ngen + 1
+    p_alive = ~(((eos >= 0) & (ptok == eos)) | (p_emitted >= limits))
+    new_tok = jnp.where(promoting, ptok, new_tok)
+    new_pos = jnp.where(promoting, promote_pos, new_pos)
+    new_emitted = jnp.where(promoting, p_emitted, new_emitted)
+    new_alive = jnp.where(promoting, p_alive, new_alive)
+    carry = (new_tok, new_pos, new_alive, new_emitted)
+    if quantized:
+        return nxt, carry, out[1], out[2]
+    return nxt, carry, out[1]
+
+
+def chunk_carry_greedy(params: Params, cfg: TransformerConfig, tok, pos,
+                       alive, emitted, ctoks, cpos, cvalid, block_tables,
+                       limits, eos, promote_row, promote_pos, promote_ngen,
+                       pools, qa=None, *, attn_impl: str = "xla",
+                       mesh=None, measure_qerr: bool = False, moe_fn=None):
+    """Greedy carry chunk step — argmax over every packed row."""
+    def sampler(logits, emitted_):
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    return _chunk_carry(params, cfg, tok, pos, alive, emitted, ctoks,
+                        cpos, cvalid, block_tables, limits, eos,
+                        promote_row, promote_pos, promote_ngen, pools, qa,
+                        sampler, attn_impl, mesh, measure_qerr,
+                        moe_fn=moe_fn)
+
+
+def chunk_carry_sample(params: Params, cfg: TransformerConfig, tok, pos,
+                       alive, emitted, ctoks, cpos, cvalid, block_tables,
+                       limits, eos, promote_row, promote_pos, promote_ngen,
+                       temperature, top_p, row_keys, chunk_ngen, pools,
+                       qa=None, *, attn_impl: str = "xla", mesh=None,
+                       measure_qerr: bool = False, moe_fn=None):
+    """Sampled carry chunk step: per-row (temperature, top_p, key) come
+    from the host; each row's token index is the carry's emitted count
+    (decode rows) or the admission-time generated count (chunk rows —
+    constant through a prefill, so the completing row's draw is exactly
+    ``fold_in(key, len(req.tokens))``, the first-token draw every other
+    path makes)."""
+    n = tok.shape[0]
+
+    def sampler(logits, emitted_):
+        ngen = jnp.zeros((logits.shape[0],), jnp.int32) \
+            .at[:n].set(emitted_).at[n:].set(chunk_ngen)
+        keys = jax.vmap(jax.random.fold_in)(row_keys, ngen)
+        return sample_tokens(logits, temperature, top_p, keys)
+
+    return _chunk_carry(params, cfg, tok, pos, alive, emitted, ctoks,
+                        cpos, cvalid, block_tables, limits, eos,
+                        promote_row, promote_pos, promote_ngen, pools, qa,
+                        sampler, attn_impl, mesh, measure_qerr,
+                        moe_fn=moe_fn)
 
 
 def decode_and_sample(params: Params, cfg: TransformerConfig, tokens,

@@ -10,11 +10,25 @@ every other metric):
   retire bookkeeping, numpy staging, Python). ``goodput.host_gap_frac``
   is the direct measurement of ROADMAP item 4's "the step loop re-enters
   Python per token" claim — the number the multi-token micro-step work
-  must drive down. Caveat: time is measured around the program CALL, so
-  a backend with fully async dispatch attributes device time that
-  completes after the call returns to the host gap; on CPU (and any
-  engine that reads tokens back every step, i.e. this one) the call
-  blocks through the readback and the split is faithful.
+  must drive down. Caveat (synchronous loop): time is measured around
+  the program CALL, so a backend with fully async dispatch attributes
+  device time that completes after the call returns to the host gap; an
+  engine that reads tokens back every step blocks through the readback
+  and the split is faithful. The OVERLAPPED loop (``ServingConfig.
+  overlap``) splits three ways instead: program time is the dispatch
+  call plus the consume edge's blocked wait (device demonstrably busy),
+  host work done while a program was in flight is *overlapped*
+  (``goodput.overlapped_host_s`` — the device has queued work under it,
+  so it is covered, not a gap), and only host time in steps with NO
+  program in flight — the drain tail, the first step's pre-dispatch
+  sliver, a flush that emptied the pipeline — charges the host gap.
+  ``host_gap_frac`` under overlap therefore measures device idle the
+  host could have prevented, which the double-buffered loop drives to
+  ~zero by construction; the wall-clock win it buys is reported
+  separately (``bench.py goodput --async``) because a one-core CPU
+  host time-slices "device" and host onto the same core — attribution
+  says what a real accelerator would hide, wall says what this host
+  actually hid.
 * **How much work was wasted?** Tokens are the unit: recompute
   preemptions roll back emitted tokens, rejected speculative proposals
   were scored and discarded, re-dispatched prefixes are re-ingested
@@ -190,7 +204,8 @@ class GoodputMeter:
         self._base_flops = 2.0 * matmul_params(cfg)
         self._attn_flops = 4.0 * cfg.n_layers * cfg.d_attn
         self.reset()
-        for stat in ("program_s", "host_s", "dispatches", "model_flops",
+        for stat in ("program_s", "host_s", "overlapped_host_s",
+                     "dispatches", "model_flops",
                      "tokens_emitted", "tokens_preempted",
                      "tokens_spec_rejected", "tokens_reingested"):
             registry.counter_fn(f"goodput.{stat}",
@@ -209,6 +224,7 @@ class GoodputMeter:
         compile seconds don't read as host gap)."""
         self.program_s = 0.0
         self.host_s = 0.0
+        self.overlapped_host_s = 0.0
         self.dispatches = 0
         self.model_flops = 0.0
         self.tokens_emitted = 0
@@ -216,21 +232,48 @@ class GoodputMeter:
         self.tokens_spec_rejected = 0
         self.tokens_reingested = 0
         self._prog_mark = 0.0
+        self._step_wait: Optional[float] = None
 
     # -- time accounting -------------------------------------------------------
     def program(self, dt: float) -> None:
         """One fused-program dispatch took ``dt`` seconds (call through
-        readback — see the module docstring's async caveat)."""
+        readback in the synchronous loop; call only — enqueue cost — in
+        the overlapped loop, whose device time lands via
+        :meth:`consume_wait`. See the module docstring's caveat)."""
         self.program_s += dt
         self.dispatches += 1
 
     def begin_step(self) -> None:
         self._prog_mark = self.program_s
+        self._step_wait = None
 
     def end_step(self, wall_s: float) -> None:
         """Close one scheduler iteration: whatever the step's wall spent
         outside its program dispatches is host gap."""
         self.host_s += max(0.0, wall_s - (self.program_s - self._prog_mark))
+
+    def consume_wait(self, dt: float) -> None:
+        """Overlapped loop only: the consume edge blocked ``dt`` seconds
+        waiting on the in-flight program — device-busy time, charged as
+        program time (without bumping the dispatch count)."""
+        self.program_s += dt
+        self._step_wait = dt
+
+    def end_step_overlapped(self, wall_s: float, covered: bool) -> None:
+        """Close one OVERLAPPED scheduler iteration. ``covered`` is the
+        engine's statement that a program was in flight across this
+        step's host work (the previous program was still unconsumed, or
+        a new one was dispatched before the sweep) — host time under a
+        live program is overlapped, not a gap: the device has work
+        queued regardless of what the host does next. A step with no
+        program in flight (the drain tail, the first step's pre-dispatch
+        sliver, a flush that emptied the pipeline) charges its full gap
+        to ``host_s`` — the device really could idle under it."""
+        gap = max(0.0, wall_s - (self.program_s - self._prog_mark))
+        if covered:
+            self.overlapped_host_s += gap
+        else:
+            self.host_s += gap
 
     # -- work / token accounting -----------------------------------------------
     def work(self, positions) -> None:
@@ -278,7 +321,10 @@ class GoodputMeter:
     # -- gauges ----------------------------------------------------------------
     @property
     def busy_s(self) -> float:
-        return self.program_s + self.host_s
+        # Overlapped host time is wall the device spent executing under
+        # the sweep — part of the busy denominator (zero in sync mode,
+        # so the synchronous gauges are unchanged).
+        return self.program_s + self.host_s + self.overlapped_host_s
 
     @property
     def host_gap_frac(self) -> float:
@@ -318,6 +364,9 @@ class GoodputMeter:
             "in_program_frac": round(1.0 - self.host_gap_frac, 6),
             "program_s": round(self.program_s, 6),
             "host_s": round(self.host_s, 6),
+            # Host work done under an in-flight program (overlap mode) —
+            # covered by device execution, so not part of the gap.
+            "overlapped_host_s": round(self.overlapped_host_s, 6),
             "dispatches": self.dispatches,
             "dispatches_per_token": round(self.dispatches_per_token, 4),
             "model_flops": self.model_flops,

@@ -219,17 +219,28 @@ class FleetKvClient:
         return self.index
 
     # -- publish -------------------------------------------------------------
-    def publish(self, engine, limit: int = 16) -> int:
-        """Ship up to ``limit`` of the engine's hot ref-0 cached blocks
-        that this client has not already published. Content-addressed
-        writes (``write_if_absent``) make duplicate publishers free:
-        bytes move only for hashes the bucket has never seen. Returns how
-        many blocks were newly advertised in this publisher's shard."""
+    def stage(self, engine, limit: int = 16) -> list:
+        """Snapshot up to ``limit`` unpublished ref-0 cached blocks as
+        (hash, device-array slices) WITHOUT blocking on device transfer.
+        This is the only half of a publish that must run while the
+        engine's pool references are stable (e.g. under the replica
+        lock); ``ship`` can then force and upload the slices off the
+        critical path while the engine keeps dispatching."""
+        self._require_bound()
+        return engine.stage_cached_blocks(limit=limit, skip=self._published)
+
+    def ship(self, staged: list) -> int:
+        """Force ``stage``'d block slices to host bytes and upload them.
+        Content-addressed writes (``write_if_absent``) make duplicate
+        publishers free: bytes move only for hashes the bucket has never
+        seen. Returns how many blocks were newly advertised in this
+        publisher's shard."""
+        from tpu_task.ml.serving.cache import staged_block_to_bytes
+
         index = self._require_bound()
-        entries = engine.export_cached_blocks(
-            limit=limit, skip=self._published)
-        if not entries:
+        if not staged:
             return 0
+        entries = [(hh, staged_block_to_bytes(s)) for hh, s in staged]
         for hash_hex, payload in entries:
             try:
                 if self._backend.write_if_absent(
@@ -249,6 +260,10 @@ class FleetKvClient:
         except OSError:
             pass                          # re-advertised on the next pass
         return len(entries)
+
+    def publish(self, engine, limit: int = 16) -> int:
+        """Stage + ship in one synchronous call (the pre-overlap path)."""
+        return self.ship(self.stage(engine, limit=limit))
 
     # -- lookup / fetch ------------------------------------------------------
     def lookup_chain(self, hashes: Sequence[bytes]) -> int:

@@ -344,6 +344,7 @@ class ReplicaServer:
     def _step_loop(self) -> None:
         while not self._stop.is_set():
             stepped = False
+            staged = None
             try:
                 with self._lock:
                     if not self.draining and self.engine.has_work:
@@ -354,16 +355,24 @@ class ReplicaServer:
                             # step they enter the prefix cache (plus a
                             # periodic pass for blocks cached by other
                             # paths) — a best-effort beat: a failed
-                            # publish just re-offers next time.
+                            # publish just re-offers next time. Only the
+                            # stage (snapshotting block references) needs
+                            # the lock; the ship — device→host transfer
+                            # plus the bucket upload — runs below, off
+                            # the lock, so in overlap mode the next
+                            # dispatched program keeps the device busy
+                            # while the payload uploads.
                             self._steps_since_publish += 1
                             if result["finished"] or \
                                     self._steps_since_publish \
                                     >= self.kv_publish_every:
                                 self._steps_since_publish = 0
-                                try:
-                                    self.kv_client.publish(self.engine)
-                                except OSError:
-                                    pass
+                                staged = self.kv_client.stage(self.engine)
+                if staged:
+                    try:
+                        self.kv_client.ship(staged)
+                    except OSError:
+                        pass
             except Exception as error:
                 # A dying step loop must never wedge the replica silently
                 # (healthz green, streams empty forever): drain instead —

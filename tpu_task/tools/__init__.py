@@ -1,0 +1,1 @@
+"""Repo-internal developer tooling (lint, audits) — not shipped behavior."""

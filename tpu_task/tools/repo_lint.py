@@ -1,0 +1,135 @@
+"""Repo lint: the two serving-path invariants a refactor silently breaks.
+
+Run via ``make lint`` (and in tier-1 through ``tests/test_repo_lint.py``).
+
+1. **No ``jnp.concatenate`` in serving token paths.** Under an outer jit
+   on the jax 0.4.x CPU backend, a concatenate whose result feeds a
+   ``shard_map``'s token slicing miscompiles (PR 15: wrong collective
+   layout — silently wrong tokens, no error). The serving token paths
+   therefore build packed rows with ``jnp.pad`` / ``.at[:n].set``
+   static-slice writes instead. A genuinely safe use (host-side, or
+   provably outside any shard_map token path) opts out with a
+   ``lint: allow-concatenate`` comment on the same line.
+
+2. **No blocking reads inside the overlapped dispatch region.** The
+   engine code between the ``lint: begin-overlap-dispatch`` and
+   ``lint: end-overlap-dispatch`` markers runs while the previous
+   program is still executing on the device; a ``block_until_ready`` /
+   ``jax.device_get`` / ``np.asarray``-of-a-device-value there
+   re-serializes the loop the async engine exists to kill — the consume
+   edge (outside the markers) is the ONE sanctioned blocking point.
+
+Both checks are textual by design: they gate idioms, not semantics, so
+they stay O(file read) and dependency-free.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+from typing import List, Tuple
+
+REPO = Path(__file__).resolve().parents[2]
+
+#: Modules whose jnp arrays are (or feed) token paths under shard_map —
+#: the serving model/engine plus the MoE dispatch they call into.
+TOKEN_PATH_GLOBS = (
+    "tpu_task/ml/serving/*.py",
+    "tpu_task/ml/models/moe.py",
+)
+
+ALLOW_CONCAT = "lint: allow-concatenate"
+BEGIN_OVERLAP = "lint: begin-overlap-dispatch"
+END_OVERLAP = "lint: end-overlap-dispatch"
+OVERLAP_FILE = "tpu_task/ml/serving/engine.py"
+
+_CONCAT_RE = re.compile(r"\bjnp\.concatenate\s*\(")
+#: Blocking-read idioms: forcing a device value waits for every program
+#: enqueued before it. `np.asarray(` is matched with a lookbehind so the
+#: host-side `jnp.asarray(` staging calls (cheap, non-blocking on host
+#: inputs) never trip it.
+_BLOCKING_RES = (
+    re.compile(r"block_until_ready"),
+    re.compile(r"\bjax\.device_get\s*\("),
+    re.compile(r"(?<![\w.])np\.asarray\s*\("),
+)
+
+
+def lint_concatenate_text(text: str, path: str) -> List[str]:
+    """Findings for rule 1 on one file's text."""
+    findings = []
+    for ln, line in enumerate(text.splitlines(), 1):
+        if _CONCAT_RE.search(line) and ALLOW_CONCAT not in line:
+            findings.append(
+                f"{path}:{ln}: jnp.concatenate in a serving token path "
+                f"(jax 0.4.x CPU SPMD miscompile under shard_map — use "
+                f"jnp.pad or .at[:n].set packing, or annotate "
+                f"'# {ALLOW_CONCAT}' if provably safe)")
+    return findings
+
+
+def lint_overlap_text(text: str, path: str) -> List[str]:
+    """Findings for rule 2 on the engine file's text. A missing begin
+    marker is itself a finding — deleting the markers must not silently
+    disable the check."""
+    findings = []
+    lines = text.splitlines()
+    spans: List[Tuple[int, int]] = []
+    begin = None
+    for ln, line in enumerate(lines, 1):
+        if BEGIN_OVERLAP in line:
+            begin = ln
+        elif END_OVERLAP in line and begin is not None:
+            spans.append((begin, ln))
+            begin = None
+    if not spans:
+        return [f"{path}: overlap-dispatch lint markers "
+                f"('{BEGIN_OVERLAP}' ... '{END_OVERLAP}') not found — "
+                f"the no-blocking region must stay marked"]
+    if begin is not None:
+        findings.append(f"{path}:{begin}: unterminated '{BEGIN_OVERLAP}'")
+    for lo, hi in spans:
+        for ln in range(lo, hi + 1):
+            stripped = lines[ln - 1].lstrip()
+            if stripped.startswith("#"):
+                continue
+            for rx in _BLOCKING_RES:
+                if rx.search(lines[ln - 1]):
+                    findings.append(
+                        f"{path}:{ln}: blocking device read "
+                        f"('{rx.pattern}') inside the overlapped "
+                        f"dispatch region — only the consume edge may "
+                        f"block")
+    return findings
+
+
+def run(repo: Path = REPO) -> List[str]:
+    findings = []
+    for glob in TOKEN_PATH_GLOBS:
+        for path in sorted(repo.glob(glob)):
+            rel = path.relative_to(repo).as_posix()
+            findings += lint_concatenate_text(
+                path.read_text(encoding="utf-8"), rel)
+    engine = repo / OVERLAP_FILE
+    if engine.exists():
+        findings += lint_overlap_text(
+            engine.read_text(encoding="utf-8"), OVERLAP_FILE)
+    else:
+        findings.append(f"{OVERLAP_FILE}: missing (overlap lint target)")
+    return findings
+
+
+def main(argv=None) -> int:
+    findings = run()
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"repo_lint: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    print("repo_lint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
