@@ -8,7 +8,7 @@ PYTHON ?= python3
 # Seed for the chaos soak: any run is replayable by pinning this.
 TPU_TASK_CHAOS_SEED ?= 20260804
 
-.PHONY: test lint smoke sweep bench bench-steady bench-serving bench-sched bench-decode bench-fleet bench-fleetkv bench-obs bench-goodput sched sched-soak chaos fleet kvfleet moe moe-serve serve-soak obs watch wheel multichip kernels-tpu clean
+.PHONY: test lint smoke sweep bench bench-steady bench-serving bench-sched bench-decode bench-fleet bench-fleetkv bench-obs bench-goodput bench-tier sched sched-soak chaos fleet kvfleet tiering moe moe-serve serve-soak obs watch wheel multichip kernels-tpu clean
 
 # Hermetic suite (the reference's `make test`, 30 s budget there; ours spans
 # the fake control planes, sharded-compute CPU checks, and the loopback GCS
@@ -54,6 +54,17 @@ bench-steady:
 # speculative accept-rate sweep); all run on CPU.
 bench-serving:
 	$(PYTHON) bench.py serving
+
+# Tiered-KV bench legs only (PR 17): the serving section's `tiering`
+# subsection — resume latency per residency tier (HBM hit vs host
+# promote vs recompute, greedy streams asserted identical — EXITS
+# NONZERO on divergence), idle-session capacity with/without the host
+# rung, the batch-32 overlap leg (host_gap_frac ~0 while blocks demote
+# in the covered window), and the int4-over-int8 density ratio (~2× the
+# blocks at the same HBM budget; full dtype table in the serving
+# section's kv_density).
+bench-tier:
+	$(PYTHON) bench.py serving --tier-only
 
 # Gang-scheduler cost model only: queue-latency percentiles, pool
 # utilization, and per-tenant requeue fairness under Poisson arrivals on
@@ -117,6 +128,16 @@ fleet:
 # prefill/decode-split handoff legs.
 kvfleet:
 	$(PYTHON) -m pytest tests/ -m kvfleet -q
+
+# Tiered-KV hierarchy tests (PR 17): int4 pack/unpack error property +
+# density, demote→promote byte identity across every kv dtype, the
+# 5×-HBM session soak (sync and overlapped loops, streams bit-identical
+# to an all-HBM reference), the long-context int4 leg, the
+# preemption-while-demoted regression, host-budget spill into the fleet
+# bucket, and prefetch_chain host→HBM promotion. Two smoke pins run in
+# tier-1; the soaks are slow.
+tiering:
+	$(PYTHON) -m pytest tests/ -m tiering -q
 
 # Sharded-replica / MoE serving tests: ep all_to_all dispatch identity,
 # tp×ep gang engines, sharded spec decode, scheduler chip accounting,

@@ -897,6 +897,11 @@ def bench_serving(n_requests: int = 36, seed: int = 0) -> dict:
         # behind the `kv_dtype="int8"` knob (≥ 1.9× blocks is the
         # acceptance line; the fp32 toy model here quantizes 4×-ish).
         "kv_density": _kv_density(cfg, scfg),
+        # Tiered KV hierarchy (PR 17): resume latency per residency tier
+        # (HBM hit / host promote / recompute), session capacity with and
+        # without the host rung, and the overlap-covered demotion check
+        # (host_gap_frac stays ~0 while blocks demote in the background).
+        "tiering": _bench_tiering(seed),
         "generate_static_batch": {
             "decode_tokens_per_s": round(useful / static_makespan, 1),
             "makespan_s": round(static_makespan, 3),
@@ -923,11 +928,15 @@ def bench_serving(n_requests: int = 36, seed: int = 0) -> dict:
 
 def _kv_density(cfg, scfg, budget_bytes=None) -> dict:
     """bytes/token + effective ``n_blocks`` at a fixed byte budget, model
-    dtype vs int8 vs fp8 — the density half of ROADMAP item 3 (int8) and
-    the fp8 row of PR 13: fp8 e4m3 codes are byte-identical to int8's
-    (1 byte + the same amortized scale sidecar), so its density equals
-    int8's; what fp8 changes is the ERROR SHAPE — relative per-element
-    rounding instead of int8's uniform grid (docs/parity.md)."""
+    dtype vs int8 vs fp8 vs int4 — the density half of ROADMAP item 3
+    (int8), the fp8 row of PR 13, and the int4 row of PR 17: fp8 e4m3
+    codes are byte-identical to int8's (1 byte + the same amortized
+    scale sidecar), so its density equals int8's; what fp8 changes is
+    the ERROR SHAPE — relative per-element rounding instead of int8's
+    uniform grid (docs/parity.md). int4 packs two codes per byte (the
+    pool's trailing dim halves), so the same budget holds ~2× int8's
+    blocks — the scale sidecar is the only reason the ratio is not
+    exactly 2.0."""
     import dataclasses
 
     from tpu_task.ml.serving.cache import (
@@ -935,28 +944,178 @@ def _kv_density(cfg, scfg, budget_bytes=None) -> dict:
 
     int8_scfg = dataclasses.replace(scfg, kv_dtype="int8")
     fp8_scfg = dataclasses.replace(scfg, kv_dtype="fp8")
+    int4_scfg = dataclasses.replace(scfg, kv_dtype="int4")
     budget = (paged_cache_bytes(cfg, scfg, scfg.n_blocks)
               if budget_bytes is None else budget_bytes)
     fp_tok = kv_token_bytes(cfg)
     i8_tok = kv_token_bytes(cfg, int8_scfg)
     f8_tok = kv_token_bytes(cfg, fp8_scfg)
+    i4_tok = kv_token_bytes(cfg, int4_scfg)
     fp_blocks = blocks_in_budget(cfg, scfg, budget)
     i8_blocks = blocks_in_budget(cfg, int8_scfg, budget)
     f8_blocks = blocks_in_budget(cfg, fp8_scfg, budget)
+    i4_blocks = blocks_in_budget(cfg, int4_scfg, budget)
     import jax.numpy as jnp
 
     return {
         "model_dtype": str(jnp.dtype(cfg.dtype)),
         "kv_bytes_per_token": {"model_dtype": fp_tok, "int8": i8_tok,
-                               "fp8": f8_tok},
+                               "fp8": f8_tok, "int4": i4_tok},
         "int8_bytes_ratio": round(i8_tok / fp_tok, 4),
         "fp8_bytes_ratio": round(f8_tok / fp_tok, 4),
+        "int4_bytes_ratio": round(i4_tok / fp_tok, 4),
         "pool_budget_mb": round(budget / 1e6, 3),
         "n_blocks_at_fixed_budget": {"model_dtype": fp_blocks,
-                                     "int8": i8_blocks, "fp8": f8_blocks},
+                                     "int8": i8_blocks, "fp8": f8_blocks,
+                                     "int4": i4_blocks},
         "int8_blocks_ratio": round(i8_blocks / max(1, fp_blocks), 2),
         "fp8_blocks_ratio": round(f8_blocks / max(1, fp_blocks), 2),
+        "int4_blocks_ratio": round(i4_blocks / max(1, fp_blocks), 2),
+        "int4_blocks_over_int8": round(i4_blocks / max(1, i8_blocks), 2),
     }
+
+
+def _bench_tiering(seed: int = 0) -> dict:
+    """Tiered KV hierarchy leg (PR 17): what the host-RAM rung buys.
+
+    Three measurements, one micro model:
+
+    - ``resume_latency_ms``: the same session resumed from each
+      residency tier — ``hbm_hit`` (prefix chain still in the paged
+      pools: pure cache hit), ``host_promote`` (chain demoted to host
+      RAM and evicted from HBM: imported back via ``write_block``),
+      ``recompute`` (no host tier, chain evicted: full prefill). The
+      greedy streams are asserted identical across all three legs —
+      the tier only moves bytes, never changes tokens.
+    - ``sessions_per_chip``: idle-session capacity with and without the
+      host rung at the same HBM budget (cost model, exact arithmetic).
+    - ``overlap``: a batch-32 staggered-finish workload through the
+      overlapped loop with offload active — early finishers' blocks
+      demote WHILE later requests decode, and ``host_gap_frac`` stays
+      ~0 because staging rides the covered window (the lint-enforced
+      ``tier-migrate`` region), not the consume edge.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_task.ml.models import transformer
+    from tpu_task.ml.serving import ServingConfig, ServingEngine
+    from tpu_task.obs import Obs
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=256, d_model=128, n_heads=8, d_head=16, n_layers=2,
+        d_ff=256, dtype=jnp.float32, n_kv_heads=4)
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(seed)
+
+    block_size, plen, max_new = 8, 32, 8
+    prompt = rng.integers(0, cfg.vocab_size, size=plen)
+    churn = [rng.integers(0, cfg.vocab_size, size=plen)
+             for _ in range(6)]
+
+    def mk(n_blocks: int, host_blocks: int) -> ServingEngine:
+        scfg = ServingConfig(
+            slots=2, block_size=block_size, n_blocks=n_blocks,
+            max_len=plen + max_new + block_size, prefix_cache=True,
+            host_offload_blocks=host_blocks)
+        return ServingEngine(params, cfg, scfg)
+
+    def turn(eng, p):
+        t0 = time.perf_counter()
+        rid = eng.submit(p, max_new)
+        eng.drain()
+        return ((time.perf_counter() - t0) * 1e3,
+                list(eng.request(rid).tokens))
+
+    # Each leg warms its own engine on the SAME shapes the timed resume
+    # uses: populate, churn, then one UNTIMED resume (compiles the
+    # leg's own resume path — hit chunking, host import, or full
+    # recompute), then churn again to restore the leg's residency state
+    # before the timed turn. The timed resume measures residency, not
+    # compilation.
+    legs, streams = {}, {}
+    for name, n_blocks, host_blocks, do_churn in (
+            ("hbm_hit", 64, 0, False),
+            ("host_promote", 14, 64, True),
+            ("recompute", 14, 0, True)):
+        eng = mk(n_blocks, host_blocks)
+        turn(eng, prompt)                      # populate + compile
+        for _ in range(2):
+            if do_churn:                       # demote + evict the chain
+                for p in churn:
+                    turn(eng, p)
+            before = eng.stats()
+            ms, streams[name] = turn(eng, prompt)
+        after = eng.stats()
+        legs[name] = {
+            "resume_ms": round(ms, 2),
+            "prefix_hit_blocks": (after["prefix_cache"]["blocks_saved"]
+                                  - before["prefix_cache"]["blocks_saved"]),
+        }
+        if host_blocks:
+            legs[name]["promoted_blocks"] = (
+                after["tiering"]["promoted_blocks"]
+                - before["tiering"]["promoted_blocks"])
+
+    # Idle-session capacity at the same HBM budget: a parked session
+    # pins ceil((plen + max_new) / block_size) blocks; the host rung
+    # holds demoted copies so HBM-evicted sessions stay resumable
+    # without recompute.
+    bps = -(-(plen + max_new) // block_size)
+    hbm_only = 64 // bps
+    with_host = (64 + 256) // bps
+    capacity = {
+        "blocks_per_session": bps,
+        "hbm_blocks": 64, "host_offload_blocks": 256,
+        "hbm_only_sessions": hbm_only,
+        "with_host_tier_sessions": with_host,
+        "capacity_ratio": round(with_host / max(1, hbm_only), 2),
+    }
+
+    # Overlap + offload at batch 32: staggered max_new so early
+    # finishers' chains go cold (ref-0) and demote while the device is
+    # still busy with the stragglers.
+    scfg = ServingConfig(
+        slots=32, block_size=8, n_blocks=384, max_len=8 + 48,
+        prefix_cache=True, overlap=True, host_offload_blocks=128)
+    obs = Obs.create("tiering-overlap")
+    eng = ServingEngine(params, cfg, scfg, obs=obs)
+    eng.submit(np.zeros((8,), np.int32), 2)
+    eng.drain()                                # compile off the books
+    eng._goodput.reset()
+    for i in range(32):
+        eng.submit(rng.integers(0, cfg.vocab_size, size=8),
+                   16 + (i % 16) * 2)
+    eng.drain()
+    stats = eng.stats()
+    gp, tier = stats["goodput"], stats["tiering"]
+    overlap = {
+        "batch": 32,
+        "host_gap_frac": gp["host_gap_frac"],
+        "demoted_blocks": tier["demoted_blocks"],
+        "host_resident_blocks": tier["host_resident_blocks"],
+        "note": ("demotions staged inside the covered window — "
+                 "host_gap_frac ~0 means the tier traffic cost no "
+                 "device idle"),
+    }
+
+    identical = (streams["hbm_hit"] == streams["host_promote"]
+                 == streams["recompute"])
+    out = {
+        "resume_latency_ms": legs,
+        "resume_streams_identical": identical,
+        "sessions_per_chip": capacity,
+        "overlap": overlap,
+        # The density rung below host RAM: same HBM budget, ~2× int8's
+        # blocks (full table in the sibling kv_density section).
+        "int4_blocks_over_int8":
+            _kv_density(cfg, scfg)["int4_blocks_over_int8"],
+    }
+    if not identical:
+        out["ERROR"] = ("greedy streams DIVERGED across residency "
+                        "tiers — promotion must be byte-identity")
+    return out
 
 
 def bench_serving_multichip(tps=(1, 8), n_requests: int = 16,
@@ -3081,6 +3240,13 @@ def _parse_args(argv):
         help="skip the production-traffic scenarios (shared-prefix prefix "
              "cache, long-prompt-under-load chunked prefill, speculative "
              "accept-rate sweep)")
+    serving.add_argument(
+        "--tier-only", action="store_true", dest="tier_only",
+        help="run only the tiered-KV legs (also `make bench-tier`): "
+             "resume latency per residency tier, session capacity with "
+             "the host rung, the batch-32 overlap/offload leg, and the "
+             "int4-over-int8 density ratio; exits nonzero if greedy "
+             "streams diverge across tiers")
     fleet_cmd = sub.add_parser(
         "fleet",
         help="fleet-serving section only (also `make bench-fleet`): "
@@ -3238,6 +3404,11 @@ if __name__ == "__main__":
                 "greedy_streams_identical", True)
         raise SystemExit(0 if ok else 1)
     if args.section == "serving":
+        if args.tier_only:
+            result = _bench_tiering(seed=args.seed)
+            print(json.dumps({"serving": {"tiering": result}}))
+            raise SystemExit(0 if result["resume_streams_identical"]
+                             else 1)
         tps = tuple(int(t) for t in str(args.tp or "1,8").split(",")
                     if t.strip())
         # Force virtual devices only on an EXPLICIT --tp: the single-chip

@@ -183,6 +183,7 @@ def aws_task(monkeypatch):
         yield control, s3, task
 
 
+@pytest.mark.slow
 def test_aws_full_lifecycle_over_http(aws_task):
     """The real AWSRealTask composition end-to-end against the stateful
     loopback control plane: create → read → stop → delete."""
@@ -299,6 +300,7 @@ def az_task(monkeypatch):
         yield control, blob, task
 
 
+@pytest.mark.slow
 def test_az_full_lifecycle_over_http(az_task):
     """The real AZRealTask composition end-to-end against the stateful ARM
     loopback: create → read → stop → delete, resource-group containment."""
@@ -426,6 +428,7 @@ def gce_task(monkeypatch):
         yield control, gcs, task
 
 
+@pytest.mark.slow
 def test_gce_full_lifecycle_over_http(gce_task):
     """The real GCERealTask composition end-to-end against the stateful
     compute loopback: create → read → stop → delete, with the 6-rule
@@ -481,6 +484,7 @@ def test_gce_image_family_fallback_over_http(gce_task):
     assert image.resource["selfLink"] == "family-link/my-proj/my-family"
 
 
+@pytest.mark.slow
 def test_gce_bare_read_recovers_remote_over_http(gce_task):
     """A fresh task (empty spec) resolves its storage from the template
     metadata through the real wire path, re-injecting local credentials."""

@@ -215,6 +215,7 @@ def _done():
     return ("ok", json.dumps({"status": "DONE"}).encode())
 
 
+@pytest.mark.slow
 def test_create_issues_full_resource_plan(monkeypatch, tmp_path):
     spec = TaskSpec(size=Size(machine="m", storage=111),
                     environment=Environment(script="#!/bin/sh\ntrue"),

@@ -1,0 +1,348 @@
+"""Tiered KV hierarchy tests (ROADMAP item 3): the HBM → host RAM →
+fleet bucket pager, plus the int4 density rung that doubles what the
+HBM tier holds.
+
+The correctness spine is the same one the fleet plane pinned: a block
+payload is only ever adopted under the content hash naming its exact
+token prefix, so demotion/promotion/spill can replace *where* KV lives
+but can never change a stream — every stream assertion here is
+bit-identity against an engine with no tier (and a pool big enough to
+never evict), and every quantization assertion is the recorded error
+contract (|dequant - value| <= scale/2 for int4's 4-bit codes).
+
+Two tests are tier-1 smoke pins (the int4 error property and the
+demote→promote byte-identity sweep); the engine-level soaks — 5× the
+HBM pool's sessions, the long-context int4 leg, preemption while
+demoted, and the spill-to-bucket arm — ride the slow set.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_task.storage.backends import LocalBackend
+
+pytestmark = pytest.mark.tiering
+
+RNG = np.random.default_rng(41)
+
+
+def _micro():
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_task.ml.models import transformer
+
+    cfg = transformer.TransformerConfig(
+        dtype=jnp.float32, vocab_size=64, d_model=32, n_layers=2,
+        n_heads=4, d_head=8, d_ff=64, n_kv_heads=2)
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _engine(cfg, params, *, rng_seed=0, kv_client=None, **knobs):
+    import jax
+
+    from tpu_task.ml.serving import ServingConfig, ServingEngine
+
+    scfg = ServingConfig(**{"slots": 2, "block_size": 4, "n_blocks": 32,
+                            "max_len": 48, **knobs})
+    return ServingEngine(params, cfg, scfg,
+                         rng=jax.random.PRNGKey(rng_seed),
+                         kv_fleet=kv_client)
+
+
+def _dtypes():
+    from tpu_task.ml.serving.cache import fp8_supported
+
+    out = [None, "int8", "int4"]
+    if fp8_supported():
+        out.append("fp8")
+    return out
+
+
+# -- smoke pin 1: the int4 error contract ------------------------------------
+
+
+def test_int4_roundtrip_error_property():
+    """Pack/unpack is the identity on all 16 nibble codes, and the
+    quantize→dequantize round trip honors |err| <= scale/2 per element
+    — the contract docs/parity.md's dtype table records for the 4-bit
+    rung (scale = amax/7, so worst-case error is amax/14)."""
+    import jax.numpy as jnp
+
+    from tpu_task.ml.serving.cache import (
+        INT4_MAX,
+        INT8_SCALE_EPS,
+        dequantize_blocks,
+        pack_int4,
+        unpack_int4,
+    )
+
+    # All 16 signed codes survive the byte packing bit-exactly.
+    codes = jnp.asarray(
+        np.tile(np.arange(-8, 8, dtype=np.int8), 4).reshape(4, 16))
+    assert np.array_equal(np.asarray(unpack_int4(pack_int4(codes))),
+                          np.asarray(codes))
+
+    # Random blocks: per-row scale, error bounded by scale/2.
+    from tpu_task.ml.serving.cache import quantize_blocks
+
+    vals = RNG.standard_normal((6, 4, 2, 16)).astype(np.float32)
+    vals[0] *= 100.0                 # large-amplitude block
+    vals[1] *= 1e-6                  # tiny block (the eps floor arm)
+    packed, scale = quantize_blocks(jnp.asarray(vals), jnp.uint8)
+    assert packed.dtype == jnp.uint8
+    assert packed.shape == (6, 4, 2, 8)       # two codes per byte
+    back = np.asarray(dequantize_blocks(packed, scale, jnp.float32))
+    amax = np.abs(vals).max(axis=(1, 3))
+    expect_scale = np.maximum(amax / INT4_MAX, INT8_SCALE_EPS)
+    err = np.abs(back - vals)
+    # Nothing clips (the amax element maps to exactly ±7), so every
+    # element sits within half a quantization step of its value.
+    assert (err <= expect_scale[:, None, :, None] / 2 + 1e-6).all()
+
+    # The density claim at the bytes level: the same byte budget holds
+    # ~2× the int4 blocks of int8 (codes halve; scale sidecars are
+    # shared overhead).
+    from tpu_task.ml.serving import ServingConfig
+    from tpu_task.ml.serving.cache import kv_block_bytes
+
+    cfg, _ = _micro()
+    kw = dict(slots=2, block_size=4, n_blocks=8, max_len=16)
+    b8 = kv_block_bytes(cfg, ServingConfig(kv_dtype="int8", **kw))
+    b4 = kv_block_bytes(cfg, ServingConfig(kv_dtype="int4", **kw))
+    budget = 1 << 20
+    assert budget // b4 >= int(1.8 * (budget // b8))
+
+
+# -- smoke pin 2: demote → promote byte identity -----------------------------
+
+
+def test_demote_promote_byte_identity_all_dtypes():
+    """The tier seam is byte-faithful for every pool dtype: stage a
+    block's device slices (the demote path's non-blocking half), force
+    them to bytes, park them in a HostKvTier, promote into a FRESH
+    pool, and export again — identical payloads end to end. Also pins
+    the tier's LRU/spill mechanics: budget eviction spills oldest-first
+    into the sink, a failing sink drops (never raises), get() refreshes
+    recency, and chain_depth stops at a hole."""
+    import jax.numpy as jnp
+
+    from tpu_task.ml.serving import ServingConfig, init_pools
+    from tpu_task.ml.serving.cache import (
+        export_block_bytes,
+        split_block_bytes,
+        stage_block_arrays,
+        staged_block_to_bytes,
+        write_block,
+    )
+    from tpu_task.ml.serving.offload import HostKvTier
+
+    cfg, _ = _micro()
+    for kv_dtype in _dtypes():
+        scfg = ServingConfig(slots=2, block_size=4, n_blocks=8,
+                             max_len=16, kv_dtype=kv_dtype)
+        pools = init_pools(cfg, scfg)
+        rng = np.random.default_rng(3)
+        filled = []
+        for layer in pools:
+            out = {}
+            for name, arr in layer.items():
+                vals = rng.standard_normal(arr.shape[1:]).astype(
+                    np.float32)
+                out[name] = arr.at[3].set(
+                    jnp.asarray(vals).astype(arr.dtype))
+            filled.append(out)
+        payload = staged_block_to_bytes(stage_block_arrays(filled, 3))
+        assert payload == export_block_bytes(filled, 3)
+
+        tier = HostKvTier(4)
+        tier.put(b"h3", payload)
+        promoted = tier.get(b"h3")
+        assert promoted == payload
+        values = split_block_bytes(promoted, cfg, scfg)
+        assert values is not None
+        fresh = write_block(
+            init_pools(cfg, scfg), jnp.int32(5),
+            [{name: jnp.asarray(leaf) for name, leaf in layer.items()}
+             for layer in values])
+        assert export_block_bytes(fresh, 5) == payload, kv_dtype
+
+    # Tier mechanics (dtype-independent): LRU spill order and the sink.
+    spilled = []
+    tier = HostKvTier(2, spill=lambda batch: spilled.extend(batch))
+    tier.put(b"a", b"pa")
+    tier.put(b"b", b"pb")
+    assert tier.get(b"a") == b"pa"          # refresh: b is now LRU
+    tier.put(b"c", b"pc")
+    assert spilled == [(b"b", b"pb")] and tier.spilled_blocks == 1
+    assert b"b" not in tier and tier.get(b"a") == b"pa"
+    assert tier.chain_depth([b"a", b"zz", b"c"]) == 1
+
+    def bad_sink(batch):
+        raise OSError("bucket down")
+
+    tier = HostKvTier(1, spill=bad_sink)
+    tier.put(b"a", b"pa")
+    tier.put(b"b", b"pb")                   # sink fails → dropped, no raise
+    assert tier.dropped_blocks == 1 and tier.spilled_blocks == 0
+
+
+# -- engine-level soaks (slow set) -------------------------------------------
+
+
+def _run_sessions(eng, n_sessions, turns, max_new=4):
+    """Interleaved multi-turn sessions: every session submits its full
+    context each turn (idle between turns — exactly the blocks the host
+    tier exists to park). Returns each session's per-turn streams."""
+    ctxs = [list(range(1 + s, 9 + s)) for s in range(n_sessions)]
+    streams = [[] for _ in range(n_sessions)]
+    for t in range(turns):
+        rids = {}
+        for s in range(n_sessions):
+            rids[s] = eng.submit(np.asarray(ctxs[s], np.int32),
+                                 max_new_tokens=max_new)
+        out = eng.drain()
+        for s in range(n_sessions):
+            toks = out[rids[s]]
+            streams[s].append(list(toks))
+            ctxs[s] += list(toks) + [(3 * s + 7 * t) % 60 + 1]
+    return streams
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("overlap", [False, True])
+def test_session_soak_5x_hbm_capacity_bit_identical(overlap):
+    """The capacity law: a pool that fits ~2 sessions serves 10 (5×)
+    multi-turn sessions with every stream bit-identical to a no-tier
+    engine whose pool never evicts — resumes ride host→HBM promotion
+    (asserted via the counters), not luck."""
+    cfg, params = _micro()
+    n_sessions, turns = 10, 3
+    knobs = dict(block_size=4, n_blocks=18, max_len=64,
+                 host_offload_blocks=256, overlap=overlap)
+    eng = _engine(cfg, params, **knobs)
+    ref = _engine(cfg, params, n_blocks=256, max_len=64,
+                  host_offload_blocks=0)
+    got = _run_sessions(eng, n_sessions, turns)
+    want = _run_sessions(ref, n_sessions, turns)
+    assert got == want
+    st = eng.stats()["tiering"]
+    assert st["demoted_blocks"] > 0
+    assert st["promoted_blocks"] > 0, st
+    # ≥5×: sessions served vs what the HBM pool alone could hold live.
+    blocks_per_session = eng.scfg.blocks_for(
+        8 + turns * 5)                       # final context length
+    fits = (eng.scfg.n_blocks - 1) // blocks_per_session
+    assert n_sessions >= 5 * max(1, fits)
+
+
+@pytest.mark.slow
+def test_long_context_int4_leg_exceeds_model_dtype_pool():
+    """The long-context leg: an int4 pool decodes a prompt whose KV AT
+    MODEL DTYPE would not fit the pool's byte budget — the density rung
+    changing what 'fits in HBM' means — with the stream bit-identical
+    to an int4 engine whose pool is big enough to never feel pressure
+    (same quantization, so identity is exact, not approximate)."""
+    import dataclasses
+
+    from tpu_task.ml.serving.cache import kv_token_bytes, paged_cache_bytes
+
+    cfg, params = _micro()
+    plen = 40
+    knobs = dict(slots=1, block_size=4, n_blocks=14, max_len=64,
+                 kv_dtype="int4", host_offload_blocks=64)
+    eng = _engine(cfg, params, **knobs)
+    dense_scfg = dataclasses.replace(eng.scfg, kv_dtype=None,
+                                     host_offload_blocks=0)
+    assert plen * kv_token_bytes(cfg, dense_scfg) > paged_cache_bytes(
+        cfg, eng.scfg, eng.scfg.n_blocks)
+    ref = _engine(cfg, params, slots=1, n_blocks=64, max_len=64,
+                  kv_dtype="int4")
+    prompt = (np.arange(plen, dtype=np.int32) * 5) % 60 + 1
+    rid = eng.submit(prompt, max_new_tokens=8)
+    rid_ref = ref.submit(prompt, max_new_tokens=8)
+    assert eng.drain()[rid] == ref.drain()[rid_ref]
+
+
+@pytest.mark.slow
+def test_preemption_while_demoted_token_identical():
+    """The regression the residency invariant exists for: a pool small
+    enough that running requests preempt each other WHILE the prefix
+    cache's tail sits demoted on the host tier — every stream must
+    still be bit-identical to the pressure-free engine (preempted
+    victims resume through promotion or recompute, never a wrong
+    stream)."""
+    cfg, params = _micro()
+    eng = _engine(cfg, params, slots=3, block_size=4, n_blocks=14,
+                  max_len=48, host_offload_blocks=128)
+    ref = _engine(cfg, params, slots=3, n_blocks=256, max_len=48)
+    prompts = [(np.arange(14, dtype=np.int32) * (s + 2)) % 60 + 1
+               for s in range(6)]
+    got, want = {}, {}
+    for eng_, out in ((eng, got), (ref, want)):
+        rids = [eng_.submit(p, max_new_tokens=10) for p in prompts]
+        res = eng_.drain()
+        for i, rid in enumerate(rids):
+            out[i] = res[rid]
+    assert got == want
+    assert eng.preemption_count > 0 or eng.stats()["tiering"][
+        "demoted_blocks"] > 0
+    assert eng.stats()["tiering"]["demoted_blocks"] > 0
+
+
+@pytest.mark.slow
+def test_host_budget_spill_lands_in_bucket(tmp_path):
+    """Beyond the host budget the tier spills into the kvfleet bucket
+    through the content-addressed plane — and a SIBLING replica imports
+    a spilled chain exactly like a published one (the spill is
+    indistinguishable to importers by design)."""
+    from tpu_task.serve.kvfleet import FleetKvClient
+
+    backend = LocalBackend(str(tmp_path))
+    cfg, params = _micro()
+    client_a = FleetKvClient(backend, "ra", refresh_interval=0.0)
+    eng = _engine(cfg, params, block_size=4, n_blocks=18, max_len=64,
+                  host_offload_blocks=3, kv_client=client_a)
+    _run_sessions(eng, 8, 2)
+    st = eng.stats()["tiering"]
+    assert st["host_spilled_blocks"] > 0, st
+    assert client_a.published_blocks > 0
+
+    # The spilled chain serves a cold sibling's admission.
+    client_b = FleetKvClient(backend, "rb", refresh_interval=0.0)
+    sib = _engine(cfg, params, n_blocks=64, max_len=64,
+                  kv_client=client_b)
+    ref = _engine(cfg, params, n_blocks=64, max_len=64)
+    prompt = np.asarray(list(range(1, 9)), np.int32)
+    rid = sib.submit(prompt, max_new_tokens=4)
+    rid_ref = ref.submit(prompt, max_new_tokens=4)
+    assert sib.drain()[rid] == ref.drain()[rid_ref]
+    assert sib.fleet_hit_blocks > 0
+
+
+@pytest.mark.slow
+def test_prefetch_chain_promotes_host_to_hbm():
+    """`prefetch_chain` generalized down the hierarchy: a router hint
+    warms HBM from host RAM with no fleet plane attached at all — the
+    next admission is a pure local prefix hit."""
+    from tpu_task.ml.serving.cache import chain_block_hashes
+
+    cfg, params = _micro()
+    eng = _engine(cfg, params, block_size=4, n_blocks=18, max_len=64,
+                  host_offload_blocks=64)
+    prompt = np.asarray(list(range(2, 14)), np.int32)
+    rid = eng.submit(prompt, max_new_tokens=4)
+    first = eng.drain()[rid]
+    # Churn until the prompt's blocks are demoted AND evicted from HBM.
+    _run_sessions(eng, 6, 2)
+    hashes = chain_block_hashes(prompt, eng.scfg.block_size)
+    missing = [h for h in hashes if not eng._pcache.has(h)]
+    assert missing, "churn failed to evict the prompt's chain"
+    n = eng.prefetch_chain(hashes)
+    assert n > 0
+    assert all(eng._pcache.has(h) for h in hashes[:len(hashes)])
+    before = eng.prefix_hit_requests
+    rid2 = eng.submit(prompt, max_new_tokens=4)
+    assert eng.drain()[rid2] == first
+    assert eng.prefix_hit_requests == before + 1

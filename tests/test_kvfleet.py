@@ -51,7 +51,7 @@ def _engine(cfg, params, *, rng_seed=0, kv_client=None, **knobs):
 # -- block payload export/import ---------------------------------------------
 
 
-@pytest.mark.parametrize("kv_dtype", [None, "int8", "fp8"])
+@pytest.mark.parametrize("kv_dtype", [None, "int8", "fp8", "int4"])
 def test_block_payload_roundtrip_bit_faithful(kv_dtype):
     """export → split → write into a FRESH pool → export again is
     byte-identical, for model-dtype and quantized (codes + scale

@@ -78,3 +78,26 @@ def test_unterminated_begin_marker_is_a_finding():
     """)
     findings = repo_lint.lint_overlap_text(text, "fake/engine.py")
     assert any("unterminated" in f for f in findings)
+
+
+def test_tier_migrate_blocking_reads_flagged():
+    # Rule 3 (the demote/promote staging region) rides the same
+    # discipline: a synchronous device read inside the markers is a
+    # finding, and deleting the markers is itself a finding.
+    text = textwrap.dedent("""\
+        # lint: begin-tier-migrate
+        staged = stage_block_arrays(self.pools, block)
+        payload = np.asarray(staged[0]["k"])
+        # lint: end-tier-migrate
+        forced = np.asarray(staged)            # consume edge: fine
+    """)
+    findings = repo_lint.lint_tier_text(text, "fake/engine.py")
+    assert len(findings) == 1
+    assert findings[0].startswith("fake/engine.py:3:")
+    assert "tier-migrate" in findings[0]
+
+
+def test_tier_migrate_missing_markers_is_a_finding():
+    findings = repo_lint.lint_tier_text("x = 1\n", "fake/engine.py")
+    assert len(findings) == 1
+    assert "tier-migrate" in findings[0] and "not found" in findings[0]

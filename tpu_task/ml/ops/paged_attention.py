@@ -25,7 +25,11 @@ with a per-``(block, kv_head)`` fp32 scale sidecar
 dequantizes IN REGISTER — the scale is constant over a grid cell, so it
 factors out of both matmuls (``scores = (q·kᵀ)·k_scale``,
 ``out = (p·v)·v_scale``) and the dequantized block never round-trips
-through memory either.
+through memory either. **int4 pools** (uint8 elements — two codes per
+byte, ``cache.pack_int4``) add one in-register step: the block's
+nibbles sign-extend to int8 codes right after the VMEM load (or the
+manual DMA), before the same scale factoring — the packed block is
+what crosses HBM→VMEM, so the DMA bytes halve along with the pool.
 
 Exactness contract (docs/parity.md "Decode kernel + quantized KV"):
 the kernel is tolerance-pinned against the XLA gather+dense reference
@@ -97,6 +101,17 @@ def use_pallas_paged() -> bool:
     return _use_pallas()
 
 
+def _unpack_int4(blk):
+    """In-register nibble→code expansion for uint8 (int4-packed) KV
+    blocks: (..., d/2) uint8 → (..., d) int8, the inverse of
+    ``cache.pack_int4``. Imported lazily — ml.ops must not import
+    ml.serving at module load (the serving package init imports the
+    engine, which imports this file)."""
+    from tpu_task.ml.serving.cache import unpack_int4
+
+    return unpack_int4(blk)
+
+
 #: Conservative budget for the kernel's scalar-prefetch operands (block
 #: tables, positions, int8 scale sidecars — all SMEM-resident on the
 #: compiled path). TPU SMEM is tens of KB per core; staying under this
@@ -110,10 +125,14 @@ def kernel_constraint_violation(block_size: int, d_head: int,
                                 n_blocks: int = 0, kv_heads: int = 0,
                                 slots: int = 0, max_blocks: int = 0,
                                 q_width: int = 1,
-                                quantized: bool = False) -> Optional[str]:
+                                quantized: bool = False,
+                                packed: bool = False) -> Optional[str]:
     """Why the COMPILED kernel cannot run on this pool geometry, or None.
     ``kv_itemsize``: bytes per KV POOL element (1 for int8 pools, else the
     model dtype's) — it sets the sublane tile ``block_size`` must honor.
+    ``packed``: the pool is int4 (uint8 pairs) — the KV VMEM blocks'
+    trailing dim is ``d_head / 2``, which must itself tile by the lane
+    count.
     The optional sizes enable the scalar-prefetch SMEM budget check: the
     block tables, positions, and (when ``quantized``) the per-(block,
     kv-head) scale sidecars all ride SMEM on the compiled path, so a huge
@@ -128,6 +147,11 @@ def kernel_constraint_violation(block_size: int, d_head: int,
     if d_head % LANE_TILE:
         return (f"d_head {d_head} is not a multiple of the {LANE_TILE}-lane "
                 f"tile the compiled kernel's VMEM blocks need")
+    if packed and (d_head // 2) % LANE_TILE:
+        return (f"int4 KV blocks carry d_head/2 = {d_head // 2} packed "
+                f"bytes in the lane dim, not a multiple of the "
+                f"{LANE_TILE}-lane tile — int4 on the compiled kernel "
+                f"needs d_head % {2 * LANE_TILE} == 0")
     sublane = kernel_sublane_tile(kv_itemsize)
     if block_size % sublane:
         return (f"block_size {block_size} is not a multiple of the "
@@ -149,7 +173,8 @@ def kernel_constraint_violation(block_size: int, d_head: int,
 # -- the kernel ---------------------------------------------------------------
 
 def _paged_decode_kernel(tables_ref, pos_ref, *rest, bs: int, w: int,
-                         group: int, num_blocks: int, quantized: bool):
+                         group: int, num_blocks: int, quantized: bool,
+                         packed: bool = False):
     """One (slot, kv_head, block) grid cell: fold one physical KV block
     into the running online softmax of the slot's whole query group.
 
@@ -192,7 +217,8 @@ def _paged_decode_kernel(tables_ref, pos_ref, *rest, bs: int, w: int,
     @pl.when(live)
     def _compute():
         q = q_ref[...].reshape(rows, d).astype(jnp.float32) / math.sqrt(d)
-        k_blk = k_ref[...].astype(jnp.float32)
+        k_blk = _unpack_int4(k_ref[...]) if packed else k_ref[...]
+        k_blk = k_blk.astype(jnp.float32)
         sm = lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
         if quantized:
@@ -214,7 +240,8 @@ def _paged_decode_kernel(tables_ref, pos_ref, *rest, bs: int, w: int,
         m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
         l_ref[...] = jnp.broadcast_to(
             (l * corr + p.sum(axis=-1))[:, None], l_ref.shape)
-        v_blk = v_ref[...].astype(jnp.float32)
+        v_blk = _unpack_int4(v_ref[...]) if packed else v_ref[...]
+        v_blk = v_blk.astype(jnp.float32)
         pv = lax.dot_general(p, v_blk, (((1,), (0,)), ((), ())),
                              preferred_element_type=jnp.float32)
         if quantized:
@@ -253,7 +280,7 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, q_positions,
     from jax.experimental.pallas import tpu as pltpu
 
     slots, w, h, d = q.shape
-    n_blocks, bs, kv, _ = k_pool.shape
+    n_blocks, bs, kv, dp = k_pool.shape
     if h % kv:
         raise ValueError(f"n_heads {h} not divisible by kv_heads {kv}")
     group = h // kv
@@ -265,10 +292,13 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, q_positions,
         raise ValueError(
             f"q_positions must be (slots, w) = ({slots}, {w}), got "
             f"{q_positions.shape}")
+    # An int4 pool's trailing dim is d/2 packed uint8 pairs — the KV
+    # BlockSpecs stage the pool's OWN width; the kernel unpacks.
+    packed = k_pool.dtype == jnp.uint8
 
     kernel = functools.partial(
         _paged_decode_kernel, bs=bs, w=w, group=group,
-        num_blocks=max_blocks, quantized=quantized)
+        num_blocks=max_blocks, quantized=quantized, packed=packed)
     n_prefetch = 4 if quantized else 2
 
     def idx_q(s, kh, b, *refs):
@@ -282,8 +312,8 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, q_positions,
         grid=(slots, kv, max_blocks),
         in_specs=[
             pl.BlockSpec((None, w, group, d), idx_q),
-            pl.BlockSpec((None, bs, None, d), idx_kv),
-            pl.BlockSpec((None, bs, None, d), idx_kv),
+            pl.BlockSpec((None, bs, None, dp), idx_kv),
+            pl.BlockSpec((None, bs, None, dp), idx_kv),
         ],
         out_specs=pl.BlockSpec((None, w, group, d), idx_q),
         scratch_shapes=[
@@ -308,7 +338,7 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, q_positions,
 
 def _paged_decode_pipelined_kernel(tables_ref, pos_ref, *rest, bs: int,
                                    w: int, group: int, max_blocks: int,
-                                   quantized: bool):
+                                   quantized: bool, packed: bool = False):
     """One (slot, kv_head) grid cell: walk the slot's live blocks with the
     KV pools still in HBM, double-buffering the block DMA.
 
@@ -368,7 +398,8 @@ def _paged_decode_pipelined_kernel(tables_ref, pos_ref, *rest, bs: int,
 
         for dma in copies(b, slot):
             dma.wait()
-        k_blk = k_buf[slot].astype(jnp.float32)
+        k_blk = _unpack_int4(k_buf[slot]) if packed else k_buf[slot]
+        k_blk = k_blk.astype(jnp.float32)
         sm = lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
         if quantized:
@@ -383,7 +414,8 @@ def _paged_decode_pipelined_kernel(tables_ref, pos_ref, *rest, bs: int,
         p = jnp.exp(sm - shift[:, None])
         p = jnp.where(mask, p, 0.0)
         corr = jnp.exp(jnp.where(m <= NEG_INF / 2, NEG_INF, m) - shift)
-        v_blk = v_buf[slot].astype(jnp.float32)
+        v_blk = _unpack_int4(v_buf[slot]) if packed else v_buf[slot]
+        v_blk = v_blk.astype(jnp.float32)
         pv = lax.dot_general(p, v_blk, (((1,), (0,)), ((), ())),
                              preferred_element_type=jnp.float32)
         if quantized:
@@ -417,7 +449,7 @@ def paged_decode_pipelined_attention(q, k_pool, v_pool, block_tables,
     from jax.experimental.pallas import tpu as pltpu
 
     slots, w, h, d = q.shape
-    n_blocks, bs, kv, _ = k_pool.shape
+    n_blocks, bs, kv, dp = k_pool.shape
     if h % kv:
         raise ValueError(f"n_heads {h} not divisible by kv_heads {kv}")
     group = h // kv
@@ -429,10 +461,11 @@ def paged_decode_pipelined_attention(q, k_pool, v_pool, block_tables,
         raise ValueError(
             f"q_positions must be (slots, w) = ({slots}, {w}), got "
             f"{q_positions.shape}")
+    packed = k_pool.dtype == jnp.uint8
 
     kernel = functools.partial(
         _paged_decode_pipelined_kernel, bs=bs, w=w, group=group,
-        max_blocks=max_blocks, quantized=quantized)
+        max_blocks=max_blocks, quantized=quantized, packed=packed)
     n_prefetch = 4 if quantized else 2
 
     def idx_q(s, kh, *refs):
@@ -448,8 +481,10 @@ def paged_decode_pipelined_attention(q, k_pool, v_pool, block_tables,
         ],
         out_specs=pl.BlockSpec((None, w, group, d), idx_q),
         scratch_shapes=[
-            pltpu.VMEM((2, bs, d), k_pool.dtype),   # double-buffered K
-            pltpu.VMEM((2, bs, d), v_pool.dtype),   # double-buffered V
+            # Buffers hold the pool's OWN block width (d/2 packed bytes
+            # for int4) — the DMA moves packed bytes; unpack is in-register.
+            pltpu.VMEM((2, bs, dp), k_pool.dtype),  # double-buffered K
+            pltpu.VMEM((2, bs, dp), v_pool.dtype),  # double-buffered V
             pltpu.SemaphoreType.DMA((2, 2)),        # (buffer, k|v)
         ],
     )
@@ -489,7 +524,11 @@ def paged_reference_attention(q, k_pool, v_pool, block_tables, q_positions,
 def dequantize_view(view, scale, block_tables, block_size: int, dtype):
     """(slots, L, kv, d) int8 gathered view × its per-(block, kv_head)
     scales → dense values in ``dtype``. The scale gathers through the same
-    block tables and broadcasts over each block's ``block_size`` tokens."""
+    block tables and broadcasts over each block's ``block_size`` tokens.
+    A uint8 view is int4-packed (d/2 trailing bytes) and unpacks to the
+    full head dim first."""
+    if view.dtype == jnp.uint8:
+        view = _unpack_int4(view)
     s_view = jnp.repeat(scale[block_tables], block_size, axis=1)
     return (view.astype(jnp.float32) * s_view[..., None]).astype(dtype)
 

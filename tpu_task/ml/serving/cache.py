@@ -42,6 +42,33 @@ where int8 flattens them. Bytes per element are identical to int8 (1 +
 the amortized sidecar); the knob trades accuracy shape, not density.
 Gated on backend dtype support (:func:`fp8_supported`) with the same
 interpret-mode CPU parity story as the int8 pools.
+
+``kv_dtype="int4"`` is the sub-byte density rung: two 4-bit codes pack
+into each uint8 pool element (adjacent channel pairs, even channel in
+the low nibble), so the same HBM holds ~2× the blocks of int8 again.
+The uint8 pool dtype IS the int4 marker — int8 pools are ``jnp.int8``,
+fp8 pools ``float8_e4m3fn``, so every generic caller (``copy_block``,
+``write_block``, export/import, COW) flows unchanged, and
+:func:`quantize_blocks`/:func:`dequantize_blocks` pack/unpack at the
+boundary. ``scale = amax / 7`` (floored at :data:`INT8_SCALE_EPS`),
+round-to-nearest codes clipped to ±7 — round-trip error ≤ ``scale / 2``
+per element, exactly int8's uniform bound at a coarser grid. A fresh
+all-zero uint8 pool unpacks to code 0 in both nibbles and dequantizes
+to exact zeros at the epsilon scale, preserving the fresh-pool
+invariant. Requires an even ``d_head`` (pairs pack along the head
+dim; validated at ``init_pools``).
+
+**Tiered residency** (ROADMAP item 3): :class:`BlockAllocator` grows a
+``demoted`` mark — a retained refcount-0 cached block whose bytes have
+been replicated to the host offload tier (``ml/serving/offload.py``).
+Demotion never invalidates the HBM copy; it makes the block the
+PREFERRED eviction victim (:meth:`PrefixCache.evict` reclaims demoted
+blocks first), so HBM frees under pressure without losing the bytes —
+a later admission re-imports them host→HBM by content hash. Touching a
+demoted block (``incref``) simply cancels the mark: the HBM bytes were
+valid all along, so resurrection is free. Invariant: demoted ⊆
+retained ∧ refcount-0 — a referenced or unretained block is never
+marked, so a slot can only ever reference a demoted block while idle.
 """
 
 from __future__ import annotations
@@ -73,7 +100,26 @@ FP8_MAX = 448.0
 #: The quantized pool dtypes (``ServingConfig.kv_dtype`` values that
 #: carry scale sidecars and route writes through
 #: :func:`quantized_append`).
-QUANT_DTYPES = ("int8", "fp8")
+QUANT_DTYPES = ("int8", "fp8", "int4")
+
+#: Largest int4 code magnitude: packed nibbles hold [-8, 7] but the
+#: symmetric grid uses ±7 so the amax element maps to exactly ±7 and
+#: nothing clips (the int8 127 analogue).
+INT4_MAX = 7
+
+
+def kv_code_dtype(kv_dtype: str):
+    """Storage dtype of a quantized pool's code arrays. ``jnp.uint8`` IS
+    the int4 marker (int8 pools are ``jnp.int8``, fp8 pools
+    ``float8_e4m3fn`` — uint8 is unambiguous), so code paths that only
+    see a pool can tell a packed layer from an int8 one by dtype alone."""
+    if kv_dtype == "int8":
+        return jnp.int8
+    if kv_dtype == "fp8":
+        return jnp.float8_e4m3fn
+    if kv_dtype == "int4":
+        return jnp.uint8
+    raise ValueError(f"not a quantized kv_dtype: {kv_dtype!r}")
 
 
 def fp8_supported() -> bool:
@@ -135,7 +181,10 @@ class ServingConfig:
       same bytes, under a documented tolerance contract
       (docs/parity.md "Decode kernel + quantized KV"); ``"fp8"`` stores
       float8 e4m3 codes through the same sidecar machinery (equal bytes
-      to int8, relative-not-uniform rounding error).
+      to int8, relative-not-uniform rounding error); ``"int4"`` packs
+      two codes per uint8 byte — ~2× the blocks of int8 in the same
+      bytes, same uniform ≤ scale/2 error bound at a coarser grid
+      (needs an even ``d_head``).
     - ``micro_k``: dispatch amortization — steady-state decode runs
       ``micro_k`` sequential iterations inside ONE jitted program
       (in-program eos/length retirement masks; a retired slot's
@@ -163,6 +212,17 @@ class ServingConfig:
       admission burst in ~burst/``prefill_slots`` fewer steps whenever
       prompts are shorter than the chunk budget (the admission-p99
       lever — ``bench.py goodput`` measures it).
+    - ``host_offload_blocks``: capacity of the host-RAM KV offload tier
+      in blocks (docs/parity.md "Tiered KV"). 0 (default) disables
+      tiering. With a budget, cold retained refcount-0 cached blocks
+      demote to pinned host arrays asynchronously on the overlap seam,
+      eviction under pool pressure reclaims demoted blocks first (the
+      bytes survive on the host), later admissions promote host-resident
+      chains back into the pool ahead of prefill, and blocks evicted
+      from a full host tier spill to the fleet KV bucket when one is
+      attached (otherwise they drop — recompute-from-prefix covers the
+      miss, never a wrong stream). Requires ``prefix_cache`` (the tier
+      is content-addressed by the cache's chained block hashes).
     """
 
     slots: int = 8
@@ -179,6 +239,7 @@ class ServingConfig:
     micro_k: int = 1
     overlap: bool = False
     prefill_slots: int = 1
+    host_offload_blocks: int = 0
 
     def __post_init__(self):
         if self.slots < 1:
@@ -222,8 +283,8 @@ class ServingConfig:
                 f"{self.decode_impl!r}")
         if self.kv_dtype not in (None,) + QUANT_DTYPES:
             raise ValueError(
-                f"kv_dtype must be None (model dtype), 'int8', or 'fp8', "
-                f"got {self.kv_dtype!r}")
+                f"kv_dtype must be None (model dtype), 'int8', 'fp8', or "
+                f"'int4', got {self.kv_dtype!r}")
         if self.micro_k < 1:
             raise ValueError(
                 f"micro_k must be >= 1, got {self.micro_k}")
@@ -246,6 +307,15 @@ class ServingConfig:
                 "overlap=True is incompatible with speculative decoding "
                 "(spec_k > 0): the draft/score round-trip is a host "
                 "sync point every round")
+        if self.host_offload_blocks < 0:
+            raise ValueError(
+                f"host_offload_blocks must be >= 0, got "
+                f"{self.host_offload_blocks}")
+        if self.host_offload_blocks and not self.prefix_cache:
+            raise ValueError(
+                "host_offload_blocks needs prefix_cache=True: the host "
+                "tier is content-addressed by the cache's chained block "
+                "hashes")
 
     @property
     def max_blocks_per_slot(self) -> int:
@@ -269,29 +339,32 @@ def kv_token_bytes(cfg: TransformerConfig,
                    scfg: Optional[ServingConfig] = None) -> int:
     """KV bytes one token occupies across all layers (k + v) — DTYPE-AWARE:
     without ``scfg`` (or with ``kv_dtype=None``) the storage dtype is the
-    model dtype; with a quantized dtype (``"int8"``/``"fp8"`` — both
-    1-byte elements) each element is one byte plus the amortized
-    per-(block, kv-head) fp32 scale sidecar
+    model dtype; with a quantized dtype each element is one byte
+    (``"int8"``/``"fp8"``) or half a byte (``"int4"`` — two codes per
+    uint8) plus the amortized per-(block, kv-head) fp32 scale sidecar
     (``2 · n_layers · kv_heads · 4 / block_size`` bytes per token)."""
     per_channel = 2 * cfg.n_layers * cfg.kv_heads
     if scfg is None or scfg.kv_dtype is None:
         return per_channel * cfg.d_head * jnp.dtype(cfg.dtype).itemsize
-    # int8 codes (1 byte/element) + the scale sidecar amortized over the
-    # block's tokens.
-    return (per_channel * cfg.d_head
+    # Quantized codes + the scale sidecar amortized over the block's
+    # tokens.
+    d_bytes = cfg.d_head // 2 if scfg.kv_dtype == "int4" else cfg.d_head
+    return (per_channel * d_bytes
             + -(-per_channel * 4 // scfg.block_size))
 
 
 def kv_block_bytes(cfg: TransformerConfig, scfg: ServingConfig) -> int:
     """Exact bytes ONE physical block costs (codes + its scale sidecar) —
     the unit ``blocks_in_budget`` divides an HBM budget by."""
-    elem = (1 if scfg.kv_dtype in QUANT_DTYPES
-            else jnp.dtype(cfg.dtype).itemsize)
-    per_block = 2 * cfg.n_layers * cfg.kv_heads * (
-        scfg.block_size * cfg.d_head * elem)
     if scfg.kv_dtype in QUANT_DTYPES:
+        d_bytes = (cfg.d_head // 2 if scfg.kv_dtype == "int4"
+                   else cfg.d_head)
+        per_block = 2 * cfg.n_layers * cfg.kv_heads * (
+            scfg.block_size * d_bytes)
         per_block += 2 * cfg.n_layers * cfg.kv_heads * 4
-    return per_block
+        return per_block
+    return 2 * cfg.n_layers * cfg.kv_heads * (
+        scfg.block_size * cfg.d_head * jnp.dtype(cfg.dtype).itemsize)
 
 
 def blocks_in_budget(cfg: TransformerConfig, scfg: ServingConfig,
@@ -324,11 +397,17 @@ def init_pools(cfg: TransformerConfig, scfg: ServingConfig) -> List[dict]:
     carries ``k_scale``/``v_scale`` sidecars of shape
     (n_blocks, kv_heads) float32; zero codes at the epsilon scale
     dequantize to exact zeros, so a fresh quantized pool reads
-    identically to a fresh fp32 one."""
+    identically to a fresh fp32 one (an all-zero uint8 int4 pool unpacks
+    to code 0 in both nibbles — the invariant survives packing)."""
     shape = (scfg.n_blocks, scfg.block_size, cfg.kv_heads, cfg.d_head)
     if scfg.kv_dtype in QUANT_DTYPES:
-        code_dtype = (jnp.int8 if scfg.kv_dtype == "int8"
-                      else jnp.float8_e4m3fn)
+        code_dtype = kv_code_dtype(scfg.kv_dtype)
+        if scfg.kv_dtype == "int4":
+            if cfg.d_head % 2:
+                raise ValueError(
+                    f"kv_dtype='int4' packs adjacent d_head pairs and "
+                    f"needs an even d_head, got {cfg.d_head}")
+            shape = shape[:-1] + (cfg.d_head // 2,)
 
         # Distinct arrays per leaf: the engine DONATES the pool pytree,
         # and XLA rejects the same buffer donated twice.
@@ -423,7 +502,28 @@ def gather_kv(pool_flat, block_table, block_size: int):
     return pool_flat[idx.reshape(block_table.shape[0], -1)]
 
 
-# -- int8 / fp8 KV block quantization ----------------------------------------
+# -- int8 / fp8 / int4 KV block quantization ---------------------------------
+
+def pack_int4(codes):
+    """(..., d) int8 codes in [-7, 7] → (..., d/2) uint8: adjacent
+    channel pairs share a byte, even channel in the low nibble. Bitwise
+    ops on the int8 codes see two's-complement nibbles (-7 & 15 == 9),
+    so packing needs no bias term."""
+    pairs = codes.reshape(codes.shape[:-1] + (codes.shape[-1] // 2, 2))
+    lo = pairs[..., 0].astype(jnp.uint8) & 15
+    hi = pairs[..., 1].astype(jnp.uint8) & 15
+    return lo | (hi << 4)
+
+
+def unpack_int4(packed):
+    """Inverse of :func:`pack_int4`: (..., d/2) uint8 → (..., d) int8.
+    Branch-free nibble sign extension ``(n ^ 8) - 8`` maps 0..15 back to
+    two's complement (9 → -7, 15 → -1, 0 → 0 — a fresh all-zero pool
+    stays exact zeros)."""
+    nibbles = jnp.stack([packed & 15, (packed >> 4) & 15], axis=-1)
+    signed = (nibbles.astype(jnp.int8) ^ 8) - 8
+    return signed.reshape(packed.shape[:-1] + (packed.shape[-1] * 2,))
+
 
 def quantize_blocks(x, code_dtype=jnp.int8):
     """(n, block_size, kv, d) float values → (codes, (n, kv) float32
@@ -439,7 +539,14 @@ def quantize_blocks(x, code_dtype=jnp.int8):
     RELATIVE, ≤ ``max(|x| · 2⁻⁴, scale · 2⁻⁹)`` per element (half-ulp of
     a normal, resp. the subnormal step at the bottom), so small entries
     of an outlier-heavy block keep precision int8's uniform grid loses.
-    Both bounds are property-pinned in tests/test_paged_attention.py."""
+
+    ``code_dtype=jnp.uint8`` (the int4 marker): ``scale = amax /``
+    :data:`INT4_MAX`, codes clipped to ±7 and PACKED two per byte
+    (:func:`pack_int4`) — the returned codes' trailing dim is ``d/2``.
+    Round-trip error ≤ ``scale / 2`` per element of the PAIR, int8's
+    bound at a 16× coarser grid.
+    All bounds are property-pinned in tests/test_paged_attention.py
+    and tests/test_kv_tiering.py."""
     amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=(1, 3))
     if jnp.dtype(code_dtype) == jnp.dtype(jnp.int8):
         scale = jnp.maximum(amax / 127.0, INT8_SCALE_EPS)
@@ -447,6 +554,12 @@ def quantize_blocks(x, code_dtype=jnp.int8):
             jnp.round(x.astype(jnp.float32) / scale[:, None, :, None]),
             -127, 127).astype(jnp.int8)
         return codes, scale
+    if jnp.dtype(code_dtype) == jnp.dtype(jnp.uint8):
+        scale = jnp.maximum(amax / float(INT4_MAX), INT8_SCALE_EPS)
+        codes = jnp.clip(
+            jnp.round(x.astype(jnp.float32) / scale[:, None, :, None]),
+            -INT4_MAX, INT4_MAX).astype(jnp.int8)
+        return pack_int4(codes), scale
     scale = jnp.maximum(amax / FP8_MAX, INT8_SCALE_EPS)
     codes = (x.astype(jnp.float32)
              / scale[:, None, :, None]).astype(code_dtype)
@@ -454,7 +567,11 @@ def quantize_blocks(x, code_dtype=jnp.int8):
 
 
 def dequantize_blocks(codes, scale, dtype=jnp.float32):
-    """Inverse of :func:`quantize_blocks` (up to the ≤ scale/2 rounding)."""
+    """Inverse of :func:`quantize_blocks` (up to the ≤ scale/2 rounding).
+    uint8 codes are packed int4 pairs and unpack to the full head dim
+    first — callers always see full-width values."""
+    if codes.dtype == jnp.uint8:
+        codes = unpack_int4(codes)
     return (codes.astype(jnp.float32)
             * scale[:, None, :, None]).astype(dtype)
 
@@ -533,11 +650,14 @@ def block_payload_nbytes(cfg: TransformerConfig, scfg: ServingConfig) -> int:
     """Exact byte length of one exported block payload — the importer's
     validation gate (a payload of any other length is treated as a miss,
     never written into the pool)."""
-    elem = (1 if scfg.kv_dtype in QUANT_DTYPES
-            else jnp.dtype(cfg.dtype).itemsize)
-    per_layer = 2 * scfg.block_size * cfg.kv_heads * cfg.d_head * elem
     if scfg.kv_dtype in QUANT_DTYPES:
+        d_bytes = (cfg.d_head // 2 if scfg.kv_dtype == "int4"
+                   else cfg.d_head)
+        per_layer = 2 * scfg.block_size * cfg.kv_heads * d_bytes
         per_layer += 2 * cfg.kv_heads * 4          # k_scale + v_scale rows
+    else:
+        per_layer = (2 * scfg.block_size * cfg.kv_heads * cfg.d_head
+                     * jnp.dtype(cfg.dtype).itemsize)
     return cfg.n_layers * per_layer
 
 
@@ -586,13 +706,13 @@ def split_block_bytes(data: bytes, cfg: TransformerConfig,
     if len(data) != block_payload_nbytes(cfg, scfg):
         return None
     if scfg.kv_dtype in QUANT_DTYPES:
-        code_dtype = (jnp.int8 if scfg.kv_dtype == "int8"
-                      else jnp.float8_e4m3fn)
+        code_dtype = kv_code_dtype(scfg.kv_dtype)
         leaves = (("k", code_dtype), ("k_scale", jnp.float32),
                   ("v", code_dtype), ("v_scale", jnp.float32))
     else:
         leaves = (("k", cfg.dtype), ("v", cfg.dtype))
-    shape = (scfg.block_size, cfg.kv_heads, cfg.d_head)
+    d_store = cfg.d_head // 2 if scfg.kv_dtype == "int4" else cfg.d_head
+    shape = (scfg.block_size, cfg.kv_heads, d_store)
     out: List[dict] = []
     offset = 0
     for _ in range(cfg.n_layers):
@@ -649,10 +769,18 @@ class BlockAllocator:
     they are instantly reclaimable, so counting them would inflate the
     metric toward the full pool size on any cache-on engine.
 
+    Tier-aware residency (docs/parity.md "Tiered KV"): a retained
+    refcount-0 block may additionally carry a ``demoted`` mark — its
+    bytes have been replicated to the host offload tier, making it the
+    preferred eviction victim. The mark never invalidates the HBM copy;
+    ``incref`` (a slot touching the block again) simply cancels it, so
+    a slot's table can only reference a demoted block while the slot is
+    idle, and touching one costs nothing.
+
     Invariants (property-tested in tests/test_serving_production.py):
     refcounts are never negative; a block is never simultaneously free and
     referenced (or free and retained); only refcount-0 blocks are ever
-    evicted back to the free list."""
+    evicted back to the free list; demoted ⊆ retained ∧ refcount-0."""
 
     def __init__(self, n_blocks: int):
         if n_blocks < 2:
@@ -662,6 +790,7 @@ class BlockAllocator:
         self._free = list(range(n_blocks - 1, SCRATCH_BLOCK, -1))
         self._ref: Dict[int, int] = {}     # block -> refcount (>= 1)
         self._retained: set = set()        # refcount-0 blocks the cache holds
+        self._demoted: set = set()         # retained blocks with a host copy
         self.high_water = 0
 
     @property
@@ -689,6 +818,28 @@ class BlockAllocator:
     def is_retained(self, block: int) -> bool:
         return block in self._retained
 
+    def is_demoted(self, block: int) -> bool:
+        return block in self._demoted
+
+    @property
+    def demoted(self) -> int:
+        """Retained refcount-0 blocks whose bytes also live on the host
+        tier — the instantly-evictable set."""
+        return len(self._demoted)
+
+    def mark_demoted(self, block: int) -> None:
+        """Record that ``block``'s bytes now live on the host tier. Only
+        a retained refcount-0 block qualifies (a referenced block's bytes
+        are still being appended to; an unretained one is already free) —
+        the caller checks liveness at finalize time and skips blocks that
+        were resurrected or evicted while the copy was in flight."""
+        self._check(block)
+        if block not in self._retained or block in self._ref:
+            raise ValueError(
+                f"mark_demoted of block {block}: only retained "
+                f"refcount-0 blocks demote")
+        self._demoted.add(block)
+
     def _check(self, block: int) -> None:
         if not SCRATCH_BLOCK < block < self.n_blocks:
             raise ValueError(f"invalid block {block}")
@@ -713,6 +864,10 @@ class BlockAllocator:
         self._check(block)
         if block in self._free:
             raise ValueError(f"incref of free block {block}")
+        # Touching a demoted block cancels the mark: its HBM bytes were
+        # never invalidated, so resurrection is free (promotion proper —
+        # host→HBM — only happens for blocks eviction already reclaimed).
+        self._demoted.discard(block)
         self._ref[block] = self._ref.get(block, 0) + 1
         self.high_water = max(self.high_water, len(self._ref))
         return self._ref[block]
@@ -745,6 +900,7 @@ class BlockAllocator:
         if block not in self._retained:
             raise ValueError(f"release of unretained block {block}")
         self._retained.discard(block)
+        self._demoted.discard(block)
         if block not in self._ref:
             self._free.append(block)
 
@@ -800,6 +956,13 @@ class PrefixCache:
         """Whether ``h`` is cached — refcount-free membership (the
         prefetch path's skip test; ``lookup`` increfs, this must not)."""
         return h in self._by_hash
+
+    def cached_block(self, h: bytes) -> Optional[int]:
+        """Physical block currently registered under ``h``, or None — no
+        incref, no LRU touch (demotion-finalize's still-the-same-block
+        check: between staging a host copy and forcing its bytes the
+        block may be evicted and even recycled under another hash)."""
+        return self._by_hash.get(h)
 
     def _touch(self, block: int) -> None:
         self._tick += 1
@@ -882,15 +1045,31 @@ class PrefixCache:
             entries = entries[:limit]
         return [(self._hash_of[b], b) for _, b in entries]
 
-    def evict(self, n: int) -> int:
-        """Evict up to ``n`` refcount-0 cached blocks, LRU first, back to
-        the free list. Referenced blocks are never touched. Returns how
-        many blocks were actually reclaimed."""
-        victims = sorted(
+    def cold_entries(self, limit: int) -> List[Tuple[bytes, int]]:
+        """Demotion candidates: (hash, block) of up to ``limit`` retained
+        refcount-0 cached blocks not yet demoted, COLDEST first — the
+        mirror of :meth:`hot_entries` (publish wants the hot end, the
+        host tier wants the LRU tail: the blocks eviction would reclaim
+        next are exactly the ones worth a host copy first)."""
+        entries = sorted(
             (t, b) for b, t in self._lru.items()
+            if self._alloc.refcount(b) == 0
+            and self._alloc.is_retained(b)
+            and not self._alloc.is_demoted(b))
+        return [(self._hash_of[b], b) for _, b in entries[:limit]]
+
+    def evict(self, n: int) -> int:
+        """Evict up to ``n`` refcount-0 cached blocks back to the free
+        list — DEMOTED blocks first (their bytes survive on the host
+        tier, so reclaiming them loses nothing), then LRU order.
+        Referenced blocks are never touched. Returns how many blocks
+        were actually reclaimed."""
+        victims = sorted(
+            (not self._alloc.is_demoted(b), t, b)
+            for b, t in self._lru.items()
             if self._alloc.refcount(b) == 0)
         freed = 0
-        for _, b in victims:
+        for _, _, b in victims:
             if freed >= n:
                 break
             del self._by_hash[self._hash_of.pop(b)]

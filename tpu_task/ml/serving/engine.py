@@ -117,6 +117,7 @@ from tpu_task.ml.serving.cache import (
     staged_block_to_bytes,
     write_blocks,
 )
+from tpu_task.ml.serving.offload import HostKvTier
 from tpu_task.ml.serving.model import (
     chunk_carry_greedy,
     chunk_carry_sample,
@@ -139,7 +140,8 @@ QUEUED, RUNNING, DONE = "queued", "running", "done"
 
 def _kv_itemsize(scfg: ServingConfig, cfg) -> int:
     """Bytes per KV POOL element — what sets the kernel's sublane tile.
-    Both quantized dtypes (int8, fp8 e4m3) are 1-byte elements."""
+    Every quantized dtype (int8, fp8 e4m3, packed int4) is a 1-byte pool
+    element; int4's 2× density comes from the HALVED trailing dim."""
     return (1 if scfg.kv_dtype in QUANT_DTYPES
             else jnp.dtype(cfg.dtype).itemsize)
 
@@ -165,7 +167,8 @@ def resolve_decode_impl(scfg: ServingConfig, cfg, tp: int = 1) -> str:
                             if scfg.prefill == "chunked" else 0),
         max_blocks=scfg.max_blocks_per_slot,
         q_width=scfg.spec_k + 1,
-        quantized=scfg.kv_dtype in QUANT_DTYPES)
+        quantized=scfg.kv_dtype in QUANT_DTYPES,
+        packed=scfg.kv_dtype == "int4")
     if want in ("pallas", "pipelined"):
         if not pa.use_pallas_paged():
             raise ValueError(
@@ -366,6 +369,36 @@ class ServingEngine:
         self.fleet_prefetch_blocks = 0
         self._h_kv_import = None
 
+        # Host-RAM offload tier (ROADMAP item 3): the middle rung of the
+        # HBM → host RAM → fleet bucket hierarchy. Cold retained ref-0
+        # cached blocks (the prefix cache's LRU tail — including every
+        # idle session's blocks, which _release parked there) demote
+        # into it on the overlap seam: staged non-blocking while a
+        # program is in flight (_demote_pass), forced to bytes at the
+        # consume edge where the host is blocked anyway
+        # (_finalize_demotions). Admission imports and prefetch hints
+        # consult it BEFORE the fleet bucket; entries past the budget
+        # spill to the bucket through the attached fleet client.
+        self._host_tier: Optional[HostKvTier] = None
+        if scfg.host_offload_blocks > 0:
+            if mesh is not None:
+                raise ValueError(
+                    "host_offload_blocks is single-chip for now: tier "
+                    "payloads are unsharded block bytes (attach the "
+                    "host tier to a mesh=None engine)")
+            spill = (kv_fleet.ship_bytes
+                     if kv_fleet is not None
+                     and hasattr(kv_fleet, "ship_bytes") else None)
+            self._host_tier = HostKvTier(
+                scfg.host_offload_blocks, spill=spill)
+        self.demoted_blocks = 0
+        self.promoted_blocks = 0
+        #: Demotions staged against an in-flight program, as (hash,
+        #: block, staged device slices): the bytes force one consume
+        #: edge later, after the program the reads enqueued behind has
+        #: completed — never on the dispatch path.
+        self._pending_demotions: List[Tuple[bytes, int, List]] = []
+
         # Asynchronous engine loop (ROADMAP item 4, the overlap PR): the
         # host sweep of micro-step N runs while the device executes
         # micro-step N+1 — see _step_overlapped for the loop contract.
@@ -521,6 +554,24 @@ class ServingEngine:
                     metrics.counter_fn(f"kvfleet.{stat}",
                                        lambda kv_fleet=kv_fleet, stat=stat:
                                        float(getattr(kv_fleet, stat, 0)))
+            if self._host_tier is not None:
+                # The tiered-KV counters (ROADMAP item 3): HBM↔host
+                # migration traffic plus the host tier's own hit/spill
+                # tail — beside kvfleet.* on the one registry, so
+                # replica /stats, /metrics, and `obs watch` see the
+                # whole hierarchy through one export path.
+                tier = self._host_tier
+                for stat in ("demoted_blocks", "promoted_blocks"):
+                    metrics.counter_fn(f"tier.{stat}",
+                                       lambda self=self, stat=stat:
+                                       float(getattr(self, stat)))
+                for stat in ("hits", "misses", "spilled_blocks",
+                             "dropped_blocks"):
+                    metrics.counter_fn(f"tier.host_{stat}",
+                                       lambda tier=tier, stat=stat:
+                                       float(getattr(tier, stat)))
+                metrics.gauge_fn("tier.host_resident_blocks",
+                                 lambda tier=tier: float(len(tier)))
 
         # Draft-model state: its "dense" cache is a paged pool with a
         # STATIC identity block layout — slot s owns blocks
@@ -768,8 +819,10 @@ class ServingEngine:
         # length). Chains are padded to power-of-two widths so the jit
         # cache holds O(log max_blocks) programs, not one per length;
         # kv_fleet is gated to mesh=None above, so a plain
-        # donate-the-pools plan suffices.
-        if kv_fleet is not None:
+        # donate-the-pools plan suffices. The host tier rides the SAME
+        # program: a host→HBM promotion is a fleet import whose payload
+        # came from RAM instead of the bucket.
+        if kv_fleet is not None or self._host_tier is not None:
             self._import_blocks_fn = self._wrap(compile_step(
                 lambda pools, dsts, values: write_blocks(
                     pools, dsts, values),
@@ -1200,6 +1253,12 @@ class ServingEngine:
                     self._micro_decode(finished)
                 else:
                     self._decode(finished)
+        # Synchronous-mode demotion: stage and force back-to-back — the
+        # device is idle after the step's readback, so the blocking
+        # force costs what it costs (the overlap loop is the path that
+        # hides it; sync mode keeps the same hierarchy semantics).
+        self._demote_pass()
+        self._finalize_demotions()
         if self._obs is not None:
             wall = time.perf_counter() - t0
             self._h_step.observe(wall)
@@ -1268,6 +1327,13 @@ class ServingEngine:
         # previous one was still unconsumed or a new one just enqueued.
         covered = rec is not None or self._inflight is not None
         self._consume_one(self._inflight, finished)
+        # Tier migration rides the covered window: last step's staged
+        # demotions force HERE (their reads enqueued behind the program
+        # the consume edge just waited out), and the next batch stages
+        # behind the program dispatched above — demote traffic is
+        # overlapped host work, never a step-loop stall.
+        self._finalize_demotions()
+        self._demote_pass()
         self._inflight = rec
         if self._obs is not None:
             wall = time.perf_counter() - t0
@@ -1687,14 +1753,16 @@ class ServingEngine:
     def _fleet_import(self, ctx: np.ndarray, have: int) -> List[int]:
         """Import the consecutive full-block tail of ``ctx`` that the
         local prefix cache missed (``have`` = local hit depth in blocks)
-        from the fleet KV plane. Any failure — index hole, stale entry
-        (missing object), torn payload, pool pressure — STOPS the import
-        and the remaining tail prefills locally; a wrong stream is
-        impossible because a payload is only adopted under the hash
-        naming its exact token prefix. Returns the imported physical
-        blocks in chain order (the caller appends them to its
-        cached-prefix list; their allocation refcount is the admitting
-        slot's reference)."""
+        from the tiers below HBM — host RAM first, then the fleet KV
+        plane. Any failure — index hole, stale entry (missing object),
+        torn payload, pool pressure — STOPS the import and the remaining
+        tail prefills locally; a wrong stream is impossible because a
+        payload is only adopted under the hash naming its exact token
+        prefix. Returns the imported physical blocks in chain order (the
+        caller appends them to its cached-prefix list; their allocation
+        refcount is the admitting slot's reference). ``hit_blocks``
+        counts imports from EITHER rung; ``stats()['tiering']``'s
+        promoted_blocks is the host-resident subset."""
         hashes = chain_block_hashes(ctx, self.scfg.block_size)
         want = hashes[have:]
         if not want:
@@ -1711,9 +1779,11 @@ class ServingEngine:
 
     def _import_hash_chain(self, want: List[bytes]) -> List[int]:
         """The fetch+write+adopt core shared by admission imports and
-        prefetch-ahead hints: look ``want`` (consecutive chained hashes)
-        up in the fleet index, fetch each payload, write the whole chain
-        into freshly allocated local blocks in ONE batched dispatch, and
+        prefetch-ahead hints: resolve ``want`` (consecutive chained
+        hashes) down the hierarchy — host tier first (RAM beats the
+        bucket by orders of magnitude), then the fleet index for the
+        remaining tail — fetch each payload, write the whole chain into
+        freshly allocated local blocks in ONE batched dispatch, and
         adopt each under its hash. Returns the imported blocks (each at
         allocation refcount 1 AND cache-retained — the caller keeps the
         ref for a slot table, or drops it to leave the block cached).
@@ -1725,19 +1795,34 @@ class ServingEngine:
         want = want[:self.scfg.max_blocks_per_slot]
         if not want:
             return []
-        try:
-            n_hit = self._fleet.lookup_chain(want)
-        except OSError:
-            n_hit = 0
         payloads: List[Tuple[bytes, List[dict]]] = []
-        for h in want[:n_hit]:
-            data = self._fleet.fetch(h)
-            if data is None:
-                break             # stale index entry → local prefill
-            values = split_block_bytes(data, self.cfg, self.scfg)
-            if values is None:
-                break             # foreign/torn payload → local prefill
-            payloads.append((h, values))
+        if self._host_tier is not None:
+            # Promotion proper: the consecutive leading run whose bytes
+            # are host-resident. A mid-chain miss falls through to the
+            # fleet below — the chain stays consecutive either way.
+            for h in want:
+                data = self._host_tier.get(h)
+                if data is None:
+                    break
+                values = split_block_bytes(data, self.cfg, self.scfg)
+                if values is None:
+                    break         # foreign payload → try the next rung
+                payloads.append((h, values))
+        n_promoted = len(payloads)
+        rest = want[n_promoted:]
+        if self._fleet is not None and rest:
+            try:
+                n_hit = self._fleet.lookup_chain(rest)
+            except OSError:
+                n_hit = 0
+            for h in rest[:n_hit]:
+                data = self._fleet.fetch(h)
+                if data is None:
+                    break         # stale index entry → local prefill
+                values = split_block_bytes(data, self.cfg, self.scfg)
+                if values is None:
+                    break         # foreign/torn payload → local prefill
+                payloads.append((h, values))
         imported: List[int] = []
         for _ in payloads:
             got = self._reserve(1, 0)
@@ -1770,6 +1855,7 @@ class ServingEngine:
                 stacked)
             for (h, _), block in zip(payloads, imported):
                 self._pcache.adopt(h, block)
+        self.promoted_blocks += min(n_promoted, len(imported))
         return imported
 
     def prefetch_chain(self, hashes: List[bytes]) -> int:
@@ -1783,8 +1869,12 @@ class ServingEngine:
         refcount 0, the same state a released cached block sits in, so
         pool pressure can evict them LRU like anything else cached.
         Best-effort by contract: every failure arm degrades to a smaller
-        (possibly empty) prefetch, never an error to the hinter."""
-        if self._fleet is None or self._pcache is None or not hashes:
+        (possibly empty) prefetch, never an error to the hinter. With a
+        host tier attached the same hint warms HBM from host RAM
+        (host→HBM promotion ahead of need) — the bucket→HBM prefetch
+        generalized down the hierarchy."""
+        if (self._fleet is None and self._host_tier is None) \
+                or self._pcache is None or not hashes:
             return 0
         have = 0
         for h in hashes:
@@ -1798,6 +1888,63 @@ class ServingEngine:
             self.allocator.decref(block)
         self.fleet_prefetch_blocks += len(imported)
         return len(imported)
+
+    # lint: begin-tier-migrate — the demote STAGING path: nothing
+    # between these markers may block on the device (block_until_ready
+    # / device_get / np.asarray of a device value). Staging runs on the
+    # step loop with a program in flight; the bytes force at the
+    # consume edge (_finalize_demotions), where the host is already
+    # blocked on the device. `make lint` (tier-1) enforces it, same
+    # discipline as the overlap-dispatch region.
+
+    def _demote_pass(self, limit: int = 8) -> None:
+        """The NON-BLOCKING half of demotion: pick up to ``limit`` of
+        the prefix cache's coldest retained ref-0 blocks (eviction's
+        next victims — an idle session's blocks join this set the step
+        its request releases) and stage their device slices toward the
+        host tier. No readback happens here: the staged reads enqueue
+        behind the in-flight program and force one consume edge later.
+        A block whose bytes are ALREADY host-resident skips the copy
+        and demotes immediately — re-demoting a resurrected block is
+        free because its host bytes never left."""
+        if self._host_tier is None or self._pcache is None:
+            return
+        budget = limit - len(self._pending_demotions)
+        if budget <= 0:
+            return
+        for h, block in self._pcache.cold_entries(budget):
+            if h in self._host_tier:
+                self.allocator.mark_demoted(block)
+                self.demoted_blocks += 1
+                continue
+            self._pending_demotions.append(
+                (h, block, stage_block_arrays(self.pools, block)))
+
+    # lint: end-tier-migrate
+
+    def _finalize_demotions(self) -> None:
+        """The BLOCKING half of demotion: force each staged entry to
+        bytes, hand it to the host tier (which LRU-spills past its
+        budget into the fleet bucket), and mark the HBM copy demoted —
+        eviction-preferred, since its bytes now survive reclaim. Runs
+        right AFTER the consume edge's program wait: the staged reads
+        enqueued behind that program, so the forces find materialized
+        buffers and cost ~nothing; in sync mode the device is idle
+        after the step's readback and blocking is the normal state.
+        Entries resurrected (incref'd) or evicted-and-recycled since
+        staging are skipped — the ``cached_block`` identity check makes
+        a wrong mark impossible (content addressing already makes a
+        wrong PAYLOAD impossible)."""
+        if not self._pending_demotions:
+            return
+        pending, self._pending_demotions = self._pending_demotions, []
+        for h, block, staged in pending:
+            if self._pcache.cached_block(h) != block \
+                    or self.allocator.refcount(block) != 0:
+                continue          # resurrected or recycled mid-flight
+            self._host_tier.put(h, staged_block_to_bytes(staged))
+            self.allocator.mark_demoted(block)
+            self.demoted_blocks += 1
 
     def stage_cached_blocks(self, limit: int = 16,
                             skip=()) -> List[Tuple[str, List]]:
@@ -1875,7 +2022,8 @@ class ServingEngine:
             cached: List[int] = []
             if self._pcache is not None:
                 cached = self._pcache.lookup(ctx)          # increfs
-                if self._fleet is not None:
+                if self._fleet is not None \
+                        or self._host_tier is not None:
                     # The blocks the LOCAL cache missed may exist in the
                     # fleet: import them by content hash instead of
                     # prefilling them (each imported block lands in the
@@ -2733,6 +2881,23 @@ class ServingEngine:
                                   if self._pcache else 0),
                 "evictions": (self._pcache.evictions
                               if self._pcache else 0),
+            },
+            "tiering": {
+                # The HBM → host RAM → bucket hierarchy (ROADMAP item
+                # 3). demoted: HBM blocks whose bytes were copied down
+                # to the host tier; promoted: blocks imported back into
+                # HBM from host RAM (the fleet counters below cover the
+                # bucket rung); the host_* fields are the tier's own
+                # view including its spill tail into the bucket.
+                "enabled": self._host_tier is not None,
+                "host_offload_blocks": self.scfg.host_offload_blocks,
+                "demoted_blocks": self.demoted_blocks,
+                "promoted_blocks": self.promoted_blocks,
+                "demoted_resident": self.allocator.demoted,
+                "pending_demotions": len(self._pending_demotions),
+                **({f"host_{k}": v
+                    for k, v in self._host_tier.stats().items()}
+                   if self._host_tier is not None else {}),
             },
             "kvfleet": {
                 "enabled": self._fleet is not None,

@@ -24,13 +24,18 @@ chosen by the engine at construction, never per call:
   ``"interpret"`` route the SAME scatter-then-attend contract through the
   block-table-walking kernel (``ml.ops.paged_attention``) that never
   materializes the gathered buffer.
-- quantized pools (``kv_dtype="int8"`` — detected from the pool layout):
-  the scatter becomes :func:`~tpu_task.ml.serving.cache.quantized_append`
+- quantized pools (``kv_dtype="int8"``/``"fp8"``/``"int4"`` — detected
+  from the pool layout): the scatter becomes
+  :func:`~tpu_task.ml.serving.cache.quantized_append`
   (per-block requantization driven by the host-computed ``qa`` arrays)
   and every step additionally returns the max quantization error of its
   writes — computed only when the engine's debug mode sets the static
   ``measure_qerr`` flag (otherwise the output is a constant 0.0, so the
-  hot path never pays for the measurement).
+  hot path never pays for the measurement). int4 (PR 17) needs nothing
+  new here: :func:`pool_is_quantized` keys off the scale sidecar, which
+  packed pools carry like int8's, and ``quantized_append``/the kernels
+  read the packing off the pool dtype (uint8 IS the int4 marker) — the
+  functions below are dtype-agnostic by construction.
 """
 
 from __future__ import annotations
@@ -61,8 +66,9 @@ from tpu_task.ml.serving.cache import (
 
 def pool_is_quantized(pools: List[dict]) -> bool:
     """Whether the pool pytree carries quantized-code scale sidecars —
-    the shared int8/fp8 discriminator every paged program keys off (the
-    code dtype itself is read off the pool arrays)."""
+    the shared int8/fp8/int4 discriminator every paged program keys off
+    (the code dtype — and, for uint8 pools, the int4 nibble packing —
+    is read off the pool arrays)."""
     return "k_scale" in pools[0]
 
 

@@ -1,0 +1,141 @@
+"""Host-RAM KV offload tier: the middle rung of the block hierarchy.
+
+The block export/import seam (``cache.export_block_bytes`` /
+``split_block_bytes`` / ``write_block``) serializes any physical block
+byte-faithfully; PR 14 used it as a SHARING plane (the kvfleet bucket).
+This module uses the same payloads as a MEMORY tier: a budgeted,
+content-addressed store of block bytes in host RAM, sitting between the
+paged HBM pools and the bucket.
+
+    HBM pool  ──demote──▶  HostKvTier  ──spill──▶  kvfleet bucket
+       ▲                       │                        │
+       └──────promote──────────┴───────fetch────────────┘
+
+* **Demote** — the engine replicates cold retained refcount-0 cached
+  blocks (the prefix cache's LRU tail — exactly the blocks eviction
+  would reclaim next) into the tier on the overlap seam: the device
+  slice is staged non-blocking (``stage_block_arrays``) while a program
+  is in flight, and the bytes are forced at the consume edge, where the
+  host is already blocked on the device — migration hides under the
+  in-flight program (``goodput.host_gap_frac`` stays ~0).
+* **Promote** — admission's hash-chain import consults this tier BEFORE
+  the fleet bucket (RAM beats a network object store by orders of
+  magnitude): a hit hands back the exact exported payload, which the
+  engine writes into a fresh HBM block and re-registers in its prefix
+  cache. ``prefetch_chain`` rides the same lookup, so the router's
+  session-affinity prefetch hints warm HBM from host RAM ahead of the
+  next turn.
+* **Spill** — entries past the block budget evict LRU-first into a
+  caller-provided sink (the engine wires ``FleetKvClient.ship_bytes``
+  when a fleet plane is attached; with no sink they drop, and the miss
+  degrades to recompute-from-prefix — the PR 14 staleness contract's
+  arm, never a wrong stream).
+
+The tier is deliberately dumb: a dict of immutable ``bytes`` payloads
+keyed by the chained content hash, LRU-ordered by dict insertion order.
+Content addressing is the whole correctness story — a payload is only
+ever adopted under the hash naming its exact token prefix, so a stale
+or dropped entry can never corrupt a stream, only cost a recompute.
+Host "pinning" here is simply keeping the bytes referenced from Python;
+the arrays ``split_block_bytes`` later views are zero-copy over them.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+__all__ = ["HostKvTier"]
+
+
+class HostKvTier:
+    """Budgeted LRU store: chained block hash → exported block payload.
+
+    ``budget_blocks`` bounds resident entries (one entry is one physical
+    block's export payload — ``cache.block_payload_nbytes`` bytes).
+    ``spill`` is called with the evicted ``[(hash, payload), ...]``
+    batch whenever an insert pushes the tier over budget; exceptions
+    from the sink are swallowed (a failed spill loses only cache, the
+    recompute fallback covers it).
+    """
+
+    def __init__(self, budget_blocks: int,
+                 spill: Optional[Callable[[List[Tuple[bytes, bytes]]],
+                                          None]] = None):
+        if budget_blocks < 1:
+            raise ValueError(
+                f"budget_blocks must be >= 1, got {budget_blocks}")
+        self.budget_blocks = budget_blocks
+        self._spill = spill
+        self._entries: Dict[bytes, bytes] = {}   # insertion order = LRU
+        self.hits = 0
+        self.misses = 0
+        self.spilled_blocks = 0
+        self.dropped_blocks = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, h: bytes) -> bool:
+        return h in self._entries
+
+    @property
+    def resident_bytes(self) -> int:
+        return sum(len(p) for p in self._entries.values())
+
+    def put(self, h: bytes, payload: bytes) -> None:
+        """Insert (or LRU-refresh) one block payload; evicts the LRU
+        tail past the budget into the spill sink."""
+        self._entries.pop(h, None)
+        self._entries[h] = payload
+        over = len(self._entries) - self.budget_blocks
+        if over <= 0:
+            return
+        victims = []
+        for old in list(self._entries):
+            if len(victims) >= over:
+                break
+            victims.append((old, self._entries.pop(old)))
+        if self._spill is not None:
+            try:
+                self._spill(victims)
+                self.spilled_blocks += len(victims)
+                return
+            except OSError:
+                pass                    # dropped below — cache, not truth
+        self.dropped_blocks += len(victims)
+
+    def get(self, h: bytes) -> Optional[bytes]:
+        """One payload by hash (LRU-touching), or None. The entry STAYS
+        resident — a promoted block may be evicted from HBM again before
+        the tier's LRU would have dropped it, and the bytes are
+        immutable, so keeping them costs nothing extra."""
+        payload = self._entries.pop(h, None)
+        if payload is None:
+            self.misses += 1
+            return None
+        self._entries[h] = payload      # re-insert = LRU touch
+        self.hits += 1
+        return payload
+
+    def chain_depth(self, hashes) -> int:
+        """Consecutive-leading-hit depth of a hash chain (the
+        ``FleetKvIndex.chain_depth`` contract: a chain with a hole stops
+        at the hole — blocks past it would leave a KV gap no import can
+        fill). Membership only; no LRU touch."""
+        depth = 0
+        for h in hashes:
+            if h not in self._entries:
+                break
+            depth += 1
+        return depth
+
+    def stats(self) -> dict:
+        return {
+            "resident_blocks": len(self._entries),
+            "budget_blocks": self.budget_blocks,
+            "resident_bytes": self.resident_bytes,
+            "hits": self.hits,
+            "misses": self.misses,
+            "spilled_blocks": self.spilled_blocks,
+            "dropped_blocks": self.dropped_blocks,
+        }

@@ -22,6 +22,11 @@ every other metric):
   so it is covered, not a gap), and only host time in steps with NO
   program in flight — the drain tail, the first step's pre-dispatch
   sliver, a flush that emptied the pipeline — charges the host gap.
+  KV tier migration (PR 17) is attributed the same way: ``_demote_pass``
+  stages device→host copies inside the covered window and forces them
+  at the consume edge, so demote traffic lands in ``overlapped_host_s``
+  / program time, never the gap — offload at batch 32 keeps
+  ``host_gap_frac`` ~0 (the `make bench-tier` pin).
   ``host_gap_frac`` under overlap therefore measures device idle the
   host could have prevented, which the double-buffered loop drives to
   ~zero by construction; the wall-clock win it buys is reported

@@ -237,10 +237,23 @@ class FleetKvClient:
         publisher's shard."""
         from tpu_task.ml.serving.cache import staged_block_to_bytes
 
-        index = self._require_bound()
         if not staged:
             return 0
-        entries = [(hh, staged_block_to_bytes(s)) for hh, s in staged]
+        return self.ship_bytes(
+            [(hh, staged_block_to_bytes(s)) for hh, s in staged])
+
+    def ship_bytes(self, entries: list) -> int:
+        """Upload pre-serialized ``(hash, payload bytes)`` entries — the
+        byte-level half of :meth:`ship`, and the host tier's SPILL sink
+        (ROADMAP item 3): blocks evicted past the host-RAM budget land
+        in the bucket through the same content-addressed plane, so a
+        spilled block is indistinguishable from a published one to every
+        importer. Hashes may be raw digests or hex strings."""
+        index = self._require_bound()
+        if not entries:
+            return 0
+        entries = [(hh if isinstance(hh, str) else hh.hex(), payload)
+                   for hh, payload in entries]
         for hash_hex, payload in entries:
             try:
                 if self._backend.write_if_absent(

@@ -53,6 +53,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import queue
 import signal
 import sys
 import threading
@@ -300,6 +301,17 @@ class ReplicaServer:
         self.kv_client = kv_client
         self.kv_publish_every = max(1, kv_publish_every)
         self._steps_since_publish = 0
+        #: The TRUE background uploader (closing the PR 16 leftover):
+        #: ``ship()`` — the device→host force plus the bucket upload —
+        #: runs on its own thread behind this bounded queue, so the
+        #: step thread pays only the non-blocking ``stage()`` even
+        #: outside overlap mode. A full queue DROPS the batch (publish
+        #: is best-effort by contract — unshipped blocks simply
+        #: re-offer on a later beat), so a slow bucket can never apply
+        #: backpressure to the decode loop.
+        self._ship_queue: "queue.Queue[list]" = queue.Queue(maxsize=8)
+        self.ship_drops = 0
+        self._ship_thread: Optional[threading.Thread] = None
         self.engine = engine if engine is not None else build_engine(
             preset, serving, obs=self.obs, kv_client=kv_client, tp=tp,
             ep=ep)
@@ -319,6 +331,17 @@ class ReplicaServer:
                              kwargs={"poll_interval": 0.05}, daemon=True),
             threading.Thread(target=self._step_loop, daemon=True),
         ]
+        if kv_client is not None:
+            self._ship_thread = threading.Thread(
+                target=self._ship_loop, daemon=True)
+            self._threads.append(self._ship_thread)
+            if self.obs is not None:
+                self.obs.metrics.gauge_fn(
+                    "kvfleet.ship_queue_depth",
+                    lambda q=self._ship_queue: float(q.qsize()))
+                self.obs.metrics.counter_fn(
+                    "kvfleet.ship_drops",
+                    lambda self=self: float(self.ship_drops))
         self._started = False
 
     # -- lifecycle -----------------------------------------------------------
@@ -335,6 +358,11 @@ class ReplicaServer:
         process-wide pool so a later server on a reused ephemeral port
         never inherits a stale connection (the PR 2 emulator contract)."""
         self._stop.set()
+        if self._ship_thread is not None and self._ship_thread.is_alive():
+            # Graceful-exit drain: the uploader keeps pulling until the
+            # queue is EMPTY after the stop flag — staged payloads that
+            # made it into the queue are shipped, not dropped.
+            self._ship_thread.join(timeout=5.0)
         self._server.shutdown()
         self._server.server_close()
         from tpu_task.storage.http_util import default_pool
@@ -358,10 +386,11 @@ class ReplicaServer:
                             # publish just re-offers next time. Only the
                             # stage (snapshotting block references) needs
                             # the lock; the ship — device→host transfer
-                            # plus the bucket upload — runs below, off
-                            # the lock, so in overlap mode the next
-                            # dispatched program keeps the device busy
-                            # while the payload uploads.
+                            # plus the bucket upload — happens on the
+                            # dedicated uploader thread behind the
+                            # bounded queue below, so neither the lock
+                            # nor the step thread ever waits on the
+                            # bucket.
                             self._steps_since_publish += 1
                             if result["finished"] or \
                                     self._steps_since_publish \
@@ -370,9 +399,9 @@ class ReplicaServer:
                                 staged = self.kv_client.stage(self.engine)
                 if staged:
                     try:
-                        self.kv_client.ship(staged)
-                    except OSError:
-                        pass
+                        self._ship_queue.put_nowait(staged)
+                    except queue.Full:
+                        self.ship_drops += 1
             except Exception as error:
                 # A dying step loop must never wedge the replica silently
                 # (healthz green, streams empty forever): drain instead —
@@ -391,6 +420,24 @@ class ReplicaServer:
                 return
             if not stepped:
                 time.sleep(0.002)
+
+    def _ship_loop(self) -> None:
+        """The background uploader: pulls staged publish batches off the
+        bounded queue and ships them (device→host force + bucket
+        upload). Runs until the stop flag is set AND the queue is empty
+        — the graceful-exit drain — and swallows OSError per batch
+        (best-effort publish: the blocks re-offer next beat)."""
+        while True:
+            try:
+                staged = self._ship_queue.get(timeout=0.05)
+            except queue.Empty:
+                if self._stop.is_set():
+                    return
+                continue
+            try:
+                self.kv_client.ship(staged)
+            except OSError:
+                pass
 
     # -- observability ---------------------------------------------------------
     def note_error(self, where: str, error: Exception,

@@ -650,9 +650,15 @@ class Router:
         before the request arrives, instead of on its TTFT path. Sends
         only the chain suffix the target is not already known to hold;
         the hint also feeds the served-chain memory, so cached-depth
-        routing sends the next turn where the prefetch landed. Entirely
-        best-effort: any failure is swallowed (the blocks import at
-        admission instead — exactly the behavior without the hint)."""
+        routing sends the next turn where the prefetch landed. With a
+        host offload tier configured (PR 17,
+        ``ServingConfig.host_offload_blocks``) the same hint is also
+        the promotion-ahead-of-need trigger: ``prefetch_chain`` on the
+        replica consults its host rung BEFORE the fleet bucket, so a
+        demoted-and-evicted session chain re-enters HBM off the TTFT
+        path too. Entirely best-effort: any failure is swallowed (the
+        blocks import at admission instead — exactly the behavior
+        without the hint)."""
         ids = list(request.prompt) + list(request.tokens)
         hashes = self._chain_hashes(ids)
         if not hashes:

@@ -19,7 +19,16 @@ Run via ``make lint`` (and in tier-1 through ``tests/test_repo_lint.py``).
    re-serializes the loop the async engine exists to kill — the consume
    edge (outside the markers) is the ONE sanctioned blocking point.
 
-Both checks are textual by design: they gate idioms, not semantics, so
+3. **No blocking reads inside the tier-migrate staging region.** Same
+   discipline, second region: the demote path between
+   ``lint: begin-tier-migrate`` and ``lint: end-tier-migrate`` stages
+   pool slices toward the host tier WHILE a program is in flight; the
+   bytes may only be forced at the consume edge
+   (``_finalize_demotions``). A synchronous ``jax.device_get`` /
+   ``np.asarray`` on a pool array there would silently turn every
+   demotion into a step-loop stall.
+
+All checks are textual by design: they gate idioms, not semantics, so
 they stay O(file read) and dependency-free.
 """
 
@@ -42,6 +51,8 @@ TOKEN_PATH_GLOBS = (
 ALLOW_CONCAT = "lint: allow-concatenate"
 BEGIN_OVERLAP = "lint: begin-overlap-dispatch"
 END_OVERLAP = "lint: end-overlap-dispatch"
+BEGIN_TIER = "lint: begin-tier-migrate"
+END_TIER = "lint: end-tier-migrate"
 OVERLAP_FILE = "tpu_task/ml/serving/engine.py"
 
 _CONCAT_RE = re.compile(r"\bjnp\.concatenate\s*\(")
@@ -69,26 +80,28 @@ def lint_concatenate_text(text: str, path: str) -> List[str]:
     return findings
 
 
-def lint_overlap_text(text: str, path: str) -> List[str]:
-    """Findings for rule 2 on the engine file's text. A missing begin
-    marker is itself a finding — deleting the markers must not silently
-    disable the check."""
+def _lint_region_text(text: str, path: str, begin_marker: str,
+                      end_marker: str, what: str,
+                      region: str) -> List[str]:
+    """Shared no-blocking-reads region check. A missing begin marker is
+    itself a finding — deleting the markers must not silently disable
+    the check."""
     findings = []
     lines = text.splitlines()
     spans: List[Tuple[int, int]] = []
     begin = None
     for ln, line in enumerate(lines, 1):
-        if BEGIN_OVERLAP in line:
+        if begin_marker in line:
             begin = ln
-        elif END_OVERLAP in line and begin is not None:
+        elif end_marker in line and begin is not None:
             spans.append((begin, ln))
             begin = None
     if not spans:
-        return [f"{path}: overlap-dispatch lint markers "
-                f"('{BEGIN_OVERLAP}' ... '{END_OVERLAP}') not found — "
+        return [f"{path}: {what} lint markers "
+                f"('{begin_marker}' ... '{end_marker}') not found — "
                 f"the no-blocking region must stay marked"]
     if begin is not None:
-        findings.append(f"{path}:{begin}: unterminated '{BEGIN_OVERLAP}'")
+        findings.append(f"{path}:{begin}: unterminated '{begin_marker}'")
     for lo, hi in spans:
         for ln in range(lo, hi + 1):
             stripped = lines[ln - 1].lstrip()
@@ -98,10 +111,28 @@ def lint_overlap_text(text: str, path: str) -> List[str]:
                 if rx.search(lines[ln - 1]):
                     findings.append(
                         f"{path}:{ln}: blocking device read "
-                        f"('{rx.pattern}') inside the overlapped "
-                        f"dispatch region — only the consume edge may "
+                        f"('{rx.pattern}') inside the {region} "
+                        f"region — only the consume edge may "
                         f"block")
     return findings
+
+
+def lint_overlap_text(text: str, path: str) -> List[str]:
+    """Findings for rule 2 (the overlapped dispatch region) on the
+    engine file's text."""
+    return _lint_region_text(
+        text, path, BEGIN_OVERLAP, END_OVERLAP,
+        "overlap-dispatch", "overlapped dispatch")
+
+
+def lint_tier_text(text: str, path: str) -> List[str]:
+    """Findings for rule 3 (the demote/promote staging region) on the
+    engine file's text: tier migration must stage non-blocking — a
+    synchronous device read there stalls the step loop the host tier
+    was built to keep busy."""
+    return _lint_region_text(
+        text, path, BEGIN_TIER, END_TIER,
+        "tier-migrate", "tier-migrate staging")
 
 
 def run(repo: Path = REPO) -> List[str]:
@@ -113,8 +144,9 @@ def run(repo: Path = REPO) -> List[str]:
                 path.read_text(encoding="utf-8"), rel)
     engine = repo / OVERLAP_FILE
     if engine.exists():
-        findings += lint_overlap_text(
-            engine.read_text(encoding="utf-8"), OVERLAP_FILE)
+        text = engine.read_text(encoding="utf-8")
+        findings += lint_overlap_text(text, OVERLAP_FILE)
+        findings += lint_tier_text(text, OVERLAP_FILE)
     else:
         findings.append(f"{OVERLAP_FILE}: missing (overlap lint target)")
     return findings
