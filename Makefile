@@ -8,7 +8,7 @@ PYTHON ?= python3
 # Seed for the chaos soak: any run is replayable by pinning this.
 TPU_TASK_CHAOS_SEED ?= 20260804
 
-.PHONY: test lint smoke sweep bench bench-steady bench-serving bench-sched bench-decode bench-fleet bench-fleetkv bench-obs bench-goodput bench-tier sched sched-soak chaos fleet kvfleet tiering moe moe-serve serve-soak obs watch wheel multichip kernels-tpu clean
+.PHONY: test lint smoke sweep bench bench-steady bench-serving bench-sched bench-decode bench-fleet bench-fleetkv bench-obs bench-goodput bench-tier bench-sla sched sched-soak chaos fleet kvfleet tiering moe moe-serve serve-soak sla-soak obs watch wheel multichip kernels-tpu clean
 
 # Hermetic suite (the reference's `make test`, 30 s budget there; ours spans
 # the fake control planes, sharded-compute CPU checks, and the loopback GCS
@@ -97,6 +97,12 @@ bench-decode:
 bench-fleet:
 	$(PYTHON) bench.py fleet
 
+# SLA brownout curve (PR 18): premium + best_effort attainment vs load at
+# 1x/2x/4x the calibrated service rate; nonzero exit if best_effort
+# attainment ever exceeds premium's (protection inverted).
+bench-sla:
+	$(PYTHON) bench.py fleet --overload
+
 # Tier-1-speed gang-scheduler tests: queue/quota/pool model, fair-share
 # ordering, victim-order properties, CLI, bench smoke (all virtual-time).
 sched:
@@ -172,6 +178,12 @@ bench-fleetkv:
 serve-soak:
 	TPU_TASK_CHAOS_SEED=$(TPU_TASK_CHAOS_SEED) \
 		$(PYTHON) -m pytest tests/ -m "fleet and slow" -q
+
+# SLA brownout soak (PR 18): seeded 2x-overload + preemption wave; premium
+# p99 TTFT must hold while best_effort sheds, fairness invariants intact.
+sla-soak:
+	TPU_TASK_CHAOS_SEED=$(TPU_TASK_CHAOS_SEED) \
+		$(PYTHON) -m pytest tests/ -m "sla and slow" -q
 
 # Observability-plane tests (tier-1 speed): metrics registry + histogram
 # math (the shared-quantile pin against numpy), tracer/ring/header, span

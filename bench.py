@@ -2352,6 +2352,155 @@ def bench_serving_fleet(replica_counts=(1, 2, 4), n_requests: int = 24,
     }
 
 
+def bench_fleet_overload(load_multipliers=(1.0, 2.0, 4.0),
+                         n_requests: int = 40, seed: int = 0) -> dict:
+    """SLO-attainment-vs-load curve (the SLA actuation plane, PR 18):
+    a premium + best_effort deadline mix through one micro replica at
+    1×/2×/4× the calibrated service rate, with the degrade ladder
+    driven by a burn beat (deadline misses + sheds since the last beat)
+    the way the fleet's burn-rate evaluator drives it in production.
+
+    What the curve must show: a KNEE, not a cliff — as load crosses
+    capacity, best_effort attainment falls first (ladder sheds +
+    expired-in-queue sheds) while premium attainment degrades last and
+    least. The CI gate (`make bench-sla`): best_effort attainment must
+    never EXCEED premium's at any load point — if protection inverts,
+    the actuation plane is routing pain to the wrong class.
+
+    Load is calibrated, not hardcoded: a warmup leg measures the
+    replica's per-request service time and each sweep point submits at
+    ``load × (1/service)``; deadlines are a fixed multiple of the same
+    measurement, so the sweep stresses queueing, not the host's CPU of
+    the day. The replica runs with a bounded admission queue
+    (``max_queue``) so overload backs up at the ROUTER — where the shed
+    gate, the ladder, and deadline expiry act — instead of vanishing
+    into an unbounded engine queue the actuation plane cannot see."""
+    import numpy as np
+
+    from tpu_task.obs import DegradeLadder
+    from tpu_task.serve import ReplicaServer, Router
+
+    rng = np.random.default_rng(seed)
+    server = ReplicaServer(preset="micro", max_queue=8).start()
+    try:
+        # Calibration: compile-warm, then time a saturated batch to get
+        # the steady per-request service time at full slot concurrency.
+        warm_router = Router(seed=seed)
+        warm_router.set_replicas(
+            {"r0": {"url": server.url, "boot_id": server.boot_id}})
+        warm = [warm_router.submit(np.zeros(4, np.int32), 2)
+                for _ in range(4)]
+        warm_router.drain(deadline_s=120)
+        t0 = time.monotonic()
+        # Decode-heavy requests (max_new 32): service time must dominate
+        # the single-threaded client loop's per-call overhead or the
+        # "overload" never outruns the engine.
+        timed = [warm_router.submit(
+            rng.integers(0, 256, size=8).astype(np.int32), 32)
+            for _ in range(8)]
+        warm_router.drain(deadline_s=120)
+        del warm, timed
+        service_s = max((time.monotonic() - t0) / 8, 1e-3)
+        # Deadline = the wait through a full replica (slots + bounded
+        # queue) plus margin: a 1x-load request always fits; a request
+        # behind a 2x-overload backlog cannot.
+        deadline_ms = 14.0 * service_s * 1000.0
+        # SLO-beat cadence scales with the measured service time so the
+        # ladder sees several beats WITHIN the overload (a fast CPU
+        # engine drains the whole sweep in well under a second).
+        beat_s = max(0.02, 2.0 * service_s)
+
+        def run_point(load: float) -> dict:
+            point_rng = np.random.default_rng(seed + int(load * 100))
+            work, t = [], 0.0
+            for i in range(n_requests):
+                t += float(point_rng.exponential(service_s / load))
+                work.append({
+                    "arrival": t,
+                    "prompt": point_rng.integers(0, 256, size=8)
+                    .astype(np.int32),
+                    "slo_class": "premium" if i % 2 == 0
+                    else "best_effort",
+                })
+            router = Router(seed=seed, ladder=DegradeLadder(
+                clamp_max_new=4))
+            router.set_replicas(
+                {"r0": {"url": server.url, "boot_id": server.boot_id}})
+            t0 = time.monotonic()
+            fids, i = {}, 0
+            last_beat = t0
+            last_bad = 0
+            max_rung = 0
+            while True:
+                now = time.monotonic()
+                while i < len(work) and work[i]["arrival"] <= now - t0:
+                    fids[i] = router.submit(
+                        work[i]["prompt"], 32,
+                        slo_class=work[i]["slo_class"],
+                        deadline_ms=deadline_ms)
+                    i += 1
+                # wait_ms=0: a blocking pump serves the backlog INSIDE
+                # the round, hiding the overload from the beat below.
+                open_count = router.pump(wait_ms=0)
+                # The SLO-evaluation beat: in the fleet this is the
+                # burn-rate evaluator's alert state arriving via
+                # flush_obs; here new burn (misses + sheds) since the
+                # last beat stands in for it on the same seam.
+                if now - last_beat >= beat_s:
+                    bad = sum(c["missed"] + c["shed"]
+                              for c in router.stats()["sla"]
+                              ["classes"].values())
+                    router.note_alerts(
+                        ["burn"] if bad > last_bad else [])
+                    last_bad = bad
+                    last_beat = now
+                    max_rung = max(max_rung, router.ladder.rung)
+                if i == len(work) and open_count == 0:
+                    break
+                if now - t0 > 300:
+                    raise RuntimeError(
+                        "overload point did not converge")
+            stats = router.stats()["sla"]
+            classes = {}
+            for cls in ("premium", "best_effort"):
+                counts = stats["classes"].get(
+                    cls, {"met": 0, "missed": 0, "shed": 0,
+                          "degraded": 0, "attainment": 1.0})
+                ttft = [router.request(fid).first_token_t
+                        - (t0 + work[j]["arrival"])
+                        for j, fid in fids.items()
+                        if work[j]["slo_class"] == cls
+                        and router.request(fid).first_token_t is not None]
+                classes[cls] = {
+                    "attainment": round(counts["attainment"], 3),
+                    "met": counts["met"], "missed": counts["missed"],
+                    "shed": counts["shed"],
+                    "degraded": counts["degraded"],
+                    "ttft_p99_ms": _hist_pct_ms(ttft, 99, ndigits=1)
+                    if ttft else None,
+                }
+            return {"load": load, "max_rung": max_rung,
+                    "classes": classes}
+
+        points = [run_point(load) for load in load_multipliers]
+    finally:
+        server.stop()
+    ordering_ok = all(
+        p["classes"]["best_effort"]["attainment"]
+        <= p["classes"]["premium"]["attainment"] + 1e-9
+        for p in points)
+    return {
+        "workload": {"n_requests": n_requests,
+                     "service_s_calibrated": round(service_s, 4),
+                     "deadline_ms": round(deadline_ms, 1),
+                     "classes": ["premium", "best_effort"]},
+        "by_load": points,
+        # The gate `make bench-sla` enforces: the brownout must route
+        # pain DOWN the class ladder, never up it.
+        "class_ordering_ok": ordering_ok,
+    }
+
+
 def bench_fleet_kv(replica_counts=(1, 2, 4), n_requests: int = 24,
                    seed: int = 0) -> dict:
     """Fleet-wide KV legs (ROADMAP item 2).
@@ -3101,6 +3250,9 @@ def main() -> int:
     # replica gangs on the scheduler, session-affine router, preempt-one
     # recovery legs — at replica count 1/2/4 on loopback HTTP.
     fleet = bench_serving_fleet()
+    # SLA actuation (PR 18): the attainment-vs-load brownout curve —
+    # premium holds while best_effort sheds as load crosses capacity.
+    fleet["overload"] = bench_fleet_overload()
     # Fleet-wide KV (ROADMAP item 2): shared-prefix scaling with block
     # shipping on vs off + the prefill/decode split latency leg.
     fleet["kvfleet"] = bench_fleet_kv()
@@ -3258,6 +3410,13 @@ def _parse_args(argv):
     fleet_cmd.add_argument("--requests", type=int, default=24)
     fleet_cmd.add_argument("--seed", type=int, default=0)
     fleet_cmd.add_argument(
+        "--overload", action="store_true", dest="overload",
+        help="run only the SLA overload sweep (also `make bench-sla`): "
+             "premium + best_effort attainment vs load at 1x/2x/4x the "
+             "calibrated service rate; exits nonzero if best_effort "
+             "attainment exceeds premium's at any load point (the "
+             "brownout must route pain down the class ladder)")
+    fleet_cmd.add_argument(
         "--kvfleet-only", action="store_true", dest="kvfleet_only",
         help="run only the fleet-KV legs (shared_prefix_scaling + "
              "prefill_decode_split — also `make bench-fleetkv`)")
@@ -3349,6 +3508,14 @@ if __name__ == "__main__":
             tuple(int(v) for v in point.lower().split("x"))
             for point in str(args.moe_grid).split(",") if point.strip()
         ) or ((1, 1), (8, 1), (1, 4), (8, 4))
+        if args.overload:
+            result = {"overload": bench_fleet_overload(seed=args.seed)}
+            print(json.dumps({"fleet": result}))
+            # The `make bench-sla` gate: class-ordering inversion at any
+            # load point means the actuation plane protects the wrong
+            # traffic.
+            raise SystemExit(
+                0 if result["overload"]["class_ordering_ok"] else 1)
         if args.moe_only:
             # The grid's widest point sets the virtual platform BEFORE
             # jax initializes (sections import it lazily).

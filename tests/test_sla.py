@@ -1,0 +1,376 @@
+"""SLA actuation plane (PR 18), tier-1 pins: the header vocabulary, the
+degrade ladder's class ordering, EDF inside the fairness invariants,
+SLO-aware victim selection, slack-ordered engine admission, the router's
+shed gate, and the SLA-aware autoscalers.
+
+Everything here is pure host Python (no engine compile, no loopback
+HTTP) — the end-to-end brownout behavior lives in test_sla_soak.py
+(`make sla-soak`)."""
+
+import io
+import json
+import urllib.error
+from collections import deque
+from types import SimpleNamespace
+
+import pytest
+
+from tpu_task.obs.sla import (
+    DEFAULT_CLASS,
+    DegradeLadder,
+    MAX_RUNG,
+    RUNG_NOSPEC,
+    RUNG_SHED,
+    class_rank,
+    format_sla_header,
+    parse_sla_header,
+)
+from tpu_task.scheduler.pool import CapacityPool, select_victims
+from tpu_task.scheduler.queue import GangSpec, QueuedTask, fair_share_order
+from tpu_task.serve.autoscale import QueueDepthAutoscaler, SlaAutoscaler
+from tpu_task.serve.router import Router, _Replica
+
+pytestmark = pytest.mark.sla
+
+
+# -- header vocabulary ---------------------------------------------------------
+
+
+def test_sla_header_roundtrip():
+    assert parse_sla_header(format_sla_header("premium", 1234.56)) == \
+        ("premium", 1234.6)
+    assert parse_sla_header(format_sla_header("best_effort")) == \
+        ("best_effort", None)
+
+
+def test_sla_header_parse_is_permissive():
+    """Garbled SLA metadata degrades to (standard, no deadline) — never
+    to a rejected request."""
+    assert parse_sla_header(None) == (DEFAULT_CLASS, None)
+    assert parse_sla_header("") == (DEFAULT_CLASS, None)
+    assert parse_sla_header(";") == (DEFAULT_CLASS, None)
+    assert parse_sla_header("premium;not-a-number") == ("premium", None)
+    assert parse_sla_header("premium;-5") == ("premium", 0.0)
+    assert class_rank("no-such-class") == class_rank(DEFAULT_CLASS)
+
+
+# -- the degrade ladder --------------------------------------------------------
+
+
+def test_ladder_escalates_and_deescalates_asymmetrically():
+    ladder = DegradeLadder(escalate_after=1, clear_after=2)
+    assert ladder.observe(True) == 1
+    assert ladder.observe(True) == 2
+    # One clear beat is not enough to convince the ladder down.
+    assert ladder.observe(False) == 2
+    assert ladder.observe(False) == 1
+    assert ladder.observe(False) == 2 - 1  # needs two MORE clear beats
+    assert ladder.observe(False) == 0
+    assert ladder.observe(False) == 0      # floor
+
+
+def test_ladder_brownout_order_least_protected_first():
+    """The brownout contract: best_effort walks every rung before
+    premium starts, and the ladder can NEVER shed premium."""
+    ladder = DegradeLadder(clamp_max_new=4, escalate_after=1)
+    for _ in range(MAX_RUNG + 3):
+        ladder.observe(True)
+    assert ladder.rung == MAX_RUNG
+    best = ladder.plan("best_effort", 32)
+    std = ladder.plan("standard", 32)
+    prem = ladder.plan("premium", 32)
+    assert best["shed"] and std["shed"]
+    assert not prem["shed"]                       # ladder ceiling
+    assert prem["no_spec"] and prem["max_new"] == 4
+    # Mid-ladder: the front has reached best_effort only.
+    ladder = DegradeLadder(clamp_max_new=4, escalate_after=1)
+    ladder.observe(True)                          # rung 1
+    assert ladder.plan("best_effort", 32)["max_new"] == 4
+    assert ladder.plan("premium", 32)["max_new"] == 32
+    ladder.observe(True)                          # rung 2
+    assert ladder.plan("best_effort", 32)["no_spec"]
+    assert not ladder.plan("standard", 32)["no_spec"]
+    ladder.observe(True)                          # rung 3
+    assert ladder.plan("best_effort", 32)["shed"]
+    assert not ladder.plan("standard", 32)["shed"]
+    assert RUNG_SHED - class_rank("premium") < RUNG_NOSPEC
+
+
+# -- EDF inside the scheduler's fairness invariants ----------------------------
+
+
+def _task(task_id, *, tenant="a", priority=0, seq=0, deadline=-1.0):
+    return QueuedTask(task_id=task_id, tenant=tenant,
+                      gang=GangSpec("v4-8"), priority=priority,
+                      submit_seq=seq, deadline=deadline)
+
+
+def test_fair_share_edf_within_tenant_and_priority():
+    tasks = [
+        _task("late", seq=0, deadline=90.0),
+        _task("none", seq=1),
+        _task("soon", seq=2, deadline=10.0),
+    ]
+    order = fair_share_order(tasks, {}, {"a": 1.0})
+    assert [t.task_id for t in order] == ["soon", "late", "none"]
+
+
+def test_edf_cannot_cross_priority_or_tenant():
+    """EDF lives strictly inside (tenant, priority): a tight deadline
+    neither outranks a higher-priority sibling nor jumps the fair-share
+    order across tenants."""
+    tasks = [
+        _task("hi-no-deadline", priority=2, seq=0),
+        _task("lo-tight", priority=0, seq=1, deadline=0.001),
+    ]
+    order = fair_share_order(tasks, {}, {"a": 1.0})
+    assert [t.task_id for t in order] == ["hi-no-deadline", "lo-tight"]
+    tasks = [
+        _task("glut-tight", tenant="glut", seq=0, deadline=0.001),
+        _task("lean-late", tenant="lean", seq=1, deadline=500.0),
+    ]
+    # lean is the deficient tenant: its task heads the order no matter
+    # how tight glut's deadline is.
+    order = fair_share_order(tasks, {"glut": 32, "lean": 0},
+                             {"glut": 1.0, "lean": 1.0})
+    assert [t.task_id for t in order] == ["lean-late", "glut-tight"]
+
+
+def test_no_deadlines_is_exactly_the_pre_sla_order():
+    tasks = [_task("t0", seq=0), _task("t1", seq=1), _task("t2", seq=2)]
+    order = fair_share_order(tasks, {}, {"a": 1.0})
+    assert [t.task_id for t in order] == ["t0", "t1", "t2"]
+
+
+def test_select_victims_prefers_most_slack():
+    """Among equally-reclaimable gangs, the one with the MOST slack
+    (deadline-less counting as infinite) dies first — reclaiming from
+    the task that can best afford the requeue."""
+    pool = CapacityPool([8])
+
+    def place(task_id, deadline):
+        task = QueuedTask(task_id=task_id, tenant="glut",
+                          gang=GangSpec("v4-8"), priority=1,
+                          state="placed", placed_at=1.0,
+                          deadline=deadline)
+        assert pool.try_place(task) is not None
+        return task
+
+    placed = [place("tight", 5.0), place("loose", -1.0)]
+    candidate = QueuedTask(task_id="new", tenant="starved",
+                           gang=GangSpec("v4-8"), priority=1)
+    victims = select_victims(candidate, placed, pool,
+                             {"glut": 8, "starved": 0},
+                             {"glut": 1.0, "starved": 1.0})
+    assert [v.task_id for v in victims] == ["loose"]
+
+
+def test_queued_task_deadline_roundtrips_with_pre_sla_records():
+    task = _task("t", deadline=12.5)
+    assert QueuedTask.from_json(task.to_json()).deadline == 12.5
+    legacy = _task("t").to_json()
+    legacy.pop("deadline")                  # a pre-SLA durable record
+    assert QueuedTask.from_json(legacy).deadline == -1.0
+
+
+# -- slack-ordered engine admission --------------------------------------------
+
+
+def test_engine_admission_is_edf_with_fifo_fallback():
+    from tpu_task.ml.serving.engine import ServingEngine
+    eng = object.__new__(ServingEngine)
+    # EDF: earliest deadline wins; deadline-less requests go last.
+    eng._queue = deque(SimpleNamespace(deadline=d)
+                      for d in (None, 30.0, 10.0))
+    assert ServingEngine._next_admit_index(eng) == 2
+    # No deadlines anywhere: index 0 — the historical FIFO (a preempted
+    # request re-queued at the head keeps its place).
+    eng._queue = deque(SimpleNamespace(deadline=None) for _ in range(3))
+    assert ServingEngine._next_admit_index(eng) == 0
+    # Class outranks deadline: a premium request with the LATER deadline
+    # still admits before same-deadline-or-earlier best_effort — the
+    # ladder makes degraded best_effort cheap, and cheap work winning
+    # EDF ties by arrival would starve the protected class.
+    eng._queue = deque([
+        SimpleNamespace(deadline=10.0, slo_class="best_effort"),
+        SimpleNamespace(deadline=30.0, slo_class="premium"),
+        SimpleNamespace(deadline=20.0, slo_class="premium"),
+    ])
+    assert ServingEngine._next_admit_index(eng) == 2
+
+
+# -- the router's shed gate ----------------------------------------------------
+
+
+def _router_with_clock(t0=100.0):
+    state = {"t": t0}
+    router = Router(seed=0, clock=lambda: state["t"])
+    return router, state
+
+
+def test_shed_gate_expired_slack_sheds_unconditionally():
+    router, state = _router_with_clock()
+    fid = router.submit([1, 2, 3], 8, deadline_ms=50.0)
+    request = router.request(fid)
+    cold = _Replica(name="r0", url="http://x")
+    assert not router._unmeetable(request, cold)
+    state["t"] += 0.06                      # past the deadline
+    assert router._unmeetable(request, cold)
+
+
+def test_shed_gate_never_sheds_on_a_cold_replica():
+    """No observations → no estimate arm: a cold fleet must not shed on
+    guesses (the regression that would refuse the first request ever)."""
+    router, _ = _router_with_clock()
+    fid = router.submit([1], 8, deadline_ms=10.0)
+    cold = _Replica(name="r0", url="http://x")
+    assert not router._unmeetable(router.request(fid), cold)
+
+
+def test_shed_gate_estimates_and_protects_by_class():
+    """The estimate arm sheds when observed service cannot fit the
+    slack — and protected classes get margin, so the gate can never
+    invert the ladder's brownout order."""
+    router, _ = _router_with_clock()
+    hot = _Replica(name="r0", url="http://x",
+                   ttft_ewma=0.05, tok_ewma=0.01)
+    # est = 50ms + 7*10ms = 120ms against 100ms slack: best_effort
+    # sheds (1.0x margin), premium does not (2.0x margin).
+    be = router.request(router.submit(
+        [1], 8, slo_class="best_effort", deadline_ms=100.0))
+    prem = router.request(router.submit(
+        [1], 8, slo_class="premium", deadline_ms=100.0))
+    assert router._unmeetable(be, hot)
+    assert not router._unmeetable(prem, hot)
+    # Far past even the premium margin (est > 2x slack) sheds premium
+    # too: an individually unmeetable deadline is not worth dispatching.
+    prem_tight = router.request(router.submit(
+        [1], 8, slo_class="premium", deadline_ms=40.0))
+    assert router._unmeetable(prem_tight, hot)
+
+
+def test_ladder_beats_drive_router_rung_and_stats():
+    router = Router(seed=0, ladder=DegradeLadder(escalate_after=1))
+    for _ in range(RUNG_SHED):
+        router.note_alerts(["burn"])
+    stats = router.stats()["sla"]
+    assert stats["rung"] == RUNG_SHED
+    fid = router.submit([1, 2], 8, slo_class="best_effort")
+    request = router.request(fid)
+    assert request.status == "shed"         # laddered shed, no replica
+    assert request.retry_after_s == router.shed_retry_after_s
+    with pytest.raises(RuntimeError):
+        router.result(fid)
+    assert router.stats()["sla"]["classes"]["best_effort"]["shed"] == 1
+
+
+# -- the 429 protocol (router side, fake transport) ----------------------------
+
+
+def _http_429(body: dict) -> urllib.error.HTTPError:
+    return urllib.error.HTTPError(
+        "http://fake/submit", 429, "busy", None,
+        io.BytesIO(json.dumps(body).encode()))
+
+
+def _router_with_fake_429(body: dict, **kwargs):
+    router = Router(seed=0, **kwargs)
+    router.set_replicas({"r0": {"url": "http://fake", "boot_id": "b0"}})
+
+    def fake_call(replica, method, path, data=None, headers=None):
+        if path == "/submit":
+            raise _http_429(body)
+        return {"slots": 4}
+
+    router._call = fake_call
+    return router
+
+
+def test_429_busy_never_quarantines_a_healthy_replica():
+    """The satellite-1 regression pin: a healthy-but-full replica answers
+    429; the router must keep the request queued and the replica in good
+    standing — quarantining on fullness would amplify overload into a
+    fleet-wide outage."""
+    router = _router_with_fake_429({"error": "overloaded",
+                                    "overloaded": True})
+    fid = router.submit([1, 2, 3], 8)
+    request = router.request(fid)
+    assert request.status == "queued"
+    replica = router._replicas["r0"]
+    assert replica.healthy
+    assert replica.quarantined_until == 0.0
+    assert replica.faults == 0
+
+
+def test_429_draining_body_quarantines_like_the_legacy_409():
+    router = _router_with_fake_429({"error": "draining", "draining": True})
+    fid = router.submit([1, 2, 3], 8)
+    assert router.request(fid).status == "queued"
+    replica = router._replicas["r0"]
+    assert not replica.healthy
+    assert replica.quarantined_until == float("inf")
+
+
+def test_429_with_expired_deadline_is_a_terminal_shed(monkeypatch):
+    """A 429 landing after the deadline has expired proves the shed gate
+    right: durable `shed` terminal with Retry-After, and the refusing
+    replica still healthy."""
+    router = _router_with_fake_429({"error": "overloaded",
+                                    "overloaded": True})
+    # Bypass the estimate gate to isolate the 429 arm; the deadline is
+    # already in the past when the refusal comes back.
+    monkeypatch.setattr(router, "_unmeetable", lambda *a: False)
+    fid = router.submit([1, 2, 3], 8, deadline_ms=-50.0)
+    request = router.request(fid)
+    assert request.status == "shed"
+    assert request.retry_after_s == router.shed_retry_after_s
+    assert router._replicas["r0"].healthy
+    with pytest.raises(RuntimeError, match="shed"):
+        router.result(fid)
+    # Durable: further pumps never resurrect a shed terminal.
+    router.pump(wait_ms=0)
+    assert router.request(fid).status == "shed"
+
+
+# -- SLA-aware autoscaling -----------------------------------------------------
+
+
+def test_queue_depth_autoscaler_attainment_gate_prevents_flap():
+    """At-capacity-but-meeting-SLO must not scale up (and must not
+    flap): backlog votes are vetoed while attainment holds, and the
+    hysteresis counter resets so a later real breach still needs full
+    patience."""
+    policy = QueueDepthAutoscaler(patience=2, high=2.0, low=0.25)
+    for _ in range(6):
+        assert policy.observe(8, 2, busy=8, attainment=1.0) == 2
+    assert policy.decisions == []
+    # The same pressure with the SLO breached scales up after patience.
+    assert policy.observe(8, 2, busy=8, attainment=0.5) == 2
+    assert policy.observe(8, 2, busy=8, attainment=0.5) == 3
+    assert policy.decisions == ["up:2->3"]
+    # Pre-SLA callers (no attainment sample) keep the PR 13 behavior.
+    policy = QueueDepthAutoscaler(patience=1)
+    assert policy.observe(8, 2, busy=8) == 3
+
+
+def test_sla_autoscaler_scales_on_the_objective_with_cooldown():
+    state = {"t": 0.0}
+    policy = SlaAutoscaler(ttft_p99_target_s=1.0, attainment_target=0.99,
+                           downscale_margin=0.5, cooldown_s=10.0,
+                           clock=lambda: state["t"])
+    # Breaching p99 scales up; the next breach inside the cooldown is
+    # ignored (capacity has not landed yet).
+    assert policy.observe(4, 2, ttft_p99=2.0, attainment=1.0) == 3
+    state["t"] = 5.0
+    assert policy.observe(4, 3, ttft_p99=2.0, attainment=1.0) == 3
+    state["t"] = 11.0
+    assert policy.observe(4, 3, ttft_p99=2.0, attainment=1.0) == 4
+    # SLO met exactly is a fleet sized exactly — only comfortable
+    # margin (p99 <= target*margin, empty backlog) scales down.
+    state["t"] = 30.0
+    assert policy.observe(0, 4, ttft_p99=0.9, attainment=1.0) == 4
+    assert policy.observe(0, 4, ttft_p99=0.4, attainment=1.0) == 3
+    # Missing samples are neutral: never scale on absent evidence.
+    state["t"] = 50.0
+    assert policy.observe(0, 3, ttft_p99=None, attainment=None) == 3
+    assert policy.decisions == ["up:2->3", "up:3->4", "down:4->3"]

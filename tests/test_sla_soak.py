@@ -1,0 +1,228 @@
+"""SLA brownout soak (`make sla-soak`): sustained 2x overload plus a
+mid-wave replica preemption through the whole actuation plane — router
+deadlines/classes, degrade ladder, bounded replica admission (429 +
+Retry-After), scheduler requeue — asserting the brownout CONTRACT:
+
+* premium p99 TTFT holds within its SLO through the overload;
+* best_effort sheds first and sheds MORE as load grows (monotone);
+* shed is a durable terminal (structured error + Retry-After, never
+  resurrected by later pumps);
+* the scheduler's fairness invariants hold throughout (any
+  SchedulerInvariantError/PoolInvariantError raised by the control loop
+  fails the test).
+
+Replayable via TPU_TASK_CHAOS_SEED, same contract as the serve soak.
+"""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tpu_task.obs import DegradeLadder
+from tpu_task.scheduler import CapacityPool, GangScheduler, TenantQuota
+from tpu_task.serve import (
+    InProcessServeDriver,
+    ReplicaServer,
+    Router,
+    ServeFleet,
+    ServeSpec,
+    wait_until,
+)
+
+pytestmark = [pytest.mark.sla, pytest.mark.chaos, pytest.mark.slow]
+
+SEED = int(os.environ.get("TPU_TASK_CHAOS_SEED", "20260804"))
+MAX_NEW = 32
+
+
+def _post(url, payload=None, headers=None):
+    data = json.dumps(payload or {}).encode()
+    request = urllib.request.Request(url, data=data, method="POST",
+                                     headers=headers or {})
+    with urllib.request.urlopen(request, timeout=10) as response:
+        return json.loads(response.read().decode())
+
+
+def test_replica_answers_429_with_retry_after_when_full_or_draining():
+    """Satellite-1 replica side: a full or draining replica answers 429
+    + ``Retry-After: 0`` with a structured body — never a bare 409 the
+    router would have to guess about."""
+    server = ReplicaServer(preset="micro", max_queue=0).start()
+    try:
+        # max_queue=0: every admission is over the bound.
+        with pytest.raises(urllib.error.HTTPError) as info:
+            _post(f"{server.url}/submit",
+                  {"prompt": [1, 2, 3], "max_new_tokens": 4})
+        assert info.value.code == 429
+        assert info.value.headers["Retry-After"] == "0"
+        assert json.loads(info.value.read().decode())["overloaded"]
+
+        _post(f"{server.url}/drain")
+        with pytest.raises(urllib.error.HTTPError) as info:
+            _post(f"{server.url}/submit",
+                  {"prompt": [1, 2, 3], "max_new_tokens": 4})
+        assert info.value.code == 429
+        assert info.value.headers["Retry-After"] == "0"
+        assert json.loads(info.value.read().decode())["draining"]
+    finally:
+        server.stop()
+
+
+def _build_fleet(replicas: int):
+    driver = InProcessServeDriver()
+    scheduler = GangScheduler(
+        CapacityPool([4 * replicas]),
+        {"sla": TenantQuota(chips=4 * replicas, weight=1.0)}, driver)
+    router = Router(seed=SEED, ladder=DegradeLadder(clamp_max_new=8))
+    fleet = ServeFleet(
+        scheduler,
+        ServeSpec(service="sla", tenant="sla", replicas=replicas,
+                  preset="micro",
+                  serving={"slots": 4, "max_queue": 8}),
+        router)
+    fleet.launch()
+    assert wait_until(lambda: len(fleet.refresh_endpoints()) == replicas,
+                      120, tick=fleet.tick, period=0.05)
+    fleet.tick()
+    warm = [router.submit(np.zeros(4, np.int32), 2)
+            for _ in range(replicas * 4)]
+    router.drain(deadline_s=180, on_idle=fleet.tick)
+    del warm
+    return driver, scheduler, router, fleet
+
+
+def _teardown(driver):
+    for task_id in list(driver.running_ids()):
+        driver._stop(task_id, graceful=False)
+
+
+def _run_wave(load: float, *, preempt: bool = False) -> dict:
+    """One soak wave at ``load`` x the calibrated service rate through a
+    2-replica fleet; optionally kills one replica a third of the way in
+    (the preemption wave) and requires it restored before exit."""
+    driver, scheduler, router, fleet = _build_fleet(2)
+    try:
+        rng = np.random.default_rng(SEED)
+        t0 = time.monotonic()
+        timed = [router.submit(
+            rng.integers(0, 256, size=8).astype(np.int32), MAX_NEW)
+            for _ in range(8)]
+        router.drain(deadline_s=180, on_idle=fleet.tick)
+        del timed
+        # Per-request service at full concurrency across the 2-replica
+        # fleet; deadlines and the beat cadence scale from it (same
+        # calibration scheme as `bench.py fleet --overload`).
+        service_s = max((time.monotonic() - t0) / 8, 1e-3)
+        deadline_ms = 14.0 * service_s * 1000.0
+        beat_s = max(0.02, 2.0 * service_s)
+
+        n_requests = 40
+        work, t = [], 0.0
+        for i in range(n_requests):
+            t += float(rng.exponential(service_s / load))
+            work.append({
+                "arrival": t,
+                "prompt": rng.integers(0, 256, size=8).astype(np.int32),
+                "slo_class": "premium" if i % 2 == 0 else "best_effort",
+            })
+
+        t0 = time.monotonic()
+        fids, i = {}, 0
+        last_beat, last_bad = t0, 0
+        killed_at = restored_at = victim = None
+        while True:
+            now = time.monotonic()
+            while i < len(work) and work[i]["arrival"] <= now - t0:
+                fids[i] = router.submit(
+                    work[i]["prompt"], MAX_NEW,
+                    slo_class=work[i]["slo_class"],
+                    deadline_ms=deadline_ms)
+                i += 1
+            open_count = router.pump(wait_ms=0)
+            fleet.tick()
+            if preempt and killed_at is None and i >= n_requests // 3:
+                live = [fid for fid in fids.values()
+                        if router.request(fid).status == "running"
+                        and router.request(fid).replica]
+                if live:
+                    victim = router.request(live[0]).replica
+                    driver.kill(victim, graceful=True)
+                    killed_at = now
+            if killed_at and restored_at is None and victim in \
+                    fleet.refresh_endpoints():
+                restored_at = now
+            if now - last_beat >= beat_s:
+                bad = sum(c["missed"] + c["shed"]
+                          for c in router.stats()["sla"]
+                          ["classes"].values())
+                router.note_alerts(["burn"] if bad > last_bad else [])
+                last_bad, last_beat = bad, now
+            if i == len(work) and open_count == 0 and (
+                    not preempt or restored_at is not None):
+                break
+            if now - t0 > 300:
+                raise RuntimeError("soak wave did not converge")
+
+        sla = router.stats()["sla"]
+        ttft = {
+            cls: sorted(
+                request.first_token_t - request.submit_t
+                for j, fid in fids.items()
+                if work[j]["slo_class"] == cls
+                and (request := router.request(fid)).first_token_t
+                is not None)
+            for cls in ("premium", "best_effort")
+        }
+        shed_fids = [fid for fid in fids.values()
+                     if router.request(fid).status == "shed"]
+        # Durable terminals: a shed request raises a structured error
+        # with its Retry-After and never resurrects on later pumps.
+        for fid in shed_fids[:3]:
+            assert router.request(fid).retry_after_s is not None
+            with pytest.raises(RuntimeError, match="shed"):
+                router.result(fid)
+        router.pump(wait_ms=0)
+        assert all(router.request(fid).status == "shed"
+                   for fid in shed_fids)
+        return {
+            "deadline_s": deadline_ms / 1000.0,
+            "classes": sla["classes"],
+            "ttft": ttft,
+            "sheds": {cls: sla["classes"].get(
+                cls, {"shed": 0})["shed"]
+                for cls in ("premium", "best_effort")},
+        }
+    finally:
+        _teardown(driver)
+
+
+def test_sla_brownout_soak_premium_holds_while_best_effort_sheds():
+    calm = _run_wave(1.0)
+    storm = _run_wave(2.0, preempt=True)
+
+    # Premium p99 TTFT within the SLO through overload + preemption.
+    for wave in (calm, storm):
+        p99 = wave["ttft"]["premium"][
+            max(0, int(len(wave["ttft"]["premium"]) * 0.99) - 1)]
+        assert p99 <= wave["deadline_s"], \
+            f"premium p99 TTFT {p99:.3f}s blew the " \
+            f"{wave['deadline_s']:.3f}s SLO"
+
+    # The brownout routes pain down the class ladder, never up it.
+    for wave in (calm, storm):
+        prem = wave["classes"].get("premium", {})
+        best = wave["classes"].get("best_effort", {})
+        assert best.get("attainment", 1.0) <= \
+            prem.get("attainment", 1.0) + 1e-9
+        assert prem.get("shed", 0) <= best.get("shed", 0)
+
+    # Best_effort sheds monotonically with load.
+    assert calm["sheds"]["best_effort"] <= storm["sheds"]["best_effort"]
+    # The storm actually browned out (the wave was not a no-op).
+    assert storm["sheds"]["best_effort"] + sum(
+        c.get("missed", 0) for c in storm["classes"].values()) > 0

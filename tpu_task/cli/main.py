@@ -414,6 +414,30 @@ def cmd_sched(args) -> int:
                   f"slow={alert['burn_slow']} "
                   f"(target {alert['target']}, "
                   f"attainment {alert['attainment']})")
+    # SLA actuation state (ISSUE 18): when a fleet shares this backend,
+    # its exported router metrics carry the brownout surface — surface
+    # the active degrade-ladder rung and per-class outcome counters
+    # next to the queue the shedding protects.
+    try:
+        from tpu_task.obs import read_metrics
+
+        merged = read_metrics(backend)
+    except Exception:
+        merged = {}
+    if any(name.startswith("sla.") for name in merged):
+        def _v(name, default=0.0):
+            return (merged.get(name) or {}).get("value", default)
+
+        print(f"sla: degrade rung {int(_v('sla.rung'))}")
+        for cls in ("premium", "standard", "best_effort"):
+            if f"sla.{cls}.met" not in merged:
+                continue
+            print(f"  {cls:<12} met {int(_v(f'sla.{cls}.met'))}"
+                  f"  missed {int(_v(f'sla.{cls}.missed'))}"
+                  f"  shed {int(_v(f'sla.{cls}.shed'))}"
+                  f"  degraded {int(_v(f'sla.{cls}.degraded'))}"
+                  f"  attainment "
+                  f"{_v(f'sla.{cls}.attainment', 1.0) * 100:.1f}%")
     return 0
 
 
@@ -554,6 +578,24 @@ def _watch_frame(merged, alerts, remote: str) -> str:
     depth = value("router.queue_depth") + value("engine.queue_depth")
     head.append(f"queue {int(depth)}")
     lines.append("  ".join(head))
+    if any(name.startswith("sla.") for name in merged):
+        # The brownout surface in two lines: the active degrade-ladder
+        # rung, then per-class met/missed/shed/degraded + attainment %.
+        rung = int(value("sla.rung"))
+        stages = ("normal", "clamp", "no-spec", "shed", "shed+")
+        parts = [f"sla  rung {rung}"
+                 f" ({stages[min(rung, len(stages) - 1)]})"]
+        lines.append("  ".join(parts))
+        for cls in ("premium", "standard", "best_effort"):
+            if f"sla.{cls}.met" not in merged:
+                continue
+            lines.append(
+                f"  {cls:<12} met {int(value(f'sla.{cls}.met'))}"
+                f"  missed {int(value(f'sla.{cls}.missed'))}"
+                f"  shed {int(value(f'sla.{cls}.shed'))}"
+                f"  degraded {int(value(f'sla.{cls}.degraded'))}"
+                f"  attainment "
+                f"{value(f'sla.{cls}.attainment', 1.0) * 100:.1f}%")
     if any(name.startswith("kvfleet.") for name in merged):
         # The fleet KV plane in one line: admission-side block hit/miss,
         # bytes moved each way, and prefill→decode stream handoffs (the

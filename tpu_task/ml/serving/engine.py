@@ -91,6 +91,7 @@ from tpu_task.ml.models.transformer import Params, TransformerConfig
 from tpu_task.ml.ops import paged_attention as pa
 from tpu_task.obs import Obs
 from tpu_task.obs.goodput import GoodputMeter
+from tpu_task.obs.sla import DEFAULT_CLASS, class_rank
 from tpu_task.obs.trace import Span, TraceContext
 from tpu_task.ml.parallel.sharding import (
     PartitionPlan,
@@ -252,6 +253,14 @@ class Request:
     #: header) — the parent every engine-side span of this request links
     #: to. None when tracing is off or the caller sent no context.
     trace: Optional[TraceContext] = None
+    #: SLA metadata (the router's SLA header, landed): protection class
+    #: and absolute deadline on THIS engine's monotonic clock (converted
+    #: from remaining-ms at submit; None = no deadline). Consumed by
+    #: slack-ordered admission and victim selection — NEVER by sampling:
+    #: tokens are keyed by (key, index), so SLA-driven reordering cannot
+    #: change a stream's values, only when/whether it runs.
+    slo_class: str = "standard"
+    deadline: Optional[float] = None
 
     @property
     def finished(self) -> bool:
@@ -434,6 +443,12 @@ class ServingEngine:
         # "spec decode is single-chip" note closes here): draft weights
         # through param_pspecs, the draft pool's kv-head axis over tp.
         self._spec_on = scfg.spec_k > 0
+        #: Brownout knob (the degrade ladder's no-spec rung): False caps
+        #: the draft width at zero INSIDE the spec step — every admitted
+        #: row still scores through the spec program's position-keyed
+        #: streams (width-1 valid), so toggling it mid-stream cannot
+        #: change token values, only skip the draft forward passes.
+        self.spec_enabled = True
         if self._spec_on and (draft_params is None or draft_cfg is None):
             raise ValueError(
                 "spec_k > 0 needs draft_params and draft_cfg")
@@ -1034,7 +1049,9 @@ class ServingEngine:
                top_p: Optional[float] = None,
                eos_token: Optional[int] = None,
                key: Optional[jax.Array] = None,
-               trace: Optional[TraceContext] = None) -> int:
+               trace: Optional[TraceContext] = None,
+               slo_class: str = "standard",
+               deadline_s: Optional[float] = None) -> int:
         """Queue a generation request; returns its id. Same sampling
         contract as ``generate``: temperature 0 is greedy, ``top_p`` needs
         temperature > 0. ``key`` overrides the engine-derived per-request
@@ -1069,11 +1086,14 @@ class ServingEngine:
             key = jax.random.fold_in(self._base_key, rid)
         else:
             key = _check_key(key)
+        now = time.monotonic()
         req = Request(
             rid=rid, prompt=prompt, max_new_tokens=max_new_tokens,
             temperature=temperature, top_p=1.0 if top_p is None else top_p,
             eos_token=eos_token, key=key,
-            submit_t=time.monotonic(), trace=trace)
+            submit_t=now, trace=trace, slo_class=str(slo_class),
+            deadline=None if deadline_s is None
+            else now + float(deadline_s))
         self._requests[rid] = req
         self._queue.append(req)
         self._obs_queue(req)
@@ -1095,7 +1115,7 @@ class ServingEngine:
         for req in self._requests.values():
             if req.status == DONE:
                 continue
-            records.append({
+            record = {
                 "rid": req.rid,
                 "prompt": [int(t) for t in np.asarray(req.prompt)],
                 "tokens": [int(t) for t in req.tokens],
@@ -1104,7 +1124,15 @@ class ServingEngine:
                 "temperature": req.temperature,
                 "top_p": req.top_p,
                 "eos_token": req.eos_token,
-            })
+                "slo_class": req.slo_class,
+            }
+            if req.deadline is not None:
+                # Deadlines cross processes as REMAINING seconds (no
+                # shared monotonic clock), clamped at 0 — an expired
+                # deadline stays expired on the importer.
+                record["deadline_s"] = max(
+                    0.0, req.deadline - time.monotonic())
+            records.append(record)
             # Close the open phase span as "exported" — the drain/export
             # leg is part of the request's waterfall. Generation state is
             # untouched; only the observability record is finalized.
@@ -1162,13 +1190,18 @@ class ServingEngine:
             rid = self._next_rid
             self._next_rid += 1
             key = _check_key(record["key"])
+            now = time.monotonic()
+            deadline_s = record.get("deadline_s")
             req = Request(
                 rid=rid, prompt=prompt, max_new_tokens=max_new,
                 temperature=float(record.get("temperature", 0.0)),
                 top_p=float(record.get("top_p", 1.0)),
                 eos_token=None if eos is None else int(eos), key=key,
-                submit_t=time.monotonic(), tokens=tokens,
-                resume_from=len(tokens), trace=trace)
+                submit_t=now, tokens=tokens,
+                resume_from=len(tokens), trace=trace,
+                slo_class=str(record.get("slo_class", "standard")),
+                deadline=None if deadline_s is None
+                else now + float(deadline_s))
             self._requests[rid] = req
             if req.finished:
                 req.status = DONE
@@ -2013,7 +2046,8 @@ class ServingEngine:
                 (i for i, r in enumerate(self._slots) if r is None), None)
             if slot is None:
                 return
-            req = self._queue[0]
+            pick = self._next_admit_index()
+            req = self._queue[pick]
             # A resumed request's already-emitted tokens are CONTEXT here:
             # ingested through the same chunk programs as the prompt, then
             # generation continues at token index len(req.tokens).
@@ -2043,7 +2077,7 @@ class ServingEngine:
                 for b in cached:
                     self.allocator.decref(b)
                 return
-            self._queue.popleft()
+            del self._queue[pick]
             table = np.zeros((self.scfg.max_blocks_per_slot,), np.int32)
             table[:len(cached)] = cached
             if need:
@@ -2095,7 +2129,8 @@ class ServingEngine:
                 (i for i, r in enumerate(self._slots) if r is None), None)
             if slot is None:
                 return
-            req = self._queue[0]
+            pick = self._next_admit_index()
+            req = self._queue[pick]
             ctx = self._context_ids(req)
             need = self.scfg.blocks_for(len(ctx))
             # Keep one spare so the running set can cross its next block
@@ -2105,7 +2140,7 @@ class ServingEngine:
             blocks = self._reserve(need, 1 if self.n_active else 0)
             if blocks is None:
                 return
-            self._queue.popleft()
+            del self._queue[pick]
             self._obs_admit(req)
             bucket = self.scfg.bucket_for(len(ctx))
             table = np.zeros((self.scfg.max_blocks_per_slot,), np.int32)
@@ -2142,12 +2177,34 @@ class ServingEngine:
                 self._retire(slot)
                 finished.append(req.rid)
 
+    def _next_admit_index(self) -> int:
+        """Slack-ordered admission (class-then-EDF, the router pump's
+        key): the queue index to admit next — higher protection class
+        first, then earliest deadline, deadline-less requests after
+        every deadlined one of their class, FIFO among equals. Class
+        outranks the deadline because the ladder makes degraded
+        best_effort work CHEAP — same-deadline cheap work would
+        otherwise tie with premium and win by arrival, starving the
+        class the brownout exists to protect. With no SLA fields in
+        the queue every key ties and the min is index 0: exactly the
+        historical FIFO, bit for bit (admission order cannot change
+        token values anyway — sampling is keyed by (key, index) — but
+        the no-SLA schedule itself is also preserved). A preempted
+        request re-queued at the head keeps winning ties at index 0."""
+        return min(range(len(self._queue)),
+                   key=lambda i: (
+                       -class_rank(getattr(
+                           self._queue[i], "slo_class", DEFAULT_CLASS)),
+                       self._queue[i].deadline is None,
+                       self._queue[i].deadline or 0.0, i))
+
     def _ensure_blocks(self, widths: Optional[np.ndarray] = None) -> None:
         """Every active slot gets blocks covering its next ``widths[i]``
         writes (default 1; a prefill chunk or a speculative span needs
         more) — evicting refcount-0 cached blocks first, then preempting
-        the youngest running request (requeued at the head,
-        restart-from-scratch recompute) when the pool is truly dry."""
+        the least-protected, most-slack, youngest running request
+        (requeued at the head, restart-from-scratch recompute) when the
+        pool is truly dry."""
         for slot in sorted(range(self.scfg.slots),
                            key=lambda i: self._admit_seq[i]):
             req = self._slots[slot]
@@ -2166,10 +2223,21 @@ class ServingEngine:
                     if got is not None:
                         self._tables[slot, block_i] = got[0]
                         break
+                    # Victim order: lowest protection class first, then
+                    # most remaining slack (deadline-less = infinite
+                    # slack), then the historical youngest-slot rule as
+                    # the tiebreak. All-default requests (standard, no
+                    # deadline) tie on the first two terms, so the pick
+                    # reduces exactly to the youngest rule.
                     victim = max(
                         (i for i, r in enumerate(self._slots)
                          if r is not None),
-                        key=lambda i: self._admit_seq[i])
+                        key=lambda i: (
+                            -class_rank(self._slots[i].slo_class),
+                            float("inf")
+                            if self._slots[i].deadline is None
+                            else self._slots[i].deadline,
+                            self._admit_seq[i]))
                     self._preempt(victim)
                     if victim == slot:
                         preempted_self = True
@@ -2578,6 +2646,13 @@ class ServingEngine:
         fused target step scores all k+1 positions, and the host commits
         the accepted prefix + one bonus/replacement token in place."""
         n, k = self.scfg.slots, self.scfg.spec_k
+        # De-speculation (the degrade ladder's no-spec rung): cap the
+        # draft width at zero INSIDE the spec step rather than falling
+        # back to the plain decode path — the NOTE below is why. The
+        # saved work is the draft catchup/propose forward passes; the
+        # target scoring round (width 1) still carries every stream.
+        if not self.spec_enabled:
+            k = 0
         bs = self.scfg.block_size
 
         def live(i: int) -> bool:
@@ -2608,8 +2683,15 @@ class ServingEngine:
         # program (width 1 valid), so a sampled request's tokens always
         # ride the position-keyed spec streams — never a mix with the
         # plain sampler that would make the stream schedule-dependent.
-        self._draft_catchup()
-        proposals = self._draft_propose(k_eff)
+        if self.spec_enabled:
+            self._draft_catchup()
+            proposals = self._draft_propose(k_eff)
+        else:
+            # Disabled: no draft forward passes at all (catchup is
+            # self-healing on re-enable — it feeds every token the
+            # draft cache missed). k_eff is all zero, so nothing below
+            # reads a proposal.
+            proposals = np.zeros((n, 1), np.int32)
 
         tokens = np.zeros((n, k + 1), np.int32)
         positions = np.zeros((n, k + 1), np.int32)

@@ -49,6 +49,15 @@ from tpu_task.obs.metrics import (
     MetricsRegistry,
     merge_snapshots,
 )
+from tpu_task.obs.sla import (
+    DEFAULT_CLASS,
+    SLA_HEADER,
+    SLO_CLASSES,
+    DegradeLadder,
+    class_rank,
+    format_sla_header,
+    parse_sla_header,
+)
 from tpu_task.obs.slo import (
     ALERT_PREFIX,
     Alert,
@@ -63,12 +72,16 @@ from tpu_task.obs.trace import TRACE_HEADER, Span, TraceContext, Tracer
 
 __all__ = [
     "ALERT_PREFIX",
+    "DEFAULT_CLASS",
     "METRICS_PREFIX",
+    "SLA_HEADER",
+    "SLO_CLASSES",
     "SPAN_PREFIX",
     "TRACE_HEADER",
     "Alert",
     "BurnWindow",
     "Counter",
+    "DegradeLadder",
     "Gauge",
     "GoodputMeter",
     "Histogram",
@@ -82,8 +95,11 @@ __all__ = [
     "TraceContext",
     "Tracer",
     "chrome_trace",
+    "class_rank",
     "export_metrics",
+    "format_sla_header",
     "merge_snapshots",
+    "parse_sla_header",
     "prometheus_text",
     "read_alerts",
     "read_metrics",

@@ -1,0 +1,160 @@
+"""SLA actuation primitives: SLO classes, deadlines/slack, and the
+degrade ladder (the actuation half of the PR 12 measurement plane).
+
+The measurement plane (``obs/slo.py``) answers "is the error budget on
+fire"; this module is the shared vocabulary every layer ACTS with:
+
+* **SLO classes** — ``premium`` > ``standard`` > ``best_effort``, a
+  total protection order. :func:`class_rank` is the number everything
+  keys on: victim selection prefers the LOWEST rank, the degrade ladder
+  reaches the HIGHEST rank last.
+* **Deadlines and slack** — a request carries an absolute deadline on
+  its owner's clock; ``slack = deadline - now`` is the one quantity
+  admission ordering (EDF), shed gates, and victim selection consume.
+  Deadlines cross process boundaries as REMAINING milliseconds (the
+  :data:`SLA_HEADER` dispatch header, next to the PR 11 trace header)
+  because two processes share no clock.
+* **The degrade ladder** (:class:`DegradeLadder`) — graceful brownout
+  under overload, driven by the burn-rate evaluator's live alert state:
+  each escalation applies to the least-protected class first, and a
+  class's response escalates clamp → de-speculate → shed. Premium can
+  never be shed by the ladder (the ladder caps below its shed rung);
+  only an individually unmeetable deadline sheds a premium request.
+
+Pure host Python, no jax/storage imports — the router, the serving
+engine, and the gang scheduler all import this without layering
+violations (same rule as the rest of ``tpu_task.obs``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+__all__ = [
+    "DEFAULT_CLASS",
+    "DegradeLadder",
+    "MAX_RUNG",
+    "RUNG_CLAMP",
+    "RUNG_NOSPEC",
+    "RUNG_SHED",
+    "SLA_HEADER",
+    "SLO_CLASSES",
+    "class_rank",
+    "format_sla_header",
+    "parse_sla_header",
+]
+
+#: Dispatch-header twin of TRACE_HEADER: ``<class>;<remaining_ms>`` (the
+#: ms part omitted for deadline-less requests). Remaining — not absolute
+#: — because router and replica share no clock.
+SLA_HEADER = "X-Tpu-Task-Sla"
+
+#: Protection order, most protected first.
+SLO_CLASSES = ("premium", "standard", "best_effort")
+
+DEFAULT_CLASS = "standard"
+
+_RANK = {"premium": 2, "standard": 1, "best_effort": 0}
+
+
+def class_rank(slo_class: Optional[str]) -> int:
+    """Protection rank: premium 2, standard 1, best_effort 0. Unknown
+    class names rank as standard — a typo must not silently make a
+    request first against the wall."""
+    return _RANK.get(slo_class or DEFAULT_CLASS, _RANK[DEFAULT_CLASS])
+
+
+def format_sla_header(slo_class: str,
+                      remaining_ms: Optional[float] = None) -> str:
+    if remaining_ms is None:
+        return str(slo_class)
+    return f"{slo_class};{remaining_ms:.1f}"
+
+
+def parse_sla_header(value: Optional[str]) \
+        -> Tuple[str, Optional[float]]:
+    """``(slo_class, remaining_ms)`` — permissive: absent/garbled
+    headers degrade to (standard, no deadline), never to a 4xx (the SLA
+    plane is advisory metadata on top of a correct request)."""
+    if not value:
+        return DEFAULT_CLASS, None
+    name, _, ms = value.partition(";")
+    name = name.strip() or DEFAULT_CLASS
+    if not ms.strip():
+        return name, None
+    try:
+        return name, max(0.0, float(ms))
+    except ValueError:
+        return name, None
+
+
+# -- the degrade ladder --------------------------------------------------------
+
+#: A class's response escalates through these rungs of its EFFECTIVE
+#: rung (``ladder.rung - class_rank``): first shorten answers, then stop
+#: paying for speculation, and only then refuse work.
+RUNG_CLAMP = 1      # clamp max_new_tokens
+RUNG_NOSPEC = 2     # disable speculative decoding
+RUNG_SHED = 3       # shed (structured terminal + Retry-After)
+
+#: Ladder ceiling: best_effort (rank 0) reaches RUNG_SHED at ladder rung
+#: 3 and standard at 4; premium (rank 2) tops out at RUNG_NOSPEC — the
+#: ladder can brownout premium, never black it out.
+MAX_RUNG = RUNG_SHED + 1
+
+
+@dataclass
+class DegradeLadder:
+    """Alert-driven brownout state machine (deterministic, clockless:
+    one :meth:`observe` per SLO evaluation beat).
+
+    Escalates one rung after ``escalate_after`` consecutive alerting
+    evaluations, de-escalates one rung after ``clear_after`` consecutive
+    clear ones — asymmetric on purpose: entering brownout should be
+    prompt, leaving it should be convinced. Per-class actuation comes
+    from :meth:`plan`: the effective rung subtracts the class's
+    protection rank, so best_effort walks every rung before standard
+    starts and premium is always two rungs behind the front."""
+
+    clamp_max_new: int = 16
+    escalate_after: int = 1
+    clear_after: int = 2
+    rung: int = 0
+    transitions: List[str] = field(default_factory=list, repr=False)
+    _firing: int = field(default=0, repr=False)
+    _clear: int = field(default=0, repr=False)
+
+    def observe(self, alerting: bool) -> int:
+        """One evaluation beat: ``alerting`` is the burn-rate
+        evaluator's live state (any alert firing). Returns the rung."""
+        if alerting:
+            self._firing += 1
+            self._clear = 0
+            if self._firing >= self.escalate_after and self.rung < MAX_RUNG:
+                self._firing = 0
+                self.rung += 1
+                self.transitions.append(f"up:{self.rung}")
+        else:
+            self._clear += 1
+            self._firing = 0
+            if self._clear >= self.clear_after and self.rung > 0:
+                self._clear = 0
+                self.rung -= 1
+                self.transitions.append(f"down:{self.rung}")
+        return self.rung
+
+    def effective_rung(self, slo_class: str) -> int:
+        return max(0, self.rung - class_rank(slo_class))
+
+    def plan(self, slo_class: str, max_new_tokens: int) -> dict:
+        """What the ladder does to ONE request of this class right now:
+        ``{shed, no_spec, max_new}`` (``max_new`` already clamped;
+        clamping never raises a request's own budget)."""
+        rung = self.effective_rung(slo_class)
+        return {
+            "shed": rung >= RUNG_SHED,
+            "no_spec": rung >= RUNG_NOSPEC,
+            "max_new": min(max_new_tokens, self.clamp_max_new)
+            if rung >= RUNG_CLAMP else max_new_tokens,
+        }

@@ -147,7 +147,11 @@ def select_victims(candidate: QueuedTask,
     1. gangs of tenants OVER their fair share before gangs of tenants under
        it — over-share capacity is borrowed and reclaimable by anyone;
     2. within each class, lowest priority first;
-    3. among equals, youngest placement first (most recent ``placed_at``) —
+    3. among equals, most remaining slack first (latest deadline;
+       deadline-less gangs — infinite slack — before any deadlined one):
+       the gang hurt least by losing its place. Same-instant slack
+       ordering IS deadline ordering, so no clock is consulted;
+    4. among those, youngest placement first (most recent ``placed_at``) —
        it has the least sunk work to lose.
 
     Eligibility guards:
@@ -212,7 +216,10 @@ def select_victims(candidate: QueuedTask,
         if not eligible:
             return []
         eligible.sort(key=lambda pair: (
-            pair[0], pair[1].priority, -pair[1].placed_at,
+            pair[0], pair[1].priority,
+            -(pair[1].deadline if pair[1].deadline >= 0.0
+              else float("inf")),
+            -pair[1].placed_at,
             pair[1].submit_seq))
         # First in documented order whose release actually opens slice
         # room — a victim in a domain too fragmented to host a slice must

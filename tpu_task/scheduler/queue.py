@@ -97,6 +97,13 @@ class QueuedTask:
     # last checkpointed ``progress`` after preemption. Ignored by real tasks.
     work: float = 0.0
     progress: float = 0.0
+    #: absolute deadline on the scheduler's clock (-1.0 = none). EDF
+    #: term WITHIN a tenant's fair share at equal priority, and the
+    #: slack term of SLO-aware victim selection — never a cross-tenant
+    #: or cross-priority lever (what keeps the fairness invariants
+    #: intact). -1.0 sentinel, not Optional: the record must stay
+    #: ``cls(**json)``-roundtrippable with pre-SLA records.
+    deadline: float = -1.0
     #: extra driver payload (e.g. the real driver's task spec fields)
     payload: Dict[str, str] = field(default_factory=dict)
 
@@ -204,9 +211,18 @@ def fair_share_order(tasks: List[QueuedTask],
 
     Tenants sort by ``running_chips / weight`` ascending (most-deficient
     first, name tie-break); each tenant's own backlog sorts by priority
-    descending then submission sequence. The result interleaves: first the
-    head of every tenant in tenant order, then the seconds, and so on — so
-    capacity freed mid-pass keeps being offered by deficit, not FIFO.
+    descending, then earliest deadline (EDF — deadline-less tasks after
+    every deadlined one), then submission sequence. The result
+    interleaves: first the head of every tenant in tenant order, then
+    the seconds, and so on — so capacity freed mid-pass keeps being
+    offered by deficit, not FIFO.
+
+    EDF lives strictly INSIDE (tenant, priority): it can never starve a
+    sibling tenant (fair share decides across tenants) nor a
+    higher-priority task (priority sorts first) — only reorder a
+    tenant's own equal-priority backlog, where a deadline-less task
+    behind an unbounded stream of deadlined ones is the submitting
+    tenant's own choice.
 
     Pure function of its inputs → deterministic for a fixed seed upstream.
     """
@@ -214,7 +230,11 @@ def fair_share_order(tasks: List[QueuedTask],
     for task in tasks:
         per_tenant.setdefault(task.tenant, []).append(task)
     for backlog in per_tenant.values():
-        backlog.sort(key=lambda task: (-task.priority, task.submit_seq))
+        backlog.sort(key=lambda task: (
+            -task.priority,
+            task.deadline < 0.0,          # deadlined tasks first
+            task.deadline if task.deadline >= 0.0 else 0.0,
+            task.submit_seq))
     tenant_order = sorted(
         per_tenant,
         key=lambda tenant: (running_chips.get(tenant, 0)

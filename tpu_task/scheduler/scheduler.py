@@ -109,7 +109,12 @@ class GangScheduler:
     # -- submission ------------------------------------------------------------
     def submit(self, tenant: str, accelerator: str, slices: int = 1,
                priority: int = 0, work: float = 0.0,
-               task_id: Optional[str] = None) -> QueuedTask:
+               task_id: Optional[str] = None,
+               deadline: Optional[float] = None) -> QueuedTask:
+        """``deadline`` is seconds from NOW (converted to an absolute
+        stamp on the scheduler's clock): the EDF term within this
+        tenant's equal-priority backlog and the slack term of victim
+        selection. None = no deadline (the historical ordering)."""
         if tenant not in self.quotas:
             raise ValueError(f"unknown tenant: {tenant!r}")
         gang = GangSpec(accelerator=accelerator, slices=slices)
@@ -123,7 +128,9 @@ class GangScheduler:
         task = QueuedTask(
             task_id=task_id or uuid.uuid4().hex[:12], tenant=tenant,
             gang=gang, priority=priority, work=work,
-            submitted_at=self.clock())
+            submitted_at=self.clock(),
+            deadline=-1.0 if deadline is None
+            else self.clock() + float(deadline))
         task = self.queue.submit(task)
         self._gang_event("gang.submitted", task,
                          chips=gang.total_chips, priority=priority)

@@ -6,7 +6,7 @@ preemption bit-identically. The fleet KV plane (``kvfleet``, ROADMAP
 item 2) adds cross-replica prefix-cache sharing by content hash and the
 disaggregated prefill/decode split on top of the same seams."""
 
-from tpu_task.serve.autoscale import QueueDepthAutoscaler
+from tpu_task.serve.autoscale import QueueDepthAutoscaler, SlaAutoscaler
 from tpu_task.serve.kvfleet import FleetKvClient, FleetKvIndex
 from tpu_task.serve.fleet import (
     InProcessServeDriver,
@@ -32,6 +32,7 @@ __all__ = [
     "Router",
     "ServeFleet",
     "ServeSpec",
+    "SlaAutoscaler",
     "bucket_endpoint_source",
     "build_engine",
     "probe_healthy",

@@ -1,20 +1,34 @@
-"""Queue-depth-driven replica autoscaling (pure policy, no I/O).
+"""Replica autoscaling policies (pure policy, no I/O).
 
-The fleet samples the router's queue depth each control tick and feeds it
-here; the policy answers "how many replicas should exist". Decisions are
-hysteretic on purpose — a serving replica is expensive to move (gang
-admission, engine compile, cache warmup), so the policy scales up only
-after ``patience`` consecutive over-threshold samples and down only after
-``patience`` consecutive idle ones, one step at a time. Deterministic:
-same sample sequence, same decisions (the fleet tests replay it).
+The fleet samples the router each control tick and feeds a policy here;
+the policy answers "how many replicas should exist". Two policies:
+
+* :class:`QueueDepthAutoscaler` — the PR 13 backlog policy: queued
+  requests per replica drive UP, idle capacity drives DOWN. Grown an
+  ``attainment`` gate: an at-capacity fleet that is still MEETING its
+  SLO is not under-provisioned — a transient burst must not flap the
+  replica count when the latency objective says nothing is wrong.
+* :class:`SlaAutoscaler` — the SLA-plane policy: targets p99 TTFT and
+  SLO attainment from the fleet-merged histograms instead of raw queue
+  depth. Scaling on the objective itself (latency felt by requests)
+  instead of its proxy (backlog) is what keeps capacity tracking the
+  SLO through brownouts, where sheds hide backlog the queue-depth
+  signal would need. Real-clock cooldown (injectable) instead of
+  tick-count patience: SLO evaluation beats are wall-time windows.
+
+Decisions are hysteretic on purpose — a serving replica is expensive to
+move (gang admission, engine compile, cache warmup) — and deterministic:
+same sample sequence (and clock), same decisions (the fleet tests
+replay it).
 """
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Callable, List, Optional
 
-__all__ = ["QueueDepthAutoscaler"]
+__all__ = ["QueueDepthAutoscaler", "SlaAutoscaler"]
 
 
 @dataclass
@@ -27,11 +41,20 @@ class QueueDepthAutoscaler:
     zero cannot answer the request that would scale it back up).
     """
 
+    #: Fleets pass SLA keyword samples (attainment) only to policies
+    #: that declare them — a user-supplied policy with the pre-SLA
+    #: ``observe(queued, replicas, busy)`` signature keeps working.
+    sla_aware = True
+
     min_replicas: int = 1
     max_replicas: int = 8
     high: float = 2.0
     low: float = 0.25
     patience: int = 3
+    #: attainment at/above this (when an attainment sample is provided)
+    #: vetoes the up-vote: meeting the SLO means the backlog is a burst
+    #: the fleet is absorbing, not under-provisioning.
+    attainment_target: float = 0.99
     _over: int = field(default=0, repr=False)
     _under: int = field(default=0, repr=False)
     decisions: List[str] = field(default_factory=list, repr=False)
@@ -45,7 +68,8 @@ class QueueDepthAutoscaler:
             raise ValueError("low watermark must sit below high")
 
     def observe(self, queued: int, replicas: int,
-                busy: Optional[int] = None) -> int:
+                busy: Optional[int] = None,
+                attainment: Optional[float] = None) -> int:
         """One control-tick sample → desired replica count.
 
         ``queued`` is backlog beyond capacity (pressure — drives UP);
@@ -54,14 +78,20 @@ class QueueDepthAutoscaler:
         backlog but is NOT idle, and scaling it down would shed replicas
         mid-stream only to re-add them a few ticks later. ``busy``
         defaults to ``queued`` for callers without a utilization signal.
+        ``attainment`` (0..1, None = no signal) generalizes the gate to
+        the SLO side: backlog WITH the objective still met is a
+        transient burst — neutral, neither an up- nor a down-vote, so
+        the decision cannot flap while the burst drains.
         """
         replicas = max(1, replicas)
         per_replica = queued / replicas
         per_busy = (queued if busy is None else busy) / replicas
-        if per_replica >= self.high:
+        meeting_slo = attainment is not None \
+            and attainment >= self.attainment_target
+        if per_replica >= self.high and not meeting_slo:
             self._over += 1
             self._under = 0
-        elif per_busy <= self.low:
+        elif per_replica < self.high and per_busy <= self.low:
             self._under += 1
             self._over = 0
         else:
@@ -75,4 +105,70 @@ class QueueDepthAutoscaler:
             desired = replicas - 1
             self._under = 0
             self.decisions.append(f"down:{replicas}->{desired}")
+        return desired
+
+
+@dataclass
+class SlaAutoscaler:
+    """``observe(queued, replicas, ttft_p99=, attainment=) -> desired``.
+
+    Scale on the objective, not the proxy: UP while observed p99 TTFT
+    exceeds ``ttft_p99_target_s`` or attainment sits under
+    ``attainment_target``; DOWN only when the SLO is met with margin
+    (``downscale_margin`` × target p99) AND the backlog is empty — an
+    SLO met exactly is a fleet sized exactly, not oversized.
+    ``cooldown_s`` on the injectable ``clock`` spaces decisions in wall
+    time (replica startup is slow; voting faster than capacity can land
+    double-scales on one burst). Missing samples (cold histograms) are
+    neutral: never scale on the absence of evidence.
+    """
+
+    sla_aware = True
+
+    min_replicas: int = 1
+    max_replicas: int = 8
+    ttft_p99_target_s: float = 1.0
+    attainment_target: float = 0.99
+    downscale_margin: float = 0.5
+    cooldown_s: float = 10.0
+    clock: Callable[[], float] = time.monotonic
+    _last_decision_t: float = field(default=float("-inf"), repr=False)
+    decisions: List[str] = field(default_factory=list, repr=False)
+
+    def __post_init__(self):
+        if self.min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError("max_replicas must be >= min_replicas")
+        if not 0.0 < self.downscale_margin < 1.0:
+            raise ValueError("downscale_margin must be in (0, 1)")
+
+    def observe(self, queued: int, replicas: int,
+                busy: Optional[int] = None,
+                ttft_p99: Optional[float] = None,
+                attainment: Optional[float] = None) -> int:
+        replicas = max(1, replicas)
+        now = self.clock()
+        if now - self._last_decision_t < self.cooldown_s:
+            return replicas
+        breaching = (ttft_p99 is not None
+                     and ttft_p99 > self.ttft_p99_target_s) \
+            or (attainment is not None
+                and attainment < self.attainment_target)
+        comfortable = queued == 0 \
+            and (ttft_p99 is None
+                 or ttft_p99 <= self.ttft_p99_target_s
+                 * self.downscale_margin) \
+            and (attainment is None
+                 or attainment >= self.attainment_target) \
+            and ttft_p99 is not None
+        desired = replicas
+        if breaching and replicas < self.max_replicas:
+            desired = replicas + 1
+            self.decisions.append(f"up:{replicas}->{desired}")
+        elif comfortable and replicas > self.min_replicas:
+            desired = replicas - 1
+            self.decisions.append(f"down:{replicas}->{desired}")
+        if desired != replicas:
+            self._last_decision_t = now
         return desired

@@ -342,6 +342,10 @@ class ServeFleet:
         self._next_index = {"decode": 0, "prefill": 0}
         #: live replica task ids PER ROLE, oldest first.
         self._pools: Dict[str, List[str]] = {"decode": [], "prefill": []}
+        #: (name, boot_id) pairs already sent a join-time warm hint —
+        #: one hint per incarnation (a reboot is a cold cache, so a new
+        #: boot id earns a fresh hint).
+        self._warmed: set = set()
 
     # Decode-pool view, kept name-stable for existing callers/tests.
     @property
@@ -421,12 +425,40 @@ class ServeFleet:
 
     def tick(self) -> None:
         self.scheduler.tick()
-        self.router.set_replicas(self.refresh_endpoints())
+        endpoints = self.refresh_endpoints()
+        self.router.set_replicas(endpoints)
+        # Scale-up placement warmth (the SLA plane's brownout recovery):
+        # a decode endpoint seen for the first time (or rebooted — new
+        # boot id, cold cache) gets the prefix chains of the still-open
+        # requests pushed ahead of its first dispatch, so new capacity
+        # joins warm for exactly the traffic the overload is shedding.
+        for name, info in endpoints.items():
+            stamp = (name, info.get("boot_id", ""))
+            if stamp not in self._warmed \
+                    and info.get("role", "decode") != "prefill":
+                self._warmed.add(stamp)
+                self.router.warm_hint(name)
         if self.autoscaler is not None:
             stats = self.router.stats()
+            kwargs = {"busy": stats["open"]}
+            if getattr(self.autoscaler, "sla_aware", False):
+                # The SLA-plane signals: fleet attainment (met over
+                # finished, all classes) and the p99 of the router's
+                # fleet-level TTFT histogram. None until observed —
+                # the policies treat missing evidence as neutral. Only
+                # policies that DECLARE sla_aware see these keywords, so
+                # a user-supplied pre-SLA policy keeps its signature.
+                import inspect
+
+                params = inspect.signature(
+                    self.autoscaler.observe).parameters
+                if "attainment" in params:
+                    kwargs["attainment"] = self._fleet_attainment(stats)
+                if "ttft_p99" in params:
+                    kwargs["ttft_p99"] = self._fleet_ttft_p99()
             desired = self.autoscaler.observe(
                 stats["queue_depth"], max(1, self.live_replicas()),
-                busy=stats["open"])
+                **kwargs)
             if desired != self.live_replicas():
                 self.scale_to(desired)
         if self.prefill_autoscaler is not None:
@@ -529,6 +561,27 @@ class ServeFleet:
                 self._obs_pending.append((spans, source, metrics))
         return exported
 
+    @staticmethod
+    def _fleet_attainment(stats: dict) -> Optional[float]:
+        """Overall SLO attainment (met / finished across every class)
+        off the router's stats; None before any request finishes."""
+        met = finished = 0
+        for counts in stats.get("sla", {}).get("classes", {}).values():
+            met += counts["met"]
+            finished += counts["met"] + counts["missed"] + counts["shed"]
+        if finished == 0:
+            return None
+        return met / finished
+
+    def _fleet_ttft_p99(self) -> Optional[float]:
+        """p99 of the router's fleet-level TTFT histogram (the
+        submit→first-token latency every request pays, whichever
+        replica served it); None while the histogram is empty."""
+        hist = self.router.obs.metrics.histogram("router.ttft_s")
+        if hist.count == 0:
+            return None
+        return hist.quantile(0.99)
+
     def _evaluate_slos(self, replica_snaps: List[dict]) -> None:
         from tpu_task.obs import merge_snapshots, write_alert
 
@@ -536,6 +589,10 @@ class ServeFleet:
             [self.router.obs.metrics.snapshot(), *replica_snaps])
         self._slo.observe(merged)
         self.slo_statuses, alerts = self._slo.evaluate()
+        # The actuation hook: every SLO evaluation beat advances the
+        # router's degrade ladder on the live alert state — brownout
+        # enters when the error budget burns, leaves when it stops.
+        self.router.note_alerts(alerts)
         if self._obs_backend is None:
             return
         for alert in alerts:

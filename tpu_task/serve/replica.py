@@ -18,8 +18,14 @@ on the pooled transport of ``storage/http_util.py``):
   identical sampled stream on any replica; ``tokens`` is an
   already-emitted prefix (a re-dispatch after a sibling's preemption) that
   is re-ingested as context via ``ServingEngine.resume_inflight``. A
-  draining replica answers 409 (NOT a retryable 5xx — the router must
-  re-pick, not re-try).
+  draining or overloaded replica answers 429 + ``Retry-After: 0`` (NOT a
+  bare 409, and not a 5xx): the transport's one paced retry fires
+  immediately, then the router re-picks a sibling — or sheds an
+  expired-deadline request — without quarantining a healthy server. The
+  :data:`~tpu_task.obs.SLA_HEADER` header (class + remaining-ms
+  deadline) rides beside the trace header into the engine's
+  slack-ordered admission. ``POST /degrade`` is the router's brownout
+  actuation (currently ``{"spec": bool}``).
 * ``GET /stream?rid=&offset=&wait_ms=`` — token streaming as incremental
   long-poll: blocks up to ``wait_ms`` for tokens past ``offset``, returns
   ``{tokens: suffix, status, draining}``. Offset-based delivery is what
@@ -63,7 +69,13 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 from urllib.parse import parse_qs, urlsplit
 
-from tpu_task.obs import TRACE_HEADER, Obs, TraceContext
+from tpu_task.obs import (
+    SLA_HEADER,
+    TRACE_HEADER,
+    Obs,
+    TraceContext,
+    parse_sla_header,
+)
 
 __all__ = [
     "MODEL_PRESETS",
@@ -156,12 +168,15 @@ class _JSONHandler(BaseHTTPRequestHandler):
     def log_message(self, *args) -> None:  # keep pytest output clean
         pass
 
-    def _reply(self, payload: dict, status: int = 200) -> None:
+    def _reply(self, payload: dict, status: int = 200,
+               headers: Optional[dict] = None) -> None:
         body = json.dumps(payload).encode()
         try:
             self.send_response(status)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for name, value in (headers or {}).items():
+                self.send_header(name, value)
             self.end_headers()
             self.wfile.write(body)
         except OSError:
@@ -239,15 +254,39 @@ class _JSONHandler(BaseHTTPRequestHandler):
             payload = json.loads(self.rfile.read(length) or b"{}")
             if path == "/submit":
                 if replica.draining:
-                    # 409, deliberately outside send()'s RETRY_STATUSES:
-                    # retrying a draining replica cannot succeed — the
-                    # router must re-dispatch to a sibling instead.
-                    self._reply({"error": "draining", "draining": True}, 409)
+                    # 429 + Retry-After: 0 — INSIDE send()'s
+                    # RETRY_STATUSES on purpose: the transport burns its
+                    # one paced retry immediately (Retry-After 0 keeps
+                    # failover fast), then the router's 429 arm reads
+                    # the draining body and re-picks a sibling without
+                    # indicting a healthy server.
+                    self._reply({"error": "draining", "draining": True},
+                                429, headers={"Retry-After": "0"})
                     return
-                self._reply({"rid": replica.submit(payload, trace=trace)})
+                if replica.overloaded():
+                    # Same shape, but healthy-and-full: the router must
+                    # try siblings (or shed an expired deadline), never
+                    # quarantine — being busy is not a fault.
+                    self._reply({"error": "overloaded",
+                                 "overloaded": True},
+                                429, headers={"Retry-After": "0"})
+                    return
+                raw_sla = self.headers.get(SLA_HEADER)
+                if raw_sla is None:
+                    # Header absent → the pre-SLA call shape, so
+                    # submit stand-ins with the old two-argument
+                    # signature keep working.
+                    self._reply({"rid": replica.submit(payload,
+                                                       trace=trace)})
+                else:
+                    self._reply({"rid": replica.submit(
+                        payload, trace=trace,
+                        sla=parse_sla_header(raw_sla))})
             elif path == "/drain":
                 replica.begin_drain()
                 self._reply({"ok": True, "draining": True})
+            elif path == "/degrade":
+                self._reply(replica.degrade(payload))
             elif path == "/prefetch":
                 self._reply({"imported": replica.prefetch(
                     payload.get("hashes") or [])})
@@ -285,7 +324,8 @@ class ReplicaServer:
                  port: int = 0, drain_file: Optional[str] = None,
                  obs_enabled: bool = True, profile_dir: str = "profiles",
                  kv_client=None, kv_publish_every: int = 20,
-                 tp: int = 1, ep: int = 1):
+                 tp: int = 1, ep: int = 1,
+                 max_queue: Optional[int] = None):
         self.boot_id = uuid.uuid4().hex[:12]
         #: One tracer + registry for the whole replica (front end AND
         #: engine — the engine records into the same registry, so /stats
@@ -312,10 +352,23 @@ class ReplicaServer:
         self._ship_queue: "queue.Queue[list]" = queue.Queue(maxsize=8)
         self.ship_drops = 0
         self._ship_thread: Optional[threading.Thread] = None
+        # "max_queue" may ride the serving dict (ServeSpec.serving →
+        # driver payload → here) — it is a front-end knob, not a
+        # ServingConfig field, so pop it before the engine build sees it.
+        serving = dict(serving or {})
+        if max_queue is None:
+            max_queue = serving.pop("max_queue", None)
+        else:
+            serving.pop("max_queue", None)
         self.engine = engine if engine is not None else build_engine(
             preset, serving, obs=self.obs, kv_client=kv_client, tp=tp,
             ep=ep)
         self.draining = False
+        #: Admission bound for the front end: with this many requests
+        #: already waiting in the engine's queue, /submit answers 429 +
+        #: Retry-After instead of letting the backlog grow unboundedly
+        #: (None = unbounded, the historical behavior).
+        self.max_queue = max_queue
         self.drain_file = drain_file
         self.profile_dir = profile_dir
         self._profile_thread: Optional[threading.Thread] = None
@@ -513,9 +566,34 @@ class ReplicaServer:
                 "source": self.boot_id}
 
     # -- front-end operations (handler-called, self-locking) ------------------
+    def overloaded(self) -> bool:
+        """Engine wait-queue at/over the admission bound (False when
+        unbounded) — the /submit 429 gate."""
+        if self.max_queue is None:
+            return False
+        with self._lock:
+            return self.engine.queue_depth >= self.max_queue
+
+    def degrade(self, payload: dict) -> dict:
+        """``POST /degrade``: the router's brownout actuation on this
+        replica — currently one knob, ``{"spec": bool}``, toggling
+        speculative decoding engine-wide (de-speculation zeroes the
+        draft width inside the SAME spec program, so admitted streams
+        stay bit-identical — the saved work is the draft forward
+        passes, never the token values)."""
+        with self._lock:
+            if "spec" in payload:
+                self.engine.spec_enabled = bool(payload["spec"])
+            return {"ok": True, "spec": bool(self.engine.spec_enabled)}
+
     def submit(self, payload: dict,
-               trace: Optional[TraceContext] = None) -> int:
+               trace: Optional[TraceContext] = None,
+               sla=None) -> int:
         prompt = [int(t) for t in payload["prompt"]]
+        slo_class, remaining_ms = sla if sla is not None \
+            else (None, None)
+        deadline_s = None if remaining_ms is None \
+            else remaining_ms / 1000.0
         kwargs = dict(
             temperature=float(payload.get("temperature", 0.0)),
             top_p=payload.get("top_p"),
@@ -542,6 +620,10 @@ class ReplicaServer:
                     else kwargs["top_p"],
                     "eos_token": kwargs["eos_token"],
                 }
+                if slo_class is not None:
+                    record["slo_class"] = slo_class
+                if deadline_s is not None:
+                    record["deadline_s"] = deadline_s
                 return next(iter(self.engine.resume_inflight(
                     [record], trace=trace).values()))
             # Fresh dispatch goes through submit (and ALL its argument
@@ -550,6 +632,10 @@ class ReplicaServer:
             # router-derived key rides the key= override.
             if key is not None:
                 kwargs["key"] = key
+            if slo_class is not None:
+                kwargs["slo_class"] = slo_class
+            if deadline_s is not None:
+                kwargs["deadline_s"] = deadline_s
             return self.engine.submit(
                 prompt, int(payload["max_new_tokens"]), trace=trace,
                 **kwargs)
@@ -597,6 +683,10 @@ class ReplicaServer:
                 "active": self.engine.n_active,
                 "queued": self.engine.queue_depth,
                 "draining": self.draining,
+                # getattr: engine stand-ins (tests, future backends)
+                # need not carry the spec toggle to answer /stats.
+                "spec_enabled": bool(
+                    getattr(self.engine, "spec_enabled", True)),
                 "boot_id": self.boot_id,
             })
         return stats

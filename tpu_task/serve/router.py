@@ -44,6 +44,18 @@ boring and deterministic:
   token-identically (the engine's ``resume_inflight`` contract). A
   replica that faults is quarantined until its endpoint re-announces with
   a new boot id (the fleet's membership refresh).
+* **SLA actuation** — requests carry ``slo_class``/``deadline_ms``
+  (propagated in the :data:`~tpu_task.obs.SLA_HEADER` dispatch header,
+  next to the trace header). A SHED GATE fast-fails work whose slack is
+  already unmeetable against the target replica's observed TTFT /
+  inter-token service estimates — a structured ``shed`` terminal with a
+  ``retry_after_s`` the client should honor — and the DEGRADE LADDER
+  (:class:`~tpu_task.obs.DegradeLadder`, driven by the PR 12 burn-rate
+  evaluator's live alert state via :meth:`Router.note_alerts`) brownouts
+  best-effort before touching premium: clamp ``max_new``, de-speculate
+  the fleet, then shed. Degradation changes whether/how much work runs,
+  NEVER token values — admitted streams stay bit-identical to the
+  no-SLA engine (the keyed-sampling contract).
 
 The router computes each request's sampling key ONCE (``fold_in(seed
 key, fleet rid)``) and ships it raw — replicas never key sampled streams
@@ -60,13 +72,29 @@ import urllib.error
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
-from tpu_task.obs import TRACE_HEADER, Obs
+from tpu_task.obs import (
+    DEFAULT_CLASS,
+    SLA_HEADER,
+    SLO_CLASSES,
+    TRACE_HEADER,
+    DegradeLadder,
+    Obs,
+    class_rank,
+    format_sla_header,
+)
+from tpu_task.obs.sla import RUNG_NOSPEC
 from tpu_task.obs.trace import Span, TraceContext
 from tpu_task.storage.http_util import send
 
 __all__ = ["FleetRequest", "NoReplicaAvailable", "Router"]
 
 QUEUED, RUNNING, DONE, FAILED = "queued", "running", "done", "failed"
+#: Terminal rejection by the SLA plane: the deadline is unmeetable (shed
+#: gate) or the degrade ladder refuses the class. Distinct from FAILED —
+#: the request was well-formed; the fleet declined the work and said
+#: when to retry (``FleetRequest.retry_after_s``).
+SHED = "shed"
+TERMINAL = (DONE, FAILED, SHED)
 
 
 class NoReplicaAvailable(RuntimeError):
@@ -101,6 +129,12 @@ class _Replica:
     #: boot id means a cold cache.
     kv_hashes: Dict[bytes, None] = field(default_factory=dict, repr=False,
                                          compare=False)
+    #: EWMA service estimates observed from this replica's streams (the
+    #: shed gate's inputs): seconds to first token, and seconds per
+    #: subsequent token. 0.0 = no observation yet — a cold fleet never
+    #: sheds on guesses.
+    ttft_ewma: float = 0.0
+    tok_ewma: float = 0.0
 
 
 @dataclass
@@ -124,6 +158,14 @@ class FleetRequest:
     submit_t: float = 0.0
     first_token_t: Optional[float] = None
     finish_t: Optional[float] = None
+    #: SLA metadata: protection class, absolute deadline on the router's
+    #: clock (None = no deadline), the Retry-After a shed answer carried,
+    #: and the degrade ladder's clamped token budget (None = unclamped —
+    #: pump's DONE check honors the clamp when set).
+    slo_class: str = DEFAULT_CLASS
+    deadline: Optional[float] = None
+    retry_after_s: Optional[float] = None
+    clamped_max_new: Optional[int] = None
     #: the request's trace (minted at submit — the root span's context);
     #: every dispatch span and every replica-side span links under it.
     trace: Optional[TraceContext] = None
@@ -154,7 +196,10 @@ class Router:
                  urlopen=None,
                  clock: Callable[[], float] = time.monotonic,
                  obs: Optional[Obs] = None,
-                 prefetch_next_turn: bool = False):
+                 prefetch_next_turn: bool = False,
+                 ladder: Optional[DegradeLadder] = None,
+                 shed_retry_after_s: float = 1.0,
+                 service_ewma_alpha: float = 0.3):
         self.seed = seed
         self.affinity_tokens = affinity_tokens
         #: KV block size the fleet's engines run — what block-aligns the
@@ -193,6 +238,23 @@ class Router:
         #: ServeFleet turns this on when the fleet has a KV plane.
         self.prefetch_next_turn = prefetch_next_turn
         self.prefetch_hints = 0          # hints sent (POST /prefetch)
+        # SLA actuation state: the degrade ladder (advanced by
+        # note_alerts — the burn-rate evaluator's live alert state is
+        # its clock), the Retry-After a shed terminal advertises, and
+        # the EWMA smoothing for the per-replica service estimates the
+        # shed gate consumes.
+        self.ladder = ladder if ladder is not None else DegradeLadder()
+        self.shed_retry_after_s = shed_retry_after_s
+        self.service_ewma_alpha = service_ewma_alpha
+        #: whether the fleet's replicas currently run with speculation
+        #: ON — note_alerts toggles this (POST /degrade) when the ladder
+        #: crosses / recrosses its no-spec rung.
+        self._fleet_spec_on = True
+        #: per-class counters: met/missed (deadline outcome of finished
+        #: requests), shed (terminal rejections), degraded (admitted
+        #: with a ladder-clamped budget).
+        self._sla_counts = {c: {"met": 0, "missed": 0, "shed": 0,
+                                "degraded": 0} for c in SLO_CLASSES}
         # Observability: the router is where traces are MINTED (one per
         # fleet request at submit) and where the fleet-level latency
         # histograms live. Tracing here is host-side bookkeeping around
@@ -210,6 +272,20 @@ class Router:
                                float(getattr(self, stat)))
         metrics.gauge_fn("router.queue_depth",
                          lambda self=self: float(self.queue_depth))
+        # The brownout surface (`sla.*`): ladder rung, per-class
+        # met/missed/shed/degraded, and attainment % — what `obs watch`
+        # and `sched status` render.
+        metrics.gauge_fn("sla.rung",
+                         lambda self=self: float(self.ladder.rung))
+        for slo_class in SLO_CLASSES:
+            for stat in ("met", "missed", "shed", "degraded"):
+                metrics.counter_fn(
+                    f"sla.{slo_class}.{stat}",
+                    lambda self=self, c=slo_class, s=stat:
+                    float(self._sla_counts[c][s]))
+            metrics.gauge_fn(
+                f"sla.{slo_class}.attainment",
+                lambda self=self, c=slo_class: self.attainment(c))
 
     # -- membership ------------------------------------------------------------
     def set_replicas(self, endpoints: Dict[str, dict]) -> None:
@@ -244,8 +320,7 @@ class Router:
     def _drop_replica(self, name: str) -> None:
         self._replicas.pop(name, None)
         for request in self._requests.values():
-            if request.replica == name and request.status not in (DONE,
-                                                                  FAILED):
+            if request.replica == name and request.status not in TERMINAL:
                 self._end_dispatch(request, status="redispatched")
                 request.replica = None
                 request.rid = None
@@ -369,22 +444,31 @@ class Router:
 
     def submit(self, prompt, max_new_tokens: int, *,
                temperature: float = 0.0, top_p: Optional[float] = None,
-               eos_token: Optional[int] = None) -> int:
+               eos_token: Optional[int] = None,
+               slo_class: str = DEFAULT_CLASS,
+               deadline_ms: Optional[float] = None) -> int:
         """Queue a fleet request; returns its fleet id. Dispatch happens
-        here when a replica is available, else on the next :meth:`pump`."""
+        here when a replica is available, else on the next :meth:`pump`.
+        ``deadline_ms`` is the e2e budget from NOW (converted to an
+        absolute deadline on the router's clock); ``slo_class`` is the
+        protection class the ladder and victim selection key on."""
         fid = self._next_fid
         self._next_fid += 1
+        now = self.clock()
         request = FleetRequest(
             fid=fid, prompt=[int(t) for t in prompt],
             max_new_tokens=int(max_new_tokens),
             temperature=float(temperature), top_p=top_p,
             eos_token=eos_token, key=self._derive_key(fid),
-            submit_t=self.clock())
+            submit_t=now, slo_class=str(slo_class),
+            deadline=None if deadline_ms is None
+            else now + float(deadline_ms) / 1000.0)
         # The trace is minted HERE, once per fleet request: everything
         # downstream (dispatches, replica engines, re-dispatches after a
         # preemption) links under this root via the propagated header.
         request.root_span = self.obs.tracer.start(
-            "request", fid=fid, max_new_tokens=request.max_new_tokens)
+            "request", fid=fid, max_new_tokens=request.max_new_tokens,
+            slo_class=request.slo_class)
         request.trace = request.root_span.ctx
         self._requests[fid] = request
         try:
@@ -392,6 +476,123 @@ class Router:
         except NoReplicaAvailable:
             pass                          # stays QUEUED; pump retries
         return fid
+
+    # -- SLA actuation ---------------------------------------------------------
+    def _slack(self, request: FleetRequest) -> Optional[float]:
+        if request.deadline is None:
+            return None
+        return request.deadline - self.clock()
+
+    def _ewma(self, old: float, observed: float) -> float:
+        if old <= 0.0:
+            return observed
+        a = self.service_ewma_alpha
+        return a * observed + (1.0 - a) * old
+
+    def attainment(self, slo_class: str) -> float:
+        """Fraction of this class's FINISHED requests (met+missed+shed)
+        that met their deadline; 1.0 with no observations — an idle
+        fleet attains its SLO."""
+        counts = self._sla_counts.get(slo_class)
+        if counts is None:
+            return 1.0
+        total = counts["met"] + counts["missed"] + counts["shed"]
+        if total == 0:
+            return 1.0
+        return counts["met"] / total
+
+    def _class_counts(self, slo_class: str) -> Dict[str, int]:
+        return self._sla_counts.setdefault(
+            slo_class, {"met": 0, "missed": 0, "shed": 0, "degraded": 0})
+
+    def _shed(self, request: FleetRequest, reason: str,
+              retry_after_s: Optional[float] = None) -> None:
+        """Structured terminal rejection: the fleet declined the work
+        (unmeetable deadline or ladder refusal) and tells the client
+        when a retry is worth it."""
+        request.status = SHED
+        request.error = reason
+        request.retry_after_s = self.shed_retry_after_s \
+            if retry_after_s is None else retry_after_s
+        request.finish_t = self.clock()
+        self._class_counts(request.slo_class)["shed"] += 1
+        self._end_dispatch(request, status="shed")
+        self._end_root(request, status="shed", reason=reason)
+
+    def _unmeetable(self, request: FleetRequest,
+                    replica: _Replica) -> bool:
+        """The shed gate: given this replica's observed service
+        estimates, would the remaining work blow the deadline even if
+        dispatched right now? Expired slack sheds unconditionally; a
+        replica with no observations yet never triggers the estimate
+        arm (don't shed on guesses)."""
+        slack = self._slack(request)
+        if slack is None:
+            return False
+        if slack <= 0.0:
+            return True
+        if replica.ttft_ewma <= 0.0:
+            return False
+        budget = request.clamped_max_new or request.max_new_tokens
+        remaining = max(1, budget - len(request.tokens))
+        est = replica.ttft_ewma + (remaining - 1) * replica.tok_ewma
+        # Protected classes get the benefit of estimate uncertainty:
+        # under brownout the ladder clamps best_effort first, which
+        # makes best_effort CHEAP and a class-blind estimate gate would
+        # then shed the class still running at full budget — inverting
+        # the protection order. The margin keeps the gate monotone with
+        # the ladder: premium sheds only when the estimate overshoots
+        # its slack 2x, best_effort at 1x.
+        return est > slack * (1.0 + 0.5 * class_rank(request.slo_class))
+
+    def note_alerts(self, alerts) -> None:
+        """One SLO-evaluation beat: advance the degrade ladder on the
+        burn-rate evaluator's live alert state, and when the ladder
+        crosses (or recrosses) its no-spec rung, toggle speculation
+        fleet-wide (POST /degrade — spec is an engine-wide program, so
+        the toggle is per-replica, not per-request). Failures to reach
+        a replica are swallowed: degrade is advisory, the next beat
+        retries."""
+        self.ladder.observe(bool(alerts))
+        spec_on = self.ladder.rung < RUNG_NOSPEC
+        if spec_on == self._fleet_spec_on:
+            return
+        self._fleet_spec_on = spec_on
+        for replica in self._replicas.values():
+            if not replica.healthy or replica.role == "prefill":
+                continue
+            try:
+                self._call(replica, "POST", "/degrade",
+                           data={"spec": spec_on})
+            except (urllib.error.URLError, OSError, ValueError):
+                continue
+
+    def warm_hint(self, name: str) -> None:
+        """Scale-up placement warmth (the PR 14 follow-on): a replica
+        that just JOINED starts cold; push it the prefix chains of the
+        still-open requests — the traffic a brownout is shedding — so
+        the new capacity pulls published KV blocks ahead of its first
+        dispatch instead of cold-prefilling through the overload."""
+        replica = self._replicas.get(name)
+        if replica is None or replica.role == "prefill":
+            return
+        seen: Dict[bytes, None] = {}
+        for request in self._requests.values():
+            if request.status in TERMINAL:
+                continue
+            for h in self._chain_hashes(request.prompt):
+                seen[h] = None
+        hashes = list(seen)
+        if not hashes:
+            return
+        try:
+            body = self._call(replica, "POST", "/prefetch",
+                              data={"hashes": [h.hex() for h in hashes]})
+        except (urllib.error.URLError, OSError, ValueError):
+            return                        # advisory, like every hint
+        self.prefetch_hints += 1
+        if int(body.get("imported") or 0) > 0:
+            self._note_served(replica, hashes)
 
     def _wants_prefill_leg(self, request: FleetRequest) -> bool:
         """A fresh long-prompt request takes the dedicated prefill pool
@@ -404,6 +605,21 @@ class Router:
 
     def _dispatch(self, request: FleetRequest,
                   exclude: Optional[set] = None) -> None:
+        # The degrade ladder speaks FIRST (class-ordered refusal/clamp),
+        # before a replica is even picked: a laddered shed must not
+        # depend on which replica affinity would have chosen.
+        plan = self.ladder.plan(request.slo_class, request.max_new_tokens)
+        if plan["shed"]:
+            self._shed(request, f"degrade ladder rung {self.ladder.rung} "
+                                f"sheds class {request.slo_class}")
+            return
+        if plan["max_new"] < request.max_new_tokens:
+            if request.clamped_max_new is None:
+                self._class_counts(request.slo_class)["degraded"] += 1
+            request.clamped_max_new = max(
+                len(request.tokens) + 1,      # never truncate received work
+                min(request.clamped_max_new or plan["max_new"],
+                    plan["max_new"]))
         prefill_leg = self._wants_prefill_leg(request)
         # ONE chain computation per dispatch attempt: pick, the span's
         # cached_depth, and _note_served below all consume it.
@@ -420,13 +636,26 @@ class Router:
             prefill_leg = False
             replica = self.pick(request.prompt, exclude=exclude,
                                 hashes=hashes)
+        # The shed gate: fast-fail work the chosen replica's observed
+        # service estimates say cannot meet its deadline — a queued
+        # death foretold is refused now, while the client can still
+        # retry elsewhere.
+        if self._unmeetable(request, replica):
+            slack = self._slack(request)
+            self._shed(request,
+                       f"deadline unmeetable on {replica.name} "
+                       f"(slack {0.0 if slack is None else slack:.3f}s)")
+            return
+        effective_max = min(request.max_new_tokens,
+                            request.clamped_max_new
+                            or request.max_new_tokens)
         payload = {
             "prompt": request.prompt,
             # The prefill leg asks for exactly the boundary token: prompt
             # ingestion + one sample, then the stream hands off to the
             # decode pool (pump's "prefilled" arm) with the published KV
             # blocks waiting in the fleet plane.
-            "max_new_tokens": 1 if prefill_leg else request.max_new_tokens,
+            "max_new_tokens": 1 if prefill_leg else effective_max,
             "temperature": request.temperature,
             "top_p": request.top_p,
             "eos_token": request.eos_token,
@@ -451,11 +680,48 @@ class Router:
             role=replica.role,
             cached_depth=self._cached_depth(replica, hashes),
             token_start=len(request.tokens))
+        # The SLA header rides next to the trace header: class always,
+        # deadline as REMAINING ms (no shared clock across processes).
+        slack = self._slack(request)
+        sla_value = format_sla_header(
+            request.slo_class,
+            None if slack is None else max(0.0, slack) * 1000.0)
         try:
             body = self._call(replica, "POST", "/submit", data=payload,
-                              headers={TRACE_HEADER: span.ctx.to_header()})
+                              headers={TRACE_HEADER: span.ctx.to_header(),
+                                       SLA_HEADER: sla_value})
         except (urllib.error.URLError, OSError, ValueError) as error:
             if isinstance(error, urllib.error.HTTPError) \
+                    and error.code == 429:
+                # Overloaded or draining — BUSY, not faulty, and checked
+                # before the generic 4xx arm (429 is 4xx). The transport
+                # already honored the replica's Retry-After once; what
+                # reaches here means the answer stuck. An expired
+                # deadline is a terminal shed (the replica's refusal
+                # proved the gate right); a draining body quarantines
+                # like the legacy 409; otherwise try siblings WITHOUT
+                # quarantining — a healthy-but-full replica must not be
+                # marked unhealthy (the never-quarantined invariant).
+                detail = {}
+                try:
+                    detail = json.loads(error.read().decode(
+                        errors="replace") or "{}")
+                except ValueError:
+                    pass
+                expired = slack is not None and slack <= 0.0
+                if expired:
+                    self.obs.tracer.end(span, status="shed")
+                    self._shed(request,
+                               f"replica {replica.name} refused (429) "
+                               f"with the deadline already expired")
+                    return
+                if detail.get("draining"):
+                    self.obs.tracer.end(span, status="draining")
+                    replica.healthy = False
+                    replica.quarantined_until = float("inf")
+                else:
+                    self.obs.tracer.end(span, status="busy")
+            elif isinstance(error, urllib.error.HTTPError) \
                     and error.code == 409:
                 # Draining, not faulty: no new admissions, but its open
                 # streams still answer — only dispatch routes around it,
@@ -551,7 +817,7 @@ class Router:
             replica.load -= 1
         request.replica = None
         request.rid = None
-        if request.status != FAILED:      # terminal rejections stay terminal
+        if request.status not in TERMINAL:  # terminal stays terminal
             request.status = QUEUED
 
     # -- streaming -------------------------------------------------------------
@@ -562,14 +828,29 @@ class Router:
         ``while router.pump():``. Single-threaded and deterministic given
         deterministic replicas/transport (the chaos tests rely on it)."""
         open_requests = [r for r in self._requests.values()
-                         if r.status not in (DONE, FAILED)]
+                         if r.status not in TERMINAL]
+        # Contention order: when fewer slots free up than requests wait,
+        # the dispatch attempts below implicitly ration them — so rank
+        # by class, then EDF within a class. A no-SLA fleet (one class,
+        # no deadlines) has all-equal keys and this collapses to fid
+        # order, the pre-SLA FIFO.
+        open_requests.sort(key=lambda r: (-class_rank(r.slo_class),
+                                          r.deadline is None,
+                                          r.deadline or 0.0, r.fid))
         for request in open_requests:
             if request.replica is None:
+                slack = self._slack(request)
+                if slack is not None and slack <= 0.0:
+                    # Queued to death already — a durable shed terminal
+                    # beats dispatching work whose answer nobody can use
+                    # (and beats holding the slot when no replica is up).
+                    self._shed(request, "deadline expired in queue")
+                    continue
                 try:
                     self._dispatch(request)
                 except NoReplicaAvailable:
                     continue
-                if request.status == FAILED:  # terminally rejected (4xx)
+                if request.status in TERMINAL:  # rejected (4xx) or shed
                     continue
             replica = self._replicas.get(request.replica or "")
             if replica is None:
@@ -597,15 +878,35 @@ class Router:
             if suffix:
                 if request.first_token_t is None:
                     request.first_token_t = self.clock()
-                    self._h_ttft.observe(
-                        request.first_token_t - request.submit_t)
+                    ttft = request.first_token_t - request.submit_t
+                    self._h_ttft.observe(ttft)
+                    replica.ttft_ewma = self._ewma(replica.ttft_ewma,
+                                                   ttft)
                 request.tokens.extend(suffix)
-            if len(request.tokens) >= request.max_new_tokens or (
+            limit = min(request.max_new_tokens,
+                        request.clamped_max_new
+                        or request.max_new_tokens)
+            if len(request.tokens) >= limit or (
                     request.eos_token is not None and request.tokens
                     and request.tokens[-1] == request.eos_token):
                 request.status = DONE
                 request.finish_t = self.clock()
                 self._h_e2e.observe(request.finish_t - request.submit_t)
+                # Feed the shed gate's inter-token estimate, and settle
+                # the deadline: met when it finished inside the budget
+                # (a deadline-less request trivially attains).
+                if request.first_token_t is not None \
+                        and len(request.tokens) > 1:
+                    per_tok = (request.finish_t - request.first_token_t) \
+                        / (len(request.tokens) - 1)
+                    replica.tok_ewma = self._ewma(replica.tok_ewma,
+                                                  per_tok)
+                counts = self._class_counts(request.slo_class)
+                if request.deadline is None \
+                        or request.finish_t <= request.deadline:
+                    counts["met"] += 1
+                else:
+                    counts["missed"] += 1
                 self._end_dispatch(request)
                 self._end_root(request, dispatches=request.dispatches)
                 if replica.load > 0:
@@ -640,7 +941,7 @@ class Router:
                 replica.quarantined_until = float("inf")
                 self._unassign(request)
         return sum(1 for r in self._requests.values()
-                   if r.status not in (DONE, FAILED))
+                   if r.status not in TERMINAL)
 
     def _hint_next_turn(self, request: FleetRequest) -> None:
         """Prefetch-ahead: the session's next turn will extend
@@ -699,7 +1000,7 @@ class Router:
                 on_idle()
             if time.monotonic() > deadline:
                 stuck = sorted(fid for fid, r in self._requests.items()
-                               if r.status not in (DONE, FAILED))
+                               if r.status not in TERMINAL)
                 raise TimeoutError(
                     f"router drain exceeded {deadline_s}s with "
                     f"{len(stuck)} open request(s): {stuck}")
@@ -713,6 +1014,10 @@ class Router:
         if request.status == FAILED:
             raise RuntimeError(
                 f"request {fid} was rejected: {request.error}")
+        if request.status == SHED:
+            raise RuntimeError(
+                f"request {fid} was shed: {request.error} "
+                f"(retry after {request.retry_after_s}s)")
         if request.status != DONE:
             raise RuntimeError(f"request {fid} is {request.status}, not done")
         return list(request.tokens)
@@ -733,7 +1038,7 @@ class Router:
         if self.prefill_threshold is None:
             return 0
         return sum(1 for r in self._requests.values()
-                   if r.status not in (DONE, FAILED) and not r.tokens
+                   if r.status not in TERMINAL and not r.tokens
                    and len(r.prompt) >= self.prefill_threshold)
 
     @property
@@ -741,7 +1046,7 @@ class Router:
         """Open requests beyond what the fleet's slots could be running —
         the autoscaler's signal (0 when capacity covers the backlog)."""
         open_count = sum(1 for r in self._requests.values()
-                         if r.status not in (DONE, FAILED))
+                         if r.status not in TERMINAL)
         return max(0, open_count - self.fleet_slots())
 
     def fleet_slots(self) -> int:
@@ -764,8 +1069,14 @@ class Router:
         return {
             "replicas": self.replicas(),
             "requests": len(self._requests),
-            "open": sum(1 for s in states if s not in (DONE, FAILED)),
+            "open": sum(1 for s in states if s not in TERMINAL),
             "failed": states.count(FAILED),
+            "shed": states.count(SHED),
+            "sla": {
+                "rung": self.ladder.rung,
+                "classes": {c: dict(counts, attainment=self.attainment(c))
+                            for c, counts in self._sla_counts.items()},
+            },
             "queue_depth": self.queue_depth,
             "redispatches": self.redispatches,
             "transport_faults": self.transport_faults,
