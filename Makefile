@@ -8,7 +8,7 @@ PYTHON ?= python3
 # Seed for the chaos soak: any run is replayable by pinning this.
 TPU_TASK_CHAOS_SEED ?= 20260804
 
-.PHONY: test lint smoke sweep bench bench-steady bench-serving bench-sched bench-decode bench-fleet bench-fleetkv bench-obs bench-goodput bench-tier bench-sla sched sched-soak chaos fleet kvfleet tiering moe moe-serve serve-soak sla-soak obs watch wheel multichip kernels-tpu clean
+.PHONY: test lint smoke sweep bench bench-steady bench-serving bench-sched bench-decode bench-fleet bench-fleetkv bench-obs bench-goodput bench-tier bench-sla bench-lora sched sched-soak chaos fleet kvfleet tiering moe moe-serve serve-soak sla-soak lora obs watch wheel multichip kernels-tpu clean
 
 # Hermetic suite (the reference's `make test`, 30 s budget there; ours spans
 # the fake control planes, sharded-compute CPU checks, and the loopback GCS
@@ -71,6 +71,14 @@ bench-tier:
 # the virtual clock (pure model; milliseconds per hundred tasks).
 bench-sched:
 	$(PYTHON) bench.py scheduler
+
+# Multi-tenant LoRA bench legs only (PR 19): the serving section's
+# `adapters` subsection — adapters-per-replica density sweep (tok/s at
+# 0/25/100% adapter-bearing slots, adapter-less overhead ratio), and the
+# live weight-roll latency. Asserts every mixed-batch stream bit-matches
+# a dedicated single-adapter engine — EXITS NONZERO on divergence.
+bench-lora:
+	$(PYTHON) bench.py serving --lora-only
 
 # Paged-decode kernel grid only: impl (xla gather vs Pallas kernel vs the
 # DMA-pipelined kernel) × kv_dtype (model dtype vs int8) × batch {1,8,32}
@@ -144,6 +152,14 @@ kvfleet:
 # tier-1; the soaks are slow.
 tiering:
 	$(PYTHON) -m pytest tests/ -m tiering -q
+
+# Multi-tenant serving tests: paged LoRA adapters in the one fused step
+# (mixed-batch bit-identity vs dedicated engines, scratch-block no-op
+# exactness, LRU evict + bucket reload) and the drain-free weight
+# hot-swap (generation pinning, export/resume round-trip, the replica
+# roll soak in the slow subset).
+lora:
+	$(PYTHON) -m pytest tests/ -m lora -q
 
 # Sharded-replica / MoE serving tests: ep all_to_all dispatch identity,
 # tp×ep gang engines, sharded spec decode, scheduler chip accounting,

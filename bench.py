@@ -902,6 +902,11 @@ def bench_serving(n_requests: int = 36, seed: int = 0) -> dict:
         # without the host rung, and the overlap-covered demotion check
         # (host_gap_frac stays ~0 while blocks demote in the background).
         "tiering": _bench_tiering(seed),
+        # Multi-tenant density (PR 19): paged LoRA adapters in the one
+        # fused step — adapter-fraction + adapters-per-replica tok/s,
+        # the adapter-less overhead pin, dedicated-engine stream
+        # identity, and the drain-free weight-roll latency.
+        "adapters": _bench_lora(seed),
         "generate_static_batch": {
             "decode_tokens_per_s": round(useful / static_makespan, 1),
             "makespan_s": round(static_makespan, 3),
@@ -1116,6 +1121,154 @@ def _bench_tiering(seed: int = 0) -> dict:
         out["ERROR"] = ("greedy streams DIVERGED across residency "
                         "tiers — promotion must be byte-identity")
     return out
+
+
+def _bench_lora(seed: int = 0) -> dict:
+    """Multi-tenant density leg (PR 19): paged LoRA adapters in the one
+    fused step, plus the drain-free weight hot-swap.
+
+    Four measurements, one micro model:
+
+    - ``adapter_fraction``: engine tok/s with 0%, 25%, and 100% of the
+      workload adapter-bearing, against a LoRA-disabled engine on the
+      same workload. ``adapterless_overhead_frac`` is the tracked
+      number: what merely ENABLING the adapter pool costs a tenant who
+      brought no adapter (acceptance line <= 5%).
+    - ``density_sweep``: tok/s as adapters-per-replica grows (1/4/8,
+      every request adapter-bearing, round-robin) at rank 4 and 8 —
+      the marginal cost of packing more tenants onto one replica.
+    - ``mixed_batch_streams_identical``: every stream of the 8-adapter
+      100% leg re-run on a dedicated single-adapter engine and compared
+      token-for-token (``--lora-only`` exits nonzero on divergence).
+    - ``swap_roll``: ``adopt_params`` wall time with a stream in flight
+      plus the drop count (must be 0) — the drain-free roll.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_task.ml.models import transformer
+    from tpu_task.ml.serving import ServingConfig, ServingEngine
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=256, d_model=128, n_heads=8, d_head=16, n_layers=2,
+        d_ff=256, dtype=jnp.float32, n_kv_heads=4)
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(seed)
+    n_req, plen, max_new = 16, 16, 16
+    prompts = [rng.integers(0, cfg.vocab_size, size=plen)
+               for _ in range(n_req)]
+
+    def mk(rank: int) -> ServingEngine:
+        scfg = ServingConfig(
+            slots=8, block_size=8, n_blocks=96, max_len=plen + max_new,
+            lora_rank=rank, n_adapter_blocks=0 if rank == 0 else 40,
+            prefix_cache=False)
+        return ServingEngine(params, cfg, scfg,
+                             rng=jax.random.PRNGKey(seed))
+
+    def adapter(i: int, rank: int):
+        arng = np.random.default_rng(1000 + i)
+        return [{"a": arng.normal(size=(cfg.d_model, rank)),
+                 "b": arng.normal(size=(rank, cfg.d_model))}
+                for _ in range(cfg.n_layers)]
+
+    def leg(eng, assign, reps: int = 3):
+        """Drain the workload once off the books (compile), then
+        ``reps`` timed passes keeping the best wall (the usual
+        shield against scheduler jitter on sub-100ms CPU legs);
+        returns (tok/s, {request index: stream})."""
+        best = float("inf")
+        for timed in range(reps + 1):
+            t0 = time.perf_counter()
+            rids = [eng.submit(p, max_new, adapter_id=aid)
+                    for p, aid in zip(prompts, assign)]
+            out = eng.drain()
+            if timed:
+                best = min(best, time.perf_counter() - t0)
+        return (round(n_req * max_new / best, 1),
+                {i: out[rid] for i, rid in enumerate(rids)})
+
+    rank = 4
+    tenants = [f"tenant-{i}" for i in range(8)]
+
+    off_tokps, off_streams = leg(mk(0), [None] * n_req)
+    eng = mk(rank)
+    for i, aid in enumerate(tenants):
+        eng.register_adapter(aid, adapter(i, rank))
+    frac_legs, streams_100 = {}, {}
+    for frac in (0.0, 0.25, 1.0):
+        bearing = int(round(frac * n_req))
+        assign = [tenants[i % len(tenants)] if i < bearing else None
+                  for i in range(n_req)]
+        tokps, streams = leg(eng, assign)
+        frac_legs[f"{int(frac * 100)}pct"] = tokps
+        if frac == 1.0:
+            streams_100 = streams
+        elif frac == 0.0:
+            # The no-op exactness pin rides the bench too: an
+            # adapter-less request in a LoRA-enabled engine must emit
+            # the LoRA-free engine's exact stream.
+            if streams != off_streams:
+                return {"ERROR": "adapter-less streams diverged from "
+                                 "the LoRA-disabled engine"}
+    overhead = max(0.0, off_tokps / frac_legs["0pct"] - 1.0)
+
+    # Dedicated-engine identity on the 100% leg: request i ran under
+    # tenants[i % 8]; a single-adapter engine must reproduce it.
+    identical = True
+    for i, aid in enumerate(tenants):
+        solo = mk(rank)
+        solo.register_adapter(aid, adapter(i, rank))
+        mine = [j for j in range(n_req) if j % len(tenants) == i]
+        rids = [solo.submit(prompts[j], max_new, adapter_id=aid)
+                for j in mine]
+        out = solo.drain()
+        identical &= all(out[rid] == streams_100[j]
+                         for j, rid in zip(mine, rids))
+
+    sweep = {}
+    for r in (4, 8):
+        for n_adapters in (1, 4, 8):
+            dense = mk(r)
+            ids = tenants[:n_adapters]
+            for i, aid in enumerate(ids):
+                dense.register_adapter(aid, adapter(i, r))
+            tokps, _ = leg(dense, [ids[i % n_adapters]
+                                   for i in range(n_req)])
+            sweep[f"rank{r}_adapters{n_adapters}"] = tokps
+
+    # Drain-free roll: adopt new weights with a stream mid-decode; the
+    # adopt call's wall time is the swap latency the step loop pays
+    # (flush + install), and nothing may drop.
+    roll = mk(0)
+    rid_old = roll.submit(prompts[0], max_new)
+    while len(roll._requests[rid_old].tokens) < 2:
+        roll.step()
+    bumped = jax.tree_util.tree_map(lambda a: a + 0.01, params)
+    t0 = time.perf_counter()
+    roll.adopt_params(bumped, generation=1)
+    adopt_ms = (time.perf_counter() - t0) * 1e3
+    rid_new = roll.submit(prompts[1], max_new)
+    out = roll.drain()
+    dropped = sum(1 for r in (rid_old, rid_new)
+                  if len(out[r]) != max_new)
+
+    result = {
+        "workload": {"requests": n_req, "prompt_len": plen,
+                     "max_new": max_new, "rank": rank, "slots": 8},
+        "lora_disabled_tokens_per_s": off_tokps,
+        "adapter_fraction_tokens_per_s": frac_legs,
+        "adapterless_overhead_frac": round(overhead, 4),
+        "density_sweep_tokens_per_s": sweep,
+        "mixed_batch_streams_identical": identical,
+        "swap_roll": {"adopt_ms": round(adopt_ms, 2),
+                      "dropped_streams": dropped},
+    }
+    if not identical:
+        result["ERROR"] = ("mixed-batch streams DIVERGED from dedicated "
+                           "single-adapter engines")
+    return result
 
 
 def bench_serving_multichip(tps=(1, 8), n_requests: int = 16,
@@ -3399,6 +3552,13 @@ def _parse_args(argv):
              "the host rung, the batch-32 overlap/offload leg, and the "
              "int4-over-int8 density ratio; exits nonzero if greedy "
              "streams diverge across tiers")
+    serving.add_argument(
+        "--lora-only", action="store_true", dest="lora_only",
+        help="run only the multi-tenant LoRA legs (also `make "
+             "bench-lora`): adapter-fraction and adapters-per-replica "
+             "tok/s, the adapter-less overhead pin, and the drain-free "
+             "weight-roll latency; exits nonzero if any mixed-batch "
+             "stream diverges from a dedicated single-adapter engine")
     fleet_cmd = sub.add_parser(
         "fleet",
         help="fleet-serving section only (also `make bench-fleet`): "
@@ -3576,6 +3736,11 @@ if __name__ == "__main__":
             print(json.dumps({"serving": {"tiering": result}}))
             raise SystemExit(0 if result["resume_streams_identical"]
                              else 1)
+        if args.lora_only:
+            result = _bench_lora(seed=args.seed)
+            print(json.dumps({"serving": {"adapters": result}}))
+            raise SystemExit(
+                0 if result.get("mixed_batch_streams_identical") else 1)
         tps = tuple(int(t) for t in str(args.tp or "1,8").split(",")
                     if t.strip())
         # Force virtual devices only on an EXPLICIT --tp: the single-chip

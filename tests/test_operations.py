@@ -207,7 +207,8 @@ def test_healthz_reports_drain_and_queue_depth(stub_replica):
     {ok, draining, queue_depth} and can route accordingly."""
     body = _get(stub_replica.url + "/healthz")
     assert body == {"ok": True, "boot_id": stub_replica.boot_id,
-                    "draining": False, "queue_depth": 3}
+                    "draining": False, "queue_depth": 3,
+                    "generation": 0}
     stub_replica.begin_drain()
     body = _get(stub_replica.url + "/healthz")
     assert body["ok"] is True and body["draining"] is True

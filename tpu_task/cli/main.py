@@ -327,9 +327,10 @@ def cmd_sched(args) -> int:
                "SHARE", "DEFICIT", "REQUEUES", "QLAT-P50", "QLAT-P99",
                "DONE", "FAILED")
     rows = []
-    services = []     # (service, tenant, replicas) footer lines
+    services = []     # (service, tenant, replicas, gens) footer lines
 
-    def tenant_rows(tenant, batch, serve, tenant_cols, svc_map):
+    def tenant_rows(tenant, batch, serve, tenant_cols, svc_map,
+                    svc_gens=None):
         out = []
         b_queued, b_running, b_chips, b_done, b_failed = batch
         s_queued, s_replicas, s_chips, s_done, s_failed = serve
@@ -344,7 +345,8 @@ def cmd_sched(args) -> int:
                                                    else ""),
                         f"{s_chips}", *blanks, s_done, s_failed))
             for service, replicas in sorted(svc_map.items()):
-                services.append((service, tenant, replicas))
+                services.append((service, tenant, replicas,
+                                 (svc_gens or {}).get(service) or []))
         return out
 
     if snapshot is not None:
@@ -367,7 +369,8 @@ def cmd_sched(args) -> int:
                  *(("%gs" % latency["p50_s"], "%gs" % latency["p99_s"])
                    if (latency := info.get("queue_latency") or {}).get(
                        "count") else ("-", "-"))),
-                serve.get("services", {}))
+                serve.get("services", {}),
+                serve.get("service_generations", {}))
     else:
         # No snapshot (scheduler never ticked): fold the queue records.
         for tenant, tasks in sorted(queue.by_tenant().items()):
@@ -398,9 +401,18 @@ def cmd_sched(args) -> int:
                  "-", "-"),
                 svc_map)
     _print_table(columns, rows)
-    for service, tenant, replicas in services:
+    for service, tenant, replicas, gens in services:
+        # One generation = steady state; several = a live weight roll in
+        # flight (replicas adopt the published checkpoint one by one).
+        if len(gens) == 1:
+            tail = f", weights gen {gens[0]}"
+        elif len(gens) > 1:
+            tail = (", rolling weights gen "
+                    + "/".join(str(g) for g in gens))
+        else:
+            tail = ""
         print(f"serve: {service} ({tenant}) — {replicas} replica"
-              f"{'s' if replicas != 1 else ''} placed")
+              f"{'s' if replicas != 1 else ''} placed{tail}")
     if snapshot is not None:
         pool = snapshot.get("pool", {})
         print(f"pool: {pool.get('used_chips', 0)}/"
@@ -606,6 +618,19 @@ def _watch_frame(merged, alerts, remote: str) -> str:
             f"  shipped {value('kvfleet.bytes_shipped') / 1e6:.2f}MB"
             f"  fetched {value('kvfleet.bytes_fetched') / 1e6:.2f}MB"
             f"  handoffs {int(value('router.handoffs'))}")
+    if any(name.startswith("adapters.") for name in merged):
+        # Multi-tenant density in one line: adapter residency/churn plus
+        # the live weight generation (stale streams > 0 = a roll is
+        # mid-flight, old streams still pinned to prior weights).
+        lines.append(
+            f"adapters  registered {int(value('adapters.registered'))}"
+            f"  resident {int(value('adapters.resident'))}"
+            f"  loads {int(value('adapters.loads'))}"
+            f"  evictions {int(value('adapters.evictions'))}"
+            f"  gen {int(value('engine.param_generation'))}"
+            f"  swaps {int(value('engine.param_swaps'))}"
+            f"  stale-streams "
+            f"{int(value('engine.stale_generation_streams'))}")
     rows = []
     for name, entry in sorted(merged.items()):
         if entry.get("type") != "histogram" or not entry.get("count"):

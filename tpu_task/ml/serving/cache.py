@@ -223,6 +223,16 @@ class ServingConfig:
       attached (otherwise they drop — recompute-from-prefix covers the
       miss, never a wrong stream). Requires ``prefix_cache`` (the tier
       is content-addressed by the cache's chained block hashes).
+    - ``lora_rank``: rank of the paged LoRA adapter pool (docs/parity.md
+      "Multi-model tenancy"). 0 (default) disables multi-tenant
+      adapters. With a rank, every fused program gathers per-slot
+      adapter blocks and applies batched shrink/expand; adapter-less
+      slots ride the all-zero scratch block (exact no-op). Adapters
+      registered at a smaller rank zero-pad to this pool rank.
+    - ``n_adapter_blocks``: capacity of the adapter block pool. One
+      block holds one layer of one adapter, so a resident adapter costs
+      ``n_layers`` blocks and block 0 is the zero scratch block (same
+      convention as the KV pool). Required >= 2 when ``lora_rank`` > 0.
     """
 
     slots: int = 8
@@ -240,6 +250,8 @@ class ServingConfig:
     overlap: bool = False
     prefill_slots: int = 1
     host_offload_blocks: int = 0
+    lora_rank: int = 0
+    n_adapter_blocks: int = 0
 
     def __post_init__(self):
         if self.slots < 1:
@@ -316,6 +328,17 @@ class ServingConfig:
                 "host_offload_blocks needs prefix_cache=True: the host "
                 "tier is content-addressed by the cache's chained block "
                 "hashes")
+        if self.lora_rank < 0:
+            raise ValueError(
+                f"lora_rank must be >= 0, got {self.lora_rank}")
+        if self.n_adapter_blocks < 0:
+            raise ValueError(
+                f"n_adapter_blocks must be >= 0, got "
+                f"{self.n_adapter_blocks}")
+        if self.lora_rank > 0 and self.n_adapter_blocks < 2:
+            raise ValueError(
+                f"lora_rank > 0 needs n_adapter_blocks >= 2 (block 0 is "
+                f"the zero scratch block), got {self.n_adapter_blocks}")
 
     @property
     def max_blocks_per_slot(self) -> int:

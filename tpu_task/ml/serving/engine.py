@@ -118,6 +118,13 @@ from tpu_task.ml.serving.cache import (
     staged_block_to_bytes,
     write_blocks,
 )
+from tpu_task.ml.serving.lora import (
+    adapter_fingerprint,
+    adapter_payload,
+    init_adapter_pool,
+    pack_adapter,
+    split_adapter_payload,
+)
 from tpu_task.ml.serving.offload import HostKvTier
 from tpu_task.ml.serving.model import (
     chunk_carry_greedy,
@@ -261,6 +268,17 @@ class Request:
     #: change a stream's values, only when/whether it runs.
     slo_class: str = "standard"
     deadline: Optional[float] = None
+    #: LoRA adapter this stream decodes under (None = the base model —
+    #: its slot rides the all-zero scratch block, an exact no-op). Set
+    #: at submit/resume, validated against the registry, round-tripped
+    #: through export/resume records.
+    adapter_id: Optional[str] = None
+    #: Param generation this stream is PINNED to: assigned at submit
+    #: time, never changed — a weight roll (adopt_params) moves new
+    #: admissions to the new generation while this stream keeps
+    #: decoding under the one it started on (docs/parity.md
+    #: "Multi-model tenancy").
+    generation: int = 0
 
     @property
     def finished(self) -> bool:
@@ -288,7 +306,8 @@ class ServingEngine:
                  rng: Optional[jax.Array] = None, mesh=None,
                  draft_params: Optional[Params] = None,
                  draft_cfg: Optional[TransformerConfig] = None,
-                 obs: Optional[Obs] = None, kv_fleet=None):
+                 obs: Optional[Obs] = None, kv_fleet=None,
+                 param_loader=None):
         self.cfg = cfg
         self.scfg = scfg = scfg or ServingConfig()
         self.mesh = mesh
@@ -296,7 +315,7 @@ class ServingEngine:
         self.ep = 1
         pools = init_pools(cfg, scfg)
         if mesh is None:
-            self.params = params
+            self._gen_params: Dict[int, Params] = {0: params}
             self.pools = pools
         else:
             # Multi-chip serving: weights lay out per the SAME logical
@@ -326,7 +345,8 @@ class ServingEngine:
             serving_moe_fn(cfg, mesh)
             self._param_specs = transformer.param_pspecs(cfg, mesh=mesh)
             self._pool_specs = pool_pspecs(pools, mesh)
-            self.params = device_put_tree(params, self._param_specs, mesh)
+            self._gen_params = {
+                0: device_put_tree(params, self._param_specs, mesh)}
             self.pools = device_put_tree(pools, self._pool_specs, mesh)
         #: The expert-parallel MoE dispatch threading through every fused
         #: step (None = the dense-dispatch reference — single chip, or a
@@ -407,6 +427,55 @@ class ServingEngine:
         #: edge later, after the program the reads enqueued behind has
         #: completed — never on the dispatch path.
         self._pending_demotions: List[Tuple[bytes, int, List]] = []
+
+        # Paged LoRA adapters (ISSUE 19): multi-tenant fine-tunes page
+        # through a second BlockAllocator over a device pool of
+        # (2, rank, d_model) per-layer blocks — registered content-
+        # addressed, gathered per slot inside every fused step, and
+        # LRU-evicted/reloaded through the kvfleet plane like demoted
+        # KV. Single-chip for now (like overlap/kv_fleet): the pool is
+        # unsharded and the per-slot gather replicates.
+        self._lora_on = scfg.lora_rank > 0
+        if self._lora_on and mesh is not None:
+            raise ValueError(
+                "lora_rank > 0 is single-chip for now: the adapter pool "
+                "is unsharded (attach adapters to a mesh=None engine)")
+        #: adapter_id -> {hash, scale, payload (host np copy or None),
+        #: blocks (resident pool blocks or None), last_use, refs
+        #: (slotted requests decoding under it — the eviction pin)}.
+        self._adapters: Dict[str, dict] = {}
+        self._lora_alloc: Optional[BlockAllocator] = None
+        self._lora_pool = None
+        if self._lora_on:
+            self._lora_alloc = BlockAllocator(scfg.n_adapter_blocks)
+            self._lora_pool = init_adapter_pool(
+                scfg.n_adapter_blocks, scfg.lora_rank, cfg.d_model,
+                cfg.dtype)
+        #: Per-slot gather tables the fused programs consume: row i of
+        #: _slot_lora_blocks is slot i's per-layer adapter block (0 =
+        #: scratch = exact no-op), _slot_lora_scale its LoRA scale.
+        self._slot_lora_blocks = np.zeros(
+            (scfg.slots, cfg.n_layers), np.int32)
+        self._slot_lora_scale = np.zeros((scfg.slots,), np.float32)
+        self.adapters_registered = 0
+        self.adapter_loads = 0
+        self.adapter_evictions = 0
+
+        # Live weight hot-swap (ISSUE 19): params are double-buffered
+        # by GENERATION — adopt_params installs a new pytree under the
+        # next generation, new admissions bind to it, every in-flight
+        # stream keeps decoding under the generation it started on
+        # (step() partitions dispatches by generation while slots span
+        # a roll), and an old buffer frees when its last stream
+        # retires. param_loader(generation) -> params (set by the
+        # replica) restores an already-freed generation so a resumed
+        # stream can pin it instead of silently decoding under new
+        # weights.
+        self.generation = 0
+        self._gen_streams: Dict[int, int] = {}
+        self._gen_filter: Optional[int] = None
+        self.param_swaps = 0
+        self.param_loader = param_loader
 
         # Asynchronous engine loop (ROADMAP item 4, the overlap PR): the
         # host sweep of micro-step N runs while the device executes
@@ -549,6 +618,31 @@ class ServingEngine:
             # replica /stats surface (configured K vs what actually ran).
             metrics.gauge_fn("engine.micro_k",
                              lambda scfg=scfg: float(scfg.micro_k))
+            # Multi-tenant serving (ISSUE 19): the param-generation roll
+            # and adapter residency, on the one registry so replica
+            # /stats, /metrics, and `obs watch` all see a mid-roll
+            # replica and its tenant density.
+            metrics.gauge_fn("engine.param_generation",
+                             lambda self=self: float(self.generation))
+            metrics.counter_fn("engine.param_swaps",
+                               lambda self=self: float(self.param_swaps))
+            metrics.gauge_fn("engine.stale_generation_streams",
+                             lambda self=self:
+                             float(self.stale_generation_streams))
+            if self._lora_on:
+                for stat, name in (("adapters_registered", "registered"),
+                                   ("adapter_loads", "loads"),
+                                   ("adapter_evictions", "evictions")):
+                    metrics.counter_fn(f"adapters.{name}",
+                                       lambda self=self, stat=stat:
+                                       float(getattr(self, stat)))
+                metrics.gauge_fn("adapters.resident",
+                                 lambda self=self: float(sum(
+                                     1 for a in self._adapters.values()
+                                     if a["blocks"] is not None)))
+                metrics.gauge_fn("adapters.pool_high_water",
+                                 lambda self=self:
+                                 float(self._lora_alloc.high_water))
             if kv_fleet is not None:
                 # The fleet-KV counters the obs satellite names: block
                 # hit/miss at admission, bytes shipped out by the
@@ -1051,7 +1145,8 @@ class ServingEngine:
                key: Optional[jax.Array] = None,
                trace: Optional[TraceContext] = None,
                slo_class: str = "standard",
-               deadline_s: Optional[float] = None) -> int:
+               deadline_s: Optional[float] = None,
+               adapter_id: Optional[str] = None) -> int:
         """Queue a generation request; returns its id. Same sampling
         contract as ``generate``: temperature 0 is greedy, ``top_p`` needs
         temperature > 0. ``key`` overrides the engine-derived per-request
@@ -1069,6 +1164,14 @@ class ServingEngine:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         if top_p is not None and temperature == 0:
             raise ValueError("top_p needs temperature > 0 (greedy ignores it)")
+        if adapter_id is not None:
+            if not self._lora_on:
+                raise ValueError(
+                    "adapter_id needs lora_rank > 0 in the ServingConfig")
+            if adapter_id not in self._adapters:
+                raise ValueError(
+                    f"unknown adapter {adapter_id!r} — register_adapter "
+                    "first")
         if self.scfg.prefill == "bucketed":
             self.scfg.bucket_for(len(prompt))  # must fit a prefill bucket
         total = len(prompt) + max_new_tokens
@@ -1093,8 +1196,11 @@ class ServingEngine:
             eos_token=eos_token, key=key,
             submit_t=now, trace=trace, slo_class=str(slo_class),
             deadline=None if deadline_s is None
-            else now + float(deadline_s))
+            else now + float(deadline_s),
+            adapter_id=adapter_id, generation=self.generation)
         self._requests[rid] = req
+        self._gen_streams[req.generation] = \
+            self._gen_streams.get(req.generation, 0) + 1
         self._queue.append(req)
         self._obs_queue(req)
         return rid
@@ -1125,7 +1231,12 @@ class ServingEngine:
                 "top_p": req.top_p,
                 "eos_token": req.eos_token,
                 "slo_class": req.slo_class,
+                # The weights the stream decodes under — the importer
+                # pins this generation or restores it before decoding.
+                "generation": req.generation,
             }
+            if req.adapter_id is not None:
+                record["adapter_id"] = req.adapter_id
             if req.deadline is not None:
                 # Deadlines cross processes as REMAINING seconds (no
                 # shared monotonic clock), clamped at 0 — an expired
@@ -1187,6 +1298,17 @@ class ServingEngine:
                     self.scfg.bucket_for(len(prompt) + len(tokens))
                 except ValueError:
                     tokens = []
+            aid = record.get("adapter_id")
+            if aid is not None:
+                if not self._lora_on:
+                    raise ValueError(
+                        f"resume record pins adapter {aid!r} but this "
+                        "engine has lora_rank 0")
+                if aid not in self._adapters:
+                    raise ValueError(
+                        f"resume record pins adapter {aid!r} — "
+                        "register_adapter on the importer first")
+            gen = int(record.get("generation", self.generation))
             rid = self._next_rid
             self._next_rid += 1
             key = _check_key(record["key"])
@@ -1201,12 +1323,34 @@ class ServingEngine:
                 resume_from=len(tokens), trace=trace,
                 slo_class=str(record.get("slo_class", "standard")),
                 deadline=None if deadline_s is None
-                else now + float(deadline_s))
+                else now + float(deadline_s),
+                adapter_id=aid, generation=gen)
             self._requests[rid] = req
             if req.finished:
                 req.status = DONE
                 req.finish_t = time.monotonic()
             else:
+                if gen not in self._gen_params:
+                    # The record pins a generation this engine does not
+                    # hold. Restore it through the param loader rather
+                    # than silently continuing the stream under
+                    # different weights.
+                    if self.param_loader is None:
+                        raise ValueError(
+                            f"resume record pins param generation {gen}, "
+                            "which is not resident and no param_loader "
+                            "could restore it — refusing to decode the "
+                            "stream under different weights")
+                    restored = self.param_loader(gen)
+                    if restored is None:
+                        raise ValueError(
+                            f"resume record pins param generation {gen} "
+                            "and the param_loader returned nothing — "
+                            "refusing to decode the stream under "
+                            "different weights")
+                    self._gen_params[gen] = restored
+                self._gen_streams[gen] = \
+                    self._gen_streams.get(gen, 0) + 1
                 if self._goodput is not None and tokens:
                     # The imported prefix is re-ingested context another
                     # engine already produced — work the goodput ratio
@@ -1233,6 +1377,205 @@ class ServingEngine:
         return list(req.tokens)
 
     @property
+    def params(self) -> Params:
+        """The ACTIVE generation's weights — what new admissions bind
+        to. Older generations stay resident in ``_gen_params`` while
+        any of their streams is in flight (:meth:`adopt_params`)."""
+        return self._gen_params[self.generation]
+
+    @property
+    def stale_generation_streams(self) -> int:
+        """In-flight streams still pinned to a non-active generation —
+        the mid-roll gauge; 0 means the roll is complete and exactly
+        one params buffer is resident."""
+        return sum(c for g, c in self._gen_streams.items()
+                   if g != self.generation)
+
+    def adopt_params(self, params: Params,
+                     generation: Optional[int] = None) -> int:
+        """Install a new weight generation WITHOUT dropping a stream —
+        the drain-free half of the hot swap: new admissions bind to the
+        new params immediately, every in-flight stream keeps decoding
+        under the generation it started on (step() partitions
+        dispatches by generation until the old streams retire), and the
+        old buffer frees when its last stream leaves. ``generation``
+        defaults to the next integer; the replica passes the published
+        checkpoint step so /healthz reports WHICH weights are live.
+        Returns the installed generation."""
+        if self.mesh is not None:
+            raise ValueError(
+                "adopt_params is single-chip for now: sharded gangs "
+                "re-shard new params by building a fresh engine")
+        gen = self.generation + 1 if generation is None else int(generation)
+        if gen <= self.generation:
+            raise ValueError(
+                f"param generation must grow monotonically: got {gen}, "
+                f"active is {self.generation}")
+        if self._overlap:
+            # The in-flight program was dispatched under the old
+            # generation's params — sweep it before the active pointer
+            # moves, so no future sweep replays a stale dispatch.
+            self.flush()
+        self._gen_params[gen] = params
+        self.generation = gen
+        self.param_swaps += 1
+        # Free every non-active generation with no streams left — the
+        # common roll (idle or all-current slots) frees the old buffer
+        # here rather than waiting for a retirement edge.
+        for g in [g for g in self._gen_params
+                  if g != gen and not self._gen_streams.get(g, 0)]:
+            del self._gen_params[g]
+        return gen
+
+    def _gen_release(self, req: Request) -> None:
+        """One stream retired: drop its generation's stream refcount
+        and free any non-active generation whose last stream just left
+        — the double-buffer release edge of the hot swap."""
+        g = req.generation
+        left = self._gen_streams.get(g, 0) - 1
+        if left > 0:
+            self._gen_streams[g] = left
+            return
+        self._gen_streams.pop(g, None)
+        if g != self.generation:
+            self._gen_params.pop(g, None)
+
+    # -- paged LoRA adapters -------------------------------------------------
+
+    def register_adapter(self, adapter_id: str, layers,
+                         scale: float = 1.0, *,
+                         host_copy: bool = True) -> str:
+        """Register a tenant's LoRA adapter under ``adapter_id``:
+        ``layers`` is one (A (d, r), B (r, d)) pair per model layer
+        (``{"a": ..., "b": ...}`` dicts or tuples; any r <= lora_rank,
+        zero-padded — see :func:`lora.pack_adapter`). The packed
+        payload is content-hashed (same weights + scale → same hash on
+        every replica) and shipped to the fleet bucket when a kv_fleet
+        client is attached, so reloads — and other replicas'
+        registrations — move no duplicate bytes. Residency is lazy:
+        pool blocks are claimed at first use, and cold refcount-0
+        adapters LRU-evict under pool pressure, reloading from the
+        host copy (``host_copy=True``) or the bucket. Returns the
+        content hash."""
+        if not self._lora_on:
+            raise ValueError(
+                "register_adapter needs lora_rank > 0 (and "
+                "n_adapter_blocks) in the ServingConfig")
+        payload = pack_adapter(layers, self.scfg.lora_rank,
+                               self.cfg.d_model)
+        if payload.shape[0] != self.cfg.n_layers:
+            raise ValueError(
+                f"adapter carries {payload.shape[0]} layers, the model "
+                f"has {self.cfg.n_layers}")
+        if self.cfg.n_layers > self.scfg.n_adapter_blocks - 1:
+            raise ValueError(
+                f"one adapter needs {self.cfg.n_layers} blocks but the "
+                f"pool holds {self.scfg.n_adapter_blocks - 1} — raise "
+                "n_adapter_blocks")
+        h = adapter_fingerprint(payload, float(scale))
+        existing = self._adapters.get(adapter_id)
+        if existing is not None:
+            if existing["hash"] == h:
+                return h              # same content: keep residency
+            if existing["refs"]:
+                raise ValueError(
+                    f"adapter {adapter_id!r} re-registered with "
+                    "different weights while streams decode under it — "
+                    "retire them first (or register a new id)")
+            if existing["blocks"] is not None:
+                self._evict_adapter(adapter_id)
+        can_ship = self._fleet is not None \
+            and hasattr(self._fleet, "ship_adapter")
+        if not host_copy and not can_ship:
+            raise ValueError(
+                "host_copy=False needs an attached kv_fleet client "
+                "with ship_adapter: an evicted adapter must have "
+                "somewhere to reload from")
+        if can_ship:
+            self._fleet.ship_adapter(
+                h, adapter_payload(payload, float(scale)))
+        self._adapters[adapter_id] = {
+            "hash": h, "scale": float(scale),
+            "payload": payload if host_copy else None,
+            "blocks": None, "last_use": 0.0, "refs": 0,
+        }
+        self.adapters_registered += 1
+        return h
+
+    def _evict_adapter(self, adapter_id: str) -> None:
+        """Return a cold adapter's blocks to the pool (its bytes need
+        no scrubbing — no slot table points at freed blocks, and the
+        next load overwrites them)."""
+        entry = self._adapters[adapter_id]
+        for b in entry["blocks"]:
+            self._lora_alloc.decref(int(b))
+        entry["blocks"] = None
+        self.adapter_evictions += 1
+
+    def _ensure_adapter_resident(self, adapter_id: str) -> dict:
+        """The adapter's registry entry with its pool blocks resident,
+        loading (and LRU-evicting cold refcount-0 adapters) on a miss —
+        the KV pool's evict-then-reload discipline applied to adapter
+        bytes. A reload with no host copy fetches from the fleet bucket
+        by content hash; any failure raises rather than decode under
+        wrong weights."""
+        entry = self._adapters[adapter_id]
+        entry["last_use"] = time.monotonic()
+        if entry["blocks"] is not None:
+            return entry
+        n_layers = self.cfg.n_layers
+        while self._lora_alloc.available < n_layers:
+            cold = [(aid, e) for aid, e in self._adapters.items()
+                    if e["blocks"] is not None and not e["refs"]]
+            if not cold:
+                raise RuntimeError(
+                    "adapter pool exhausted with every resident adapter "
+                    "in use — raise n_adapter_blocks")
+            self._evict_adapter(
+                min(cold, key=lambda kv: kv[1]["last_use"])[0])
+        blocks = self._lora_alloc.alloc(n_layers)
+        payload = entry["payload"]
+        if payload is None:
+            data = (self._fleet.fetch_adapter(entry["hash"])
+                    if self._fleet is not None
+                    and hasattr(self._fleet, "fetch_adapter") else None)
+            if data is None:
+                for b in blocks:
+                    self._lora_alloc.decref(b)
+                raise RuntimeError(
+                    f"adapter {adapter_id!r} evicted and its payload "
+                    f"({entry['hash']}) unavailable in the fleet bucket "
+                    "— refusing to decode under missing weights")
+            payload, _scale = split_adapter_payload(data)
+            if payload.shape != (n_layers, 2, self.scfg.lora_rank,
+                                 self.cfg.d_model):
+                for b in blocks:
+                    self._lora_alloc.decref(b)
+                raise RuntimeError(
+                    f"adapter {adapter_id!r} payload has foreign "
+                    f"geometry {payload.shape}")
+        self._lora_pool = self._lora_pool.at[jnp.asarray(blocks)].set(
+            jnp.asarray(payload, self._lora_pool.dtype))
+        entry["blocks"] = [int(b) for b in blocks]
+        self.adapter_loads += 1
+        return entry
+
+    def _bind_adapter(self, slot: int, req: Request) -> None:
+        """Point the slot's per-layer gather rows at its adapter's pool
+        blocks (scratch rows + scale 0 for adapter-less requests — the
+        exact-no-op path) and pin the adapter against eviction for the
+        slot's lifetime."""
+        if not self._lora_on or req.adapter_id is None:
+            self._slot_lora_blocks[slot] = 0
+            self._slot_lora_scale[slot] = 0.0
+            return
+        entry = self._ensure_adapter_resident(req.adapter_id)
+        entry["refs"] += 1
+        self._slot_lora_blocks[slot] = np.asarray(
+            entry["blocks"], np.int32)
+        self._slot_lora_scale[slot] = entry["scale"]
+
+    @property
     def n_active(self) -> int:
         return sum(r is not None for r in self._slots)
 
@@ -1252,40 +1595,69 @@ class ServingEngine:
         Returns what happened (request ids admitted/finished, active).
         With ``ServingConfig.overlap`` on, the iteration instead runs the
         asynchronous loop (:meth:`_step_overlapped`): dispatch the NEXT
-        program, then sweep the PREVIOUS one — results lag one step."""
+        program, then sweep the PREVIOUS one — results lag one step.
+
+        Mid-roll (streams pinned to more than one param generation in
+        flight after :meth:`adopt_params`) the step partitions its
+        dispatches BY generation: each partition runs the normal fused
+        programs under its own weights with the other partitions' slots
+        masked out exactly like empty slots. Keyed sampling makes every
+        stream schedule-independent, so the partitioned schedule emits
+        the same tokens each stream would see in a dedicated engine —
+        old streams finish under old weights, new ones run the new, and
+        nobody drops. The overlap loop requires a single generation;
+        the sync partitioned path carries the roll window."""
         if self._overlap:
-            return self._step_overlapped()
+            if all(r.generation == self.generation
+                   for r in list(self._slots) + list(self._queue)
+                   if r is not None):
+                return self._step_overlapped()
+            # Mid-roll: sweep the in-flight program and fall through to
+            # the synchronous partitioned body until the old streams
+            # retire.
+            self.flush()
         t0 = time.perf_counter() if self._obs is not None else 0.0
         if self._goodput is not None:
             self._goodput.begin_step()
         self.steps += 1
-        admitted, finished = [], []
+        admitted = []
+        finished = list(self._pending_finished)   # swept by a flush
+        self._pending_finished = []
         self._admit(admitted, finished)
-        if self.n_active:
-            prefilling = self.scfg.prefill == "chunked" and any(
-                self._prefilling(i) for i in range(self.scfg.slots))
-            if prefilling:
-                # With spec on, the chunk program advances ONLY the
-                # ingesting slot and the spec round below advances the
-                # decoders: a request's post-first tokens then ALWAYS come
-                # from the position-keyed spec streams, so its sampled
-                # stream is identical under any co-scheduling (the same
-                # schedule-independence the plain sampler's fold_in keys
-                # give the non-speculative engine).
-                self._chunk_step(finished)
-            if self._spec_on:
-                self._spec_step(finished)
-            elif not prefilling:
-                # One path per slot per scheduler step: a step with an
-                # admitting slot runs the packed chunk program above (the
-                # chunk IS that step's multi-token budget); pure-decode
-                # steady state runs the K-wide micro-step when configured
-                # (spec rounds, when on, are already the multi-token
-                # path). K=1 keeps the byte-identical per-token program.
-                if self.scfg.micro_k > 1:
-                    self._micro_decode(finished)
-                else:
-                    self._decode(finished)
+        gens = sorted({r.generation for r in self._slots if r is not None})
+        for g in (gens if len(gens) > 1 else [None]):
+            self._gen_filter = g
+            try:
+                if not any(self._gen_ok(r) for r in self._slots):
+                    continue
+                prefilling = self.scfg.prefill == "chunked" and any(
+                    self._gen_ok(self._slots[i]) and self._prefilling(i)
+                    for i in range(self.scfg.slots))
+                if prefilling:
+                    # With spec on, the chunk program advances ONLY the
+                    # ingesting slot and the spec round below advances the
+                    # decoders: a request's post-first tokens then ALWAYS
+                    # come from the position-keyed spec streams, so its
+                    # sampled stream is identical under any co-scheduling
+                    # (the same schedule-independence the plain sampler's
+                    # fold_in keys give the non-speculative engine).
+                    self._chunk_step(finished)
+                if self._spec_on:
+                    self._spec_step(finished)
+                elif not prefilling:
+                    # One path per slot per scheduler step: a step with an
+                    # admitting slot runs the packed chunk program above
+                    # (the chunk IS that step's multi-token budget);
+                    # pure-decode steady state runs the K-wide micro-step
+                    # when configured (spec rounds, when on, are already
+                    # the multi-token path). K=1 keeps the byte-identical
+                    # per-token program.
+                    if self.scfg.micro_k > 1:
+                        self._micro_decode(finished)
+                    else:
+                        self._decode(finished)
+            finally:
+                self._gen_filter = None
         # Synchronous-mode demotion: stage and force back-to-back — the
         # device is idle after the step's readback, so the blocking
         # force costs what it costs (the overlap loop is the path that
@@ -1557,13 +1929,15 @@ class ServingEngine:
         rec_pos = self._planned_pos.copy()
         if self._all_greedy():
             ys, qerr = self._launch(
-                self._micro_carry_greedy_fn, self.params, tok, pos, alive,
+                self._micro_carry_greedy_fn, self._model_params(), tok, pos,
+                alive,
                 emitted, jnp.asarray(self._tables), jnp.asarray(limits),
                 jnp.asarray(eos), qa=qa)
         else:
             temps, tops = self._temps_tops()
             ys, qerr = self._launch(
-                self._micro_carry_sample_fn, self.params, tok, pos, alive,
+                self._micro_carry_sample_fn, self._model_params(), tok, pos,
+                alive,
                 emitted, jnp.asarray(self._tables), jnp.asarray(limits),
                 jnp.asarray(eos), jnp.asarray(temps), jnp.asarray(tops),
                 jnp.asarray(self._slot_keys), qa=qa)
@@ -1633,7 +2007,19 @@ class ServingEngine:
         work = (len(decode) + int(cvalid.sum()),
                 float(sum(int(rec_pos[i]) for i in decode))
                 + float(cpos[cvalid].sum()))
-        base = (self.params, tok, pos_c, alive_c, emitted_c,
+        lblocks = lscales = None
+        if self._lora_on:
+            # Chunk rows inherit the owning slot's adapter rows (same
+            # expansion as the sync chunk path).
+            lblocks = np.zeros((n + W, self.cfg.n_layers), np.int32)
+            lscales = np.zeros((n + W,), np.float32)
+            lblocks[:n] = self._slot_lora_blocks
+            lscales[:n] = self._slot_lora_scale
+            for i, roff, c, _pos, _completing in rows:
+                lblocks[n + roff:n + roff + c] = self._slot_lora_blocks[i]
+                lscales[n + roff:n + roff + c] = self._slot_lora_scale[i]
+        base = (self._model_params(lblocks, lscales), tok, pos_c, alive_c,
+                emitted_c,
                 jnp.asarray(ctoks), jnp.asarray(cpos),
                 jnp.asarray(cvalid), jnp.asarray(tables),
                 jnp.asarray(limits), jnp.asarray(eos), jnp.asarray(prow),
@@ -2054,7 +2440,11 @@ class ServingEngine:
             ctx = self._context_ids(req)
             plen = len(ctx)
             cached: List[int] = []
-            if self._pcache is not None:
+            # Adapter-bearing requests SKIP the prefix cache both ways:
+            # their KV is adapter-dependent from layer 1 on (the LoRA
+            # delta feeds the next layer's projections), so base-model
+            # blocks must never seed them nor their blocks the cache.
+            if self._pcache is not None and req.adapter_id is None:
                 cached = self._pcache.lookup(ctx)          # increfs
                 if self._fleet is not None \
                         or self._host_tier is not None:
@@ -2109,6 +2499,7 @@ class ServingEngine:
             self._prefill_target[slot] = plen
             self._last_token[slot] = 0
             self._draft_pos[slot] = 0
+            self._bind_adapter(slot, req)
             if self._overlap:
                 # The slot's planned device state restarts with the new
                 # occupant: any still-unswept program dispatched against
@@ -2147,8 +2538,13 @@ class ServingEngine:
             table[:need] = blocks
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :len(ctx)] = ctx
+            self._bind_adapter(slot, req)
             logits = self._run_program(
-                self._prefill_fn, self.params, jnp.asarray(padded),
+                self._prefill_fn,
+                self._model_params(self._slot_lora_blocks[slot][None],
+                                   self._slot_lora_scale[slot:slot + 1],
+                                   gen=req.generation),
+                jnp.asarray(padded),
                 jnp.int32(len(ctx)), jnp.asarray(table))
             if self._quantized:
                 self.quantized_block_writes += need
@@ -2353,9 +2749,59 @@ class ServingEngine:
             [r.top_p if r else 1.0 for r in self._slots], np.float32)
         return temps, tops
 
+    def _gen_ok(self, req: Optional[Request]) -> bool:
+        """Does this slot participate in the CURRENT dispatch? A slot
+        is masked out when step() is partitioning by generation and the
+        request is pinned to a different one."""
+        return req is not None and (self._gen_filter is None
+                                    or req.generation == self._gen_filter)
+
+    def _dispatch_gen(self) -> int:
+        """Which generation's weights the next program runs under: the
+        partition being dispatched when step() is mid-partition, else
+        the single generation with live streams (post-swap streams
+        still draining), else the active generation."""
+        if self._gen_filter is not None:
+            return self._gen_filter
+        live = {g for g, c in self._gen_streams.items() if c}
+        if len(live) == 1:
+            return next(iter(live))
+        return self.generation
+
+    def _model_params(self, blocks=None, scales=None,
+                      gen: Optional[int] = None) -> Params:
+        """The params pytree a fused program closes over: the dispatch
+        generation's weights, plus — when LoRA is on — the adapter pool
+        and the per-row gather tables under the ``"lora"`` key (the
+        model fns read it with ``params.get("lora")``, so a LoRA-free
+        engine passes the identical pytree it always did and keeps its
+        bit-exact pins). ``blocks``/``scales`` default to the per-slot
+        tables; packed programs pass their own per-row expansion."""
+        base = self._gen_params[self._dispatch_gen() if gen is None
+                                else gen]
+        if not self._lora_on:
+            return base
+        if blocks is None:
+            blocks = self._slot_lora_blocks
+        if scales is None:
+            scales = self._slot_lora_scale
+        if not np.asarray(scales).any():
+            # No row in this dispatch carries an adapter (every slot is
+            # scratch-bound, scale 0) — drop the LoRA branch entirely
+            # and run the LoRA-free program. Bit-safe: scale-0 rows
+            # contribute exactly 0.0 either way. This is what pins the
+            # adapter-less overhead at ~0: a LoRA-ENABLED engine serving
+            # only base traffic dispatches the same program a LoRA-free
+            # engine does, paying for the pool only when a registered
+            # adapter is actually in the batch.
+            return base
+        return {**base, "lora": (self._lora_pool,
+                                 jnp.asarray(blocks, jnp.int32),
+                                 jnp.asarray(scales, jnp.float32))}
+
     def _decode(self, finished: list) -> None:
         self._ensure_blocks()
-        active = np.array([r is not None for r in self._slots])
+        active = np.array([self._gen_ok(r) for r in self._slots])
         if not active.any():
             return
         positions = np.where(active, self._positions, 0)
@@ -2364,7 +2810,7 @@ class ServingEngine:
               if self._quantized else None)
         if self._all_greedy():
             toks = self._run_program(
-                self._decode_greedy_fn, self.params,
+                self._decode_greedy_fn, self._model_params(),
                 jnp.asarray(self._last_token), jnp.asarray(positions),
                 jnp.asarray(self._tables), jnp.asarray(active), qa=qa)
         else:
@@ -2372,7 +2818,7 @@ class ServingEngine:
             ngen = np.array([len(r.tokens) if r else 0 for r in self._slots],
                             np.int32)
             toks = self._run_program(
-                self._decode_fn, self.params,
+                self._decode_fn, self._model_params(),
                 jnp.asarray(self._last_token), jnp.asarray(positions),
                 jnp.asarray(self._tables), jnp.asarray(active),
                 jnp.asarray(temps), jnp.asarray(tops),
@@ -2387,7 +2833,7 @@ class ServingEngine:
         toks = np.asarray(toks)
         now = time.monotonic()
         for slot, req in enumerate(self._slots):
-            if req is None:
+            if not self._gen_ok(req):
                 continue
             tok = int(toks[slot])
             req.tokens.append(tok)
@@ -2406,7 +2852,7 @@ class ServingEngine:
         block-reservation widths and the in-program retirement limits."""
         spans = np.zeros((self.scfg.slots,), np.int32)
         for i, req in enumerate(self._slots):
-            if req is not None:
+            if self._gen_ok(req):
                 spans[i] = min(self.scfg.micro_k,
                                req.max_new_tokens - len(req.tokens))
         return spans
@@ -2451,7 +2897,7 @@ class ServingEngine:
               if self._quantized else None)
         if self._all_greedy():
             toks = self._run_program(
-                self._micro_greedy_fn, self.params,
+                self._micro_greedy_fn, self._model_params(),
                 jnp.asarray(self._last_token), jnp.asarray(positions),
                 jnp.asarray(self._tables), jnp.asarray(active),
                 jnp.asarray(spans), jnp.asarray(eos), qa=qa)
@@ -2460,7 +2906,7 @@ class ServingEngine:
             ngen = np.array(
                 [len(r.tokens) if r else 0 for r in self._slots], np.int32)
             toks = self._run_program(
-                self._micro_sample_fn, self.params,
+                self._micro_sample_fn, self._model_params(),
                 jnp.asarray(self._last_token), jnp.asarray(positions),
                 jnp.asarray(self._tables), jnp.asarray(active),
                 jnp.asarray(spans), jnp.asarray(eos), jnp.asarray(temps),
@@ -2530,7 +2976,7 @@ class ServingEngine:
             budget = W
             for i in sorted(range(n), key=lambda j: self._admit_seq[j]):
                 req = self._slots[i]
-                if req is None:
+                if not self._gen_ok(req):
                     continue
                 pos = int(self._positions[i])
                 target = int(self._prefill_target[i])
@@ -2591,17 +3037,31 @@ class ServingEngine:
             rows[i] = (off, c, pos)
             off += c
         pos_masked = np.where(active, positions, 0)
+        lblocks = lscales = None
+        if self._lora_on:
+            # Per-row adapter tables for the packed batch: decode rows
+            # keep their slot's rows, each chunk row inherits its owning
+            # slot's.
+            lblocks = np.zeros((R, self.cfg.n_layers), np.int32)
+            lscales = np.zeros((R,), np.float32)
+            lblocks[:n] = self._slot_lora_blocks
+            lscales[:n] = self._slot_lora_scale
+            for i, (off, c, _pos) in rows.items():
+                lblocks[n + off:n + off + c] = self._slot_lora_blocks[i]
+                lscales[n + off:n + off + c] = self._slot_lora_scale[i]
         qa = (self._quant_layout(tables, pos_masked[:, None],
                                  active[:, None])
               if self._quantized else None)
         if self._all_greedy():
             toks = self._run_program(
-                self._decode_greedy_fn, self.params, jnp.asarray(tokens),
+                self._decode_greedy_fn,
+                self._model_params(lblocks, lscales), jnp.asarray(tokens),
                 jnp.asarray(pos_masked), jnp.asarray(tables),
                 jnp.asarray(active), qa=qa)
         else:
             toks = self._run_program(
-                self._decode_fn, self.params, jnp.asarray(tokens),
+                self._decode_fn,
+                self._model_params(lblocks, lscales), jnp.asarray(tokens),
                 jnp.asarray(pos_masked), jnp.asarray(tables),
                 jnp.asarray(active), jnp.asarray(temps),
                 jnp.asarray(tops), jnp.asarray(keys),
@@ -2657,8 +3117,9 @@ class ServingEngine:
 
         def live(i: int) -> bool:
             # Mid-prompt slots advance through the chunk program, never a
-            # spec round — their row here stays fully masked.
-            return self._slots[i] is not None and not self._prefilling(i)
+            # spec round — their row here stays fully masked. Slots pinned
+            # to another generation wait for their own partition.
+            return self._gen_ok(self._slots[i]) and not self._prefilling(i)
 
         def eff() -> np.ndarray:
             ke = np.zeros((n,), np.int32)
@@ -2709,7 +3170,7 @@ class ServingEngine:
               if self._quantized else None)
         if self._all_greedy():
             scored = self._run_program(
-                self._spec_greedy_fn, self.params, jnp.asarray(tokens),
+                self._spec_greedy_fn, self._model_params(), jnp.asarray(tokens),
                 jnp.asarray(positions), jnp.asarray(valid),
                 jnp.asarray(self._tables), qa=qa)
             probs = None
@@ -2717,7 +3178,7 @@ class ServingEngine:
         else:
             temps, tops = self._temps_tops()
             probs = self._run_program(
-                self._spec_probs_fn, self.params, jnp.asarray(tokens),
+                self._spec_probs_fn, self._model_params(), jnp.asarray(tokens),
                 jnp.asarray(positions), jnp.asarray(valid),
                 jnp.asarray(self._tables), jnp.asarray(temps),
                 jnp.asarray(tops), qa=qa)
@@ -2874,7 +3335,15 @@ class ServingEngine:
         blocks cached instead of free."""
         req = self._slots[slot]
         live = self._tables[slot][self._tables[slot] != SCRATCH_BLOCK]
-        if self._pcache is not None and req is not None:
+        if self._lora_on:
+            if req is not None and req.adapter_id is not None:
+                entry = self._adapters.get(req.adapter_id)
+                if entry is not None and entry["refs"]:
+                    entry["refs"] -= 1
+            self._slot_lora_blocks[slot] = 0
+            self._slot_lora_scale[slot] = 0.0
+        if self._pcache is not None and req is not None \
+                and req.adapter_id is None:
             n_valid = int(self._positions[slot])
             n_full = n_valid // self.scfg.block_size
             if n_full:
@@ -2895,6 +3364,7 @@ class ServingEngine:
         req.status = DONE
         req.finish_t = time.monotonic()
         self._release(slot)
+        self._gen_release(req)
         self._obs_retire(req)
 
     # -- observability -------------------------------------------------------
@@ -3007,6 +3477,29 @@ class ServingEngine:
                 "accept_rate": round(
                     self.spec_accepted / self.spec_proposed, 4)
                 if self.spec_proposed else 0.0,
+            },
+            # Hot-swap state: the ACTIVE weight generation, how many
+            # rolls this engine has absorbed, and the per-generation
+            # in-flight stream counts — more than one key here means a
+            # roll is mid-flight (old streams draining under old
+            # weights).
+            "generation": self.generation,
+            "adapters": {
+                "enabled": self._lora_on,
+                "rank": self.scfg.lora_rank,
+                "pool_blocks": self.scfg.n_adapter_blocks,
+                "registered": self.adapters_registered,
+                "resident": sum(
+                    1 for e in self._adapters.values()
+                    if e["blocks"] is not None),
+                "loads": self.adapter_loads,
+                "evictions": self.adapter_evictions,
+                "pool_high_water": (self._lora_alloc.high_water
+                                    if self._lora_alloc else 0),
+                "param_swaps": self.param_swaps,
+                "stale_generation_streams": self.stale_generation_streams,
+                "generations": {str(g): c
+                                for g, c in sorted(self._gen_streams.items())},
             },
         }
         if self._obs is not None:

@@ -62,6 +62,7 @@ from tpu_task.ml.serving.cache import (
     quantized_append,
     token_slots,
 )
+from tpu_task.ml.serving.lora import apply_lora
 
 
 def pool_is_quantized(pools: List[dict]) -> bool:
@@ -179,9 +180,10 @@ def paged_prefill(params: Params, cfg: TransformerConfig, tokens, length,
     positions = jnp.arange(s)
     write_idx = token_slots(block_table, positions, block_size)
     x = embed_lookup(params["embed"].astype(cfg.dtype), tokens)
+    lora = params.get("lora")
     new_pools: List[dict] = []
     qerrs: List[jax.Array] = []
-    for layer, pool in zip(params["layers"], pools):
+    for layer_i, (layer, pool) in enumerate(zip(params["layers"], pools)):
         updated: dict = {}
 
         def attn_fn(q, k, v, pool=pool, updated=updated):
@@ -206,8 +208,16 @@ def paged_prefill(params: Params, cfg: TransformerConfig, tokens, length,
                     v[0]).reshape(pool["v"].shape)
             return gqa_cached_attention(q, k, v, positions)
 
+        x_in = x
         x, _aux = _block(x, layer, cfg, attn_fn, positions=positions,
                          moe_fn=moe_fn)
+        if lora is not None:
+            # Parallel adapter branch around the unmodified block:
+            # h += ((x @ A) * scale) @ B, gathered per row from the paged
+            # adapter pool; block 0 is all-zero, so a lora-less row adds
+            # an exact 0.0 (the rank-0 no-op contract, docs/parity.md).
+            lpool, lblocks, lscales = lora
+            x = x + apply_lora(x_in, lpool, lblocks[:, layer_i], lscales)
         new_pools.append(updated)
     x = _rmsnorm(x, params["final_norm"])
     logits = x[:, length - 1] @ params["unembed"].astype(cfg.dtype)
@@ -247,9 +257,10 @@ def paged_decode_step(params: Params, cfg: TransformerConfig, tokens,
     write_idx = jnp.where(
         active, token_slots(block_tables, positions, block_size), 0)
     x = embed_lookup(params["embed"].astype(cfg.dtype), tokens[:, None])
+    lora = params.get("lora")
     new_pools: List[dict] = []
     qerrs: List[jax.Array] = []
-    for layer, pool in zip(params["layers"], pools):
+    for layer_i, (layer, pool) in enumerate(zip(params["layers"], pools)):
         updated: dict = {}
 
         def attn_fn(q, k, v, pool=pool, updated=updated):
@@ -276,8 +287,12 @@ def paged_decode_step(params: Params, cfg: TransformerConfig, tokens,
             v_view = gather_kv(vf, block_tables, block_size)
             return gqa_cached_attention(q, k_view, v_view, pos2d)
 
+        x_in = x
         x, _aux = _block(x, layer, cfg, attn_fn, positions=pos2d,
                          moe_fn=moe_fn)
+        if lora is not None:
+            lpool, lblocks, lscales = lora
+            x = x + apply_lora(x_in, lpool, lblocks[:, layer_i], lscales)
         new_pools.append(updated)
     x = _rmsnorm(x, params["final_norm"])
     logits = x[:, -1] @ params["unembed"].astype(cfg.dtype)
@@ -648,9 +663,10 @@ def _multitoken_features(params: Params, cfg: TransformerConfig, tokens,
     write_idx = jnp.where(
         valid, phys * block_size + qpos % block_size, 0).reshape(-1)
     x = embed_lookup(params["embed"].astype(cfg.dtype), tokens)
+    lora = params.get("lora")
     new_pools: List[dict] = []
     qerrs: List[jax.Array] = []
-    for layer, pool in zip(params["layers"], pools):
+    for layer_i, (layer, pool) in enumerate(zip(params["layers"], pools)):
         updated: dict = {}
 
         def attn_fn(q, k, v, pool=pool, updated=updated):
@@ -684,8 +700,12 @@ def _multitoken_features(params: Params, cfg: TransformerConfig, tokens,
             v_view = gather_kv(vf, block_tables, block_size)
             return gqa_cached_attention(q, k_view, v_view, qpos)
 
+        x_in = x
         x, _aux = _block(x, layer, cfg, attn_fn, positions=qpos,
                          moe_fn=moe_fn)
+        if lora is not None:
+            lpool, lblocks, lscales = lora
+            x = x + apply_lora(x_in, lpool, lblocks[:, layer_i], lscales)
         new_pools.append(updated)
     feats = _rmsnorm(x, params["final_norm"])
     if quantized:

@@ -382,10 +382,20 @@ class GangScheduler:
             serve = [task for task in backlog
                      if task.payload.get("kind") == "serve"]
             services: Dict[str, int] = {}
+            # Per-service active weight generations, as announced in the
+            # replicas' endpoint files and relayed by ServeFleet.tick()
+            # (empty when no fleet drives this scheduler). More than one
+            # generation under a service = a live weight roll mid-flight.
+            relayed = getattr(self, "serve_generations", {})
+            generations: Dict[str, list] = {}
             for task in serve:
                 if task.state == "placed":
                     name = task.payload.get("service", "?")
                     services[name] = services.get(name, 0) + 1
+                    if task.task_id in relayed:
+                        gens = generations.setdefault(name, [])
+                        if relayed[task.task_id] not in gens:
+                            gens.append(relayed[task.task_id])
             tenants[tenant] = {
                 "queued": sum(1 for task in backlog if task.schedulable),
                 "running_gangs": sum(1 for task in backlog
@@ -425,6 +435,9 @@ class GangScheduler:
                     "failed": sum(1 for task in serve
                                   if task.state == "failed"),
                     "services": dict(sorted(services.items())),
+                    "service_generations": {
+                        name: sorted(gens)
+                        for name, gens in sorted(generations.items())},
                 },
             }
         out = {

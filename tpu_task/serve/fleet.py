@@ -226,7 +226,8 @@ class InProcessServeDriver:
         self._servers[task.task_id] = server
         self._killed.pop(task.task_id, None)
         self.endpoints[task.task_id] = {
-            "url": server.url, "boot_id": server.boot_id}
+            "url": server.url, "boot_id": server.boot_id,
+            "generation": getattr(server.engine, "generation", 0)}
 
     def poll(self, task) -> str:
         if task.task_id in self._killed:
@@ -427,6 +428,13 @@ class ServeFleet:
         self.scheduler.tick()
         endpoints = self.refresh_endpoints()
         self.router.set_replicas(endpoints)
+        # Relay each replica's announced weight generation so the
+        # scheduler's status snapshot (and `sched status`) can show a
+        # fleet mid-way through a live weight roll.
+        self.scheduler.serve_generations = {
+            task_id: int(info["generation"])
+            for task_id, info in endpoints.items()
+            if info.get("generation") is not None}
         # Scale-up placement warmth (the SLA plane's brownout recovery):
         # a decode endpoint seen for the first time (or rebooted — new
         # boot id, cold cache) gets the prefix chains of the still-open

@@ -313,6 +313,40 @@ class FleetKvClient:
         self.bytes_fetched += len(data)
         return data
 
+    # -- adapters ------------------------------------------------------------
+    # LoRA adapter payloads ride the same content-addressed plane as KV
+    # blocks but under their own prefix and WITHOUT the KV length gate
+    # (an adapter payload's size varies with n_layers × rank, validated
+    # by the importer via lora.split_adapter_payload instead). A missed
+    # or torn fetch answers None — the engine raises rather than decode
+    # under wrong weights, the adapter analogue of degrade-to-prefill.
+    def _adapter_key(self, hash_hex: str) -> str:
+        index = self._require_bound()
+        return f"{index.namespace}/adapters/{hash_hex}"
+
+    def ship_adapter(self, hash_hex: str, payload: bytes) -> bool:
+        """Upload one packed adapter under its content hash
+        (write_if_absent — re-registering a known adapter ships
+        nothing). Returns whether bytes actually moved."""
+        try:
+            if self._backend.write_if_absent(
+                    self._adapter_key(hash_hex), payload):
+                self.bytes_shipped += len(payload)
+                return True
+        except OSError:
+            pass
+        return False
+
+    def fetch_adapter(self, hash_hex: str) -> Optional[bytes]:
+        """One adapter payload by content hash, or None on any failure."""
+        try:
+            data = self._backend.read(self._adapter_key(hash_hex))
+        except (OSError, ResourceNotFoundError):
+            self.fetch_misses += 1
+            return None
+        self.bytes_fetched += len(data)
+        return data
+
     def stats(self) -> dict:
         return {
             "source": self.source,

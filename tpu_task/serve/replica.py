@@ -290,6 +290,8 @@ class _JSONHandler(BaseHTTPRequestHandler):
             elif path == "/prefetch":
                 self._reply({"imported": replica.prefetch(
                     payload.get("hashes") or [])})
+            elif path == "/adapter":
+                self._reply(replica.register_adapter(payload))
             else:
                 self._reply({"error": f"no such path {path!r}"}, 404)
         except (KeyError, ValueError, TypeError) as error:
@@ -325,7 +327,9 @@ class ReplicaServer:
                  obs_enabled: bool = True, profile_dir: str = "profiles",
                  kv_client=None, kv_publish_every: int = 20,
                  tp: int = 1, ep: int = 1,
-                 max_queue: Optional[int] = None):
+                 max_queue: Optional[int] = None,
+                 ckpt_dir: Optional[str] = None,
+                 ckpt_poll_s: float = 0.5):
         self.boot_id = uuid.uuid4().hex[:12]
         #: One tracer + registry for the whole replica (front end AND
         #: engine — the engine records into the same registry, so /stats
@@ -363,6 +367,26 @@ class ReplicaServer:
         self.engine = engine if engine is not None else build_engine(
             preset, serving, obs=self.obs, kv_client=kv_client, tp=tp,
             ep=ep)
+        #: Live weight hot-swap (drain-free roll): when a checkpoint
+        #: directory is given, the step loop polls its publish marker
+        #: (``latest_step`` — the atomic LATEST pointer the async
+        #: checkpointer writes) every ``ckpt_poll_s`` and adopts any NEW
+        #: step via ``engine.adopt_params``: in-flight streams keep
+        #: their pinned generation, new admissions take the published
+        #: weights, zero streams drop. The step visible at BOOT is the
+        #: baseline, not loaded — the replica's constructor params are
+        #: its generation 0; only steps published after boot roll.
+        self.ckpt_dir = ckpt_dir
+        self.ckpt_poll_s = max(0.05, float(ckpt_poll_s))
+        self._ckpt_next_poll = 0.0
+        self._ckpt_step: Optional[int] = None
+        if ckpt_dir is not None:
+            from tpu_task.ml.checkpoint import latest_step
+            self._ckpt_step = latest_step(ckpt_dir)
+            # Resume records pinning an already-pruned generation
+            # restore through this loader instead of failing over to
+            # silently-different weights.
+            self.engine.param_loader = self._load_generation
         self.draining = False
         #: Admission bound for the front end: with this many requests
         #: already waiting in the engine's queue, /submit answers 429 +
@@ -428,6 +452,12 @@ class ReplicaServer:
             staged = None
             try:
                 with self._lock:
+                    if self.ckpt_dir is not None:
+                        # The hot-swap beat rides the step loop OUTSIDE
+                        # the has-work gate: an idle replica still rolls
+                        # to freshly published weights, so its next
+                        # admission decodes the new generation.
+                        self._poll_checkpoint()
                     if not self.draining and self.engine.has_work:
                         result = self.engine.step()
                         stepped = True
@@ -474,6 +504,54 @@ class ReplicaServer:
             if not stepped:
                 time.sleep(0.002)
 
+    def _poll_checkpoint(self) -> None:
+        """One hot-swap poll (caller holds the lock): if the async
+        checkpointer published a NEW step since the last look, restore
+        it and :meth:`~tpu_task.ml.serving.ServingEngine.adopt_params`
+        — in-flight streams keep decoding under their pinned
+        generation, new admissions take the new weights, nothing
+        drains and nothing drops. A torn or unreadable checkpoint is a
+        skipped beat (structured error, retry next poll), never a
+        crash or a partial adopt."""
+        now = time.monotonic()
+        if now < self._ckpt_next_poll:
+            return
+        self._ckpt_next_poll = now + self.ckpt_poll_s
+        from tpu_task.ml.checkpoint import latest_step, restore_checkpoint
+
+        try:
+            step = latest_step(self.ckpt_dir)
+        except OSError:
+            return
+        if step is None or step == self._ckpt_step \
+                or (self._ckpt_step is not None and step < self._ckpt_step):
+            return
+        try:
+            params = restore_checkpoint(
+                self.ckpt_dir, self.engine.params, step=step)
+        except (OSError, ValueError, KeyError) as error:
+            self.note_error("ckpt_poll", error)
+            return
+        self._ckpt_step = step
+        self.engine.adopt_params(
+            params,
+            generation=step if step > self.engine.generation else None)
+        if self.obs is not None:
+            self.obs.metrics.counter("replica.param_rolls").inc()
+
+    def _load_generation(self, generation: int):
+        """Engine ``param_loader``: restore a pinned generation (a
+        checkpoint step) a resume record references but the engine no
+        longer holds. None on a miss — the engine then refuses the
+        record rather than decode it under different weights."""
+        from tpu_task.ml.checkpoint import restore_checkpoint
+
+        try:
+            return restore_checkpoint(
+                self.ckpt_dir, self.engine.params, step=int(generation))
+        except (OSError, ValueError, KeyError):
+            return None
+
     def _ship_loop(self) -> None:
         """The background uploader: pulls staged publish batches off the
         bounded queue and ships them (device→host force + bucket
@@ -515,7 +593,13 @@ class ReplicaServer:
             return {"ok": True, "boot_id": self.boot_id,
                     "draining": self.draining,
                     "queue_depth": self.engine.queue_depth
-                    + self.engine.n_active}
+                    + self.engine.n_active,
+                    # The ACTIVE weight generation (checkpoint step once
+                    # a published roll has landed) — `sched status` and
+                    # the router read this to see mid-roll fleets.
+                    # getattr: test stubs implement only the submit/step
+                    # surface and never roll weights.
+                    "generation": getattr(self.engine, "generation", 0)}
 
     def metrics_text(self) -> str:
         """``GET /metrics``: the whole replica's registry (front end AND
@@ -586,6 +670,20 @@ class ReplicaServer:
                 self.engine.spec_enabled = bool(payload["spec"])
             return {"ok": True, "spec": bool(self.engine.spec_enabled)}
 
+    def register_adapter(self, payload: dict) -> dict:
+        """``POST /adapter``: register a tenant's LoRA adapter on this
+        replica — ``{"adapter_id": ..., "layers": [{"a": [[...]],
+        "b": [[...]]}, ...], "scale": ...}``. Returns the content hash
+        so the caller can verify every replica agreed on the bytes."""
+        adapter_id = str(payload["adapter_id"])
+        layers = payload["layers"]
+        with self._lock:
+            content = self.engine.register_adapter(
+                adapter_id, layers, scale=float(payload.get("scale", 1.0)))
+        if self.obs is not None:
+            self.obs.metrics.counter("replica.adapters_registered").inc()
+        return {"ok": True, "adapter_id": adapter_id, "hash": content}
+
     def submit(self, payload: dict,
                trace: Optional[TraceContext] = None,
                sla=None) -> int:
@@ -603,6 +701,7 @@ class ReplicaServer:
         if kwargs["eos_token"] is not None:
             kwargs["eos_token"] = int(kwargs["eos_token"])
         key = payload.get("key")
+        adapter_id = payload.get("adapter_id")
         tokens = [int(t) for t in payload.get("tokens") or ()]
         with self._lock:
             if tokens:
@@ -624,6 +723,10 @@ class ReplicaServer:
                     record["slo_class"] = slo_class
                 if deadline_s is not None:
                     record["deadline_s"] = deadline_s
+                if adapter_id is not None:
+                    record["adapter_id"] = str(adapter_id)
+                if payload.get("generation") is not None:
+                    record["generation"] = int(payload["generation"])
                 return next(iter(self.engine.resume_inflight(
                     [record], trace=trace).values()))
             # Fresh dispatch goes through submit (and ALL its argument
@@ -636,6 +739,8 @@ class ReplicaServer:
                 kwargs["slo_class"] = slo_class
             if deadline_s is not None:
                 kwargs["deadline_s"] = deadline_s
+            if adapter_id is not None:
+                kwargs["adapter_id"] = str(adapter_id)
             return self.engine.submit(
                 prompt, int(payload["max_new_tokens"]), trace=trace,
                 **kwargs)
@@ -744,6 +849,11 @@ def main(argv=None) -> int:
                              "cross-replica prefix-cache sharing; must be "
                              "the same bucket for every replica of the "
                              "service, NOT the replica's own task bucket")
+    parser.add_argument("--ckpt-dir", default="",
+                        help="checkpoint directory to poll for live "
+                             "weight hot-swap: each newly published step "
+                             "rolls in drain-free (in-flight streams "
+                             "finish under their pinned generation)")
     args = parser.parse_args(argv)
 
     kv_client = None
@@ -759,7 +869,8 @@ def main(argv=None) -> int:
         host=args.host, port=args.port,
         drain_file=os.path.abspath(args.drain_file),
         obs_enabled=not args.no_obs, kv_client=kv_client,
-        tp=args.tp, ep=args.ep)
+        tp=args.tp, ep=args.ep,
+        ckpt_dir=args.ckpt_dir or None)
     replica.start()
 
     # Durable observability export: spans/metrics land under obs/ in the
@@ -803,10 +914,20 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGTERM, on_sigterm)
     signal.signal(signal.SIGINT, on_sigterm)
 
-    with open(args.endpoint_file + ".tmp", "w") as handle:
-        json.dump({"url": replica.url, "boot_id": replica.boot_id,
-                   "preset": args.preset, "pid": os.getpid()}, handle)
-    os.replace(args.endpoint_file + ".tmp", args.endpoint_file)
+    def write_endpoint() -> int:
+        # The announce record carries the ACTIVE weight generation so
+        # `sched status` (which reads endpoint files, not live replicas)
+        # shows a mid-roll fleet; the beat loop rewrites it when a
+        # published checkpoint rolls in.
+        generation = replica.engine.generation
+        with open(args.endpoint_file + ".tmp", "w") as handle:
+            json.dump({"url": replica.url, "boot_id": replica.boot_id,
+                       "preset": args.preset, "pid": os.getpid(),
+                       "generation": generation}, handle)
+        os.replace(args.endpoint_file + ".tmp", args.endpoint_file)
+        return generation
+
+    announced_gen = write_endpoint()
     print(f"replica serving on {replica.url} (boot {replica.boot_id})",
           flush=True)
 
@@ -822,6 +943,8 @@ def main(argv=None) -> int:
             replica.begin_drain()
             break
         beats += 1
+        if replica.engine.generation != announced_gen:
+            announced_gen = write_endpoint()
         if beats % 10 == 0:               # ~every 2 s
             flush_obs()
     # Brief linger so the router can fetch the draining suffix/export

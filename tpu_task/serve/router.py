@@ -117,6 +117,10 @@ class _Replica:
     #: "prefill" (a dedicated prompt-ingestion replica: it only ever sees
     #: the 1-token prefill leg of long-prompt requests).
     role: str = "decode"
+    #: active weight generation the replica last announced (endpoint.json
+    #: / healthz). None = replica predates generation reporting. A change
+    #: here is a live weight roll, NOT a reboot — streams keep flowing.
+    generation: Optional[int] = None
     healthy: bool = True
     load: int = 0               # open fleet requests assigned here
     faults: int = 0
@@ -166,6 +170,12 @@ class FleetRequest:
     deadline: Optional[float] = None
     retry_after_s: Optional[float] = None
     clamped_max_new: Optional[int] = None
+    #: multi-tenant serving: which registered LoRA adapter the stream
+    #: decodes under (None = base model). Rides every dispatch and
+    #: re-dispatch payload, and extends the affinity key — same prompt
+    #: under different adapters must not collide on one warm replica's
+    #: prefix cache, which adapter streams bypass anyway.
+    adapter_id: Optional[str] = None
     #: the request's trace (minted at submit — the root span's context);
     #: every dispatch span and every replica-side span links under it.
     trace: Optional[TraceContext] = None
@@ -304,6 +314,8 @@ class Router:
             known = self._replicas.get(name)
             boot = info.get("boot_id", "")
             role = info.get("role", "decode")
+            gen = info.get("generation")
+            gen = None if gen is None else int(gen)
             if known is None or known.url != info["url"] \
                     or known.boot_id != boot or known.role != role:
                 if known is not None:
@@ -313,9 +325,16 @@ class Router:
                     # cache).
                     self._drop_replica(name)
                 self._replicas[name] = _Replica(
-                    name=name, url=info["url"], boot_id=boot, role=role)
-            elif not known.healthy and now >= known.quarantined_until:
-                known.healthy = True
+                    name=name, url=info["url"], boot_id=boot, role=role,
+                    generation=gen)
+            else:
+                if not known.healthy and now >= known.quarantined_until:
+                    known.healthy = True
+                # A generation bump under the same boot id is a drain-free
+                # weight hot-swap: record it without touching load, health,
+                # or the served-prefix memory.
+                if gen is not None:
+                    known.generation = gen
 
     def _drop_replica(self, name: str) -> None:
         self._replicas.pop(name, None)
@@ -328,8 +347,33 @@ class Router:
 
     def replicas(self) -> Dict[str, dict]:
         return {name: {"url": r.url, "boot_id": r.boot_id, "role": r.role,
-                       "healthy": r.healthy, "load": r.load}
+                       "healthy": r.healthy, "load": r.load,
+                       "generation": r.generation}
                 for name, r in sorted(self._replicas.items())}
+
+    def register_adapter(self, adapter_id: str, layers,
+                         scale: float = 1.0) -> Dict[str, str]:
+        """Broadcast a tenant's LoRA adapter to every healthy replica
+        (``POST /adapter``) so any affinity or failover target can serve
+        it. Returns {replica name: content hash}; raises if the replicas
+        disagree on the hash (same id MUST mean same bytes fleet-wide)
+        or if no healthy replica accepted it."""
+        payload = {"adapter_id": str(adapter_id), "layers": layers,
+                   "scale": float(scale)}
+        hashes: Dict[str, str] = {}
+        for name, replica in sorted(self._replicas.items()):
+            if not replica.healthy:
+                continue
+            body = self._call(replica, "POST", "/adapter", data=payload)
+            hashes[name] = body.get("hash", "")
+        if not hashes:
+            raise NoReplicaAvailable(
+                "no healthy replica accepted the adapter")
+        if len(set(hashes.values())) > 1:
+            raise RuntimeError(
+                f"adapter {adapter_id!r} hashed differently across "
+                f"replicas: {hashes} — one id must mean one set of bytes")
+        return hashes
 
     # -- dispatch policy -------------------------------------------------------
     @property
@@ -355,23 +399,31 @@ class Router:
             out.append(h)
         return out
 
-    def _affinity_key(self, prompt: List[int]) -> bytes:
+    def _affinity_key(self, prompt: List[int],
+                      adapter_id: Optional[str] = None) -> bytes:
         """The affinity key, BLOCK-ALIGNED on the prefix cache's chain
         hashes: the chain hash of the longest full-block prefix inside
         the first ``affinity_tokens`` ids. Prompts that share every full
         block of the window agree even when they diverge inside the
         trailing partial block — affinity granularity IS cache
         granularity. Prompts shorter than one block fall back to their
-        raw ids (nothing block-shaped to share yet)."""
+        raw ids (nothing block-shaped to share yet). The tenant's
+        adapter id extends the key: adapter streams of one tenant herd
+        onto the same replica (their adapter stays resident there — the
+        adapter analogue of a warm prefix), without colliding with the
+        base-model traffic for the same prompt."""
         window = prompt[:self.affinity_tokens]
         chain = self._chain_hashes(window)
-        if chain:
-            return chain[-1]
-        return ",".join(str(t) for t in window).encode()
+        key = chain[-1] if chain \
+            else ",".join(str(t) for t in window).encode()
+        if adapter_id is not None:
+            key += b"|adapter:" + str(adapter_id).encode()
+        return key
 
-    def _affinity_hash(self, prompt: List[int]) -> int:
+    def _affinity_hash(self, prompt: List[int],
+                       adapter_id: Optional[str] = None) -> int:
         return int.from_bytes(
-            hashlib.blake2b(self._affinity_key(prompt),
+            hashlib.blake2b(self._affinity_key(prompt, adapter_id),
                             digest_size=8).digest(), "big")
 
     @staticmethod
@@ -399,7 +451,8 @@ class Router:
 
     def pick(self, prompt: List[int], exclude: Optional[set] = None,
              role: Optional[str] = None,
-             hashes: Optional[List[bytes]] = None) -> _Replica:
+             hashes: Optional[List[bytes]] = None,
+             adapter_id: Optional[str] = None) -> _Replica:
         """Affinity-preferred, cached-depth-aware, load-spilled replica
         choice. ``role="prefill"`` picks from the dedicated prefill pool;
         the default picks from the decode pool (every non-prefill
@@ -420,7 +473,8 @@ class Router:
         if hashes is None:       # _dispatch precomputes; direct calls don't
             hashes = self._chain_hashes(prompt)
         depth = {r.name: self._cached_depth(r, hashes) for r in pool}
-        preferred = pool[self._affinity_hash(prompt) % len(pool)]
+        preferred = pool[self._affinity_hash(prompt, adapter_id)
+                         % len(pool)]
         deepest = max(pool, key=lambda r: (depth[r.name],
                                            r is preferred, r.name))
         if depth[deepest.name] > depth[preferred.name]:
@@ -446,7 +500,8 @@ class Router:
                temperature: float = 0.0, top_p: Optional[float] = None,
                eos_token: Optional[int] = None,
                slo_class: str = DEFAULT_CLASS,
-               deadline_ms: Optional[float] = None) -> int:
+               deadline_ms: Optional[float] = None,
+               adapter_id: Optional[str] = None) -> int:
         """Queue a fleet request; returns its fleet id. Dispatch happens
         here when a replica is available, else on the next :meth:`pump`.
         ``deadline_ms`` is the e2e budget from NOW (converted to an
@@ -462,7 +517,8 @@ class Router:
             eos_token=eos_token, key=self._derive_key(fid),
             submit_t=now, slo_class=str(slo_class),
             deadline=None if deadline_ms is None
-            else now + float(deadline_ms) / 1000.0)
+            else now + float(deadline_ms) / 1000.0,
+            adapter_id=None if adapter_id is None else str(adapter_id))
         # The trace is minted HERE, once per fleet request: everything
         # downstream (dispatches, replica engines, re-dispatches after a
         # preemption) links under this root via the propagated header.
@@ -627,7 +683,8 @@ class Router:
         try:
             replica = self.pick(request.prompt, exclude=exclude,
                                 role="prefill" if prefill_leg else None,
-                                hashes=hashes)
+                                hashes=hashes,
+                                adapter_id=request.adapter_id)
         except NoReplicaAvailable:
             if not prefill_leg:
                 raise
@@ -635,7 +692,8 @@ class Router:
             # dispatch rather than queueing the request to death.
             prefill_leg = False
             replica = self.pick(request.prompt, exclude=exclude,
-                                hashes=hashes)
+                                hashes=hashes,
+                                adapter_id=request.adapter_id)
         # The shed gate: fast-fail work the chosen replica's observed
         # service estimates say cannot meet its deadline — a queued
         # death foretold is refused now, while the client can still
@@ -661,6 +719,8 @@ class Router:
             "eos_token": request.eos_token,
             "key": request.key,
         }
+        if request.adapter_id is not None:
+            payload["adapter_id"] = request.adapter_id
         if request.tokens:
             # Re-dispatch: the received prefix is re-ingested as context
             # by the sibling; the continuation is token-identical.
